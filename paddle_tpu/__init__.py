@@ -1,0 +1,76 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle-parity
+capabilities.
+
+Architecture (see SURVEY.md §7): imperative "DyGraph-like" execution with an
+eager autograd tape over jax.vjp, a captured/compiled "static-graph-like" mode
+via trace-to-XLA (paddle_tpu.jit), one op library serving both, and a
+Fleet-parity distributed stack expressed as SPMD over named device meshes
+(pjit/shard_map) with XLA collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    Tensor,
+    TPUPlace,
+    XPUPlace,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .framework import dtypes as _dtypes
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+
+# dtype aliases (paddle.float32 etc.)
+bool = _dtypes.bool_  # noqa: A001 — paddle exposes `paddle.bool`
+uint8 = _dtypes.uint8
+int8 = _dtypes.int8
+int16 = _dtypes.int16
+int32 = _dtypes.int32
+int64 = _dtypes.int64
+float16 = _dtypes.float16
+bfloat16 = _dtypes.bfloat16
+float32 = _dtypes.float32
+float64 = _dtypes.float64
+complex64 = _dtypes.complex64
+complex128 = _dtypes.complex128
+
+from . import tensor  # noqa: E402  (patches Tensor methods)
+from .tensor import *  # noqa: F401,F403,E402
+from .tensor import einsum  # noqa: F401,E402
+from .tensor import linalg  # noqa: F401,E402  (paddle.linalg namespace)
+
+from . import amp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+
+from .device import get_device, is_compiled_with_cuda, is_compiled_with_tpu, set_device  # noqa: E402,F401
+from .framework.io_state import load, save  # noqa: E402,F401
+from .hapi_model import Model  # noqa: E402,F401
+
+is_tensor = tensor.is_tensor  # noqa: F811
+
+
+def is_grad_enabled_():  # paddle parity helper
+    from .framework.core import is_grad_enabled as _ig
+
+    return _ig()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False, allow_unused=False):
+    from .autograd import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph, allow_unused)

@@ -1,0 +1,182 @@
+"""Automatic mixed precision (paddle.amp parity).
+
+Reference: ``python/paddle/amp/`` — auto_cast O1/O2 with white/black op lists
+and GradScaler dynamic loss scaling (SURVEY.md §2.2, §5).
+
+TPU-native design: bfloat16 is the default amp dtype — it shares float32's
+exponent range, so **loss scaling is unnecessary** (GradScaler degrades to a
+pass-through that still tracks found_inf for API parity; with float16 it runs
+real dynamic scaling). The cast hooks live in framework.op's dispatch gateway,
+exactly where the reference's generated AMP hooks sit (§3.1 step 3).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dtypes
+from ..framework.core import Tensor, no_grad
+from ..framework.op import AMP_BLACK, AMP_WHITE, amp_state, raw
+
+__all__ = ["auto_cast", "autocast", "amp_guard", "decorate", "GradScaler"]
+
+
+@contextlib.contextmanager
+def auto_cast(
+    enable=True,
+    custom_white_list=None,
+    custom_black_list=None,
+    level="O1",
+    dtype="bfloat16",
+    use_promote=True,
+):
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError("level must be O0/O1/O2")
+    prev = (amp_state.enable, amp_state.dtype, amp_state.level)
+    added_w, added_b = set(), set()
+    if custom_white_list:
+        for op in custom_white_list:
+            if op not in AMP_WHITE:
+                AMP_WHITE.add(op)
+                added_w.add(op)
+    if custom_black_list:
+        for op in custom_black_list:
+            if op not in AMP_BLACK:
+                AMP_BLACK.add(op)
+                added_b.add(op)
+    amp_state.enable = bool(enable) and level != "O0"
+    amp_state.dtype = _dtypes.convert_dtype(dtype)
+    amp_state.level = level
+    try:
+        yield
+    finally:
+        amp_state.enable, amp_state.dtype, amp_state.level = prev
+        AMP_WHITE.difference_update(added_w)
+        AMP_BLACK.difference_update(added_b)
+
+
+autocast = auto_cast
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype, keep fp32 master
+    weights in the optimizer (reference: paddle.amp.decorate)."""
+    dt = _dtypes.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if _dtypes.is_floating_point(p.dtype) and p.dtype == _dtypes.float32:
+                    p._rebind(p._value.astype(dt))
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) else list(optimizers)
+            for o in opt_list:
+                o._use_master_weights = True if master_weight is None else bool(master_weight)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaler (paddle.amp.GradScaler parity).
+
+    With bfloat16 (TPU default) scaling is an identity; with float16 it
+    implements the reference's dynamic scheme: scale *= 2 every
+    ``incr_every_n_steps`` good steps, scale /= 2 on inf/nan, skip that step.
+    """
+
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=2.0**15,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=1000,
+        decr_every_n_nan_or_inf=1,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad._rebind(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

@@ -1,0 +1,168 @@
+"""paddle.autograd parity: backward, grad, PyLayer, hooks.
+
+Reference: ``python/paddle/autograd/`` over the eager engine
+(``paddle/fluid/eager/backward.cc``) — SURVEY.md §2.2, §3.2. Here both ride
+the jax.vjp tape in framework.core.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from ..framework.core import (
+    Tensor,
+    TapeNode,
+    no_grad as _no_grad_ctx,
+    run_backward,
+    is_grad_enabled,
+)
+from ..framework.op import raw
+
+no_grad = _no_grad_ctx
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """Functional gradient (paddle.grad parity). ``create_graph`` (double
+    backward) is served by the functional path: use paddle_tpu.incubate
+    ``vjp``/``jvp`` or jax transforms for higher-order derivatives."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager autograd) is not supported; "
+            "use paddle_tpu.incubate.autograd.vjp/jvp (functional) instead."
+        )
+    single_out = isinstance(outputs, Tensor)
+    outputs = [outputs] if single_out else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    # stash current .grad, run backward with retain markers, then restore
+    saved = [(t._grad, t._retain_grads) for t in inputs]
+    for t in inputs:
+        t._grad = None
+        t._retain_grads = True
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph))
+        grads = []
+        for t in inputs:
+            if t._grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused in the "
+                        "graph; set allow_unused=True to return None for it."
+                    )
+                grads.append(None)
+            else:
+                grads.append(t._grad)
+    finally:
+        for t, (g, r) in zip(inputs, saved):
+            t._grad = g
+            t._retain_grads = r
+    return grads[0] if single_in else grads
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (paddle.autograd.PyLayer parity).
+
+    Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        with _no_grad_ctx():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(outs, Tensor)
+        out_list = [outs] if single else list(outs)
+        if need_grad:
+            diff_inputs = [
+                t
+                for t in tensor_inputs
+                if jnp.issubdtype(t.dtype, jnp.floating) or jnp.issubdtype(t.dtype, jnp.complexfloating)
+            ]
+
+            def vjp_fn(cts):
+                ct_list = cts if isinstance(cts, (list, tuple)) else [cts]
+                ct_tensors = [Tensor(c) for c in ct_list]
+                with _no_grad_ctx():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gin = [gin] if isinstance(gin, Tensor) or gin is None else list(gin)
+                vals = []
+                gi = iter(gin)
+                for t in diff_inputs:
+                    g = next(gi, None)
+                    vals.append(
+                        jnp.zeros_like(t._value) if g is None else raw(g)
+                    )
+                return tuple(vals)
+
+            import jax
+
+            out_vals = [o._value for o in out_list]
+            metas = [(tuple(v.shape), v.dtype) for v in out_vals]
+            treedef = jax.tree_util.tree_structure(out_vals)
+            node = TapeNode(cls.__name__, vjp_fn, tuple(diff_inputs), metas, treedef)
+            uids = []
+            for o in out_list:
+                o._node = node
+                o.stop_gradient = False
+                uids.append(o._uid)
+            node.out_uids = tuple(uids)
+        return outs
+
+
+def set_grad_enabled(mode):
+    from ..framework.core import set_grad_enabled as s
+
+    return s(mode)
+
+
+def is_grad_enabled_fn():
+    return is_grad_enabled()

@@ -1,0 +1,38 @@
+"""Framework core: Tensor, autograd tape, dtypes, flags, rng, op registry."""
+from . import dtypes, flags, rng
+from .core import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    Tensor,
+    TPUPlace,
+    XPUPlace,
+    enable_grad,
+    is_grad_enabled,
+    is_tensor,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from .op import OP_REGISTRY, defop, raw
+
+__all__ = [
+    "Tensor",
+    "Place",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "XPUPlace",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "is_tensor",
+    "run_backward",
+    "defop",
+    "raw",
+    "OP_REGISTRY",
+    "dtypes",
+    "flags",
+    "rng",
+]

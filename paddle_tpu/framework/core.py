@@ -1,0 +1,497 @@
+"""Tensor and the eager autograd tape.
+
+Reference capability reproduced here (SURVEY.md §1 L3, §3.1/§3.2):
+  * ``paddle.Tensor`` — imperative tensor with ``stop_gradient`` semantics
+    (upstream: ``paddle/fluid/eager/`` EagerVariable + pybind eager tensor).
+  * DyGraph autograd — grad-node graph recorded during forward, walked by
+    ``Tensor.backward()`` (upstream: ``paddle/fluid/eager/backward.cc``,
+    generated ``*GradNode``s).
+
+TPU-native design: instead of hand-written per-op C++ GradNodes, forward ops
+run under ``jax.vjp`` — XLA traces the forward once and hands back a pullback
+closure; the "grad node" IS that closure. Residuals live on device as jax
+arrays. Inside a functional transform (``paddle_tpu.jit``/``jax.grad``) the
+tape stays silent (values are tracers) and differentiation is handled by JAX —
+one op library, two execution modes, mirroring the reference's
+"single PHI kernel library, two frontends" shape.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as _dtypes
+
+try:  # Tracer detection — used to auto-disable the tape under jax transforms
+    _Tracer = jax.core.Tracer
+except AttributeError:  # pragma: no cover - older/newer jax layouts
+    from jax._src.core import Tracer as _Tracer
+
+_float0 = jax.dtypes.float0
+
+# --------------------------------------------------------------------------
+# Grad-mode state
+# --------------------------------------------------------------------------
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool):
+    _state.grad_enabled = v
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad parity: context manager + decorator."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    prev = is_grad_enabled()
+    _set_grad_enabled(bool(mode))
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+# --------------------------------------------------------------------------
+# Places (device handles) — API parity with paddle.CPUPlace/CUDAPlace/...
+# The reference dispatches kernels by Place; here a Place is a jax.Device tag.
+# --------------------------------------------------------------------------
+class Place:
+    def __init__(self, kind: str, device_id: int = 0):
+        self.kind = kind
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+
+def CPUPlace():
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id: int = 0):
+    return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id: int = 0):  # accepted for script compatibility
+    return Place("tpu", device_id)
+
+
+def XPUPlace(device_id: int = 0):
+    return Place("tpu", device_id)
+
+
+# --------------------------------------------------------------------------
+# Tape
+# --------------------------------------------------------------------------
+class TapeNode:
+    """One recorded op: pullback closure + graph edges.
+
+    ``inputs`` are the differentiable input Tensors (strong refs keep the
+    upstream graph alive until backward releases it); ``out_metas`` lets
+    backward synthesize zero cotangents for unused outputs.
+    """
+
+    __slots__ = ("op_name", "vjp_fn", "inputs", "out_metas", "out_treedef", "out_uids")
+
+    def __init__(self, op_name, vjp_fn, inputs, out_metas, out_treedef):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_metas = out_metas  # list of (shape, dtype)
+        self.out_treedef = out_treedef
+        self.out_uids = ()  # filled in by defop once output Tensors exist
+
+
+def _zero_cotangent(meta):
+    shape, dtype = meta
+    if _dtypes.is_floating_point(dtype) or _dtypes.is_complex(dtype):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, _float0)
+
+
+def run_backward(
+    tensors: Sequence["Tensor"],
+    grad_tensors: Optional[Sequence[Optional["Tensor"]]] = None,
+    retain_graph: bool = False,
+):
+    """Reverse-walk the tape from ``tensors`` (paddle.autograd.backward parity).
+
+    Reference analogue: ``egr::Backward`` ready-queue over GradNodes
+    (SURVEY.md §3.2). Here: topological sort over TapeNodes, cotangent
+    accumulation per tensor, one ``vjp_fn`` call per node.
+    """
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors must match tensors in length")
+
+    # cotangent accumulator keyed by tensor uid; uid->tensor map keeps refs
+    cts = {}
+    id2t = {}
+
+    def _acc(t: "Tensor", ct):
+        if isinstance(ct, np.ndarray) and ct.dtype == _float0:
+            return
+        k = t._uid
+        id2t[k] = t
+        if k in cts:
+            cts[k] = cts[k] + ct
+        else:
+            cts[k] = ct
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "Trying to backward through a tensor with stop_gradient=True"
+            )
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward roots"
+                )
+            g_val = jnp.ones_like(t._value)
+        else:
+            g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        _acc(t, g_val)
+        if t._node is not None:
+            roots.append(t._node)
+
+    # Topological order over nodes (iterative postorder DFS).
+    topo: List[TapeNode] = []
+    seen = set()
+    stack = [(n, False) for n in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            topo.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for it in node.inputs:
+            if it._node is not None and id(it._node) not in seen:
+                stack.append((it._node, False))
+
+    from . import flags as _flags
+
+    retain_all = _flags.get_flags("FLAGS_retain_grad_for_all_tensor")
+
+    for node in reversed(topo):
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to run backward a second time through a released graph; "
+                "pass retain_graph=True to backward()."
+            )
+        # collect output cotangents; skip node entirely if none of its outputs
+        # received a cotangent (dead branch)
+        out_cts = []
+        any_ct = False
+        for meta, out_id in zip(node.out_metas, node.out_uids):
+            ct = cts.pop(out_id, None)
+            if ct is None:
+                out_cts.append(_zero_cotangent(meta))
+            else:
+                any_ct = True
+                out_cts.append(ct)
+                id2t.pop(out_id, None)
+        if not any_ct:
+            continue
+        ct_tree = jax.tree_util.tree_unflatten(node.out_treedef, out_cts)
+        in_cts = node.vjp_fn(ct_tree)
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, g in zip(node.inputs, in_cts):
+            if t.stop_gradient:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == _float0:
+                continue
+            if t._node is None or t._retain_grads or retain_all:
+                t._accumulate_grad(g)
+            if t._node is not None:
+                _acc(t, g)
+
+    # leaves among the roots themselves (e.g. x.backward() where x is a leaf)
+    for k, ct in list(cts.items()):
+        t = id2t.get(k)
+        if t is not None and t._node is None and not t.stop_gradient:
+            t._accumulate_grad(ct)
+
+
+# --------------------------------------------------------------------------
+# Tensor
+# --------------------------------------------------------------------------
+import itertools as _itertools
+
+_uid_counter = _itertools.count()
+
+
+class Tensor:
+    """Imperative tensor backed by a jax.Array (or a JAX tracer under jit).
+
+    paddle.Tensor parity surface; most math methods are patched on by
+    ``paddle_tpu.tensor`` after the op library is defined (mirroring the
+    reference, where Python monkey-patches methods onto the pybind tensor —
+    ``python/paddle/base/dygraph/tensor_patch_methods.py``).
+    """
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "name",
+        "persistable",
+        "trainable",
+        "_node",
+        "_uid",
+        "_retain_grads",
+        "_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jnp.ndarray, jax.Array)) and not isinstance(
+            value, _Tracer
+        ):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._node = None
+        self._uid = next(_uid_counter)
+        self._retain_grads = False
+        self._hooks = None
+
+    # -- basic properties ------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = list(self._value.devices())[0]
+            kind = "cpu" if dev.platform == "cpu" else "tpu"
+            return Place(kind, dev.id)
+        except Exception:
+            return Place("tpu", 0)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    def _accumulate_grad(self, g):
+        if self._hooks:
+            for h in self._hooks:
+                out = h(Tensor(g))
+                if out is not None:
+                    g = out._value if isinstance(out, Tensor) else out
+        if self._grad is None:
+            self._grad = Tensor(jnp.asarray(g))
+        else:
+            self._grad = Tensor(self._grad._value + g)
+
+    def register_hook(self, hook):
+        """Hook on the gradient flowing into this tensor (paddle parity)."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Removable(self._hooks, hook)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    # -- autograd --------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph=False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    # -- materialization -------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        if isinstance(self._value, _Tracer):
+            raise RuntimeError(
+                "Tensor.numpy() is not allowed inside a captured (jit) program; "
+                "this is a host sync point. Move it outside paddle_tpu.jit."
+            )
+        return np.asarray(self._value)
+
+    def item(self, *idx):
+        a = self.numpy()
+        return a.item(*idx) if idx else a.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def _is_initialized(self):
+        return True
+
+    # -- value rebinding (in-place family) -------------------------------
+    def _rebind(self, value, node=None):
+        self._value = value
+        if node is not None:
+            self._node = node
+        return self
+
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {v.shape} vs {self._value.shape}"
+            )
+        self._value = v.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    # -- misc dunders (math dunders patched by paddle_tpu.tensor) --------
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a multi-element Tensor is ambiguous"
+            )
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if isinstance(self._value, _Tracer):
+            return f"Tensor(shape={self.shape}, dtype={_dtypes.dtype_name(self.dtype)}, traced, stop_gradient={sg})"
+        return (
+            f"Tensor(shape={self.shape}, dtype={_dtypes.dtype_name(self.dtype)}, "
+            f"stop_gradient={sg},\n       {np.asarray(self._value)})"
+        )
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def is_tracer_value(v) -> bool:
+    return isinstance(v, _Tracer)
+
+
+# Pytree registration: a Tensor flattens to its value; metadata rides along.
+def _t_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _t_unflatten(aux, children):
+    t = Tensor(children[0], stop_gradient=aux[0], name=aux[1])
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _t_flatten, _t_unflatten)

@@ -1,0 +1,87 @@
+"""Dtype system for paddle_tpu.
+
+Reference capability: PaddlePaddle's ``phi::DataType`` / ``paddle.dtype``
+(upstream ``paddle/phi/common/data_type.h``; see SURVEY.md §2.1 "PHI core").
+TPU-native design: dtypes ARE jax/numpy dtypes; we expose paddle-style names
+and conversion helpers. bfloat16 is the first-class reduced precision type on
+TPU (MXU-native), float16 is supported but discouraged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances; bfloat16 via ml_dtypes which
+# jax re-exports as jnp.bfloat16).
+bool_ = jnp.dtype("bool")
+uint8 = jnp.dtype("uint8")
+int8 = jnp.dtype("int8")
+int16 = jnp.dtype("int16")
+int32 = jnp.dtype("int32")
+int64 = jnp.dtype("int64")
+float16 = jnp.dtype("float16")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype("float32")
+float64 = jnp.dtype("float64")
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "fp16": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOAT_DTYPES = (float16, bfloat16, float32, float64)
+INT_DTYPES = (uint8, int8, int16, int32, int64)
+
+
+def convert_dtype(dtype):
+    """Normalize a user-facing dtype spec (str | np.dtype | jnp type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unsupported dtype string: {dtype!r}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    d = jnp.dtype(dtype)
+    return jnp.issubdtype(d, np.floating)  # covers bfloat16 via ml_dtypes
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)
+
+
+def default_float_dtype():
+    from . import flags
+
+    return convert_dtype(flags.get_flags("FLAGS_default_float_dtype"))

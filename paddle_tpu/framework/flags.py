@@ -1,0 +1,47 @@
+"""Runtime flag system.
+
+Reference capability: PaddlePaddle's gflags-style runtime flags
+(``paddle/phi/core/flags.cc``; ``paddle.set_flags``/``paddle.get_flags`` —
+SURVEY.md §5 "Config/flag system"). TPU-native design: a plain in-process
+registry; XLA knobs pass through to the XLA_FLAGS env var.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+_FLAG_DEFAULTS: Dict[str, Any] = {
+    # numeric / execution behavior
+    "FLAGS_default_float_dtype": "float32",
+    "FLAGS_cudnn_deterministic": False,  # accepted for API parity; XLA is deterministic
+    "FLAGS_deterministic": True,
+    # eager engine
+    "FLAGS_retain_grad_for_all_tensor": False,
+    # memory (informational on TPU; PJRT owns the allocator)
+    "FLAGS_allocator_strategy": "pjrt",
+    "FLAGS_fraction_of_gpu_memory_to_use": 1.0,
+    # logging
+    "FLAGS_log_level": int(os.environ.get("PADDLE_TPU_LOG_LEVEL", "0")),
+    # jit / tracing
+    "FLAGS_jit_cache_size": 128,
+    "FLAGS_use_donated_buffers": True,
+    # amp
+    "FLAGS_amp_dtype": "bfloat16",
+    # benchmarking
+    "FLAGS_benchmark": False,
+}
+
+_flags: Dict[str, Any] = dict(_FLAG_DEFAULTS)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        if k not in _FLAG_DEFAULTS:
+            raise ValueError(f"Unknown flag {k!r}. Known flags: {sorted(_FLAG_DEFAULTS)}")
+        _flags[k] = v
+
+
+def get_flags(flags: Union[str, Iterable[str]]):
+    if isinstance(flags, str):
+        return _flags[flags]
+    return {k: _flags[k] for k in flags}

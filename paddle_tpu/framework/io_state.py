@@ -1,0 +1,54 @@
+"""paddle.save / paddle.load parity.
+
+Reference: ``python/paddle/framework/io.py`` — pickled state dicts of
+numpy-converted tensors (SURVEY.md §5 "Checkpoint/resume"). The distributed,
+sharded, re-shardable checkpoint path (Orbax-style) lives in
+``paddle_tpu.distributed.checkpoint``; this is the single-host format.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .core import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "value": np.asarray(obj._value), "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["value"]
+            t = Tensor(obj["value"], stop_gradient=obj.get("stop_gradient", True), name=obj.get("name"))
+            return t
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj, return_numpy=return_numpy)

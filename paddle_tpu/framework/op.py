@@ -1,0 +1,176 @@
+"""Op definition decorator — the single dispatch gateway for all tensor ops.
+
+Reference capability: PaddlePaddle's YAML op registry + codegen
+(``paddle/phi/api/yaml/ops.yaml`` → generated C++ API + eager autograd nodes;
+SURVEY.md §2.1 "PHI API + codegen"). The reference generates, per op: a Python
+binding, an AMP cast hook, a GradNode recorder, and a kernel dispatch.
+
+TPU-native design: one Python decorator provides all four — the "kernel" is a
+pure jax function (XLA does the per-backend lowering the reference hand-writes
+per device), the GradNode is a ``jax.vjp`` pullback, AMP casting consults the
+active ``paddle_tpu.amp.auto_cast`` policy, and under a JAX trace the wrapper
+degrades to a plain function call so one op library serves both the eager and
+the captured/compiled execution modes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as _dtypes
+from .core import Tensor, TapeNode, is_grad_enabled, is_tracer_value
+
+OP_REGISTRY: Dict[str, Callable] = {}
+
+# AMP op lists (mirrors the reference's white/black lists in
+# ``python/paddle/amp/amp_lists.py``): "white" ops run in the low-precision
+# dtype (MXU-bound: matmul/conv), "black" ops are kept in float32 for
+# numerical safety.
+AMP_WHITE = set()
+AMP_BLACK = set()
+
+# Active amp state is owned by paddle_tpu.amp; it mutates this holder to avoid
+# an import cycle. Fields: enable(bool), dtype(jnp dtype), level('O1'|'O2').
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level")
+
+    def __init__(self):
+        self.enable = False
+        self.dtype = _dtypes.bfloat16
+        self.level = "O1"
+
+
+amp_state = _AmpState()
+
+
+def _amp_cast(opname, vals):
+    if not amp_state.enable:
+        return vals
+    in_white = opname in AMP_WHITE
+    in_black = opname in AMP_BLACK
+    if amp_state.level == "O2":
+        target = _dtypes.float32 if in_black else amp_state.dtype
+    else:
+        if in_white:
+            target = amp_state.dtype
+        elif in_black:
+            target = _dtypes.float32
+        else:
+            return vals
+    out = []
+    for v in vals:
+        if v is not None and _dtypes.is_floating_point(v.dtype) and v.dtype != target:
+            v = v.astype(target)
+        out.append(v)
+    return out
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def defop(fn=None, *, name: Optional[str] = None, amp: Optional[str] = None):
+    """Register ``fn`` (a pure jax function) as a framework op.
+
+    The wrapper accepts Tensors (or anything jnp accepts) wherever ``fn``
+    expects arrays, including inside lists/tuples/dicts, and returns Tensors
+    in the same structure ``fn`` returns arrays.
+    """
+
+    def deco(f):
+        opname = name or f.__name__
+        if amp == "white":
+            AMP_WHITE.add(opname)
+        elif amp == "black":
+            AMP_BLACK.add(opname)
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=_is_tensor_leaf
+            )
+            t_idx = []  # differentiable (float/complex) tensor leaf positions
+            t_vals = []
+            diff_tensors = []
+            any_tracer = False
+            need_grad = False
+            grad_on = is_grad_enabled()
+            vals = list(leaves)
+            for i, leaf in enumerate(leaves):
+                if isinstance(leaf, Tensor):
+                    v = leaf._value
+                    vals[i] = v
+                    if is_tracer_value(v):
+                        any_tracer = True
+                    if _dtypes.is_floating_point(v.dtype) or _dtypes.is_complex(
+                        v.dtype
+                    ):
+                        t_idx.append(i)
+                        t_vals.append(v)
+                        diff_tensors.append(leaf)
+                        if grad_on and not leaf.stop_gradient:
+                            need_grad = True
+
+            if t_vals:
+                cast = _amp_cast(opname, t_vals)
+                if cast is not t_vals:
+                    for i, v in zip(t_idx, cast):
+                        vals[i] = v
+                    t_vals = cast
+
+            record = need_grad and not any_tracer
+
+            if not record:
+                a, k = jax.tree_util.tree_unflatten(treedef, vals)
+                out = f(*a, **k)
+                return _wrap_outputs(out, node=None, any_tracer=any_tracer)
+
+            const_vals = list(vals)
+
+            def pure(*tv):
+                vs = list(const_vals)
+                for i, v in zip(t_idx, tv):
+                    vs[i] = v
+                a, k = jax.tree_util.tree_unflatten(treedef, vs)
+                return f(*a, **k)
+
+            out, vjp_fn = jax.vjp(pure, *t_vals)
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+            metas = [(tuple(o.shape), o.dtype) for o in out_leaves]
+            node = TapeNode(opname, vjp_fn, tuple(diff_tensors), metas, out_treedef)
+            return _wrap_outputs(out, node=node, any_tracer=False)
+
+        wrapper.op_name = opname
+        wrapper.raw_fn = f
+        OP_REGISTRY[opname] = wrapper
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def _wrap_outputs(out, node, any_tracer):
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    uids = []
+    for o in out_leaves:
+        t = Tensor(o, stop_gradient=(node is None))
+        if node is not None:
+            if not (_dtypes.is_floating_point(o.dtype) or _dtypes.is_complex(o.dtype)):
+                t.stop_gradient = True
+            t._node = node
+        wrapped.append(t)
+        uids.append(t._uid)
+    if node is not None:
+        node.out_uids = tuple(uids)
+    res = jax.tree_util.tree_unflatten(out_treedef, wrapped)
+    return res
+
+
+def raw(x):
+    """Unwrap a Tensor (or pass through arrays/scalars) to a jax value."""
+    return x._value if isinstance(x, Tensor) else x

@@ -1,0 +1,105 @@
+"""Random number state management.
+
+Reference capability: Paddle's global/generator seeds (``paddle.seed``) and
+Fleet's ``RNGStatesTracker`` for tensor-parallel dropout
+(``python/paddle/distributed/fleet/layers/mpu/random.py`` — SURVEY.md §2.3 "TP").
+
+TPU-native design: JAX's splittable counter-based PRNG. A global ``Generator``
+holds a base key + a monotonically increasing offset; each random op folds the
+offset in. Inside a captured/compiled program (``paddle_tpu.jit``), the step
+machinery seeds a *trace-scoped* key so every compiled call sees fresh
+randomness via an explicit key argument (stateful RNG inside an XLA program
+would bake constants into the executable). Named-axis generators mirror the
+reference's RNGStatesTracker: the "local" generator additionally folds in the
+process/mesh coordinate so tensor-parallel dropout masks are decorrelated.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._offset = 0
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._offset = 0
+        return self
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        self._seed, self._offset = state
+        self._key = jax.random.key(self._seed)
+
+    def next_key(self):
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        return jax.random.fold_in(self._key, off)
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+# Trace-scoped key: when paddle_tpu.jit traces a function, it installs a key
+# here (a tracer); random ops consume splits of it instead of the global state.
+_trace_state = threading.local()
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """Set the global random seed (paddle.seed parity)."""
+    return _default_generator.manual_seed(int(value))
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    """Install a trace-scoped RNG key (used by the jit machinery)."""
+    prev = getattr(_trace_state, "key", None)
+    prev_n = getattr(_trace_state, "n", 0)
+    _trace_state.key = key
+    _trace_state.n = 0
+    try:
+        yield
+    finally:
+        _trace_state.key = prev
+        _trace_state.n = prev_n
+
+
+def in_trace_scope() -> bool:
+    return getattr(_trace_state, "key", None) is not None
+
+
+def next_key(generator: Optional[Generator] = None):
+    """Produce a fresh PRNG key for one random op."""
+    tk = getattr(_trace_state, "key", None)
+    if tk is not None:
+        n = _trace_state.n
+        _trace_state.n = n + 1
+        return jax.random.fold_in(tk, n)
+    return (generator or _default_generator).next_key()

@@ -1,0 +1,2 @@
+"""hapi package: callbacks, progress bar (paddle.hapi parity)."""
+from . import callbacks  # noqa: F401

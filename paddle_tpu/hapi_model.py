@@ -1,0 +1,202 @@
+"""High-level Model API (paddle.Model / hapi parity).
+
+Reference: ``python/paddle/hapi/model.py`` — Keras-style
+prepare/fit/evaluate/predict with callbacks and metrics (SURVEY.md §2.2
+"Hapi"). TPU-native: the train step runs through paddle_tpu.jit.TrainStep so
+``fit`` trains with ONE compiled XLA program per batch shape.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .framework.core import Tensor, no_grad
+from .framework.op import raw
+from .hapi import callbacks as _cb
+from .io import DataLoader
+from .jit import TrainStep
+from .metric import Metric
+from .nn.layer import Layer
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare --
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        if optimizer is not None and loss is not None:
+            def loss_fn(model, *batch):
+                *xs, y = batch
+                out = model(*xs)
+                return self._loss(out, y)
+
+            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+        return self
+
+    # ---------------------------------------------------------------- steps --
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        loss = self._train_step(*inputs, *labels)
+        return [float(loss.numpy())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        out = self.network(*inputs)
+        loss = self._loss(out, labels[0]) if (self._loss and labels) else None
+        metrics = []
+        for m in self._metrics:
+            c = m.compute(out, *labels)
+            metrics.append(m.update(c))
+        return ([float(loss.numpy())] if loss is not None else []), metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        return [out.numpy() if isinstance(out, Tensor) else out]
+
+    # ------------------------------------------------------------------ fit --
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        drop_last=False,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+        accumulate_grad_batches=1,
+        num_iters=None,
+    ):
+        if not isinstance(train_data, DataLoader):
+            train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                                      drop_last=drop_last, num_workers=num_workers)
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+                eval_data, batch_size=batch_size, num_workers=num_workers
+            )
+        cbks = _cb.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=len(train_loader),
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=["loss"] + [n for m in self._metrics for n in (m.name() if isinstance(m.name(), list) else [m.name()])],
+        )
+        cbks.on_begin("train")
+        step_count = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                xs, ys = self._split_batch(batch)
+                losses = self.train_batch(xs, ys)
+                logs["loss"] = losses[0]
+                logs["batch_size"] = (raw(xs[0]).shape[0] if xs else batch_size)
+                cbks.on_batch_end("train", step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_end("train", logs)
+        return self
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []
+
+    def _run_eval(self, loader, cbks=None):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            xs, ys = self._split_batch(batch)
+            l, _ = self.eval_batch(xs, ys)
+            if l:
+                losses.append(l[0])
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        return self._run_eval(loader)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            xs = batch if not isinstance(batch, (list, tuple)) else batch[0]
+            outputs.append(self.predict_batch([xs])[0])
+        if stack_outputs:
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    # ------------------------------------------------------------- persist --
+    def save(self, path, training=True):
+        from .framework.io_state import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .framework.io_state import load as _load
+        import os
+
+        state = _load(path + ".pdparams") if os.path.exists(path + ".pdparams") else _load(path)
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters() if p.trainable)
+        lines = [f"Total params: {n_params:,}", f"Trainable params: {trainable:,}"]
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params, "trainable_params": trainable}

@@ -1,0 +1,276 @@
+"""paddle.jit parity: trace-and-compile stateful Layer programs.
+
+Reference: ``python/paddle/jit/`` — dy2static rewrites Python AST into a
+static Program, which the StandaloneExecutor runs (SURVEY.md §2.2 "Dy2Static",
+§3.4). TPU-native design (SURVEY.md §7 "Design stance"): ``to_static`` LIFTS a
+stateful Layer computation into a pure function of (params, buffers, args,
+rng_key), traces it ONCE with jax, and caches the compiled XLA executable per
+input signature — the "static graph mode" IS the jit cache. No AST rewriting:
+data-dependent Python control flow simply triggers a retrace per branch taken
+(guard semantics), and `.numpy()` inside a traced region raises with guidance.
+
+``TrainStep`` is the training analogue: forward + backward + optimizer update
+fused into ONE compiled program (the per-op dispatch loop of the reference's
+DyGraph — §3.1 step 5 — disappears; XLA schedules the whole step).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import rng as _rng
+from ..framework.core import Tensor, no_grad
+from ..framework.op import raw
+from ..nn.layer import Layer
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        from ..framework.dtypes import convert_dtype
+
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _collect_layers(obj) -> List[Layer]:
+    if isinstance(obj, Layer):
+        return [obj]
+    self_obj = getattr(obj, "__self__", None)
+    if isinstance(self_obj, Layer):
+        return [self_obj]
+    # function closures may reference layers
+    layers = []
+    closure = getattr(obj, "__closure__", None) or ()
+    for cell in closure:
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            layers.append(v)
+    g = getattr(obj, "__globals__", None)
+    return layers
+
+
+class TracedLayer:
+    """The product of ``to_static``: a signature-cached compiled callable."""
+
+    def __init__(self, fn: Callable, layers: Optional[Sequence[Layer]] = None, full_graph=True):
+        self._fn = fn
+        self._layers = list(layers) if layers is not None else _collect_layers(fn)
+        self._cache = {}
+        self._last_out_tree = None
+        functools.update_wrapper(self, fn, updated=[])
+
+    def _state_tensors(self):
+        tensors, is_buffer = [], []
+        seen = set()
+        for layer in self._layers:
+            for _, p in layer.named_parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    tensors.append(p)
+                    is_buffer.append(False)
+            for _, b in layer.named_buffers():
+                if id(b) not in seen:
+                    seen.add(id(b))
+                    tensors.append(b)
+                    is_buffer.append(True)
+        return tensors, is_buffer
+
+    def __call__(self, *args, **kwargs):
+        state, is_buffer = self._state_tensors()
+        state_vals = [t._value for t in state]
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+        arg_vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
+        # traced leaves: Tensors and ndarray-likes; python scalars stay static
+        arr_idx = [
+            i
+            for i, (l, v) in enumerate(zip(leaves, arg_vals))
+            if isinstance(l, Tensor) or isinstance(v, (np.ndarray, jax.Array))
+        ]
+        tensor_flags = tuple(isinstance(leaves[i], Tensor) for i in arr_idx)
+        arr_vals = [jnp.asarray(arg_vals[i]) for i in arr_idx]
+        static_part = tuple(
+            (i, arg_vals[i]) for i in range(len(arg_vals)) if i not in set(arr_idx)
+        )
+        training = tuple(l.training for l in self._layers)
+        key = (
+            treedef,
+            tuple((tuple(v.shape), str(v.dtype)) for v in arr_vals),
+            static_part,
+            training,
+            len(state_vals),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(treedef, arr_idx, tensor_flags, static_part, state, is_buffer)
+            self._cache[key] = entry
+        jitted, out_tree_box = entry
+        rng_key = _rng.next_key()
+        outs_flat, new_state = jitted(state_vals, arr_vals, rng_key)
+        for t, v, buf in zip(state, new_state, is_buffer):
+            t._value = v
+        out_tree = out_tree_box[0]
+        wrapped = [Tensor(o) if hasattr(o, "shape") else o for o in outs_flat]
+        return jax.tree_util.tree_unflatten(out_tree, wrapped)
+
+    def _compile(self, treedef, arr_idx, tensor_flags, static_part, state, is_buffer):
+        fn = self._fn
+        out_tree_box = [None]
+        static_map = dict(static_part)
+
+        def pure(state_vals, arr_vals, rng_key):
+            vals = dict(static_map)
+            for i, v, was_t in zip(arr_idx, arr_vals, tensor_flags):
+                vals[i] = Tensor(v) if was_t else v
+            rebuilt = [vals[i] for i in range(len(vals))]
+            a, k = jax.tree_util.tree_unflatten(treedef, rebuilt)
+            originals = [t._value for t in state]
+            with _rng.trace_key_scope(rng_key):
+                try:
+                    for t, sv in zip(state, state_vals):
+                        t._value = sv
+                    out = fn(*a, **k)
+                    new_state = [t._value for t in state]
+                finally:
+                    for t, ov in zip(state, originals):
+                        t._value = ov
+            out_leaves, out_tree = jax.tree_util.tree_flatten(
+                out, is_leaf=_is_tensor
+            )
+            out_tree_box[0] = out_tree
+            out_vals = [o._value if isinstance(o, Tensor) else o for o in out_leaves]
+            return out_vals, new_state
+
+        jitted = jax.jit(pure)
+        return jitted, out_tree_box
+
+    # introspection helpers (paddle parity-ish)
+    @property
+    def program_cache_size(self):
+        return len(self._cache)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, full_graph=True, backend=None, **kwargs):
+    """paddle.jit.to_static parity: decorator or direct call on Layer/function."""
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            traced = TracedLayer(fn.forward, layers=[fn])
+            fn.forward = traced
+            return fn
+        return TracedLayer(fn)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TrainStep:
+    """Fused, compiled train step: forward + grad + optimizer in one XLA program.
+
+    TPU-native replacement for the reference's per-op DyGraph train loop
+    (SURVEY.md §3.2). Under a device mesh, the same class compiles the SPMD
+    program (sharded params in = sharded params out) — used by fleet.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate=True):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._cache = {}
+        self._donate = donate
+        # stable state ordering
+        self._params = [p for p in optimizer._parameter_list]
+        seen = {id(p) for p in self._params}
+        self._buffers = [b for _, b in model.named_buffers() if id(b) not in seen]
+        self._extra_params = [
+            p for _, p in model.named_parameters() if id(p) not in seen
+        ]
+
+    def __call__(self, *batch):
+        params = self._params
+        buffers = self._buffers + self._extra_params
+        p_vals = [p._value for p in params]
+        b_vals = [b._value for b in buffers]
+        opt_states = self._opt.functional_states()
+        batch_vals = [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        key = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
+        jitted = self._cache.get(key)
+        if jitted is None:
+            jitted = self._compile()
+            self._cache[key] = jitted
+        rng_key = _rng.next_key()
+        loss_val, new_p, new_b, new_st = jitted(p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
+        for p, v in zip(params, new_p):
+            p._value = v
+        for b, v in zip(buffers, new_b):
+            b._value = v
+        self._opt.load_functional_states(new_st)
+        if isinstance(self._opt._learning_rate, type(None)):
+            pass
+        return Tensor(loss_val)
+
+    def _compile(self):
+        model, loss_fn, opt = self._model, self._loss_fn, self._opt
+        params, buffers = self._params, self._buffers + self._extra_params
+        trainable = [p.trainable for p in params]
+
+        def loss_of(train_vals, fixed):
+            b_vals, batch_vals, rng_key = fixed
+            orig_p = [p._value for p in params]
+            orig_b = [b._value for b in buffers]
+            with _rng.trace_key_scope(rng_key):
+                try:
+                    for p, v in zip(params, train_vals):
+                        p._value = v
+                    for b, v in zip(buffers, b_vals):
+                        b._value = v
+                    batch_t = [Tensor(v) for v in batch_vals]
+                    loss = loss_fn(model, *batch_t)
+                    loss_val = raw(loss)
+                    new_b = [b._value for b in buffers]
+                finally:
+                    for p, v in zip(params, orig_p):
+                        p._value = v
+                    for b, v in zip(buffers, orig_b):
+                        b._value = v
+            return loss_val, new_b
+
+        def step(p_vals, b_vals, opt_states, batch_vals, lr, rng_key):
+            (loss_val, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                p_vals, (b_vals, batch_vals, rng_key)
+            )
+            grads = [g if t else None for g, t in zip(grads, trainable)]
+            new_p, new_st = opt.functional_step(p_vals, grads, opt_states, lr)
+            return loss_val, new_p, new_b, new_st
+
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)

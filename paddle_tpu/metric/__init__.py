@@ -1,0 +1,172 @@
+"""Metrics (paddle.metric parity).
+
+Reference: ``python/paddle/metric/metrics.py`` (SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import raw
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pv = np.asarray(raw(pred))
+        lv = np.asarray(raw(label))
+        idx = np.argsort(-pv, axis=-1)[..., : self.maxk]
+        if lv.ndim == pv.ndim:
+            lv = lv.squeeze(-1) if lv.shape[-1] == 1 else np.argmax(lv, -1)
+        correct = idx == lv[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(raw(correct)) if isinstance(correct, Tensor) else np.asarray(correct)
+        accs = []
+        num = c.shape[0] if c.ndim else 1
+        for i, k in enumerate(self.topk):
+            ck = c[..., :k].any(-1).sum()
+            self.total[i] += float(ck)
+            self.count[i] += num
+            accs.append(float(ck) / max(num, 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(raw(preds)) if isinstance(preds, Tensor) else np.asarray(preds)
+        l = np.asarray(raw(labels)) if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_pos = (p > 0.5).astype(np.int64).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        return self.tp / (self.tp + self.fp) if (self.tp + self.fp) else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(raw(preds)) if isinstance(preds, Tensor) else np.asarray(preds)
+        l = np.asarray(raw(labels)) if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_pos = (p > 0.5).astype(np.int64).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        return self.tp / (self.tp + self.fn) if (self.tp + self.fn) else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(raw(preds)) if isinstance(preds, Tensor) else np.asarray(preds)
+        l = np.asarray(raw(labels)) if isinstance(labels, Tensor) else np.asarray(labels)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        l = l.reshape(-1)
+        bins = np.minimum((p * self.num_thresholds).astype(np.int64), self.num_thresholds - 1)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds, np.int64)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        # integrate TPR/FPR over thresholds (descending)
+        pos = self._stat_pos[::-1].cumsum()
+        neg = self._stat_neg[::-1].cumsum()
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    m = Accuracy(topk=(k,))
+    c = m.compute(input, label)
+    m.update(c)
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))

@@ -1,0 +1,15 @@
+"""paddle.nn.functional parity surface."""
+from .activation import *  # noqa: F401,F403
+from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import (  # noqa: F401
+    batch_norm,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    local_response_norm,
+    normalize,
+    rms_norm,
+)

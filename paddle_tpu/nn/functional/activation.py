@@ -1,0 +1,186 @@
+"""Activation functionals (paddle.nn.functional parity).
+
+Reference: ``python/paddle/nn/functional/activation.py`` (SURVEY.md §2.2).
+All are VPU elementwise ops; XLA fuses them into adjacent matmuls/convs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.op import defop
+
+
+@defop
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    return x._rebind(out._value, out._node)
+
+
+@defop
+def relu6(x, name=None):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+@defop
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@defop
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@defop
+def prelu(x, weight, data_format="NCHW", name=None):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch_axis] = weight.size
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@defop
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    # eval-mode deterministic variant; training sampling handled by the layer
+    neg = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, neg * x)
+
+
+@defop(amp="black")
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@defop(amp="black")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@defop
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import rng as _rng
+
+    g = jax.random.gumbel(_rng.next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard - jax.lax.stop_gradient(y) + y
+    return y
+
+
+@defop
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@defop
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@defop
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@defop
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@defop
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return jnp.where(x > threshold, x, value)
+
+
+@defop
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@defop
+def silu(x, name=None):
+    return jax.nn.silu(x)
+
+
+@defop
+def swish(x, name=None):
+    return jax.nn.silu(x)
+
+
+@defop
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+@defop
+def softsign(x, name=None):
+    return jax.nn.soft_sign(x)
+
+
+@defop
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@defop
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@defop
+def glu(x, axis=-1, name=None):
+    return jax.nn.glu(x, axis=axis)
+
+
+@defop
+def maxout(x, groups, axis=1, name=None):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)

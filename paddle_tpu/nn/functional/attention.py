@@ -1,0 +1,79 @@
+"""Attention functionals.
+
+Reference: ``python/paddle/nn/functional/flash_attention.py`` (wrapping the
+external flashattn CUDA lib — SURVEY.md §2.3 "CP", §5 "Long-context").
+TPU-native design: the public API lowers to (a) a Pallas flash-attention
+kernel on TPU (paddle_tpu/ops/pallas/flash_attention.py) when shapes allow,
+else (b) a jnp reference path that XLA still fuses well.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.op import defop, raw
+
+_USE_PALLAS = True
+
+
+def _sdpa_reference(q, k, v, mask, dropout_p, causal, scale, key=None):
+    # q,k,v: [B, T, H, D] (paddle flash-attention layout)
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,T,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(cm, logits, jnp.asarray(-jnp.inf, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-jnp.inf, logits.dtype))
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,T,H,D]
+
+
+@defop(amp="white", name="sdpa_op")
+def _sdpa(q, k, v, mask, key, dropout_p, causal, scale, use_pallas):
+    if use_pallas and mask is None and dropout_p == 0.0:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention as _fa
+
+            return _fa(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _sdpa_reference(q, k, v, mask, dropout_p, causal, scale, key)
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, scale=None, name=None
+):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+
+    Layout [batch, seq, heads, head_dim] (matches paddle flash attention).
+    """
+    from ...framework import rng as _rng
+
+    p = float(dropout_p) if training else 0.0
+    rng_key = _rng.next_key() if p > 0 else None
+    return _sdpa(
+        query, key, value, attn_mask, rng_key,
+        dropout_p=p, causal=bool(is_causal), scale=scale, use_pallas=_USE_PALLAS,
+    )
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, rng_name="", training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,321 @@
+"""Common functionals: linear, dropout, embedding, padding, interpolate, etc.
+
+Reference: ``python/paddle/nn/functional/common.py``, ``input.py``,
+``vision.py`` (SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import rng as _rng
+from ...framework.core import Tensor
+from ...framework.op import defop, raw
+
+
+@defop(amp="white")
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: [in_features, out_features]
+    out = jnp.matmul(x, weight.astype(x.dtype))
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+@defop(amp="white")
+def bilinear(x1, x2, weight, bias=None, name=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight.astype(x1.dtype), x2)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+@defop(name="dropout_op")
+def _dropout(x, key, p, mode):
+    if mode == "upscale_in_train":
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    # downscale_in_infer: train multiplies by mask only
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x.scale(1.0 - p) if p else x
+        return x
+    if axis is not None:
+        return _dropout_axis(x, _rng.next_key(), p=float(p), axis=tuple(np.atleast_1d(axis).tolist()), mode=mode)
+    return _dropout(x, _rng.next_key(), p=float(p), mode=mode)
+
+
+@defop(name="dropout_axis_op")
+def _dropout_axis(x, key, p, axis, mode):
+    shape = [1] * x.ndim
+    for a in axis:
+        shape[a] = x.shape[a]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return _dropout_axis(x, _rng.next_key(), p=float(p), axis=axis, mode="upscale_in_train")
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return _dropout_axis(x, _rng.next_key(), p=float(p), axis=axis, mode="upscale_in_train")
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout(x, _rng.next_key(), p=float(p))
+
+
+@defop(name="alpha_dropout_op")
+def _alpha_dropout(x, key, p):
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p**2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+@defop
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(x, num_classes, name=None):
+    return _one_hot(x, num_classes=int(num_classes))
+
+
+@defop(name="one_hot_op")
+def _one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@defop(name="pad_op")
+def _pad(x, pad_cfg, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_cfg, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pad_cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    xv = raw(x)
+    nd = xv.ndim
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+    if len(pad) == 2 * nd:
+        # full-spec: paddle uses numpy-style [(lo,hi)...] flattened per dim
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (paddle semantics:
+        # [left, right, top, bottom, front, back] on the spatial dims)
+        nsp = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        channel_last = data_format[-1] == "C"
+        for i in range(nsp):
+            dim = (nd - 1 - i - (1 if channel_last else 0)) if True else 0
+            cfg[dim] = (pad[2 * i], pad[2 * i + 1])
+    return _pad(x, pad_cfg=tuple(cfg), mode=mode, value=value)
+
+
+@defop(name="cosine_similarity_op")
+def _cos_sim(x1, x2, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cos_sim(x1, x2, axis=int(axis), eps=float(eps))
+
+
+@defop(name="pixel_shuffle_op")
+def _pixel_shuffle(x, upscale_factor, data_format):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(x, upscale_factor=int(upscale_factor), data_format=data_format)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(x, downscale_factor=int(downscale_factor), data_format=data_format)
+
+
+@defop(name="pixel_unshuffle_op")
+def _pixel_unshuffle(x, downscale_factor, data_format):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return jnp.reshape(x, (n, c * r * r, h // r, w // r))
+
+
+@defop(name="interpolate_op")
+def _interpolate(x, size, mode, align_corners, data_format):
+    channel_last = data_format[-1] == "C"
+    if not channel_last:
+        # jax.image.resize wants spatial dims explicit; keep NCHW and resize last dims
+        pass
+    n, c = (x.shape[0], x.shape[1]) if not channel_last else (x.shape[0], x.shape[-1])
+    spatial_axes = tuple(range(2, x.ndim)) if not channel_last else tuple(range(1, x.ndim - 1))
+    out_shape = list(x.shape)
+    for ax, s in zip(spatial_axes, size):
+        out_shape[ax] = s
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+    if align_corners and method != "nearest":
+        # build index grid per spatial dim and gather (align_corners semantics)
+        out = x
+        for ax, s_out in zip(spatial_axes, size):
+            s_in = x.shape[ax]
+            if s_out == 1:
+                idx = jnp.zeros((1,), jnp.float32)
+            else:
+                idx = jnp.linspace(0.0, s_in - 1.0, s_out)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, s_in - 1)
+            w = (idx - lo).astype(x.dtype)
+            shape = [1] * out.ndim
+            shape[ax] = s_out
+            w = jnp.reshape(w, shape)
+            out = jnp.take(out, lo, axis=ax) * (1 - w) + jnp.take(out, hi, axis=ax) * w
+        return out
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    xv = raw(x)
+    channel_last = data_format[-1] == "C"
+    spatial = xv.shape[2:] if not channel_last else xv.shape[1:-1]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size / scale_factor required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        size = [int(raw(s)) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    return _interpolate(x, size=tuple(size), mode=mode, align_corners=bool(align_corners), data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@defop(name="label_smooth_op")
+def _label_smooth(label, prior_dist, epsilon):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _label_smooth(label, prior_dist, epsilon=float(epsilon))
+
+
+@defop(name="sequence_mask_op")
+def _sequence_mask(lengths, maxlen, dtype):
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lengths[:, None] if lengths.ndim == 1 else row < lengths[..., None]
+    return mask.astype(dtype)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...framework.dtypes import convert_dtype
+
+    xv = raw(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(xv).max())
+    return _sequence_mask(x, maxlen=int(maxlen), dtype=convert_dtype(dtype))
+
+
+@defop(name="temperature_softmax")
+def temperature_softmax(x, t):
+    return jax.nn.softmax(x / t, axis=-1)
+
+
+@defop(name="grid_sample_op")
+def _grid_sample(x, grid, mode, padding_mode, align_corners):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        ix = (gx + 1) * 0.5 * (w - 1)
+        iy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        ix = ((gx + 1) * w - 1) * 0.5
+        iy = ((gy + 1) * h - 1) * 0.5
+
+    def sample(img, yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        return jax.vmap(lambda im, y1, x1: im[:, y1, x1], in_axes=(0, 0, 0))(
+            img, yy.astype(jnp.int32), xx.astype(jnp.int32)
+        )
+
+    if mode == "nearest":
+        return sample(x, jnp.round(iy), jnp.round(ix))
+    x0 = jnp.floor(ix)
+    y0 = jnp.floor(iy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = (x1 - ix) * (y1 - iy)
+    wb = (x1 - ix) * (iy - y0)
+    wc = (ix - x0) * (y1 - iy)
+    wd = (ix - x0) * (iy - y0)
+    va = sample(x, y0, x0)
+    vb = sample(x, y1, x0)
+    vc = sample(x, y0, x1)
+    vd = sample(x, y1, x1)
+    return va * wa[:, None] + vb * wb[:, None] + vc * wc[:, None] + vd * wd[:, None]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode, align_corners=bool(align_corners))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: planned with the EP/MoE work")

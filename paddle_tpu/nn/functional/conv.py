@@ -1,0 +1,328 @@
+"""Convolution & pooling functionals.
+
+Reference: ``python/paddle/nn/functional/conv.py``, ``pooling.py``
+(SURVEY.md §2.2). TPU-native: ``lax.conv_general_dilated`` — XLA lowers convs
+onto the MXU (implicit GEMM); pooling via ``lax.reduce_window``. Logical
+layout is paddle's NCHW; XLA's layout assignment picks the physical TPU
+layout, so no manual transposes are needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import defop
+
+__all__ = [
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv1d_transpose",
+    "conv2d_transpose",
+    "conv3d_transpose",
+    "max_pool1d",
+    "max_pool2d",
+    "max_pool3d",
+    "avg_pool1d",
+    "avg_pool2d",
+    "avg_pool3d",
+    "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d",
+    "adaptive_max_pool1d",
+    "adaptive_max_pool2d",
+    "unfold",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _conv_padding(padding, nsp, stride, ksize, dilation, in_shape):
+    """Normalize paddle padding spec to lax [(lo,hi)] per spatial dim."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * nsp
+        if p == "SAME":
+            pads = []
+            for i in range(nsp):
+                out = -(-in_shape[i] // stride[i])
+                eff_k = (ksize[i] - 1) * dilation[i] + 1
+                total = max(0, (out - 1) * stride[i] + eff_k - in_shape[i])
+                pads.append((total // 2, total - total // 2))
+            return pads
+        raise ValueError(f"bad padding {padding}")
+    if isinstance(padding, int):
+        return [(padding, padding)] * nsp
+    padding = list(padding)
+    if len(padding) == nsp and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # NCHW-style 4-elem nested list: strip batch/channel dims
+        sp = [p for p in padding if list(p) != [0, 0]]
+        sp = padding[-nsp:]
+        return [tuple(p) for p in sp]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nsp, data_format):
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        perm = (0, nsp + 1) + tuple(range(1, nsp + 1))
+        x = jnp.transpose(x, perm)
+    in_shape = x.shape[2:]
+    stride = _tuple(stride, nsp)
+    dilation = _tuple(dilation, nsp)
+    ksize = weight.shape[2:]
+    pads = _conv_padding(padding, nsp, stride, ksize, dilation, in_shape)
+    spatial = "DHW"[-nsp:]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    )
+    out = jax.lax.conv_general_dilated(
+        x,
+        weight.astype(x.dtype),
+        window_strides=stride,
+        padding=pads,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + jnp.reshape(bias.astype(out.dtype), (1, -1) + (1,) * nsp)
+    if channel_last:
+        inv = (0,) + tuple(range(2, nsp + 2)) + (1,)
+        out = jnp.transpose(out, inv)
+    return out
+
+
+@defop(amp="white")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+@defop(amp="white")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+@defop(amp="white")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, nsp, data_format, output_size):
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        perm = (0, nsp + 1) + tuple(range(1, nsp + 1))
+        x = jnp.transpose(x, perm)
+    stride = _tuple(stride, nsp)
+    dilation = _tuple(dilation, nsp)
+    # paddle weight layout for transpose conv: [in_c, out_c/groups, *k]
+    ksize = weight.shape[2:]
+    pads = _conv_padding(padding, nsp, stride, ksize, dilation, x.shape[2:])
+    opad = _tuple(output_padding, nsp) if output_padding else (0,) * nsp
+    # gradient-of-conv formulation: lhs_dilation=stride
+    eff_k = [(ksize[i] - 1) * dilation[i] + 1 for i in range(nsp)]
+    tpads = [
+        (eff_k[i] - 1 - pads[i][0], eff_k[i] - 1 - pads[i][1] + opad[i])
+        for i in range(nsp)
+    ]
+    spatial = "DHW"[-nsp:]
+    # flip spatial dims, swap I/O: weight [in, out/g, *k] -> [out, in/g? ...]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nsp)))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape((groups, ic // groups, ocg) + tuple(ksize))
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((groups * ocg, ic // groups) + tuple(ksize))
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    )
+    out = jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(1,) * nsp,
+        padding=tpads,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if output_size is not None:
+        tgt = _tuple(output_size, nsp)
+        sl = (slice(None), slice(None)) + tuple(slice(0, t) for t in tgt)
+        out = out[sl]
+    if bias is not None:
+        out = out + jnp.reshape(bias.astype(out.dtype), (1, -1) + (1,) * nsp)
+    if channel_last:
+        inv = (0,) + tuple(range(2, nsp + 2)) + (1,)
+        out = jnp.transpose(out, inv)
+    return out
+
+
+@defop(amp="white")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format, output_size)
+
+
+@defop(amp="white")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, output_size)
+
+
+@defop(amp="white")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, output_size)
+
+
+# ------------------------------------------------------------------ pooling --
+
+
+def _pool(x, ksize, stride, padding, nsp, reducer, init, ceil_mode, data_format, count_include_pad=True):
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        perm = (0, nsp + 1) + tuple(range(1, nsp + 1))
+        x = jnp.transpose(x, perm)
+    ksize = _tuple(ksize, nsp)
+    stride = _tuple(stride if stride is not None else ksize, nsp)
+    pads = _conv_padding(padding, nsp, stride, ksize, (1,) * nsp, x.shape[2:])
+    if ceil_mode:
+        new_pads = []
+        for i in range(nsp):
+            size = x.shape[2 + i] + pads[i][0] + pads[i][1]
+            rem = (size - ksize[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+            new_pads.append((pads[i][0], pads[i][1] + extra))
+        pads = new_pads
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    padcfg = ((0, 0), (0, 0)) + tuple(pads)
+    out = jax.lax.reduce_window(x, init, reducer, window, strides, padcfg)
+    if reducer is jax.lax.add:
+        if count_include_pad:
+            denom = float(np.prod(ksize))
+            out = out / jnp.asarray(denom, out.dtype)
+        else:
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padcfg)
+            out = out / counts
+    if channel_last:
+        inv = (0,) + tuple(range(2, nsp + 2)) + (1,)
+        out = jnp.transpose(out, inv)
+    return out
+
+
+@defop
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+
+
+@defop
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+
+
+@defop
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+
+
+@defop
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0, ceil_mode, data_format, count_include_pad=not exclusive)
+
+
+@defop
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0, ceil_mode, data_format, count_include_pad=not exclusive)
+
+
+@defop
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0, ceil_mode, data_format, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, nsp, mode):
+    out_sizes = _tuple(output_size, nsp)
+    sp = x.shape[2:]
+    # decompose into per-dim segment means/maxes (paddle adaptive semantics)
+    for d in range(nsp):
+        n_in, n_out = sp[d], out_sizes[d]
+        if n_in % n_out == 0:
+            k = n_in // n_out
+            shape = x.shape[: 2 + d] + (n_out, k) + x.shape[2 + d + 1 :]
+            xr = jnp.reshape(x, shape)
+            x = jnp.mean(xr, axis=2 + d + 1) if mode == "avg" else jnp.max(xr, axis=2 + d + 1)
+        else:
+            # general case: gather windows start/end per output index
+            starts = [int(np.floor(i * n_in / n_out)) for i in range(n_out)]
+            ends = [int(np.ceil((i + 1) * n_in / n_out)) for i in range(n_out)]
+            slices = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * x.ndim
+                sl[2 + d] = slice(s, e)
+                seg = x[tuple(sl)]
+                red = jnp.mean(seg, axis=2 + d, keepdims=True) if mode == "avg" else jnp.max(seg, axis=2 + d, keepdims=True)
+                slices.append(red)
+            x = jnp.concatenate(slices, axis=2 + d)
+    return x
+
+
+@defop
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+@defop
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    if data_format[-1] == "C":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+        out = _adaptive_pool(x, output_size, 2, "avg")
+        return jnp.transpose(out, (0, 2, 3, 1))
+    return _adaptive_pool(x, output_size, 2, "avg")
+
+
+@defop
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg")
+
+
+@defop
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max")
+
+
+@defop
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+@defop
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle.nn.functional.unfold parity)."""
+    n, c, h, w = x.shape
+    k = _tuple(kernel_sizes, 2)
+    s = _tuple(strides, 2)
+    d = _tuple(dilations, 2)
+    p = _conv_padding(paddings, 2, s, k, d, (h, w))
+    x = jnp.pad(x, ((0, 0), (0, 0), p[0], p[1]))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, k, s, [(0, 0), (0, 0)], rhs_dilation=d,
+        dimension_numbers=jax.lax.conv_dimension_numbers(x.shape, (1, c) + k, ("NCHW", "OIHW", "NCHW")),
+    )
+    # patches: [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, oh*ow]
+    return jnp.reshape(patches, (n, patches.shape[1], -1))

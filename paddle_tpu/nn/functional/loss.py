@@ -1,0 +1,289 @@
+"""Loss functionals.
+
+Reference: ``python/paddle/nn/functional/loss.py`` (SURVEY.md §2.2).
+cross_entropy mirrors paddle semantics: integer labels (sparse) or soft
+labels, ignore_index, label_smoothing, reduction modes; computed in float32
+under AMP ("black" list) for numerical safety.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import defop, raw
+from ...framework.core import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@defop(amp="black", name="cross_entropy_op")
+def _cross_entropy(input, label, weight, ignore_index, reduction, soft_label, axis, label_smoothing):
+    axis = axis % input.ndim
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    nclass = input.shape[axis]
+    if soft_label:
+        soft = label
+        if label_smoothing > 0.0:
+            soft = soft * (1.0 - label_smoothing) + label_smoothing / nclass
+        per = -jnp.sum(soft * logp, axis=axis)
+        if reduction == "mean":
+            return jnp.mean(per)
+        return _reduce(per, reduction)
+    lbl = label
+    if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis)
+    if label_smoothing > 0.0:
+        smooth_loss = -jnp.mean(logp, axis=axis)
+        per = -(1.0 - label_smoothing) * picked + label_smoothing * smooth_loss
+    else:
+        per = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        per = per * w
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    else:
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(per, reduction)
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    if not use_softmax:
+        # input is already a probability distribution
+        eps = 1e-12
+        li = log_of(input, eps)
+        return _nll_from_logp(li, label, weight, ignore_index=int(ignore_index), reduction=reduction, soft_label=bool(soft_label), axis=int(axis))
+    return _cross_entropy(
+        input,
+        label,
+        weight,
+        ignore_index=int(ignore_index),
+        reduction=reduction,
+        soft_label=bool(soft_label),
+        axis=int(axis),
+        label_smoothing=float(label_smoothing),
+    )
+
+
+@defop(name="log_of")
+def log_of(x, eps):
+    return jnp.log(jnp.maximum(x, eps))
+
+
+@defop(name="nll_from_logp")
+def _nll_from_logp(logp, label, weight, ignore_index, reduction, soft_label, axis):
+    axis = axis % logp.ndim
+    if soft_label:
+        per = -jnp.sum(label * logp, axis=axis)
+        return _reduce(per, reduction)
+    lbl = label
+    if lbl.ndim == logp.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.squeeze(jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis), axis)
+    per = jnp.where(valid, -picked, 0.0)
+    if weight is not None:
+        per = per * jnp.take(weight, safe)
+    if reduction == "mean":
+        return jnp.sum(per) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(per, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis) if loss.ndim < raw(logits).ndim else loss
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return _nll_from_logp(input, label, weight, ignore_index=int(ignore_index), reduction=reduction, soft_label=False, axis=1 if raw(input).ndim > 1 else -1)
+
+
+@defop(name="mse_loss_op")
+def _mse(input, label, reduction):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(input, label, reduction=reduction)
+
+
+@defop(name="l1_loss_op")
+def _l1(input, label, reduction):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(input, label, reduction=reduction)
+
+
+@defop(amp="black", name="bce_op")
+def _bce(input, label, weight, reduction):
+    eps = 1e-12
+    per = -(label * jnp.log(jnp.maximum(input, eps)) + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        per = per * weight
+    return _reduce(per, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    return _bce(input, label, weight, reduction=reduction)
+
+
+@defop(amp="black", name="bce_logits_op")
+def _bce_logits(logit, label, weight, pos_weight, reduction):
+    # numerically-stable: max(x,0) - x*z + log(1+exp(-|x|))
+    x, z = logit, label
+    base = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        logsig = -jax.nn.softplus(-x)
+        log1msig = -jax.nn.softplus(x)
+        base = -(pos_weight * z * logsig + (1 - z) * log1msig)
+    if weight is not None:
+        base = base * weight
+    return _reduce(base, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    return _bce_logits(logit, label, weight, pos_weight, reduction=reduction)
+
+
+@defop(name="kl_div_op")
+def _kl_div(input, label, reduction, log_target):
+    if log_target:
+        per = jnp.exp(label) * (label - input)
+    else:
+        per = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(per) / input.shape[0]
+    return _reduce(per, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return _kl_div(input, label, reduction=reduction, log_target=bool(log_target))
+
+
+@defop(name="smooth_l1_op")
+def _smooth_l1(input, label, reduction, delta):
+    d = jnp.abs(input - label)
+    per = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce(per, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(input, label, reduction=reduction, delta=float(delta))
+
+
+@defop(name="huber_op")
+def _huber(input, label, reduction, delta):
+    d = jnp.abs(input - label)
+    per = jnp.where(d <= delta, 0.5 * d * d, delta * d - 0.5 * delta * delta)
+    return _reduce(per, reduction)
+
+
+def huber_loss(input, label, reduction="mean", delta=1.0):
+    return _huber(input, label, reduction=reduction, delta=float(delta))
+
+
+@defop(name="margin_ranking_op")
+def _margin_ranking(input, other, label, margin, reduction):
+    per = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(per, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return _margin_ranking(input, other, label, margin=float(margin), reduction=reduction)
+
+
+@defop(name="cosine_embedding_op")
+def _cosine_embedding(input1, input2, label, margin, reduction):
+    cos = jnp.sum(input1 * input2, -1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1) + 1e-12
+    )
+    per = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(per, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    return _cosine_embedding(input1, input2, label, margin=float(margin), reduction=reduction)
+
+
+@defop(name="hinge_embedding_op")
+def _hinge_embedding(input, label, margin, reduction):
+    per = jnp.where(label == 1, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(per, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _hinge_embedding(input, label, margin=float(margin), reduction=reduction)
+
+
+@defop(name="triplet_margin_op")
+def _triplet(anchor, positive, negative, margin, p, eps, swap, reduction):
+    dp = jnp.linalg.norm(anchor - positive + eps, ord=p, axis=-1)
+    dn = jnp.linalg.norm(anchor - negative + eps, ord=p, axis=-1)
+    if swap:
+        dn2 = jnp.linalg.norm(positive - negative + eps, ord=p, axis=-1)
+        dn = jnp.minimum(dn, dn2)
+    per = jnp.maximum(dp - dn + margin, 0.0)
+    return _reduce(per, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet(input, positive, negative, margin=float(margin), p=float(p), eps=float(epsilon), swap=bool(swap), reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return _mse(input, label, reduction="none")
+
+
+@defop(name="ctc_loss_op")
+def _ctc(log_probs, labels, input_lengths, label_lengths, blank, reduction):
+    # optax.ctc_loss expects [B, T, C] logits and padded labels
+    import optax
+
+    logits = jnp.transpose(log_probs, (1, 0, 2)) if log_probs.ndim == 3 else log_probs
+    B, T, C = logits.shape
+    logit_padding = (jnp.arange(T)[None, :] >= input_lengths[:, None]).astype(jnp.float32)
+    L = labels.shape[1]
+    label_padding = (jnp.arange(L)[None, :] >= label_lengths[:, None]).astype(jnp.float32)
+    per = optax.ctc_loss(logits, logit_padding, labels, label_padding, blank_id=blank)
+    if reduction == "mean":
+        return jnp.mean(per / jnp.maximum(label_lengths.astype(jnp.float32), 1.0))
+    return _reduce(per, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    return _ctc(log_probs, labels, input_lengths, label_lengths, blank=int(blank), reduction=reduction)

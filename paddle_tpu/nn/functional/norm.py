@@ -1,0 +1,183 @@
+"""Normalization functionals.
+
+Reference: ``python/paddle/nn/functional/norm.py`` (SURVEY.md §2.2).
+These are HBM-bandwidth-bound; XLA fuses the mean/var/scale chain.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.op import defop, raw
+from ...framework.core import Tensor
+
+
+@defop(amp="black", name="batch_norm_infer")
+def _bn_infer(x, mean, var, weight, bias, epsilon, data_format):
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    inv = jnp.reciprocal(jnp.sqrt(var + epsilon))
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop(amp="black", name="batch_norm_train")
+def _bn_train(x, weight, bias, epsilon, data_format):
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    axes = tuple(a for a in range(x.ndim) if a != ch_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    inv = jnp.reciprocal(jnp.sqrt(var + epsilon))
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    if training and not use_global_stats:
+        out, mean, var = _bn_train(x, weight, bias, epsilon=float(epsilon), data_format=data_format)
+        # update running stats in place (buffers); correct both eager & traced:
+        # the jit bridge snapshots buffer values after the traced call.
+        m = float(momentum)
+        n = raw(x).size // raw(mean).size
+        unbiased = raw(var) * (n / max(n - 1, 1))
+        running_mean._rebind(raw(running_mean) * m + raw(mean) * (1 - m))
+        running_var._rebind(raw(running_var) * m + unbiased * (1 - m))
+        return out
+    return _bn_infer(x, running_mean, running_var, weight, bias, epsilon=float(epsilon), data_format=data_format)
+
+
+@defop(amp="black", name="layer_norm_op")
+def _layer_norm(x, weight, bias, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = raw(x).ndim - len(tuple(normalized_shape))
+    return _layer_norm(x, weight, bias, epsilon=float(epsilon), begin_axis=begin)
+
+
+@defop(amp="black", name="group_norm_op")
+def _group_norm(x, weight, bias, epsilon, num_groups, data_format):
+    if data_format[-1] == "C":
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        x = jnp.transpose(x, perm)
+        transposed = True
+    else:
+        transposed = False
+    n, c = x.shape[:2]
+    g = num_groups
+    xr = jnp.reshape(x, (n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xr.ndim))
+    mean = jnp.mean(xr, axis=axes, keepdims=True)
+    var = jnp.var(xr, axis=axes, keepdims=True)
+    out = (xr - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    out = jnp.reshape(out, x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if transposed:
+        inv = (0,) + tuple(range(2, x.ndim)) + (1,)
+        out = jnp.transpose(out, inv)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    return _group_norm(x, weight, bias, epsilon=float(epsilon), num_groups=int(num_groups), data_format=data_format)
+
+
+@defop(amp="black", name="instance_norm_op")
+def _instance_norm(x, weight, bias, epsilon):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    return _instance_norm(x, weight, bias, epsilon=float(eps))
+
+
+@defop(name="rms_norm_op", amp="black")
+def _rms_norm(x, weight, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes, keepdims=True)
+    out = (x.astype(jnp.float32) * jnp.reciprocal(jnp.sqrt(ms + epsilon))).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (used by modern LLM configs; reference family: incubate fused_rms_norm)."""
+    begin = raw(x).ndim - (raw(weight).ndim if weight is not None else 1)
+    return _rms_norm(x, weight, epsilon=float(epsilon), begin_axis=begin)
+
+
+@defop(name="l2_normalize_op")
+def _normalize(x, p, axis, epsilon):
+    if p == 2:
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        denom = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p)
+    return x / jnp.maximum(denom, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, p=float(p), axis=int(axis), epsilon=float(epsilon))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    return _lrn(x, size=int(size), alpha=float(alpha), beta=float(beta), k=float(k))
+
+
+@defop(name="lrn_op")
+def _lrn(x, size, alpha, beta, k):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    acc = sum(sq[:, i : i + c] for i in range(size))
+    return x / jnp.power(k + alpha * acc, beta)

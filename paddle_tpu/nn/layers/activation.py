@@ -1,0 +1,77 @@
+"""Activation layers. Reference: ``python/paddle/nn/layer/activation.py``."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(fixed)
+            # positional args map onto the functional's signature in order
+            import inspect
+
+            fn = getattr(F, fn_name)
+            params = [p for p in inspect.signature(fn).parameters][1:]
+            for name, v in zip(params, args):
+                self._kwargs[name] = v
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+ELU = _simple("elu")
+SELU = _simple("selu")
+CELU = _simple("celu")
+LeakyReLU = _simple("leaky_relu")
+Sigmoid = _simple("sigmoid")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Hardtanh = _simple("hardtanh")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+Tanhshrink = _simple("tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu")
+GELU = _simple("gelu")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+Tanh = _simple("tanh")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+GLU = _simple("glu")
+Maxout = _simple("maxout")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr, default_initializer=I.Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

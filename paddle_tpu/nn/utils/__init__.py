@@ -1,0 +1,60 @@
+"""paddle.nn.utils parity: gradient-norm helpers, parameters_to_vector."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.op import raw
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(raw(g))) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(raw(g)), norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._rebind(raw(g) * clip_coef)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._rebind(jnp.clip(raw(p.grad), -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [jnp.reshape(raw(p), (-1,)) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = raw(vec)
+    for p in parameters:
+        n = p.size
+        p._rebind(jnp.reshape(v[offset : offset + n], raw(p).shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    raise NotImplementedError("weight_norm: planned")
+
+
+def remove_weight_norm(layer, name="weight"):
+    raise NotImplementedError("weight_norm: planned")
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    raise NotImplementedError("spectral_norm: planned")

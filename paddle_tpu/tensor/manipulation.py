@@ -1,0 +1,674 @@
+"""Shape/layout manipulation ops (paddle.tensor.manipulation parity).
+
+Reference: ``python/paddle/tensor/manipulation.py`` (SURVEY.md §2.2).
+All static-shape ops trace cleanly under jit; the data-dependent-shape family
+(nonzero/masked_select/unique) is eager-only by design — XLA requires static
+shapes — and raises a clear error under a trace, mirroring how the reference's
+dy2static marks such ops as unsupported-in-static.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, is_tracer_value
+from ..framework.op import defop, raw
+
+
+def _ishape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(raw(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+@defop(name="reshape_op")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, shape=_ishape(shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return x._rebind(out._value, out._node)
+
+
+@defop(name="transpose_op")
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=tuple(int(p) for p in perm))
+
+
+def t(x, name=None):
+    xv = raw(x)
+    if xv.ndim < 2:
+        return x if isinstance(x, Tensor) else Tensor(xv)
+    if xv.ndim == 2:
+        return _transpose(x, perm=(1, 0))
+    raise ValueError("paddle.t only supports tensors with ndim<=2; use transpose")
+
+
+@defop
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    perm = list(range(raw(x).ndim))
+    perm[axis1], perm[axis2] = perm[axis2], perm[axis1]
+    return _transpose(x, perm=tuple(perm))
+
+
+swapdims = swapaxes
+
+
+@defop(name="flatten_op")
+def _flatten(x, start_axis, stop_axis):
+    shape = x.shape
+    nd = len(shape)
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+    new_shape = shape[:sa] + (int(np.prod(shape[sa : so + 1])) if shape else 1,) + shape[so + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+@defop(name="squeeze_op")
+def _squeeze(x, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None:
+        if isinstance(axis, (int, np.integer)):
+            axis = (int(axis),)
+        else:
+            axis = tuple(int(a) for a in axis)
+    return _squeeze(x, axis=axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    return x._rebind(out._value, out._node)
+
+
+@defop(name="unsqueeze_op")
+def _unsqueeze(x, axis):
+    for a in sorted(axis):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return _unsqueeze(x, axis=tuple(int(a) for a in axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    return x._rebind(out._value, out._node)
+
+
+@defop(name="concat_op")
+def _concat(xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(raw(axis)) if isinstance(axis, Tensor) else int(axis)
+    return _concat(list(x), axis=axis)
+
+
+@defop(name="stack_op")
+def _stack(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(list(x), axis=int(axis))
+
+
+@defop(name="split_op")
+def _split(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(raw(axis)) if isinstance(axis, Tensor) else int(axis)
+    if isinstance(num_or_sections, (list, tuple)):
+        secs = [int(raw(s)) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        total = raw(x).shape[axis]
+        known = [s for s in secs if s >= 0]
+        secs = [s if s >= 0 else total - int(np.sum(known)) for s in secs]
+        return list(_split(x, sections=secs, axis=axis))
+    return list(_split(x, sections=int(num_or_sections), axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = raw(x).shape[axis]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+unstack = unbind
+
+
+@defop(name="tile_op")
+def _tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, repeat_times=_ishape(repeat_times))
+
+
+@defop(name="expand_op")
+def _expand(x, shape):
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s == -1 and i >= len(shape) - x.ndim else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    return _expand(x, shape=_ishape(shape))
+
+
+def expand_as(x, y, name=None):
+    return _expand(x, shape=tuple(raw(y).shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return _expand(x, shape=_ishape(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    vals = jnp.broadcast_arrays(*[raw(i) for i in inputs])
+    shape = tuple(vals[0].shape)
+    return [_expand(i, shape=shape) for i in inputs]
+
+
+@defop
+def flip(x, axis, name=None):
+    return jnp.flip(x, axis=axis if not isinstance(axis, list) else tuple(axis))
+
+
+reverse = flip
+
+
+@defop
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@defop
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+# -------------------------------------------------------------- gather etc ---
+
+
+@defop
+def gather(x, index, axis=0, name=None):
+    index = jnp.asarray(index)
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=axis)
+
+
+@defop
+def gather_nd(x, index, name=None):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    indices = jnp.asarray(indices)
+    if broadcast:
+        # paddle broadcasts indices against arr except on `axis`
+        tgt = list(arr.shape)
+        tgt[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tgt)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@defop
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    indices = jnp.asarray(indices)
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    dims = [jnp.arange(s) for s in indices.shape]
+    grids = jnp.meshgrid(*dims, indexing="ij")
+    grids[axis] = indices
+    idx = tuple(grids)
+    if reduce == "assign":
+        return arr.at[idx].set(values)
+    if reduce in ("add", "sum"):
+        return arr.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return arr.at[idx].multiply(values)
+    raise ValueError(f"unsupported reduce mode {reduce}")
+
+
+@defop
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, jnp.asarray(index), axis=axis)
+
+
+@defop
+def index_sample(x, index):
+    index = jnp.asarray(index)
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@defop
+def index_add(x, index, axis, value, name=None):
+    index = jnp.asarray(index)
+    sl = [slice(None)] * x.ndim
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@defop
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@defop
+def scatter_op(x, index, updates, overwrite=True):
+    index = jnp.asarray(index)
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle: overwrite=False means accumulate (after zeroing the rows)
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return scatter_op(x, index, updates, overwrite=overwrite)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    return x._rebind(out._value, out._node)
+
+
+@defop
+def scatter_nd_add(x, index, updates, name=None):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = jnp.zeros(_ishape(shape), raw(updates).dtype)
+    return scatter_nd_add(Tensor(zeros), index, updates)
+
+
+@defop
+def where_op(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return where_op(condition, x, y)
+
+
+@defop
+def select_scatter(x, values, axis, index, name=None):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    return x.at[tuple(sl)].set(values)
+
+
+# ----------------------------------------------- data-dependent (eager only) --
+
+
+def _require_eager(x, opname):
+    if is_tracer_value(raw(x)):
+        raise RuntimeError(
+            f"{opname} has a data-dependent output shape and cannot run inside a "
+            "captured (jit) program on TPU. Run it eagerly, or restructure with "
+            "masking (e.g. paddle_tpu.where with a fill value)."
+        )
+
+
+def nonzero(x, as_tuple=False, name=None):
+    _require_eager(x, "nonzero")
+    res = np.nonzero(np.asarray(raw(x)))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(r[:, None] if False else r)) for r in res)
+    return Tensor(jnp.asarray(np.stack(res, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    _require_eager(x, "masked_select")
+    return Tensor(jnp.asarray(np.asarray(raw(x))[np.asarray(raw(mask))]))
+
+
+@defop
+def masked_fill(x, mask, value, name=None):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def unique(
+    x,
+    return_index=False,
+    return_inverse=False,
+    return_counts=False,
+    axis=None,
+    dtype="int64",
+    name=None,
+):
+    _require_eager(x, "unique")
+    res = np.unique(
+        np.asarray(raw(x)),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    _require_eager(x, "unique_consecutive")
+    a = np.asarray(raw(x))
+    if axis is None:
+        a = a.reshape(-1)
+        change = np.ones(len(a), bool)
+        if len(a) > 1:
+            change[1:] = a[1:] != a[:-1]
+        out = a[change]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+        if return_counts:
+            idx = np.nonzero(change)[0]
+            counts = np.diff(np.append(idx, len(a)))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis is not supported yet")
+
+
+# ------------------------------------------------------------------- sort ----
+
+
+@defop(name="sort_op")
+def _sort(x, axis, descending):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _sort(x, axis=int(axis), descending=bool(descending))
+
+
+@defop(name="argsort_op")
+def _argsort(x, axis, descending):
+    idx = jnp.argsort(x, axis=axis)
+    return jnp.flip(idx, axis=axis) if descending else idx
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _argsort(x, axis=int(axis), descending=bool(descending))
+
+
+@defop(name="topk_op")
+def _topk(x, k, axis, largest, sorted):
+    if axis is None:
+        axis = x.ndim - 1
+    x_moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(x_moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-x_moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(raw(k)) if isinstance(k, Tensor) else int(k)
+    vals, idx = _topk(x, k=k, axis=axis if axis is None else int(axis), largest=bool(largest), sorted=bool(sorted))
+    idx = idx.astype("int64")
+    return vals, idx
+
+
+@defop(name="kthvalue_op")
+def _kthvalue(x, k, axis, keepdim):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    sl = [slice(None)] * x.ndim
+    sl[axis] = k - 1
+    v = vals[tuple(sl)]
+    i = idxs[tuple(sl)]
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    axis = int(axis) % raw(x).ndim
+    return _kthvalue(x, k=int(k), axis=axis, keepdim=bool(keepdim))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    _require_eager(x, "mode")
+    a = np.asarray(raw(x))
+    axis = int(axis) % a.ndim
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    ms = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        vals, counts = np.unique(row, return_counts=True)
+        m = vals[np.argmax(counts)]
+        ms[i] = m
+        idxs[i] = int(np.nonzero(row == m)[0][-1])
+    out_shape = moved.shape[:-1]
+    ms = ms.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        ms = np.expand_dims(ms, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(ms)), Tensor(jnp.asarray(idxs))
+
+
+@defop(name="argmax_op")
+def _argmax(x, axis, keepdim):
+    if axis is None:
+        return jnp.argmax(jnp.reshape(x, (-1,)))
+    out = jnp.argmax(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmax(x, axis=axis if axis is None else int(axis), keepdim=bool(keepdim))
+    return out.astype(dtype)
+
+
+@defop(name="argmin_op")
+def _argmin(x, axis, keepdim):
+    if axis is None:
+        return jnp.argmin(jnp.reshape(x, (-1,)))
+    out = jnp.argmin(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmin(x, axis=axis if axis is None else int(axis), keepdim=bool(keepdim))
+    return out.astype(dtype)
+
+
+@defop
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape((-1, sorted_sequence.shape[-1])),
+            values.reshape((-1, values.shape[-1])),
+        ).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+# ------------------------------------------------------------------- cast ----
+
+
+@defop(name="cast_op")
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    from ..framework.dtypes import convert_dtype
+
+    return _cast(x, dtype=convert_dtype(dtype))
+
+
+def cast_(x, dtype):
+    out = cast(x, dtype)
+    return x._rebind(out._value, out._node)
+
+
+# -------------------------------------------------------------- getitem ------
+
+
+def _norm_index(idx):
+    """Convert a python/paddle index spec into a jnp-compatible one."""
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, Tensor):
+        return raw(idx)
+    if isinstance(idx, (list, np.ndarray)):
+        return jnp.asarray(idx)
+    return idx  # int / slice / None / Ellipsis
+
+
+@defop(name="getitem_op")
+def _getitem(x, idx):
+    return x[idx]
+
+
+def tensor_getitem(x, idx):
+    nidx = _norm_index(idx)
+    # boolean-mask indexing has a data-dependent shape → eager only
+    def _has_bool(i):
+        if isinstance(i, tuple):
+            return any(_has_bool(j) for j in i)
+        return hasattr(i, "dtype") and i.dtype == jnp.bool_
+
+    if _has_bool(nidx):
+        _require_eager(x, "boolean-mask indexing")
+        return Tensor(jnp.asarray(np.asarray(raw(x))[np.asarray(nidx) if not isinstance(nidx, tuple) else tuple(np.asarray(i) if hasattr(i, "dtype") else i for i in nidx)]))
+    return _getitem(x, idx=nidx)
+
+
+@defop(name="setitem_op")
+def _setitem(x, v, idx):
+    v = jnp.asarray(v, x.dtype)
+    return x.at[idx].set(v)
+
+
+def tensor_setitem(x, idx, value):
+    nidx = _norm_index(idx)
+    vv = raw(value)
+    out = _setitem(x, value if isinstance(value, Tensor) else vv, idx=nidx)
+    x._rebind(out._value, out._node)
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x
+
+
+@defop
+def pad_nd(x, pad, mode="constant", value=0.0):
+    return jnp.pad(x, pad, mode=mode, constant_values=value) if mode == "constant" else jnp.pad(x, pad, mode=mode)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError(
+        "as_strided is CUDA-pointer-specific; TPU tensors are not strided views"
+    )
+
+
+@defop
+def view_op(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return view_op(x, shape=_ishape(shape_or_dtype))
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return view_op(x, shape=tuple(raw(other).shape))
+
+
+@defop
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = offsets or [0] * x.ndim
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[sl]
+
+
+@defop
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    in_shard = (input >= lo) & (input < lo + shard_size)
+    return jnp.where(in_shard, input - lo, ignore_value)

@@ -1,0 +1,646 @@
+"""Elementwise & reduction math ops (paddle.tensor.math / stat parity).
+
+Reference: ``python/paddle/tensor/math.py``, ``stat.py`` (SURVEY.md §2.2).
+Each op is a pure jnp function registered through ``defop`` — eager mode gets
+tape recording via jax.vjp, captured mode gets plain XLA tracing, and XLA
+fuses the elementwise chains into surrounding matmuls (HBM-bandwidth
+optimization the reference does with hand-written fusion passes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import defop, raw
+from ..framework.core import Tensor
+
+# ---------------------------------------------------------------- binary ----
+
+
+@defop
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+@defop
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+@defop
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+@defop
+def divide(x, y, name=None):
+    return jnp.divide(x, y)
+
+
+@defop
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+@defop
+def remainder(x, y, name=None):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+@defop
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+@defop
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+@defop
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+@defop
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+@defop
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+@defop
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+@defop
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+@defop
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@defop
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@defop
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@defop
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@defop
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@defop
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+@defop
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+@defop
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+# ----------------------------------------------------------------- unary ----
+
+
+@defop
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+@defop
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+@defop
+def square(x, name=None):
+    return jnp.square(x)
+
+
+@defop(amp="black")
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+@defop
+def expm1(x, name=None):
+    return jnp.expm1(x)
+
+
+@defop(amp="black")
+def log(x, name=None):
+    return jnp.log(x)
+
+
+@defop
+def log2(x, name=None):
+    return jnp.log2(x)
+
+
+@defop
+def log10(x, name=None):
+    return jnp.log10(x)
+
+
+@defop
+def log1p(x, name=None):
+    return jnp.log1p(x)
+
+
+@defop
+def abs(x, name=None):
+    return jnp.abs(x)
+
+
+@defop
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+@defop
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+@defop
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+@defop
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+@defop
+def round(x, name=None):
+    return jnp.round(x)
+
+
+@defop
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+@defop
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+@defop
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+@defop
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+@defop
+def tan(x, name=None):
+    return jnp.tan(x)
+
+
+@defop
+def asin(x, name=None):
+    return jnp.arcsin(x)
+
+
+@defop
+def acos(x, name=None):
+    return jnp.arccos(x)
+
+
+@defop
+def atan(x, name=None):
+    return jnp.arctan(x)
+
+
+@defop
+def sinh(x, name=None):
+    return jnp.sinh(x)
+
+
+@defop
+def cosh(x, name=None):
+    return jnp.cosh(x)
+
+
+@defop
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@defop
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+@defop
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+@defop
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+@defop
+def reciprocal(x, name=None):
+    return jnp.reciprocal(x)
+
+
+@defop
+def erf(x, name=None):
+    return jax.scipy.special.erf(x)
+
+
+@defop
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(x)
+
+
+@defop
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+@defop
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@defop
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@defop
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+@defop
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@defop
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@defop
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@defop
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+@defop
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+@defop
+def real(x, name=None):
+    return jnp.real(x)
+
+
+@defop
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+# ------------------------------------------------------------- logic-ish ----
+
+
+@defop
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+@defop
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+@defop
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+# ------------------------------------------------------------ reductions ----
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop(name="sum")
+def _sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = jnp.int64
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.dtypes import convert_dtype
+
+    return _sum(x, axis=_axis(axis), dtype=convert_dtype(dtype), keepdim=keepdim)
+
+
+@defop(name="mean")
+def _mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _mean(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop(name="max")
+def _max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _max(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop(name="min")
+def _min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _min(x, axis=_axis(axis), keepdim=keepdim)
+
+
+amax = max
+amin = min
+
+
+@defop(name="prod")
+def _prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework.dtypes import convert_dtype
+
+    return _prod(x, axis=_axis(axis), keepdim=keepdim, dtype=convert_dtype(dtype))
+
+
+@defop(name="all")
+def _all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _all(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop(name="any")
+def _any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _any(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop(name="var_op")
+def _var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop(name="std_op")
+def _std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop(name="median_op")
+def _median(x, axis=None, keepdim=False, name=None):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _median(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop(name="quantile_op")
+def _quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _quantile(x, raw(q), axis=_axis(axis), keepdim=keepdim)
+
+
+@defop
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+@defop
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+@defop(name="logsumexp_op")
+def _logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+# ------------------------------------------------------------- cumulative ----
+
+
+@defop
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@defop
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+@defop
+def cummax_op(x, axis):
+    return jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+@defop
+def cummin_op(x, axis):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape([-1])
+        axis = 0
+    values = cummax_op(x, axis=int(axis))
+    # paddle returns (values, indices); indices computed eagerly
+    return values, None
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape([-1])
+        axis = 0
+    return cummin_op(x, axis=int(axis)), None
+
+
+@defop
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+# ---------------------------------------------------------------- others ----
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(list(inputs))
+
+
+@defop(name="add_n_op")
+def _add_n(inputs):
+    out = inputs[0]
+    for v in inputs[1:]:
+        out = out + v
+    return out
+
+
+@defop
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@defop
+def multiply_no_nan(x, y, name=None):
+    return jnp.where(y == 0, jnp.zeros_like(x * y), x * y)
+
+
+@defop
+def increment(x, value=1.0, name=None):
+    return x + jnp.asarray(value, x.dtype)
+
+
+@defop
+def histogram_op(x, bins, min, max):
+    return jnp.histogram(x, bins=bins, range=(min, max))[0]
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    xv = raw(x)
+    if min == 0 and max == 0:
+        min, max = float(xv.min()), float(xv.max())
+    out = histogram_op(x, bins=int(bins), min=float(min), max=float(max))
+    return out.astype("int64")
+
+
+@defop
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x, weights=weights, minlength=minlength, length=None)
+
+
+@defop
+def broadcast_shape_helper(x, y):
+    return jnp.broadcast_arrays(x, y)[0]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

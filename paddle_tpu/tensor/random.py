@@ -1,0 +1,160 @@
+"""Random sampling ops (paddle.tensor.random parity).
+
+Reference: ``python/paddle/tensor/random.py`` (SURVEY.md §2.2). TPU-native
+design: every sample consumes a fresh splittable PRNG key from
+``framework.rng`` — stateful-looking API (paddle.seed / paddle.rand) over a
+counter-based stateless PRNG, so the same ops also work inside captured
+programs where the jit machinery injects a trace-scoped key (see rng.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes as _dtypes, rng as _rng
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(raw(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+@defop(name="uniform_op")
+def _uniform(key, shape, dtype, min, max):
+    return jax.random.uniform(key, shape, dtype=dtype, minval=min, maxval=max)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    dt = _dtypes.convert_dtype(dtype) or _dtypes.float32
+    key = jax.random.key(seed) if seed else _rng.next_key()
+    return _uniform(key, shape=_shape(shape), dtype=dt, min=float(raw(min)), max=float(raw(max)))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype or "float32", 0.0, 1.0)
+
+
+@defop(name="normal_op")
+def _normal(key, shape, dtype, mean, std):
+    return jax.random.normal(key, shape, dtype=dtype) * std + mean
+
+
+def standard_normal(shape, dtype=None, name=None):
+    dt = _dtypes.convert_dtype(dtype) or _dtypes.float32
+    return _normal(_rng.next_key(), shape=_shape(shape), dtype=dt, mean=0.0, std=1.0)
+
+
+randn = standard_normal
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        shp = _shape(shape) if shape is not None else tuple(np.broadcast_shapes(
+            tuple(raw(mean).shape) if isinstance(mean, Tensor) else (),
+            tuple(raw(std).shape) if isinstance(std, Tensor) else (),
+        ))
+        return _normal_t(mean, std, _rng.next_key(), shape=shp)
+    return _normal(_rng.next_key(), shape=_shape(shape if shape is not None else [1]), dtype=_dtypes.float32, mean=float(mean), std=float(std))
+
+
+@defop(name="normal_tensor_op")
+def _normal_t(mean, std, key, shape):
+    return jax.random.normal(key, shape, dtype=jnp.float32) * std + mean
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    dt = _dtypes.convert_dtype(dtype) or _dtypes.float32
+    key = jax.random.key(seed) if seed else _rng.next_key()
+    return _normal(key, shape=_shape(shape), dtype=dt, mean=float(mean), std=float(std))
+
+
+@defop(name="randint_op")
+def _randint(key, shape, low, high, dtype):
+    return jax.random.randint(key, shape, low, high, dtype=dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dtypes.convert_dtype(dtype) or _dtypes.int64
+    return _randint(_rng.next_key(), shape=_shape(shape), low=int(raw(low)), high=int(raw(high)), dtype=dt)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = dtype or _dtypes.dtype_name(raw(x).dtype)
+    return randint(low, high, tuple(raw(x).shape), dt)
+
+
+@defop(name="randperm_op")
+def _randperm(key, n, dtype):
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return _randperm(_rng.next_key(), n=int(n), dtype=_dtypes.convert_dtype(dtype))
+
+
+@defop(name="bernoulli_op")
+def _bernoulli(x, key):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def bernoulli(x, name=None):
+    return _bernoulli(x, _rng.next_key())
+
+
+@defop(name="poisson_op")
+def _poisson(x, key):
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+def poisson(x, name=None):
+    return _poisson(x, _rng.next_key())
+
+
+@defop(name="multinomial_op")
+def _multinomial(x, key, num_samples, replacement):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        batch = x.shape[:-1]
+        out = jax.random.categorical(key, logits, axis=-1, shape=(num_samples,) + batch)
+        return jnp.moveaxis(out, 0, -1) if batch else out
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    out = _multinomial(x, _rng.next_key(), num_samples=int(num_samples), replacement=bool(replacement))
+    out = out.astype("int64")
+    if num_samples == 1 and not replacement:
+        return out
+    return out
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    out = uniform(tuple(raw(x).shape), _dtypes.dtype_name(raw(x).dtype), min, max)
+    return x._rebind(out._value)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = gaussian(tuple(raw(x).shape), mean, std, dtype=_dtypes.dtype_name(raw(x).dtype))
+    return x._rebind(out._value)
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _rng.next_key()
+    u = jax.random.uniform(key, tuple(raw(x).shape), dtype=raw(x).dtype)
+    return x._rebind(-jnp.log1p(-u) / lam)
+
+
+def shuffle_(x, name=None):
+    key = _rng.next_key()
+    return x._rebind(jax.random.permutation(key, raw(x), axis=0))

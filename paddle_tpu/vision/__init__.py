@@ -1,0 +1,2 @@
+"""paddle.vision parity: models, transforms, datasets."""
+from . import datasets, models, transforms  # noqa: F401

@@ -1,0 +1,173 @@
+"""Vision datasets (paddle.vision.datasets parity).
+
+Reference: ``python/paddle/vision/datasets/`` — MNIST/Cifar/DatasetFolder etc.
+Offline build: downloads are unavailable, so file-backed datasets load from a
+user-provided path; ``FakeData``/synthetic generators cover tests and
+benchmarks (the reference's tests do the same with small random data).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification data (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000, transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = rng.randint(0, self.num_classes)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference: paddle.vision.datasets.MNIST)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=False, backend=None):
+        if download and (image_path is None or not os.path.exists(image_path or "")):
+            raise RuntimeError("offline environment: provide image_path/label_path")
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    def _load(self, image_path, label_path):
+        with (gzip.open(image_path, "rb") if image_path.endswith(".gz") else open(image_path, "rb")) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with (gzip.open(label_path, "rb") if label_path.endswith(".gz") else open(label_path, "rb")) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError("offline environment: provide data_file (cifar tar.gz)")
+        self.transform = transform
+        self.data, self.labels = self._load(data_file, mode)
+
+    def _load(self, data_file, mode):
+        datas, labels = [], []
+        with tarfile.open(data_file) as tf:
+            names = [n for n in tf.getnames() if ("data_batch" in n if mode == "train" else "test_batch" in n)]
+            for n in sorted(names):
+                d = pickle.load(tf.extractfile(n), encoding="bytes")
+                datas.append(d[b"data"])
+                labels.extend(d.get(b"labels", d.get(b"fine_labels", [])))
+        data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        return data, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """ImageNet-style folder dataset (reference: DatasetFolder). Images load
+    via numpy (.npy) or PIL if available."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(d)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(tuple(extensions)):
+                        self.samples.append((os.path.join(dirpath, fn), self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            with open(path, "rb") as f:
+                return np.asarray(Image.open(f).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL unavailable; use .npy images") from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append(os.path.join(dirpath, fn))
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,97 @@
+"""Vision Transformer (paddle.vision ViT-family parity).
+
+Reference family: ViT models in paddle.vision / PaddleClas. Attention rides
+the same flash-attention path as the NLP stack.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...nn import Dropout, GELU, LayerNorm, Linear, Sequential
+from ...nn.layer import Layer, LayerList
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.transformer import MultiHeadAttention
+
+
+class PatchEmbed(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_chans, embed_dim, kernel_size=patch_size, stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)  # [B, E, H', W']
+        b, e = x.shape[0], x.shape[1]
+        x = x.reshape([b, e, -1]).transpose([0, 2, 1])  # [B, N, E]
+        return x
+
+
+class MLP(Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = Linear(dim, hidden)
+        self.act = GELU()
+        self.fc2 = Linear(hidden, dim)
+        self.drop = Dropout(drop)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class Block(Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, drop=0.0, attn_drop=0.0):
+        super().__init__()
+        self.norm1 = LayerNorm(dim, 1e-6)
+        self.attn = MultiHeadAttention(dim, num_heads, attn_drop)
+        self.norm2 = LayerNorm(dim, 1e-6)
+        self.mlp = MLP(dim, int(dim * mlp_ratio), drop)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, num_classes=1000,
+                 embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0, drop_rate=0.0,
+                 attn_drop_rate=0.0, **kwargs):
+        super().__init__()
+        self.num_classes = num_classes
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter((1, 1, embed_dim))
+        self.pos_embed = self.create_parameter((1, n + 1, embed_dim))
+        self.pos_drop = Dropout(drop_rate)
+        self.blocks = LayerList([
+            Block(embed_dim, num_heads, mlp_ratio, drop_rate, attn_drop_rate) for _ in range(depth)
+        ])
+        self.norm = LayerNorm(embed_dim, 1e-6)
+        self.head = Linear(embed_dim, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = self.cls_token.expand([b, 1, self.cls_token.shape[2]])
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        cls_out = x[:, 0]
+        return self.head(cls_out) if self.head is not None else cls_out
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled (offline)")
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_l_16(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled (offline)")
+    return VisionTransformer(embed_dim=1024, depth=24, num_heads=16, **kwargs)

@@ -1,0 +1,307 @@
+"""Image transforms (paddle.vision.transforms parity).
+
+Reference: ``python/paddle/vision/transforms/`` (SURVEY.md §2.2 "Vision").
+Host-side numpy ops (run in DataLoader workers), CHW/HWC aware.
+"""
+from __future__ import annotations
+
+import numbers
+import random as _pyrandom
+from typing import List, Sequence
+
+import numpy as np
+
+from ...framework.core import Tensor
+from ...framework.op import raw
+
+
+def _to_np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(raw(img))
+    return np.asarray(img)
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        if a.dtype == np.uint8:
+            a = a.astype(np.float32) / 255.0
+        else:
+            a = a.astype(np.float32)
+        if self.data_format == "CHW":
+            a = np.transpose(a, (2, 0, 1))
+        return Tensor(a)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        out = (a - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        a = _to_np(img)  # HWC
+        h, w = a.shape[:2]
+        if isinstance(self.size, int):
+            if h < w:
+                nh, nw = self.size, int(w * self.size / h)
+            else:
+                nh, nw = int(h * self.size / w), self.size
+        else:
+            nh, nw = self.size
+        ys = (np.arange(nh) + 0.5) * h / nh - 0.5
+        xs = (np.arange(nw) + 0.5) * w / nw - 0.5
+        ys = np.clip(ys, 0, h - 1)
+        xs = np.clip(xs, 0, w - 1)
+        if self.interpolation == "nearest":
+            out = a[np.round(ys).astype(int)[:, None], np.round(xs).astype(int)[None, :]]
+        else:
+            y0 = np.floor(ys).astype(int)
+            x0 = np.floor(xs).astype(int)
+            y1 = np.minimum(y0 + 1, h - 1)
+            x1 = np.minimum(x0 + 1, w - 1)
+            wy = (ys - y0)[:, None, None] if a.ndim == 3 else (ys - y0)[:, None]
+            wx = (xs - x0)[None, :, None] if a.ndim == 3 else (xs - x0)[None, :]
+            f = a.astype(np.float32)
+            out = (
+                f[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+                + f[y1[:, None], x0[None, :]] * wy * (1 - wx)
+                + f[y0[:, None], x1[None, :]] * (1 - wy) * wx
+                + f[y1[:, None], x1[None, :]] * wy * wx
+            )
+            if a.dtype == np.uint8:
+                out = np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return a[i : i + th, j : j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            a = np.pad(a, ((p[1], p[3]), (p[0], p[2])) + (((0, 0),) if a.ndim == 3 else ()))
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = _pyrandom.randint(0, max(h - th, 0))
+        j = _pyrandom.randint(0, max(w - tw, 0))
+        return a[i : i + th, j : j + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3), interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * _pyrandom.uniform(*self.scale)
+            ar = np.exp(_pyrandom.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = _pyrandom.randint(0, h - th)
+                j = _pyrandom.randint(0, w - tw)
+                return self._resize(a[i : i + th, j : j + tw])
+        return self._resize(CenterCrop(min(h, w)).__call__(a))
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        if _pyrandom.random() < self.prob:
+            return a[:, ::-1].copy()
+        return a
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        if _pyrandom.random() < self.prob:
+            return a[::-1].copy()
+        return a
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        p = self.padding
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        cfg = ((p[1], p[3]), (p[0], p[2])) + (((0, 0),) if a.ndim == 3 else ())
+        return np.pad(a, cfg, constant_values=self.fill)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        if a.ndim == 2:
+            a = a[..., None]
+        return np.transpose(a, self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        f = 1 + _pyrandom.uniform(-self.value, self.value)
+        return np.clip(a * f, 0, 255).astype(np.uint8)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        if self.brightness:
+            a = a * (1 + _pyrandom.uniform(-self.brightness, self.brightness))
+        if self.contrast:
+            mean = a.mean()
+            a = (a - mean) * (1 + _pyrandom.uniform(-self.contrast, self.contrast)) + mean
+        return np.clip(a, 0, 255).astype(np.uint8)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        k = _pyrandom.randint(0, 3)
+        return np.rot90(a, k).copy()  # coarse rotation (90° steps)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        g = a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114
+        out = np.stack([g] * self.n, -1)
+        return out.astype(np.uint8)
+
+
+# functional access (paddle.vision.transforms.functional subset)
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _to_np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_np(img)[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return _to_np(img)[top : top + height, left : left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)

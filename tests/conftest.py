@@ -1,0 +1,22 @@
+"""Test fixture: 8 virtual CPU devices, axon TPU plugin disabled.
+
+Mirrors the reference's hardware-free distributed test strategy
+(SURVEY.md §4): where Paddle simulates a cluster with localhost
+subprocesses + Gloo, we simulate an 8-chip slice with
+--xla_force_host_platform_device_count on the CPU PJRT backend.
+"""
+import os
+
+# Must happen before any jax backend initialization.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

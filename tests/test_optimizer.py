@@ -1,0 +1,142 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Adam, AdamW, ClipGradByGlobalNorm, Lamb, Momentum, RMSProp, lr
+
+rng = np.random.RandomState(0)
+
+
+def _quad_problem(opt_cls, steps=60, **kw):
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = nn.layer.Parameter(paddle.to_tensor(np.zeros(3, np.float32))._value)
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+def test_sgd_converges():
+    w, tgt = _quad_problem(SGD, learning_rate=0.1, steps=100)
+    np.testing.assert_allclose(w, tgt, atol=1e-2)
+
+
+def test_momentum_converges():
+    w, tgt = _quad_problem(Momentum, learning_rate=0.05, momentum=0.9, steps=120)
+    np.testing.assert_allclose(w, tgt, atol=5e-2)
+
+
+def test_adam_converges():
+    w, tgt = _quad_problem(Adam, learning_rate=0.3, steps=150)
+    np.testing.assert_allclose(w, tgt, atol=5e-2)
+
+
+def test_adamw_decay():
+    # with pure decay and zero grads, weights shrink
+    w = nn.layer.Parameter(paddle.to_tensor(np.ones(3, np.float32))._value)
+    opt = AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+    w.grad = paddle.to_tensor(np.zeros(3, np.float32))
+    opt.step()
+    assert (w.numpy() < 1.0).all()
+
+
+def test_adam_matches_manual():
+    a = rng.rand(4).astype(np.float32)
+    g = rng.rand(4).astype(np.float32)
+    w = nn.layer.Parameter(paddle.to_tensor(a)._value)
+    opt = Adam(learning_rate=0.01, parameters=[w])
+    w.grad = paddle.to_tensor(g)
+    opt.step()
+    # manual first adam step
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = a - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), ref, rtol=1e-5)
+
+
+def test_global_norm_clip():
+    w = nn.layer.Parameter(paddle.to_tensor(np.zeros(4, np.float32))._value)
+    opt = SGD(learning_rate=1.0, parameters=[w], grad_clip=ClipGradByGlobalNorm(1.0))
+    w.grad = paddle.to_tensor(np.full(4, 100.0, np.float32))
+    opt.step()
+    assert np.linalg.norm(w.numpy()) <= 1.0 + 1e-5
+
+
+def test_lr_schedulers():
+    s = lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    c = lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(c() - 1.0) < 1e-6
+    for _ in range(10):
+        c.step()
+    assert c() < 1e-6
+
+    w = lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    assert w() < 0.1
+    for _ in range(6):
+        w.step()
+    np.testing.assert_allclose(w(), 0.1, rtol=1e-6)
+
+
+def test_scheduler_with_optimizer():
+    sched = lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    w = nn.layer.Parameter(paddle.to_tensor(np.zeros(2, np.float32))._value)
+    opt = SGD(learning_rate=sched, parameters=[w])
+    assert opt.get_lr() == 0.1
+    sched.step()
+    assert abs(opt.get_lr() - 0.01) < 1e-9
+
+
+def test_optimizer_state_dict():
+    w = nn.layer.Parameter(paddle.to_tensor(np.ones(3, np.float32))._value, name="w0")
+    opt = Adam(learning_rate=0.01, parameters=[w])
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    opt.step()
+    sd = opt.state_dict()
+    assert any("moment1" in k for k in sd)
+    opt2 = Adam(learning_rate=0.01, parameters=[w])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        opt2._accumulators[0]["moment1"], opt._accumulators[0]["moment1"]
+    )
+
+
+def test_amp_autocast_bf16():
+    import paddle_tpu.amp as amp
+
+    x = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        z = paddle.matmul(x, y)
+        assert z.dtype == paddle.bfloat16
+        s = paddle.exp(x)  # black list -> stays fp32
+        assert s.dtype == paddle.float32
+    z2 = paddle.matmul(x, y)
+    assert z2.dtype == paddle.float32
+
+
+def test_grad_scaler_fp16_flow():
+    import paddle_tpu.amp as amp
+
+    w = nn.layer.Parameter(paddle.to_tensor(np.ones(2, np.float32))._value)
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=2.0)
+    loss = (w * w).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 2.0, rtol=1e-5)

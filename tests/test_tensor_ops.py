@@ -1,0 +1,187 @@
+"""Op unit tests vs NumPy reference — the reference's OpTest pattern
+(SURVEY.md §4: test/legacy_test/op_test.py runs each op against NumPy and
+checks gradients numerically)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+rng = np.random.RandomState(0)
+A = rng.rand(3, 4).astype(np.float32)
+B = rng.rand(3, 4).astype(np.float32) + 0.5
+M = rng.rand(4, 5).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "pfn,nfn",
+    [
+        (paddle.add, np.add),
+        (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply),
+        (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum),
+        (paddle.minimum, np.minimum),
+        (paddle.atan2, np.arctan2),
+    ],
+)
+def test_binary_ops(pfn, nfn):
+    np.testing.assert_allclose(pfn(t(A), t(B)).numpy(), nfn(A, B), rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "pfn,nfn",
+    [
+        (paddle.sqrt, np.sqrt),
+        (paddle.exp, np.exp),
+        (paddle.log, np.log),
+        (paddle.abs, np.abs),
+        (paddle.sin, np.sin),
+        (paddle.cos, np.cos),
+        (paddle.tanh, np.tanh),
+        (paddle.floor, np.floor),
+        (paddle.ceil, np.ceil),
+        (paddle.square, np.square),
+    ],
+)
+def test_unary_ops(pfn, nfn):
+    np.testing.assert_allclose(pfn(t(B)).numpy(), nfn(B), rtol=1e-5, atol=1e-6)
+
+
+def test_matmul():
+    np.testing.assert_allclose(paddle.matmul(t(A), t(M)).numpy(), A @ M, rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.matmul(t(A), t(A), transpose_y=True).numpy(), A @ A.T, rtol=1e-5
+    )
+
+
+def test_reductions():
+    np.testing.assert_allclose(paddle.sum(t(A)).numpy(), A.sum(), rtol=1e-6)
+    np.testing.assert_allclose(paddle.mean(t(A), axis=1).numpy(), A.mean(1), rtol=1e-6)
+    np.testing.assert_allclose(paddle.max(t(A), axis=0).numpy(), A.max(0))
+    np.testing.assert_allclose(paddle.min(t(A), axis=0, keepdim=True).numpy(), A.min(0, keepdims=True))
+    np.testing.assert_allclose(paddle.prod(t(A), axis=1).numpy(), A.prod(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t(A)).numpy(), np.log(np.exp(A).sum()), rtol=1e-5)
+    np.testing.assert_allclose(paddle.std(t(A)).numpy(), A.std(ddof=1), rtol=1e-5)
+
+
+def test_manipulation():
+    x = t(A)
+    assert paddle.reshape(x, [4, 3]).shape == [4, 3]
+    assert paddle.reshape(x, [-1]).shape == [12]
+    assert paddle.transpose(x, [1, 0]).shape == [4, 3]
+    assert paddle.unsqueeze(x, 0).shape == [1, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [3, 4]
+    assert paddle.flatten(x).shape == [12]
+    c = paddle.concat([x, x], axis=0)
+    assert c.shape == [6, 4]
+    s = paddle.split(c, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == [3, 4]
+    st = paddle.stack([x, x], axis=0)
+    assert st.shape == [2, 3, 4]
+    np.testing.assert_allclose(paddle.flip(x, axis=0).numpy(), A[::-1])
+    np.testing.assert_allclose(paddle.tile(x, [2, 1]).numpy(), np.tile(A, (2, 1)))
+
+
+def test_indexing():
+    x = t(A)
+    np.testing.assert_allclose(x[0].numpy(), A[0])
+    np.testing.assert_allclose(x[1:, 2].numpy(), A[1:, 2])
+    np.testing.assert_allclose(x[:, ::2].numpy(), A[:, ::2])
+    idx = paddle.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(paddle.gather(x, idx, axis=1).numpy(), A[:, [0, 2]])
+    y = t(A.copy())
+    y[0, 0] = 99.0
+    assert y[0, 0].numpy() == np.float32(99.0)
+
+
+def test_sort_topk_argmax():
+    x = t(A)
+    np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(), np.sort(A, 1))
+    np.testing.assert_allclose(paddle.argsort(x, axis=1).numpy(), np.argsort(A, 1))
+    vals, idx = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), -np.sort(-A, 1)[:, :2], rtol=1e-6)
+    np.testing.assert_allclose(paddle.argmax(x, axis=1).numpy(), A.argmax(1))
+
+
+def test_where_logic():
+    c = A > 0.5
+    np.testing.assert_allclose(paddle.where(t(c), t(A), t(B)).numpy(), np.where(c, A, B))
+    assert bool(paddle.allclose(t(A), t(A)))
+    assert not bool(paddle.allclose(t(A), t(B)))
+    np.testing.assert_array_equal((t(A) > t(B)).numpy(), A > B)
+
+
+def test_linalg():
+    sq = A @ A.T + np.eye(3, dtype=np.float32) * 3
+    np.testing.assert_allclose(paddle.inverse(t(sq)).numpy(), np.linalg.inv(sq), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.det(t(sq)).numpy(), np.linalg.det(sq), rtol=1e-4)
+    np.testing.assert_allclose(paddle.norm(t(A)).numpy(), np.linalg.norm(A), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.cholesky(t(sq)).numpy(), np.linalg.cholesky(sq), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_einsum():
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", t(A), t(M)).numpy(), np.einsum("ij,jk->ik", A, M), rtol=1e-5
+    )
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_allclose(paddle.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+    np.testing.assert_allclose(paddle.full([2, 2], 7.0).numpy(), np.full((2, 2), 7.0))
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+    np.testing.assert_allclose(paddle.tril(t(A)).numpy(), np.tril(A))
+
+
+def test_cumulative():
+    np.testing.assert_allclose(paddle.cumsum(t(A), axis=1).numpy(), A.cumsum(1), rtol=1e-6)
+    np.testing.assert_allclose(paddle.cumprod(t(A), dim=0).numpy(), A.cumprod(0), rtol=1e-6)
+
+
+def test_cast_astype():
+    x = t(A)
+    assert str(x.astype("int32").numpy().dtype) == "int32"
+    assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+
+def test_random_shapes_and_determinism():
+    paddle.seed(42)
+    a = paddle.rand([3, 3])
+    paddle.seed(42)
+    b = paddle.rand([3, 3])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    assert paddle.randn([2, 5]).shape == [2, 5]
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = paddle.randperm(10).numpy()
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_dunder_math():
+    x, y = t(A), t(B)
+    np.testing.assert_allclose((x + y).numpy(), A + B, rtol=1e-6)
+    np.testing.assert_allclose((x - 2.0).numpy(), A - 2.0, rtol=1e-6)
+    np.testing.assert_allclose((2.0 * x).numpy(), 2.0 * A, rtol=1e-6)
+    np.testing.assert_allclose((x / y).numpy(), A / B, rtol=1e-6)
+    np.testing.assert_allclose((x @ t(M)).numpy(), A @ M, rtol=1e-5)
+    np.testing.assert_allclose((-x).numpy(), -A)
+    np.testing.assert_allclose((x**2).numpy(), A**2, rtol=1e-6)
+
+
+def test_data_dependent_eager_only():
+    x = t(np.array([1.0, 0.0, 2.0, 0.0], np.float32))
+    nz = paddle.nonzero(x)
+    np.testing.assert_array_equal(nz.numpy().ravel(), [0, 2])
+    m = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(m.numpy(), [1.0, 2.0])
+    u = paddle.unique(paddle.to_tensor(np.array([3, 1, 1, 2])))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
