@@ -49,6 +49,7 @@ from .tensor import linalg  # noqa: F401,E402  (paddle.linalg namespace)
 from . import amp  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from . import device  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import metric  # noqa: E402,F401

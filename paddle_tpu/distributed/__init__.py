@@ -1,0 +1,95 @@
+"""paddle_tpu.distributed — the Fleet-parity distributed stack, TPU-native.
+
+Layer map (SURVEY.md §2.3): collectives are XLA collectives over ICI/DCN
+named by mesh axes; groups are mesh slices; hybrid parallelism is one named
+mesh [dp, pp, sharding, sep, mp]; ZeRO is placement; pipeline is a compiled
+collective-permute schedule; auto-parallel is the native execution model.
+"""
+from __future__ import annotations
+
+from . import mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    build_mesh,
+    get_global_mesh,
+    global_mesh,
+    set_global_mesh,
+    sharding_constraint,
+)
+from .env import (  # noqa: F401
+    Group,
+    ParallelEnv,
+    destroy_process_group,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    new_group,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    irecv,
+    isend,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+    wait,
+)
+from .parallel import DataParallel, spawn  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding as sharding_api  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_tensor,
+)
+from .fleet.utils.recompute_helper import recompute  # noqa: F401
+
+
+def get_group(gid=None):
+    from .env import _default_group, _groups
+
+    if gid is None:
+        return _default_group
+    for g in _groups:
+        if g.id == gid:
+            return g
+    return None
+
+
+# `shard_map` convenience re-export: the explicit-SPMD escape hatch
+# (reference analogue: writing custom collective ops).
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+    import jax
+
+    from .mesh import require_global_mesh
+
+    return jax.shard_map(
+        f,
+        mesh=mesh or require_global_mesh(),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **kwargs,
+    )
+
+
+QueueDataset = None  # PS-mode datasets: deliberate non-goal (SURVEY.md §2.3 PS)

@@ -1,0 +1,171 @@
+"""Auto-parallel API (paddle.distributed auto_parallel parity).
+
+Reference capability (SURVEY.md §2.3 "Auto-parallel"): `DistAttr`
+(process_mesh + dims_mapping), `shard_tensor`, sharding completion/
+partitioner/reshard passes over a static program
+(`python/paddle/distributed/auto_parallel/`).
+
+TPU-native design: this IS the native execution model — `shard_tensor` is a
+device_put with a NamedSharding; "completion" (propagating shardings through
+the graph) and "partitioner/reshard" (inserting collectives) are what GSPMD
+does inside XLA for every jit'ed program. The API is therefore thin and
+total: every op in the framework is auto-parallel by construction.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ...framework.core import Tensor
+from ...framework.op import raw
+from .. import mesh as _mesh
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD tracks partial values internally;
+    at the API boundary we reduce eagerly (a psum via resharding)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh parity — wraps jax.sharding.Mesh."""
+
+    def __init__(
+        self,
+        mesh: Union[Sequence, np.ndarray, None] = None,
+        dim_names: Optional[Sequence[str]] = None,
+        shape: Optional[Sequence[int]] = None,
+        process_ids: Optional[Sequence[int]] = None,
+    ):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids or range(len(jax.devices()))).reshape(
+                shape or (-1,)
+            )
+        self._ids = arr
+        self.shape = list(arr.shape)
+        self.ndim = arr.ndim
+        self.dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
+        self.process_ids = [int(i) for i in arr.ravel()]
+        devs = np.asarray(jax.devices(), dtype=object)[arr.ravel()].reshape(arr.shape)
+        self.jax_mesh = Mesh(devs, tuple(self.dim_names))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self.process_ids == other.process_ids
+            and self.shape == other.shape
+        )
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _placements_to_spec(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int) -> P:
+    entries: List = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[axis_idx]
+            if entries[pl.dim] is None:
+                entries[pl.dim] = name
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (name,)
+            else:
+                entries[pl.dim] = (entries[pl.dim], name)
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement], dtype=None, **kwargs):
+    """Place a tensor on a process mesh (paddle.distributed.shard_tensor)."""
+    v = raw(data) if isinstance(data, Tensor) else jax.numpy.asarray(data)
+    spec = _placements_to_spec(mesh, placements, v.ndim)
+    out = jax.device_put(v, NamedSharding(mesh.jax_mesh, spec))
+    t = Tensor(out, stop_gradient=getattr(data, "stop_gradient", True))
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Move a tensor to a new placement (reference: auto_parallel reshard —
+    the comm-inserting pass; here a single resharding device_put / constraint)."""
+    v = raw(tensor)
+    spec = _placements_to_spec(mesh, placements, v.ndim)
+    from ...framework.op import defop
+
+    if any(isinstance(p, Partial) for p in placements):
+        raise NotImplementedError("reshard to Partial is not supported")
+    from ..mesh import sharding_constraint
+    from ...framework.core import is_tracer_value
+
+    if is_tracer_value(v):
+        out = sharding_constraint(v, spec, mesh.jax_mesh)
+    else:
+        out = jax.device_put(v, NamedSharding(mesh.jax_mesh, spec))
+    t = Tensor(out, stop_gradient=tensor.stop_gradient if isinstance(tensor, Tensor) else True)
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Apply a user shard_fn(name, layer, mesh) over sublayers (paddle parity)."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    m = _mesh.get_global_mesh()
+    if m is None:
+        return None
+    pm = ProcessMesh.__new__(ProcessMesh)
+    pm.jax_mesh = m
+    pm.shape = list(m.devices.shape)
+    pm.ndim = m.devices.ndim
+    pm.dim_names = list(m.axis_names)
+    pm.process_ids = [d.id for d in m.devices.ravel()]
+    pm._ids = np.asarray(pm.process_ids).reshape(pm.shape)
+    return pm
+
+
+def set_mesh(mesh: ProcessMesh):
+    _mesh.set_global_mesh(mesh.jax_mesh)

@@ -1,0 +1,305 @@
+"""Collective communication API (paddle.distributed.* parity).
+
+Reference capability (SURVEY.md §2.3 "Collective ops", "Comm APIs"): per-op
+NCCL collectives — `c_allreduce_sum`, `c_allgather`, `c_broadcast`,
+`c_reducescatter`, `alltoall`, `send_v2/recv_v2` — issued eagerly on comm
+streams (`paddle/fluid/operators/collective/`, ProcessGroupNCCL).
+
+TPU-native design — two execution contexts, one API:
+
+* **Traced** (inside `shard_map`/`pjit`-traced code, where values carry named
+  mesh axes): each call lowers to the XLA collective — `lax.psum`,
+  `lax.all_gather`, `lax.psum_scatter`, `lax.all_to_all`, `lax.ppermute` —
+  scheduled by XLA over ICI/DCN. This is the hot path; it is how the parallel
+  layers and pipeline schedules are built.
+
+* **Eager** (plain arrays under the single-controller SPMD runtime): there is
+  no per-rank divergent state — an array is *global*, possibly sharded over
+  mesh devices. Eager collectives are therefore *reshardings / global
+  reductions of the global view*, with per-rank semantics derived from the
+  convention that each device holds equal (replicated) or sharded slices:
+  all_reduce(SUM) on a replicated array multiplies by nranks (every rank
+  contributed an equal tensor); all_gather stacks the per-device view;
+  reduce_scatter shards; broadcast is the identity (global arrays are already
+  consistent). These match what the NCCL ops would produce rank-by-rank under
+  the same data placement.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import Tensor, is_tracer_value
+from ..framework.op import raw
+from .env import Group, _resolve_group
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _axes(group: Group):
+    names = group.axis_names
+    return names[0] if len(names) == 1 else names
+
+
+def _in_trace(v) -> bool:
+    return is_tracer_value(v)
+
+
+def _wrap_like(x, out):
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+# ---------------------------------------------------------------- all_reduce
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    g = _resolve_group(group)
+    v = raw(tensor)
+    if _in_trace(v):
+        ax = _axes(g)
+        if op == ReduceOp.SUM:
+            out = lax.psum(v, ax)
+        elif op == ReduceOp.MAX:
+            out = lax.pmax(v, ax)
+        elif op == ReduceOp.MIN:
+            out = lax.pmin(v, ax)
+        elif op == ReduceOp.AVG:
+            out = lax.pmean(v, ax)
+        else:
+            out = lax.psum(jnp.log(jnp.abs(v)), ax)  # PROD via log-sum-exp sign
+            sign = lax.psum(jnp.where(v < 0, 1, 0), ax)
+            out = jnp.exp(out) * jnp.where(sign % 2 == 1, -1.0, 1.0)
+    else:
+        n = g.nranks
+        if op == ReduceOp.SUM:
+            out = v * n
+        elif op == ReduceOp.AVG:
+            out = v
+        elif op in (ReduceOp.MAX, ReduceOp.MIN):
+            out = v
+        else:
+            out = v**n
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On TPU a rooted reduce is an all_reduce (result is consistent globally).
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+# ---------------------------------------------------------------- all_gather
+def all_gather(tensor_list: Optional[List], tensor=None, group=None, sync_op=True, axis=0):
+    """paddle.distributed.all_gather parity.
+
+    Also usable functionally: `out = all_gather(None, x, group)` returns the
+    stacked [nranks, ...] result (traced) / list (eager).
+    """
+    g = _resolve_group(group if not isinstance(tensor_list, Group) else tensor_list)
+    v = raw(tensor)
+    if _in_trace(v):
+        out = lax.all_gather(v, _axes(g), axis=0, tiled=False)
+        if tensor_list is not None and isinstance(tensor_list, list):
+            for i in range(g.nranks):
+                tensor_list.append(_wrap_like(tensor, out[i]))
+            return tensor_list
+        return _wrap_like(tensor, out)
+    # eager: every device holds the replicated global value
+    outs = [_wrap_like(tensor, jnp.asarray(v)) for _ in range(g.nranks)]
+    if tensor_list is not None and isinstance(tensor_list, list):
+        tensor_list.extend(outs)
+        return tensor_list
+    return outs
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _resolve_group(group)
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+# ----------------------------------------------------------------- broadcast
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    v = raw(tensor)
+    if _in_trace(v):
+        # select rank src's value on all ranks of the group axis
+        ax = _axes(g)
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        gathered = lax.all_gather(v, ax, axis=0, tiled=False)
+        out = gathered[src_local]
+    else:
+        out = jnp.asarray(v)  # global arrays are already consistent
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+# ------------------------------------------------------------- reduce_scatter
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce across the group then scatter shards along dim 0.
+
+    Functional traced form: `out = reduce_scatter(x, group=g)` with x of
+    shape [n*k, ...] returns this rank's [k, ...] reduced shard.
+    """
+    g = _resolve_group(group)
+    if tensor_list is not None:
+        v = jnp.concatenate([raw(t) for t in tensor_list], axis=0)
+    else:
+        v = raw(tensor)
+    if _in_trace(v):
+        out = lax.psum_scatter(v, _axes(g), scatter_dimension=0, tiled=True)
+    else:
+        n = g.nranks
+        idx = max(g.rank, 0)
+        shard = v.shape[0] // n
+        out = v[idx * shard : (idx + 1) * shard] * n
+    if tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return _wrap_like(tensor, out)
+
+
+# -------------------------------------------------------------------- scatter
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if tensor_list is not None:
+        v = jnp.stack([raw(t) for t in tensor_list], axis=0)
+    else:
+        v = raw(tensor)
+    if _in_trace(v):
+        ax = _axes(g)
+        idx = lax.axis_index(ax)
+        out = lax.dynamic_index_in_dim(v, idx, axis=0, keepdims=False)
+        out = broadcast(out, src=src, group=g)
+        out = raw(out)
+    else:
+        idx = max(g.rank, 0)
+        out = v[idx]
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+# ------------------------------------------------------------------- alltoall
+def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    """paddle.distributed.alltoall parity.
+
+    Traced functional form: `out = alltoall(x, group=g)` where x's dim 0 is
+    [nranks * k] → lax.all_to_all splitting dim 0 and concatenating dim 0.
+    """
+    g = _resolve_group(group)
+    if in_tensor_list is not None and isinstance(in_tensor_list, (list, tuple)):
+        v = jnp.concatenate([raw(t)[None] for t in in_tensor_list], axis=0)
+        vflat = v.reshape((-1,) + v.shape[2:])
+    else:
+        vflat = raw(out_tensor_list if in_tensor_list is None else in_tensor_list)
+    if _in_trace(vflat):
+        out = lax.all_to_all(
+            vflat.reshape((g.nranks, -1) + vflat.shape[1:]),
+            _axes(g),
+            split_axis=0,
+            concat_axis=0,
+            tiled=False,
+        )
+        out = out.reshape((-1,) + vflat.shape[1:])
+    else:
+        out = jnp.asarray(vflat)
+    if in_tensor_list is not None and isinstance(out_tensor_list, list):
+        chunks = out.reshape((g.nranks, -1) + out.shape[1:])
+        for i in range(g.nranks):
+            out_tensor_list.append(Tensor(chunks[i, 0]))
+        return out_tensor_list
+    return _wrap_like(out_tensor_list, out)
+
+
+def alltoall_single(
+    out_tensor, in_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True
+):
+    g = _resolve_group(group)
+    v = raw(in_tensor if in_tensor is not None else out_tensor)
+    if _in_trace(v):
+        out = lax.all_to_all(
+            v.reshape((g.nranks, -1) + v.shape[1:]),
+            _axes(g),
+            split_axis=0,
+            concat_axis=0,
+        ).reshape(v.shape)
+    else:
+        out = jnp.asarray(v)
+    if in_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._rebind(out)
+        return out_tensor
+    return _wrap_like(out_tensor, out)
+
+
+# ------------------------------------------------------------------ p2p & misc
+def ppermute(tensor, perm: Sequence, group=None):
+    """Collective-permute (the TPU replacement for NCCL send/recv pairs —
+    SURVEY.md §2.3 PP row: `send_v2/recv_v2` → `lax.ppermute` over ICI)."""
+    g = _resolve_group(group)
+    v = raw(tensor)
+    if not _in_trace(v):
+        raise RuntimeError(
+            "ppermute/send/recv are compiled collectives on TPU: call inside "
+            "a shard_map-traced region (see paddle_tpu.distributed.shard_map)"
+        )
+    return _wrap_like(tensor, lax.ppermute(v, _axes(g), list(perm)))
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    n = g.nranks
+    # A lone send in SPMD is expressed as the shifted permutation ring.
+    return ppermute(tensor, [(i, dst) for i in range(n)], group=g)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    n = g.nranks
+    return ppermute(tensor, [(src, i) for i in range(n)], group=g)
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    g = _resolve_group(group)
+    # Eager barrier: synchronize all outstanding device work.
+    try:
+        jax.block_until_ready(jax.device_put(jnp.zeros((), jnp.float32)))
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = raw(tensor)
+    if not _in_trace(v):
+        jax.block_until_ready(v)
+    return tensor
+
+
+# stream.* namespace parity (paddle.distributed.stream)
+class stream:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    reduce = staticmethod(reduce)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,190 @@
+"""Parallel environment + process groups.
+
+Reference capabilities (SURVEY.md §2.3 "Process bootstrap & launch",
+"Rendezvous / store", "ProcessGroup / comm backend"):
+  * `paddle.distributed.init_parallel_env` — TCPStore rendezvous + default
+    ProcessGroupNCCL creation (`python/paddle/distributed/parallel.py`).
+  * `paddle.distributed.new_group(ranks)` — per-subgroup NCCL communicator.
+  * `ParallelEnv` — rank/world_size/device id from launcher env vars.
+
+TPU-native design: rendezvous is JAX's built-in coordination service
+(`jax.distributed.initialize` — one process per *host*, devices discovered
+via PJRT). A "Group" is not a communicator but a named slice of the device
+mesh; collectives on a group compile to XLA collectives with the group's
+`axis_name` (see collective.py). In the single-controller SPMD world every
+device is addressable from this process, so "rank" has two readings:
+`process_index` (host rank — what multi-host launch sees) and device index
+(the reference's per-GPU rank). We expose the device reading for API parity,
+since the reference maps one rank per accelerator.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from . import mesh as _mesh
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity."""
+
+    def __init__(self):
+        self._env_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def local_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def dev_id(self) -> int:
+        return 0
+
+
+class Group:
+    """A collective group = an ordered set of devices + a mesh axis name.
+
+    `axis_names` names the mesh axes this group spans; inside a traced/
+    shard_map region collectives on the group reduce over those axes. The
+    group also carries a private 1-D mesh over its devices for eager
+    resharding-style collectives.
+    """
+
+    _next_gid = 0
+
+    def __init__(
+        self,
+        ranks: Sequence[int],
+        axis_names: Optional[Sequence[str]] = None,
+        mesh: Optional[Mesh] = None,
+        name: Optional[str] = None,
+    ):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.id = Group._next_gid
+        Group._next_gid += 1
+        self.name = name or f"group_{self.id}"
+        self.axis_names = tuple(axis_names) if axis_names else (f"_g{self.id}",)
+        if mesh is None:
+            devs = [jax.devices()[r] for r in self.ranks]
+            mesh = Mesh(np.array(devs), (self.axis_names[0],))
+        self.mesh = mesh
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        # Single-controller: this process owns all devices; report the first
+        # local one for parity with scripts that branch on group rank.
+        local = {d.id for d in jax.local_devices()}
+        for i, r in enumerate(self.ranks):
+            if r in local:
+                return i
+        return -1
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axes={self.axis_names})"
+
+
+_default_group: Optional[Group] = None
+_groups: List[Group] = []
+
+
+def is_initialized() -> bool:
+    return _default_group is not None
+
+
+def init_parallel_env() -> Group:
+    """Initialize the default (world) group over all devices.
+
+    Multi-host: if launch-set coordination env vars are present
+    (PADDLE_MASTER / JAX_COORDINATOR_ADDRESS + world size), bootstrap the
+    JAX distributed runtime first so jax.devices() spans all hosts —
+    replacing the reference's TCPStore + NCCL-unique-id exchange.
+    """
+    global _default_group
+    if _default_group is not None:
+        return _default_group
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("PADDLE_MASTER")
+    nproc = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("JAX_PROCESS_ID")
+    if coord and nproc and int(nproc) > 1 and not jax._src.distributed.global_state.client:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid or 0),
+        )
+    world = list(range(len(jax.devices())))
+    _default_group = Group(world, axis_names=None, name="world")
+    _groups.append(_default_group)
+    if _mesh.get_global_mesh() is None:
+        _mesh.set_global_mesh(_default_group.mesh)
+    return _default_group
+
+
+def get_default_group() -> Group:
+    if _default_group is None:
+        init_parallel_env()
+    return _default_group
+
+
+def _resolve_group(group: Optional[Group]) -> Group:
+    return group if group is not None else get_default_group()
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None, timeout=None) -> Group:
+    """paddle.distributed.new_group parity — a subgroup over device ids."""
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    g = Group(list(ranks))
+    _groups.append(g)
+    return g
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index() if not is_initialized() else _default_group.rank
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    return len(jax.devices())
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    if group is None or group is _default_group:
+        _default_group = None
+        _groups.clear()
+    elif group in _groups:
+        _groups.remove(group)

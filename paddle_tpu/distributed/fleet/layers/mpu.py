@@ -1,0 +1,289 @@
+"""Tensor-parallel (Megatron-style) layers — fleet.layers.mpu parity.
+
+Reference capability (SURVEY.md §2.3 "Tensor/model parallel",
+`python/paddle/distributed/fleet/layers/mpu/mp_layers.py`): each rank holds a
+weight shard and the forward/backward insert explicit NCCL collectives —
+ColumnParallelLinear (identity fwd / allreduce bwd), RowParallelLinear
+(allreduce fwd), VocabParallelEmbedding (mask + allreduce), and
+ParallelCrossEntropy (`c_softmax_with_cross_entropy`); RNG decorrelation via
+`mpu/random.py` RNGStatesTracker.
+
+TPU-native design: the layers hold the *full logical* parameter annotated
+with a PartitionSpec on the `mp` mesh axis (`weight.dist_spec`); GSPMD
+partitions the matmul and inserts the identical allreduce/allgather pattern
+at compile time. The explicit f/g conjugate-function machinery of Megatron
+disappears — `sharding_constraint` on activations is the only hand annotation
+(it is what makes XLA choose the Megatron comm pattern instead of
+re-replicating). The classes remain so Paddle hybrid-parallel model code
+ports verbatim, and so parameter shardings can be harvested by the sharded
+train step (fleet.distributed_optimizer).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....framework import rng as _rng
+from ....framework.core import Tensor
+from ....framework.op import defop, raw
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer import Layer, ParamAttr
+from ... import mesh as _mesh
+from ..topology import get_hybrid_communicate_group
+
+
+def _data_axes():
+    """Mesh axes that shard the batch dim of activations ((dp, sharding))."""
+    m = _mesh.get_global_mesh()
+    if m is None:
+        return None
+    axes = tuple(a for a in ("dp", "sharding") if a in m.shape and m.shape[a] > 1)
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _has_mp() -> bool:
+    m = _mesh.get_global_mesh()
+    return m is not None and "mp" in m.shape
+
+
+@defop(name="mp_reshard")
+def _reshard(x, spec: P):
+    return _mesh.sharding_constraint(x, spec)
+
+
+def mark_activation(x, *, last_mp: bool = False, seq_mp: bool = False, seq_dim: int = 1):
+    """Constrain an activation's layout: batch on (dp, sharding), optionally
+    hidden on mp (column-parallel output) or sequence on mp (Megatron-SP)."""
+    m = _mesh.get_global_mesh()
+    if m is None:
+        return x
+    nd = x.ndim
+    spec = [None] * nd
+    spec[0] = _data_axes()
+    if last_mp and _has_mp():
+        spec[nd - 1] = "mp"
+    if seq_mp and _has_mp():
+        spec[seq_dim] = "mp"
+    return _reshard(x, P(*spec))
+
+
+class ColumnParallelLinear(Layer):
+    """y = x @ W[:, shard] — W sharded on the output dim over `mp`."""
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        has_bias: bool = True,
+        gather_output: bool = True,
+        fuse_matmul_bias: bool = False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        hcg = get_hybrid_communicate_group()
+        self.world_size = (
+            mp_group.nranks if mp_group is not None
+            else (hcg.get_model_parallel_world_size() if hcg else 1)
+        )
+        if out_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree {self.world_size}"
+            )
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.dist_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        self.weight.split_axis = 1
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_spec = P("mp")
+            self.bias.is_distributed = True
+            self.bias.split_axis = 0
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        return mark_activation(y, last_mp=not self.gather_output)
+
+
+class RowParallelLinear(Layer):
+    """y = x[shard] @ W[shard, :] + allreduce — W sharded on the input dim."""
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        has_bias: bool = True,
+        input_is_parallel: bool = False,
+        fuse_matmul_bias: bool = False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        hcg = get_hybrid_communicate_group()
+        self.world_size = (
+            mp_group.nranks if mp_group is not None
+            else (hcg.get_model_parallel_world_size() if hcg else 1)
+        )
+        if in_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree {self.world_size}"
+            )
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+        self.weight.split_axis = 0
+        if has_bias:
+            # bias applied after the (implicit) allreduce — replicated
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_spec = P(None)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = mark_activation(x, last_mp=True)
+        y = F.linear(x, self.weight, self.bias)
+        # GSPMD: contraction over the mp-sharded dim → partial-sum → allreduce
+        return mark_activation(y)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded over the vocab dim on `mp`.
+
+    The reference masks out-of-shard ids and allreduces
+    (`c_embedding` — SURVEY.md §2.3 "Collective ops"); GSPMD derives the same
+    dynamic-slice + allreduce from the table's sharding.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        hcg = get_hybrid_communicate_group()
+        self.world_size = (
+            mp_group.nranks if mp_group is not None
+            else (hcg.get_model_parallel_world_size() if hcg else 1)
+        )
+        if num_embeddings % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"vocab {num_embeddings} not divisible by mp degree {self.world_size}"
+            )
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(std=0.02),
+        )
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+        self.weight.split_axis = 0
+
+    def forward(self, x):
+        y = F.embedding(x, self.weight)
+        return mark_activation(y)
+
+
+@defop(name="parallel_cross_entropy")
+def _parallel_softmax_ce(logits, label, ignore_index):
+    # Numerically-stable CE; when logits' vocab dim is mp-sharded GSPMD
+    # computes the max/sum reductions with allreduces over mp — the same
+    # pattern as the reference's fused c_softmax_with_cross_entropy.
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - jax.lax.stop_gradient(m)
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
+    logprobs = shifted - lse
+    label_ = jnp.where(label == ignore_index, 0, label)
+    picked = jnp.take_along_axis(logprobs, label_[..., None], axis=-1)[..., 0]
+    loss = -jnp.where(label == ignore_index, 0.0, picked)
+    return loss
+
+
+class ParallelCrossEntropy(Layer):
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return _parallel_softmax_ce(input, raw(label), self.ignore_index)
+
+
+# --------------------------------------------------------------- RNG tracker
+class RNGStatesTracker:
+    """mpu.random.RNGStatesTracker parity: named decorrelated RNG streams.
+
+    Megatron needs per-rank local seeds so dropout masks on mp-sharded
+    activations differ per shard while replicated tensors share masks. Under
+    GSPMD, tensors are globally consistent and one counter-based key suffices
+    for correctness; we still fold the stream name (and a per-name seed) into
+    the key so `get_rng_state_tracker().rng_state("local_seed")` produces an
+    independent stream, matching reference script behavior.
+    """
+
+    def __init__(self):
+        self._seeds = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._seeds:
+            raise ValueError(f"seed name {name} already added")
+        self._seeds[name] = _rng.Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self._seeds.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self._seeds.setdefault(n, _rng.Generator(0)).set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng"):
+        if name not in self._seeds:
+            self.add(name, hash(name) % (2**31))
+        gen = self._seeds[name]
+        if _rng.in_trace_scope():
+            # inside a compiled program: derive from the trace key + name
+            with _rng.trace_key_scope(
+                jax.random.fold_in(_rng.next_key(), hash(name) % (2**31))
+            ):
+                yield
+        else:
+            prev = _rng._default_generator
+            _rng._default_generator = gen
+            try:
+                yield
+            finally:
+                _rng._default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 0):
+    global _tracker
+    _tracker = RNGStatesTracker()
+    _tracker.add("global_seed", seed)
+    _tracker.add("local_seed", seed + 1024)

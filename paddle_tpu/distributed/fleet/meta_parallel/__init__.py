@@ -1,0 +1,15 @@
+from ..layers.mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .pipeline_parallel import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
+from .sharding import GroupShardedStage1, GroupShardedStage2, GroupShardedStage3  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,365 @@
+"""Pipeline parallelism (fleet.meta_parallel pipeline parity), TPU-native.
+
+Reference capability (SURVEY.md §2.3 "Pipeline parallel"):
+`PipelineLayer` segments a LayerDesc list into stages
+(`parallel_layers/pp_layers.py`); `PipelineParallel.train_batch` runs 1F1B
+over micro-batches with NCCL P2P between stage ranks
+(`pipeline_parallel.py`, `pp_utils/p2p_communication.py`).
+
+TPU-native design (SURVEY.md §7 step 7 and "Hard parts"): no NCCL P2P exists;
+the schedule lives *inside one compiled program*:
+
+* `SpmdPipeline` — the workhorse. N structurally-identical blocks' parameters
+  are stacked along a leading stage/layer dim sharded over the `pp` mesh
+  axis. Forward is either a `lax.scan` over layers (pp=1: plain layer
+  stacking) or a **circular micro-batch schedule inside `shard_map`**: each
+  pp rank applies its resident layers and hands activations to the next
+  stage with `lax.ppermute` (collective-permute over ICI — the send_v2/
+  recv_v2 replacement). `jax.grad` differentiates straight through the
+  schedule, so fwd+bwd+update still compile as ONE XLA program; remat on
+  blocks bounds activation memory (the role 1F1B plays in the reference).
+
+* `PipelineLayer` keeps the LayerDesc/seg_method API: it instantiates the
+  descs, finds the longest homogeneous run (the transformer body), and folds
+  it into a `SpmdPipeline`; pre/suffix layers (embedding, head) run on all
+  stages (replicated or TP-sharded), which is cheap under SPMD.
+"""
+from __future__ import annotations
+
+import functools
+import re
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....framework.core import Tensor
+from ....framework.op import defop, raw
+from ....nn.layer import Layer, Parameter
+from ... import mesh as _mesh
+
+
+class LayerDesc:
+    """Deferred layer construction (reference: pp_layers.LayerDesc)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer (e.g. tied embedding/head). Single-controller SPMD
+    holds one copy, so 'sharing across stages' is simple object sharing."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def _param_sig(layer: Layer):
+    return tuple(
+        (n, tuple(raw(p).shape), str(raw(p).dtype)) for n, p in layer.named_parameters()
+    )
+
+
+class SpmdPipeline(Layer):
+    """Stack of identical blocks, layer dim sharded over `pp`."""
+
+    def __init__(
+        self,
+        blocks: Sequence[Layer],
+        num_stages: Optional[int] = None,
+        num_microbatches: Optional[int] = None,
+        recompute_block: bool = False,
+    ):
+        super().__init__()
+        blocks = list(blocks)
+        if not blocks:
+            raise ValueError("SpmdPipeline needs at least one block")
+        sig = _param_sig(blocks[0])
+        for b in blocks[1:]:
+            if _param_sig(b) != sig:
+                raise ValueError("SpmdPipeline blocks must be structurally identical")
+        self.num_layers = len(blocks)
+        m = _mesh.get_global_mesh()
+        self.num_stages = num_stages or _mesh.mesh_axis_size("pp")
+        if self.num_layers % max(self.num_stages, 1) != 0:
+            raise ValueError(
+                f"{self.num_layers} layers not divisible by {self.num_stages} stages"
+            )
+        self.num_microbatches = num_microbatches
+        self.recompute_block = recompute_block
+        # template block is NOT a registered sublayer (its params are absorbed
+        # into the stacked ones); hide it from Layer.__setattr__.
+        self._template_holder = [blocks[0]]
+        self._tparams = [p for _, p in blocks[0].named_parameters()]
+        names = [n for n, _ in blocks[0].named_parameters()]
+        self._stacked: List[Parameter] = []
+        for i, (n, tp) in enumerate(zip(names, self._tparams)):
+            vals = [raw([q for _, q in b.named_parameters()][i]) for b in blocks]
+            stacked = jnp.stack(vals, axis=0)
+            sp = Parameter(stacked, trainable=tp.trainable, name=f"stacked_{n}")
+            base_spec = list(getattr(tp, "dist_spec", None) or P())
+            base_spec += [None] * (stacked.ndim - 1 - len(base_spec))
+            sp.dist_spec = P("pp", *base_spec)
+            self.add_parameter(n.replace(".", "__"), sp)
+            self._stacked.append(sp)
+        # buffers must be stage-invariant (none in standard transformer blocks)
+        if list(blocks[0].named_buffers()):
+            raise ValueError("SpmdPipeline blocks with buffers are not supported")
+
+    # -- functional application of the template with given leaf values -------
+    def _apply_block(self, leaf_vals, x):
+        tmpl = self._template_holder[0]
+        originals = [p._value for p in self._tparams]
+        try:
+            for p, v in zip(self._tparams, leaf_vals):
+                p._value = v
+            out = tmpl(Tensor(x))
+            return raw(out)
+        finally:
+            for p, v in zip(self._tparams, originals):
+                p._value = v
+
+    def forward(self, x):
+        return _pipeline_forward(
+            raw(x) if isinstance(x, Tensor) else x,
+            *[p for p in self._stacked],
+            pipe=self,
+        )
+
+
+@defop(name="spmd_pipeline")
+def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
+    m = _mesh.get_global_mesh()
+    S = pipe.num_stages
+    block = pipe._apply_block
+    if pipe.recompute_block:
+        block = jax.checkpoint(block, policy=jax.checkpoint_policies.dots_saveable)
+
+    if S <= 1 or m is None or "pp" not in m.shape or m.shape["pp"] < S:
+        # layer-stacked scan (the idiomatic big-model pattern: one block
+        # compiled once, scanned over the layer dim)
+        def body(h, leaves):
+            return block(leaves, h), None
+
+        h, _ = lax.scan(body, x, tuple(stacked_vals))
+        return h
+
+    # ---- circular micro-batch schedule over the pp axis --------------------
+    M = pipe.num_microbatches or S
+    B = x.shape[0]
+    if B % M != 0:
+        M = 1
+    mb = B // M
+    xm = x.reshape((M, mb) + x.shape[1:])
+    L_per = pipe.num_layers // S
+
+    def stage_apply(local_leaves, h):
+        def body(h, leaves):
+            return block(leaves, h), None
+
+        h, _ = lax.scan(body, h, local_leaves)
+        return h
+
+    def spmd_fn(local_stacked, xm_all):
+        stage = lax.axis_index("pp")
+        state = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        out_buf = jnp.zeros_like(xm_all)
+
+        def step(t, carry):
+            state_, out_ = carry
+            inp = jnp.where(stage == 0, xm_all[jnp.minimum(t, M - 1)], state_)
+            h = stage_apply(local_stacked, inp)
+            widx = t - (S - 1)
+            valid = (stage == S - 1) & (widx >= 0)
+            wi = jnp.clip(widx, 0, M - 1)
+            old = lax.dynamic_slice_in_dim(out_, wi, 1, 0)[0]
+            out_ = lax.dynamic_update_slice_in_dim(
+                out_, jnp.where(valid, h, old)[None], wi, 0
+            )
+            nxt = lax.ppermute(h, "pp", [(i, i + 1) for i in range(S - 1)])
+            return nxt, out_
+
+        _, out_buf = lax.fori_loop(0, M + S - 1, step, (state, out_buf))
+        # only the last stage holds real outputs; replicate across pp
+        out_buf = lax.psum(
+            jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)), "pp"
+        )
+        return out_buf
+
+    mapped = jax.shard_map(
+        spmd_fn,
+        mesh=m,
+        in_specs=(tuple(P("pp") for _ in stacked_vals), P()),
+        out_specs=P(),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+    # jit wrapper: the partial-manual shard_map eager impl path is broken in
+    # current jax (nested unmatch uses the full axis set); the traced path is
+    # fine, and under an outer jit this inlines.
+    out = jax.jit(mapped)(tuple(stacked_vals), xm)
+    return out.reshape((B,) + out.shape[2:])
+
+
+class PipelineLayer(Layer):
+    """paddle PipelineLayer parity: LayerDesc list + segmentation."""
+
+    def __init__(
+        self,
+        layers: Sequence,
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn: Optional[Callable] = None,
+        seg_method: str = "uniform",
+        recompute_interval: int = 0,
+        num_virtual_pipeline_stages: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self.num_stages = num_stages or max(_mesh.mesh_axis_size("pp"), 1)
+        built: List[Layer] = []
+        self._shared = {}
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                if d.forward_func is not None:
+                    layer = _ForwardWrapper(layer, d.forward_func)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"unsupported pipeline item {d!r}")
+        # find the longest homogeneous run to fold into SpmdPipeline
+        runs = []
+        i = 0
+        while i < len(built):
+            j = i
+            if list(built[i].named_parameters()):
+                sig = (type(built[i]), _param_sig(built[i]))
+                while j + 1 < len(built) and isinstance(built[j + 1], type(built[i])) and (
+                    type(built[j + 1]),
+                    _param_sig(built[j + 1]),
+                ) == sig:
+                    j += 1
+            runs.append((i, j))
+            i = j + 1
+        best = max(runs, key=lambda r: r[1] - r[0])
+        lo, hi = best
+        n_run = hi - lo + 1
+        self._segments: List[Layer] = []
+        if (
+            self.num_stages > 1
+            and n_run >= self.num_stages
+            and n_run % self.num_stages == 0
+        ):
+            for l in built[:lo]:
+                self._segments.append(l)
+            self._segments.append(
+                SpmdPipeline(
+                    built[lo : hi + 1],
+                    num_stages=self.num_stages,
+                    recompute_block=recompute_interval > 0,
+                )
+            )
+            for l in built[hi + 1 :]:
+                self._segments.append(l)
+        else:
+            self._segments = built
+        for i, l in enumerate(self._segments):
+            self.add_sublayer(f"seg_{i}", l)
+
+    def forward(self, x):
+        for l in self._segments:
+            x = l(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *a, **k):
+        return self._fn(*a, **k)
+
+
+class _ForwardWrapper(Layer):
+    def __init__(self, layer, fn):
+        super().__init__()
+        self.inner = layer
+        self._fn = fn
+
+    def forward(self, *a, **k):
+        return self._fn(self.inner, *a, **k)
+
+
+class PipelineParallel(Layer):
+    """fleet.meta_parallel.PipelineParallel parity: the train_batch driver."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._step_cache = {}
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One pipelined training step — compiled end to end (forward over all
+        micro-batches + backward + update in a single XLA program)."""
+        from .. import DistTrainStep
+
+        x, y = data
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        key = id(optimizer)
+        step = self._step_cache.get(key)
+        if step is None:
+
+            def compute_loss(model, xb, yb):
+                out = model(xb)
+                return loss_fn(out, yb)
+
+            step = DistTrainStep(self._layers, compute_loss, optimizer)
+            self._step_cache[key] = step
+        loss = step(x, y)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if compute_loss and loss_fn is not None:
+            return loss_fn(out, y)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

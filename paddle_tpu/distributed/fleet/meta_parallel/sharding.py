@@ -1,0 +1,59 @@
+"""GroupSharded (ZeRO) stages as placement policies.
+
+Reference capability (SURVEY.md §2.3 "Sharding (ZeRO-1/2/3)"):
+`GroupShardedStage1/2/3` in
+`python/paddle/distributed/fleet/meta_parallel/sharding/` implement hand-
+rolled optimizer-state/grad/param sharding with explicit broadcast /
+reduce-scatter / gather hooks over the sharding NCCL group.
+
+TPU-native design: each stage is a *data placement*, not an engine —
+  stage 1: optimizer states sharded over the `sharding` mesh axis
+           (HybridParallelOptimizer does this placement);
+  stage 2: + gradients — under a compiled step, grads are transient values
+           inside one XLA program; GSPMD already materializes them sharded
+           where the consumer (the sharded optimizer update) wants them, so
+           stage 2 collapses into stage 1 at the API level;
+  stage 3: + parameters sharded (`shard_model_parameters(fsdp=True)`); XLA's
+           latency-hiding scheduler overlaps the param all-gathers with
+           compute — the hand-written gather/release hooks of the reference
+           become compiler work.
+"""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from .. import HybridParallelOptimizer, shard_model_parameters
+
+
+class _GroupShardedBase(Layer):
+    stage = 1
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False, **kwargs):
+        super().__init__()
+        self._layers = layer
+        shard_model_parameters(layer, fsdp=(self.stage == 3))
+        self._optim = (
+            optimizer
+            if optimizer is None or isinstance(optimizer, HybridParallelOptimizer)
+            else HybridParallelOptimizer(optimizer)
+        )
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class GroupShardedStage1(_GroupShardedBase):
+    stage = 1
+
+
+class GroupShardedStage2(_GroupShardedBase):
+    stage = 2
+
+
+class GroupShardedStage3(_GroupShardedBase):
+    stage = 3

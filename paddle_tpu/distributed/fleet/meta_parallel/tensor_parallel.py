@@ -1,0 +1,28 @@
+"""TensorParallel model wrapper (fleet.meta_parallel.TensorParallel parity).
+
+Reference: wraps the model to broadcast non-distributed params across the mp
+group at init and sync grads. TPU-native: parameter placement (device_put
+with each param's dist_spec) makes every rank's view consistent by
+construction — the wrapper only performs placement, then defers to the model.
+"""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        from .. import shard_model_parameters
+
+        self._layers = layers
+        shard_model_parameters(layers)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,86 @@
+"""DistributedStrategy (fleet.DistributedStrategy parity).
+
+Reference: a protobuf (`paddle/fluid/framework/distributed_strategy.proto`)
+wrapped by `fleet/base/distributed_strategy.py` holding every distributed
+knob (SURVEY.md §5 "Config/flag system"). TPU-native design: one typed
+Python object — no proto round-trip; only the knobs that are meaningful
+under XLA/SPMD do anything, the rest are accepted for script compatibility
+and recorded (so a Paddle training script's strategy blocks run unchanged).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class _SubConfig(dict):
+    """Dict with attribute access, tolerant of unknown keys."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees — mirror of strategy.hybrid_configs
+        self.hybrid_configs: _SubConfig = _SubConfig(
+            dp_degree=1,
+            mp_degree=1,
+            pp_degree=1,
+            sharding_degree=1,
+            sep_degree=1,
+            order=["dp", "pp", "sharding", "sep", "mp"],
+        )
+        # amp — maps to bf16-first autocast (GradScaler vestigial on TPU)
+        self.amp = False
+        self.amp_configs: _SubConfig = _SubConfig(
+            init_loss_scaling=32768.0,
+            use_dynamic_loss_scaling=True,
+            use_pure_fp16=False,
+            use_bf16=True,
+            custom_white_list=[],
+            custom_black_list=[],
+        )
+        # recompute — maps to jax.checkpoint policy on marked blocks
+        self.recompute = False
+        self.recompute_configs: _SubConfig = _SubConfig(checkpoints=[])
+        # sharding (ZeRO) — maps to param/opt-state sharding specs
+        self.sharding = False
+        self.sharding_configs: _SubConfig = _SubConfig(
+            sharding_degree=1, stage=1, offload=False
+        )
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs: _SubConfig = _SubConfig(
+            micro_batch_size=1, accumulate_steps=1, schedule_mode="1F1B"
+        )
+        self.gradient_merge = False
+        self.gradient_merge_configs: _SubConfig = _SubConfig(k_steps=1, avg=True)
+        self.lamb = False
+        self.dgc = False
+        self.fuse_all_reduce_ops = True  # no-op: XLA fuses
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+        self.without_graph_optimization = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: _SubConfig = _SubConfig(
+            tensor_parallel_degree=1, tensor_init_seed=-1
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            k: (dict(v) if isinstance(v, _SubConfig) else v)
+            for k, v in self.__dict__.items()
+        }
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in self.to_dict().items():
+            lines.append(f"  {k}={v},")
+        return "\n".join(lines) + ")"

@@ -1,0 +1,224 @@
+"""Hybrid-parallel topology (fleet.base.topology parity).
+
+Reference capability (SURVEY.md §2.3 "Hybrid topology",
+`python/paddle/distributed/fleet/base/topology.py`): `CommunicateTopology`
+lays ranks out on a [dp, pp, sharding, sep, mp] grid; `HybridCommunicateGroup`
+derives per-axis subgroups (NCCL communicators) and this rank's coordinate.
+
+TPU-native design: the grid IS a `jax.sharding.Mesh` with named axes — the
+subgroup-per-axis machinery collapses into axis names. Axis order puts `mp`
+innermost so tensor-parallel collectives ride same-host/neighbor ICI links
+and `dp` outermost (slowest links / DCN across slices) — the same locality
+rule the reference encodes by ordering, now enforced by mesh construction.
+`sharding` doubles as the FSDP/ZeRO axis (§2.3 "Sharding (ZeRO-1/2/3)").
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import mesh as _mesh
+from ..env import Group
+
+_HYBRID_ORDER = ["data", "pipe", "sharding", "sep", "model"]
+_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+
+
+class CommunicateTopology:
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "sep", "model"),
+        dims: Sequence[int] = (1, 1, 1, 1, 1),
+    ):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = itertools.product(*(range(d) for d in self._dims))
+        self._world_size = int(np.prod(self._dims))
+        ranks = np.arange(self._world_size).reshape(self._dims)
+        self._rank_grid = ranks
+        self._coord_of_rank = {
+            int(ranks[c]): c for c in itertools.product(*(range(d) for d in self._dims))
+        }
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._rank_grid[coord])
+
+    def get_coord(self, rank: int):
+        return self._coord_of_rank[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        take = np.take(self._rank_grid, index, axis=axis)
+        return [int(r) for r in take.ravel()]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All subgroups along `axis_name`: ranks varying only on that axis."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_grid, axis, -1).reshape(-1, self._dims[axis])
+        return [[int(r) for r in row] for row in moved]
+
+
+class HybridCommunicateGroup:
+    """Rank-coordinate + per-axis group view over the global hybrid mesh."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        ndev = len(jax.devices())
+        if self.nranks != ndev:
+            raise ValueError(
+                f"hybrid topology spans {self.nranks} ranks but {ndev} devices "
+                "are visible; degrees must multiply to the device count"
+            )
+        names = topology.get_hybrid_group_names()
+        mesh_axes = tuple(_AXIS_ALIAS[n] for n in names)
+        dims = tuple(topology.get_dim(n) for n in names)
+        self.mesh = _mesh.build_mesh(dims, mesh_axes)
+        _mesh.set_global_mesh(self.mesh)
+
+        self.global_rank = 0  # single-controller: coordinate of device 0
+        self._coord = topology.get_coord(self.global_rank)
+
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = topology.get_dim("sharding") if "sharding" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+
+        self._groups: Dict[str, Group] = {}
+        for n in names:
+            axis = _AXIS_ALIAS[n]
+            idx = {m: self._coord[i] for i, m in enumerate(names) if m != n}
+            # ranks of this rank's subgroup along axis n
+            sub = self._sub_ranks(n)
+            self._groups[axis] = Group(sub, axis_names=(axis,), name=f"{axis}_group")
+
+    def _sub_ranks(self, axis_name: str) -> List[int]:
+        names = self._topo.get_hybrid_group_names()
+        coord = dict(zip(names, self._coord))
+        ranks = []
+        for i in range(self._topo.get_dim(axis_name)):
+            c = dict(coord)
+            c[axis_name] = i
+            ranks.append(self._topo.get_rank(**c))
+        return ranks
+
+    # --- topology info (fleet parity names) --------------------------------
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord[0]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["dp"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._coord[-1]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["mp"].ranks[0]
+
+    # pipeline
+    def get_stage_id(self):
+        return self._coord[1]
+
+    def get_pipe_parallel_rank(self):
+        return self._coord[1]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_p2p_groups(self):
+        return self._groups["pp"]
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord[2]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._groups["sharding"].ranks[0]
+
+    # sep (Ulysses sequence parallel)
+    def get_sep_parallel_rank(self):
+        return self._coord[3]
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    # checks
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return self._groups["mp"]
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        names = self._topo.get_hybrid_group_names()
+        coord = dict(zip(names, self._coord))
+        coord["pipe"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

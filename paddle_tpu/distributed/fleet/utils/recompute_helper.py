@@ -1,0 +1,88 @@
+"""Activation recomputation (fleet.utils.recompute parity).
+
+Reference: `python/paddle/distributed/fleet/utils/recompute.py` — re-runs the
+forward of a block during backward instead of storing activations (plus RNG
+state stashing so dropout masks replay identically).
+
+TPU-native design: `jax.checkpoint` (rematerialization) — XLA re-emits the
+forward ops in the backward pass; RNG replay is free because randomness is
+explicit (counter-based keys are part of the traced inputs). The
+`dots_saveable` policy keeps matmul outputs (MXU work) and recomputes the
+cheap HBM-bound elementwise ops — the right default trade on TPU where HBM
+bandwidth, not FLOPs, is the bottleneck (SURVEY.md "HBM bandwidth").
+"""
+from __future__ import annotations
+
+import jax
+
+from ....framework.core import Tensor
+from ....framework.op import defop, raw
+
+
+@defop(name="recompute")
+def _recompute_apply(vals, fn):
+    # `fn` is a static (non-tensor) leaf: the checkpointed pure function.
+    # Going through defop makes the whole block ONE tape node in eager mode
+    # (jax.vjp of the checkpointed fn), mirroring the reference's single
+    # RecomputeFunction autograd node.
+    return fn(*vals)
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True, policy=None, **kwargs):
+    """Run `function(*args)` under rematerialization."""
+    if policy is None:
+        policy = jax.checkpoint_policies.dots_saveable
+
+    tensor_args = [isinstance(a, Tensor) for a in args]
+    # The block's parameters must be explicit differentiable inputs of the
+    # tape node, or their grads would be lost in eager mode (they are closure
+    # constants otherwise).
+    fn_self = getattr(function, "__self__", None)
+    owner = function if hasattr(function, "named_parameters") else fn_self
+    params = [p for _, p in owner.named_parameters()] if owner is not None else []
+    n_args = len(args)
+
+    def pure(*vals):
+        arg_vals, p_vals = vals[:n_args], vals[n_args:]
+        originals = [p._value for p in params]
+        try:
+            for p, v in zip(params, p_vals):
+                p._value = v
+            wrapped = [Tensor(v) if t else v for v, t in zip(arg_vals, tensor_args)]
+            out = function(*wrapped, **kwargs)
+            return jax.tree_util.tree_map(
+                raw, out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+        finally:
+            for p, v in zip(params, originals):
+                p._value = v
+
+    # Tensors pass into defop intact (so grads are recorded); defop hands the
+    # pure fn their raw values in the same positions.
+    return _recompute_apply(list(args) + params, jax.checkpoint(pure, policy=policy))
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """fleet.utils.recompute_sequential parity: chunk a Sequential and
+    recompute each segment."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else 1
+    if hasattr(functions, "_sub_layers"):
+        functions = list(functions._sub_layers.values())
+    n = len(functions)
+    per = max(1, n // max(segments, 1))
+    x = args[0] if len(args) == 1 else args
+
+    def run_segment(fs):
+        def seg(x_):
+            for f in fs:
+                x_ = f(x_)
+            return x_
+
+        return seg
+
+    i = 0
+    while i < n:
+        seg_fns = functions[i : i + per]
+        x = recompute(run_segment(seg_fns), x, **kwargs)
+        i += per
+    return x

@@ -1,0 +1,122 @@
+"""Megatron-style sequence parallelism (fleet.utils.sequence_parallel_utils
+parity) + Ulysses (`sep`) all-to-all helpers.
+
+Reference capability (SURVEY.md §2.3 "Sequence parallel", §5 "Long-context"):
+on the mp group, activations outside attention/MLP are sharded along the
+sequence dim; explicit autograd ops `ScatterOp`/`GatherOp`/`AllGatherOp`/
+`ReduceScatterOp` move between layouts, and sequence-parallel params get a
+separate grad allreduce (`mark_as_sequence_parallel_parameter`,
+`register_sequence_parallel_allreduce_hooks`).
+
+TPU-native design: the layouts are PartitionSpecs — sequence dim on the `mp`
+axis vs hidden dim on the `mp` axis — and the scatter/gather pairs are
+`sharding_constraint` transitions; GSPMD emits the all-gather before the
+matmul and the reduce-scatter after, exactly the Megatron-SP comm pattern.
+The grad-sync hooks are unnecessary: parameter grads are globally correct by
+construction under SPMD (documented no-ops kept for script parity).
+
+The `sep` (Ulysses) helpers reshard between sequence-sharded and
+head-sharded layouts around attention — the all-to-all emerges from the
+layout change (reference: `sep` axis in topology.py).
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ....framework.op import defop
+from ... import mesh as _mesh
+from ..layers.mpu import _data_axes
+
+
+def _seq_spec(ndim: int, seq_dim: int, axis: str) -> P:
+    spec = [None] * ndim
+    spec[0] = _data_axes()
+    spec[seq_dim] = axis
+    return P(*spec)
+
+
+def _full_spec(ndim: int) -> P:
+    spec = [None] * ndim
+    spec[0] = _data_axes()
+    return P(*spec)
+
+
+@defop(name="sp_scatter")
+def _sp_scatter(x, seq_dim):
+    return _mesh.sharding_constraint(x, _seq_spec(x.ndim, seq_dim, "mp"))
+
+
+@defop(name="sp_gather")
+def _sp_gather(x, seq_dim):
+    return _mesh.sharding_constraint(x, _full_spec(x.ndim))
+
+
+class ScatterOp:
+    """Shard the sequence dim over mp (fwd scatter / bwd all-gather)."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        return _sp_scatter(x, axis)
+
+
+class GatherOp:
+    """Replicate the sequence dim (fwd all-gather / bwd scatter)."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        return _sp_gather(x, axis)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def scatter(x, axis=1):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x, axis=1):
+    return GatherOp.apply(x, axis)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Grad sync for SP params is implicit under SPMD; keep the marker."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse=False):
+    """No-op under SPMD: XLA produces globally-reduced grads. Kept for parity."""
+    return model
+
+
+# ------------------------------------------------------- sep / Ulysses layout
+@defop(name="sep_to_heads")
+def sep_reshard_to_heads(x, head_dim_axis):
+    """[b, s/sep, h, d] → heads sharded on sep: the layout flip IS the
+    all-to-all (lax.all_to_all under shard_map; GSPMD reshard under pjit)."""
+    m = _mesh.get_global_mesh()
+    if m is None or "sep" not in m.shape or m.shape["sep"] == 1:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = _data_axes()
+    spec[head_dim_axis] = "sep"
+    return _mesh.sharding_constraint(x, P(*spec))
+
+
+@defop(name="sep_to_sequence")
+def sep_reshard_to_sequence(x, seq_dim=1):
+    m = _mesh.get_global_mesh()
+    if m is None or "sep" not in m.shape or m.shape["sep"] == 1:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = _data_axes()
+    spec[seq_dim] = "sep"
+    return _mesh.sharding_constraint(x, P(*spec))

@@ -1,0 +1,51 @@
+"""Launch CLI (python -m paddle_tpu.distributed.launch).
+
+Reference (SURVEY.md §3.5): `paddle.distributed.launch` spawns one process
+per GPU with PADDLE_TRAINER_ID / endpoints env and watches them.
+
+TPU-native design: one process per *host*; devices are discovered by PJRT.
+Single-host: exec the script directly (all local chips visible). Multi-host:
+set the JAX coordination env (coordinator address, process id/count) from
+the same PADDLE_* env names the reference launcher uses, so Paddle-style
+cluster tooling keeps working, then exec the script — rendezvous happens in
+`init_parallel_env` via `jax.distributed.initialize`.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def build_parser():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default="1", help="number of hosts")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="accepted for parity; on TPU one process drives all local chips")
+    p.add_argument("--master", type=str, default=None, help="coordinator host:port")
+    p.add_argument("--rank", type=int, default=None, help="this host's process id")
+    p.add_argument("--ips", type=str, default=None, help="comma-separated host ips (parity)")
+    p.add_argument("--devices", "--gpus", "--xpus", type=str, default=None, dest="devices")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv=None):
+    args = build_parser().parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    if nnodes > 1:
+        if not args.master:
+            raise SystemExit("--master host:port is required for multi-host launch")
+        os.environ.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
+        os.environ.setdefault("PADDLE_MASTER", args.master)
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nnodes))
+        os.environ.setdefault("JAX_NUM_PROCESSES", str(nnodes))
+        rank = args.rank if args.rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        os.environ["PADDLE_TRAINER_ID"] = str(rank)
+        os.environ["JAX_PROCESS_ID"] = str(rank)
+    sys.argv = [args.training_script] + list(args.training_script_args)
+    runpy.run_path(args.training_script, run_name="__main__")

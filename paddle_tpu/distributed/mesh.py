@@ -1,0 +1,133 @@
+"""Global device-mesh context — the TPU-native "communicator" layer.
+
+Reference capability replaced here (SURVEY.md §2.3): Paddle manages NCCL
+communicators per process subgroup (`ProcessGroupNCCL`, `NCCLCommContext`,
+unique-id rendezvous over TCPStore). On TPU there are no user-managed
+communicators: collectives are compiled into the XLA program and ride the
+ICI/DCN fabric. The analogue of "creating communicators" is *constructing a
+named device mesh* (`jax.sharding.Mesh`) whose axes map onto the physical
+topology; every collective is then named by mesh axis instead of by
+communicator handle.
+
+Axis order convention (mirrors the reference's HybridCommunicateGroup order
+[dp, pp, sharding, sep, mp] — `fleet/base/topology.py`): the *last* axes are
+the fastest-varying over devices, so `mp` (the most bandwidth-hungry axis)
+lands on adjacent devices / same-host ICI, `dp` on the slowest links — the
+same locality goal the reference encodes in its topology ordering.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+_state = threading.local()
+
+# Canonical hybrid axis names, outermost → innermost.
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def build_mesh(
+    axis_dims: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with the given axis shape.
+
+    Degenerate (size-1) axes are kept so sharding specs can always name any
+    hybrid axis regardless of the configured degree.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(axis_dims))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axis dims {tuple(axis_dims)} require {n} devices, "
+            f"got {len(devices)}"
+        )
+    dev_array = np.array(devices).reshape(tuple(axis_dims))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def set_global_mesh(mesh: Optional[Mesh]):
+    _state.mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+def require_global_mesh() -> Mesh:
+    m = get_global_mesh()
+    if m is None:
+        raise RuntimeError(
+            "no global device mesh: call paddle_tpu.distributed.fleet.init() "
+            "or init_parallel_env() first"
+        )
+    return m
+
+
+@contextlib.contextmanager
+def global_mesh(mesh: Mesh):
+    prev = get_global_mesh()
+    set_global_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_global_mesh(prev)
+
+
+def named_sharding(spec: PartitionSpec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or require_global_mesh(), spec)
+
+
+def _sanitize_spec(spec: PartitionSpec, shape, mesh: Mesh) -> PartitionSpec:
+    """Drop axis names from dims they don't divide evenly (correctness first:
+    an indivisible dim stays replicated rather than erroring)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for dim, e in zip(shape, entries):
+        names = e if isinstance(e, tuple) else ((e,) if e is not None else ())
+        size = 1
+        for n in names:
+            size *= mesh.shape.get(n, 1)
+        if size > 1 and dim % size != 0:
+            out.append(None)
+        else:
+            out.append(e)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def sharding_constraint(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """Pin `value`'s layout to `spec` on the (global) mesh.
+
+    Inside a jit trace this becomes an XLA sharding annotation (GSPMD inserts
+    whatever collectives are needed to honor it — the TPU-native equivalent of
+    the reference's explicit c_allgather/c_reducescatter ops). Eagerly it is a
+    device_put (a real resharding transfer).
+    """
+    m = mesh or get_global_mesh()
+    if m is None or m.empty:
+        return value
+    spec = _sanitize_spec(spec, tuple(value.shape), m)
+    try:
+        from jax import lax
+
+        return lax.with_sharding_constraint(value, NamedSharding(m, spec))
+    except Exception:
+        return jax.device_put(value, NamedSharding(m, spec))
+
+
+def mesh_axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or get_global_mesh()
+    if m is None or name not in m.shape:
+        return 1
+    return m.shape[name]

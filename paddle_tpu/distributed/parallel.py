@@ -1,0 +1,87 @@
+"""paddle.DataParallel + spawn parity.
+
+Reference (SURVEY.md §2.3 "Data parallel, dygraph"): `paddle.DataParallel`
+wraps a Layer with the C++ EagerReducer — bucketed, overlapped grad
+allreduce, `no_sync`, find_unused_parameters (`imperative/reducer.cc`).
+
+TPU-native design: under SPMD with a compiled train step, data-parallel grad
+reduction is emitted by XLA from the batch sharding — there is nothing to
+bucket or overlap by hand (the latency-hiding scheduler does it). The
+wrapper's job reduces to (a) placing the module's params on the mesh and
+(b) keeping the API (`no_sync`, `scale_loss`) alive for ported scripts.
+"""
+from __future__ import annotations
+
+import contextlib
+import multiprocessing
+import os
+from typing import Optional
+
+from ..nn.layer import Layer
+from .env import get_world_size, init_parallel_env
+
+
+class DataParallel(Layer):
+    def __init__(
+        self,
+        layers: Layer,
+        strategy=None,
+        comm_buffer_size: int = 25,
+        last_comm_buffer_size: int = 1,
+        find_unused_parameters: bool = False,
+        group=None,
+    ):
+        super().__init__()
+        init_parallel_env()
+        self._layers = layers
+        from .fleet import shard_model_parameters
+
+        shard_model_parameters(layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        # grad sync is part of the compiled step; nothing to defer
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+def spawn(func, args=(), nprocs: Optional[int] = None, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity.
+
+    On TPU the unit of multi-process execution is one process per *host* (JAX
+    single-controller owns every local chip), so in-host spawn degenerates to
+    a direct call; multi-host launch goes through `paddle_tpu.distributed.launch`.
+    """
+    if nprocs in (None, 1) or get_world_size() >= 1:
+        func(*args)
+        return None
+    procs = []
+    ctx = multiprocessing.get_context("spawn")
+    for rank in range(nprocs):
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(rank), PADDLE_TRAINERS_NUM=str(nprocs))
+        p = ctx.Process(target=_spawn_target, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+def _spawn_target(func, args, env):
+    os.environ.update(env)
+    func(*args)

@@ -1,0 +1,48 @@
+"""paddle.distributed.sharding parity: `group_sharded_parallel`.
+
+Reference (SURVEY.md §2.3 "Sharding (ZeRO-1/2/3)",
+`python/paddle/distributed/sharding/group_sharded.py`): wraps model+optimizer
+into GroupShardedStage1/2/3 engines with explicit gather/scatter hooks.
+
+TPU-native: the stages are placements (see meta_parallel/sharding.py) —
+  level "os"     → ZeRO-1: optimizer states sharded
+  level "os_g"   → ZeRO-2: + grads (implicit inside the compiled step)
+  level "p_g_os" → ZeRO-3: + parameters sharded (FSDP)
+"""
+from __future__ import annotations
+
+from ..fleet import HybridParallelOptimizer, shard_model_parameters
+
+_LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(
+    model,
+    optimizer,
+    level: str = "os_g",
+    scaler=None,
+    group=None,
+    offload=False,
+    sync_buffers=False,
+    buffer_max_size=2**23,
+    segment_size=2**20,
+    sync_comm=False,
+    dp_group=None,
+    exclude_layer=None,
+):
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {list(_LEVELS)}, got {level!r}")
+    stage = _LEVELS[level]
+    shard_model_parameters(model, fsdp=(stage == 3))
+    if not isinstance(optimizer, HybridParallelOptimizer):
+        optimizer = HybridParallelOptimizer(optimizer)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Checkpoint a sharded model (gathers happen on host materialization)."""
+    from ...framework.io_state import save
+
+    save(model.state_dict(), output + ".pdparams" if not output.endswith(".pdparams") else output)
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

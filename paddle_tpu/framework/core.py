@@ -288,6 +288,10 @@ class Tensor:
         "_uid",
         "_retain_grads",
         "_hooks",
+        # distributed metadata (auto_parallel / fleet placement)
+        "dist_spec",
+        "process_mesh",
+        "placements",
         "__weakref__",
     )
 

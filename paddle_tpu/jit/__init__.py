@@ -221,6 +221,7 @@ class TrainStep:
         b_vals = [b._value for b in buffers]
         opt_states = self._opt.functional_states()
         batch_vals = [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        batch_vals = self._place_batch(batch_vals)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         key = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
         jitted = self._cache.get(key)
@@ -237,6 +238,11 @@ class TrainStep:
         if isinstance(self._opt._learning_rate, type(None)):
             pass
         return Tensor(loss_val)
+
+    def _place_batch(self, batch_vals):
+        """Hook: distributed subclasses place the batch on the data mesh axes
+        (fleet.DistTrainStep)."""
+        return batch_vals
 
     def _compile(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
@@ -272,5 +278,8 @@ class TrainStep:
             new_p, new_st = opt.functional_step(p_vals, grads, opt_states, lr)
             return loss_val, new_p, new_b, new_st
 
+        return self._jit(step)
+
+    def _jit(self, step):
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
