@@ -186,9 +186,11 @@ def distributed_model(model: Layer) -> Layer:
     # distributed_optimizer).
     stage3 = _strategy is not None and _strategy.sharding_configs.get("stage", 1) == 3
     shard_model_parameters(model, fsdp=stage3)
-    if hcg.get_pipe_parallel_world_size() > 1:
-        from ..meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.pipeline_parallel import PipelineLayer, PipelineParallel
 
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        # a PipelineLayer gets the train_batch driver; models embedding
+        # SpmdPipeline internally need no wrapper
         return PipelineParallel(model, hcg, _strategy)
     return model
 

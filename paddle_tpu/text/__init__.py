@@ -1,0 +1,8 @@
+"""paddle_tpu.text — text model zoo + dataset helpers.
+
+Reference: `python/paddle/text/` (datasets) and the PaddleNLP model zoo the
+BASELINE workloads are drawn from (SURVEY.md §6): BERT-base MLM, ERNIE-3.0
+fine-tune, GPT-3 pretraining configs.
+"""
+from . import models  # noqa: F401
+from .models import *  # noqa: F401,F403

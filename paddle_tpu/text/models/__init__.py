@@ -1,0 +1,22 @@
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTForPretraining,
+    GPTLMHeadModel,
+    GPTModel,
+    GPTPretrainingCriterion,
+)
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+)
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieForTokenClassification,
+    ErnieModel,
+)

@@ -1,0 +1,223 @@
+"""BERT family (encoder LM) — PaddleNLP BertModel parity, TPU-native.
+
+Reference capability (SURVEY.md §6 workloads "BERT-base MLM (data-parallel)"):
+PaddleNLP `BertModel` / `BertForMaskedLM` / `BertForSequenceClassification` /
+`BertForPretraining` built on paddle.nn.TransformerEncoder.
+
+TPU-native notes: encoder blocks use the same mp-shardable projections as GPT
+(so mp/dp hybrid works out of the box), attention runs on the flash kernel
+with an additive padding mask, and blocks are uniform for SpmdPipeline
+stacking.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...distributed.fleet.layers.mpu import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+
+class BertConfig:
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        intermediate_size: int = 3072,
+        hidden_act: str = "gelu",
+        hidden_dropout_prob: float = 0.1,
+        attention_probs_dropout_prob: float = 0.1,
+        max_position_embeddings: int = 512,
+        type_vocab_size: int = 2,
+        initializer_range: float = 0.02,
+        pad_token_id: int = 0,
+        layer_norm_eps: float = 1e-12,
+        use_flash_attention: bool = True,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash_attention = use_flash_attention
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = nn.ParamAttr(initializer=I.Normal(std=config.initializer_range))
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ... import tensor as pt
+
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = pt.arange(0, seq, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = pt.zeros_like(input_ids)
+        emb = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, t, h = x.shape
+        qkv = self.qkv_proj(x).reshape([b, t, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
+            is_causal=False, training=self.training,
+        )
+        return self.out_proj(out.reshape([b, t, h]))
+
+
+class BertLayer(nn.Layer):
+    """Post-LN encoder block (BERT convention)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.fc_in = ColumnParallelLinear(config.hidden_size, config.intermediate_size, gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size, config.hidden_size, input_is_parallel=True)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.act = F.gelu if config.hidden_act == "gelu" else getattr(F, config.hidden_act)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.dropout(self.attention(x, attn_mask)))
+        x = self.ln_2(x + self.dropout(self.fc_out(self.act(self.fc_in(x)))))
+        return x
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig, add_pooling_layer: bool = True):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config) if add_pooling_layer else None
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            # [b, t] (1 = keep) → additive [b, 1, 1, t] on logits
+            from ...framework.op import raw
+            import jax.numpy as jnp
+
+            m = raw(attention_mask)
+            mask = ((1.0 - m.astype(jnp.float32)) * -1e9)[:, None, None, :]
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = self.pooler(x) if self.pooler is not None else None
+        return x, pooled
+
+
+class BertLMPredictionHead(nn.Layer):
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.act = F.gelu
+        self._tied = embedding_weights
+        if embedding_weights is None:
+            self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        self.decoder_bias = self.create_parameter([config.vocab_size], is_bias=True)
+
+    def forward(self, hidden):
+        h = self.layer_norm(self.act(self.transform(hidden)))
+        if self._tied is not None:
+            return F.linear(h, self._tied.t()) + self.decoder_bias
+        return self.decoder(h) + self.decoder_bias
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config, add_pooling_layer=False)
+        self.cls = BertLMPredictionHead(config, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        hidden, _ = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.cls(hidden)
+        if labels is not None:
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]),
+                ignore_index=-100,
+            )
+        return logits
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2, dropout=None):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(dropout if dropout is not None else config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score, masked_lm_labels, next_sentence_labels=None):
+        mlm = F.cross_entropy(
+            prediction_scores.reshape([-1, self.vocab_size]),
+            masked_lm_labels.reshape([-1]),
+            ignore_index=-100,
+        )
+        if next_sentence_labels is not None and seq_relationship_score is not None:
+            nsp = F.cross_entropy(seq_relationship_score, next_sentence_labels.reshape([-1]))
+            return mlm + nsp
+        return mlm

@@ -1,0 +1,185 @@
+"""ERNIE family (Baidu's BERT-style encoder with task-type embeddings).
+
+Reference capability (SURVEY.md §6 "ERNIE-3.0-base fine-tune (dygraph)" —
+the headline workload of BASELINE.json): PaddleNLP `ErnieModel` /
+`ErnieForMaskedLM` / `ErnieForSequenceClassification`. Architecturally an
+encoder transformer like BERT plus a `task_type` embedding table (ERNIE 3.0)
+and relu/gelu FFN; we share the BERT blocks (same mp-shardable projections).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from .bert import BertLayer, BertPooler
+
+
+class ErnieConfig:
+    def __init__(
+        self,
+        vocab_size: int = 40000,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        intermediate_size: int = 3072,
+        hidden_act: str = "gelu",
+        hidden_dropout_prob: float = 0.1,
+        attention_probs_dropout_prob: float = 0.1,
+        max_position_embeddings: int = 2048,
+        type_vocab_size: int = 4,
+        task_type_vocab_size: int = 3,
+        use_task_id: bool = True,
+        initializer_range: float = 0.02,
+        pad_token_id: int = 0,
+        layer_norm_eps: float = 1e-12,
+        use_flash_attention: bool = True,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash_attention = use_flash_attention
+
+    @staticmethod
+    def ernie3_base(**kw):
+        return ErnieConfig(hidden_size=768, num_hidden_layers=12, num_attention_heads=12, **kw)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        from ...distributed.fleet.layers.mpu import VocabParallelEmbedding
+
+        init = nn.ParamAttr(initializer=I.Normal(std=config.initializer_range))
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.use_task_id = config.use_task_id
+        if config.use_task_id:
+            self.task_type_embeddings = nn.Embedding(config.task_type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, task_type_ids=None):
+        from ... import tensor as pt
+
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = pt.arange(0, seq, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = pt.zeros_like(input_ids)
+        emb = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = pt.zeros_like(input_ids)
+            emb = emb + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class _ErnieBlockConfig:
+    """Adapter so BertLayer can consume ErnieConfig fields."""
+
+    def __init__(self, c: ErnieConfig):
+        self.hidden_size = c.hidden_size
+        self.num_attention_heads = c.num_attention_heads
+        self.intermediate_size = c.intermediate_size
+        self.hidden_act = c.hidden_act
+        self.hidden_dropout_prob = c.hidden_dropout_prob
+        self.attention_probs_dropout_prob = c.attention_probs_dropout_prob
+        self.layer_norm_eps = c.layer_norm_eps
+        self.use_flash_attention = c.use_flash_attention
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig, add_pooling_layer: bool = True):
+        super().__init__()
+        self.config = config
+        bc = _ErnieBlockConfig(config)
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(bc) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(bc) if add_pooling_layer else None
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        mask = None
+        if attention_mask is not None:
+            from ...framework.op import raw
+            import jax.numpy as jnp
+
+            m = raw(attention_mask)
+            mask = ((1.0 - m.astype(jnp.float32)) * -1e9)[:, None, None, :]
+        x = self.embeddings(input_ids, token_type_ids, position_ids, task_type_ids)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = self.pooler(x) if self.pooler is not None else None
+        return x, pooled
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        from .bert import BertLMPredictionHead
+
+        self.ernie = ErnieModel(config, add_pooling_layer=False)
+        head_cfg = _ErnieBlockConfig(config)
+        head_cfg.vocab_size = config.vocab_size
+        self.cls = BertLMPredictionHead(head_cfg, self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        hidden, _ = self.ernie(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.cls(hidden)
+        if labels is not None:
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]),
+                ignore_index=-100,
+            )
+        return logits
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(dropout if dropout is not None else config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class ErnieForTokenClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(config, add_pooling_layer=False)
+        self.dropout = nn.Dropout(dropout if dropout is not None else config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        hidden, _ = self.ernie(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(hidden))
+        if labels is not None:
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1])
+            )
+        return logits

@@ -1,0 +1,249 @@
+"""GPT family — decoder-only LM, hybrid-parallel-ready (the flagship model).
+
+Reference capability (SURVEY.md §6 workloads "GPT-3 1.3B (dp+mp)",
+"GPT-3 6.7B (pp+sharding)"): the Paddle ecosystem's GPT lives in
+PaddleNLP/fleetx (`GPTModel`, `GPTForPretraining`, `GPTPretrainingCriterion`)
+built from fleet mpu layers (`VocabParallelEmbedding`,
+`ColumnParallelLinear`/`RowParallelLinear`) with 1F1B pipeline and
+sequence-parallel options.
+
+TPU-native design: the same layer classes (they ARE sharding annotations
+here), flash attention on the MXU-friendly [B, T, H, D] layout, bf16-first,
+and the transformer body built as a list of identical blocks so
+`SpmdPipeline` can stack them (layer-dim scan → one compiled block, or pp
+circular schedule over the mesh). The causal mask is folded into attention
+(no materialized [T,T] mask tensor in HBM).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...framework.core import Tensor
+from ...distributed import mesh as _mesh
+from ...distributed.fleet.layers.mpu import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    mark_activation,
+)
+from ...distributed.fleet.utils import recompute as _recompute
+
+
+class GPTConfig:
+    """Static model hyperparameters (mirrors PaddleNLP GPTConfig fields)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 50304,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        intermediate_size: Optional[int] = None,
+        hidden_act: str = "gelu",
+        max_position_embeddings: int = 1024,
+        hidden_dropout_prob: float = 0.1,
+        attention_probs_dropout_prob: float = 0.1,
+        initializer_range: float = 0.02,
+        use_recompute: bool = False,
+        use_flash_attention: bool = True,
+        sequence_parallel: bool = False,
+        tie_word_embeddings: bool = True,
+        layer_norm_epsilon: float = 1e-5,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.hidden_act = hidden_act
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+        self.use_flash_attention = use_flash_attention
+        self.sequence_parallel = sequence_parallel
+        self.tie_word_embeddings = tie_word_embeddings
+        self.layer_norm_epsilon = layer_norm_epsilon
+
+    # canonical sizes (PaddleNLP gpt configs / GPT-3 table)
+    @staticmethod
+    def gpt2_small(**kw):
+        return GPTConfig(hidden_size=768, num_hidden_layers=12, num_attention_heads=12, **kw)
+
+    @staticmethod
+    def gpt3_1p3b(**kw):
+        return GPTConfig(hidden_size=2048, num_hidden_layers=24, num_attention_heads=16,
+                         max_position_embeddings=2048, **kw)
+
+    @staticmethod
+    def gpt3_6p7b(**kw):
+        return GPTConfig(hidden_size=4096, num_hidden_layers=32, num_attention_heads=32,
+                         max_position_embeddings=2048, **kw)
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=I.Normal(std=config.initializer_range)),
+        )
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=I.Normal(std=config.initializer_range)),
+        )
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        from ... import tensor as pt
+
+        if position_ids is None:
+            seq = input_ids.shape[1]
+            position_ids = pt.arange(0, seq, dtype="int64")
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        return self.dropout(emb)
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention: fused mp-sharded QKV projection + flash kernel."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+        self.dropout_p = config.attention_probs_dropout_prob
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x):
+        b, t, h = x.shape
+        qkv = self.qkv_proj(x)  # [b, t, 3h] (hidden mp-sharded)
+        qkv = qkv.reshape([b, t, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, t, H, d]
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.dropout_p, is_causal=True, training=self.training
+        )
+        out = out.reshape([b, t, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(config.hidden_size, config.intermediate_size, gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size, config.hidden_size, input_is_parallel=True)
+        self.act = F.gelu if config.hidden_act == "gelu" else getattr(F, config.hidden_act)
+
+    def forward(self, x):
+        return self.fc_out(self.act(self.fc_in(x)))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN block. Structurally uniform across depth → SpmdPipeline-stackable."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self._use_recompute = config.use_recompute
+        self._sequence_parallel = config.sequence_parallel
+
+    def _block(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        if self._sequence_parallel:
+            x = mark_activation(x, seq_mp=True)
+        return x
+
+    def forward(self, x):
+        if self._use_recompute:
+            return _recompute(self._block, x)
+        return self._block(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        blocks = [GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        pp = _mesh.mesh_axis_size("pp")
+        if pp > 1 and config.num_hidden_layers % pp == 0:
+            from ...distributed.fleet.meta_parallel.pipeline_parallel import SpmdPipeline
+
+            self.decoder = SpmdPipeline(
+                blocks, num_stages=pp, recompute_block=config.use_recompute
+            )
+        else:
+            self.decoder = nn.LayerList(blocks)
+        self.final_layernorm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        if isinstance(self.decoder, nn.LayerList):
+            for blk in self.decoder:
+                x = blk(x)
+        else:
+            x = self.decoder(x)
+        return self.final_layernorm(x)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Masked LM loss over mp-sharded logits (reference:
+    GPTPretrainingCriterion with c_softmax_with_cross_entropy)."""
+
+    def __init__(self, config: Optional[GPTConfig] = None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=-100)
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = self.ce(logits, labels)
+        if loss_mask is not None:
+            lm = loss_mask.reshape(loss.shape)
+            return (loss * lm).sum() / lm.sum().clip(min=1.0)
+        return loss.mean()
+
+
+class GPTForCausalLM(nn.Layer):
+    """GPT with a (tied) LM head — PaddleNLP GPTForCausalLM/GPTForPretraining."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False, gather_output=False
+            )
+        self.criterion = GPTPretrainingCriterion(config)
+
+    def _logits(self, hidden):
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight  # [V, h], mp-sharded on V
+            logits = F.linear(hidden, w.t())
+            return mark_activation(logits, last_mp=True)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, position_ids=None, labels=None, loss_mask=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = self._logits(hidden)
+        if labels is not None:
+            return self.criterion(logits, labels, loss_mask)
+        return logits
+
+
+GPTLMHeadModel = GPTForCausalLM
+GPTForPretraining = GPTForCausalLM
