@@ -1,0 +1,82 @@
+"""Headline benchmark: ERNIE-3.0-base fine-tune throughput, tokens/sec/chip.
+
+This is the BASELINE.json headline metric ("ERNIE-3.0 tokens/sec/chip").
+One compiled train step (fwd + bwd + AdamW) of ERNIE-3.0-base
+(12L / 768h / 12 heads) sequence classification under bf16 autocast,
+seq_len=128, on whatever single accelerator is visible (the driver runs this
+on one real TPU chip).
+
+Baseline anchor: the north star is ">=0.8x per-chip H100 throughput". No
+reference numbers exist in-repo (BASELINE.json published: {}), so we anchor
+on a public-knowledge estimate of H100 mixed-precision fine-tune throughput
+for a BERT/ERNIE-base-class encoder at seq 128: ~600k tokens/s/GPU;
+0.8x => 480k tokens/s is the vs_baseline=1.0 mark.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 480_000.0  # 0.8 x est. H100 per-chip (see docstring)
+
+BATCH = 32
+SEQ = 128
+WARMUP = 3
+STEPS = 10
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.text.models import ErnieConfig, ErnieForSequenceClassification
+
+    paddle.seed(0)
+    cfg = ErnieConfig(
+        vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+        num_attention_heads=12, intermediate_size=3072,
+        hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+        max_position_embeddings=2048,
+    )
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-5, parameters=model.parameters())
+    step = TrainStep(model, lambda m, ids, y: m(ids, labels=y), opt)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype(np.int32))
+    y = paddle.to_tensor(rng.integers(0, 2, (BATCH,)).astype(np.int32))
+
+    def one_step():
+        with amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+            return step(ids, y)
+
+    for _ in range(WARMUP):
+        loss = one_step()
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss = one_step()
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ * STEPS / dt
+    print(json.dumps({
+        "metric": "ernie3.0-base finetune tokens/sec/chip (bf16, seq128)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
+    }))
+    print(f"# loss={float(loss):.4f} step_time={dt / STEPS * 1e3:.1f}ms "
+          f"device={jax.devices()[0].platform}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""paddle_tpu.ops — hand-written TPU kernels (Pallas).
+
+Reference analogue (SURVEY.md §2.1 "PHI kernels"): Paddle hand-writes CUDA
+kernels per op; here XLA generates almost everything and Pallas covers only
+the ops XLA can't fuse optimally — flash attention, ring attention, MoE
+grouped matmul (SURVEY.md §7 step 8).
+"""
+from . import pallas  # noqa: F401

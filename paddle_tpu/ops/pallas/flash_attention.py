@@ -1,0 +1,170 @@
+"""Flash attention — Pallas TPU kernel.
+
+Reference capability (SURVEY.md §2.3 "CP" row, §5 "Long-context"): Paddle
+wraps the external flashattn CUDA library
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu`,
+`python/paddle/nn/functional/flash_attention.py`).
+
+TPU-native design: an online-softmax blockwise kernel (the flash-attention
+recurrence) written in Pallas. Q/K/V blocks stream HBM→VMEM per grid step;
+the MXU does the [block_q, d] x [d, block_k] logits and [block_q, block_k] x
+[block_k, d] accumulation in fp32; running max/denominator live in VMEM
+scratch across the innermost (key) grid dimension. Causal masking skips
+whole key blocks above the diagonal (predicated with pl.when), so compute is
+~halved for causal LM — the same tiling strategy as splash attention.
+
+Backward: jax.custom_vjp whose bwd differentiates the jnp reference (XLA
+fuses it well); a dedicated bwd kernel is a later optimization.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is optional at import time (CPU test runs)
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fa_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, seq_len: int, block_q: int, block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: key block strictly above the diagonal contributes nothing
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_idx < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, k_idx <= q_idx)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [block_q, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
+    """q,k,v: [BH, T, D] → o: [BH, T, D]."""
+    bh, t, d = q.shape
+    block_q = min(block_q, max(t, 8))
+    block_k = min(block_k, max(t, 8))
+    pad_q = (-t) % block_q
+    pad_k = (-t) % block_k
+    tq, tk = t + pad_q, t + pad_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    nq, nk = tq // block_q, tk // block_k
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, seq_len=t,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+    )
+    vmem = pltpu.VMEM if pltpu is not None else pl.ANY
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            vmem((block_q, 128), jnp.float32),
+            vmem((block_q, 128), jnp.float32),
+            vmem((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :t] if pad_q else out
+
+
+def _reference(q, k, v, causal, scale):
+    # [BH, T, D] reference used only for the backward pass
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = s.shape[-1]
+        cm = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(cm, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fa(q, k, v, causal, scale, interpret):
+    return _fa_forward(q, k, v, causal, scale, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret):
+    return _fa(q, k, v, causal, scale, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, scale, interpret, res, do):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal, scale), q, k, v)
+    return vjp(do)
+
+
+_fa.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None):
+    """q, k, v: [B, T, H, D] (paddle flash-attention layout) → [B, T, H, D]."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    interpret = jax.default_backend() != "tpu"
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    o = _fa(fold(q), fold(k), fold(v), bool(causal), float(scale), interpret)
+    return jnp.swapaxes(o.reshape(b, h, t, d), 1, 2)

@@ -1,0 +1,78 @@
+"""Flash-attention (Pallas, interpret on CPU) and ring-attention tests.
+
+Mirrors the reference's op-test pattern (SURVEY.md §4): kernel vs dense
+NumPy/jnp reference for forward, and analytic-grad parity for backward.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+from paddle_tpu.nn.functional.ring_attention import context_parallel_attention
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _rand(b, t, h, d, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def test_flash_attention_matches_reference():
+    q, k, v = _rand(2, 100, 2, 32)  # odd length exercises padding/masking
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal)
+        ref = _sdpa_reference(q, k, v, None, 0.0, causal, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_grads():
+    q, k, v = _rand(1, 64, 2, 16)
+
+    def f_pl(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).mean()
+
+    def f_ref(q, k, v):
+        return (_sdpa_reference(q, k, v, None, 0.0, True, None) ** 2).mean()
+
+    g_pl = jax.grad(f_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_routes_to_flash_kernel():
+    """The public functional uses the Pallas kernel when mask/dropout allow."""
+    import paddle_tpu.nn.functional as F
+
+    q, k, v = _rand(1, 32, 2, 16)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), is_causal=True
+    )
+    ref = _sdpa_reference(q, k, v, None, 0.0, True, None)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_exactness():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(sep_degree=8)
+    fleet.init(is_collective=True, strategy=s)
+    q, k, v = _rand(2, 64, 2, 16)
+    for causal in (False, True):
+        out = context_parallel_attention(q, k, v, causal=causal)
+        ref = _sdpa_reference(q, k, v, None, 0.0, causal, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_grad():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(sep_degree=8)
+    fleet.init(is_collective=True, strategy=s)
+    q, k, v = _rand(1, 32, 2, 8)
+    g = jax.grad(lambda q: (context_parallel_attention(q, k, v, causal=True) ** 2).mean())(q)
+    gr = jax.grad(lambda q: (_sdpa_reference(q, k, v, None, 0.0, True, None) ** 2).mean())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4, atol=1e-6)
