@@ -1,0 +1,9 @@
+"""paddle.incubate parity namespace: MoE and experimental distributed models
+(SURVEY.md §2.2 "Incubate")."""
+from . import moe  # noqa: F401
+from .moe import MoELayer, global_gather, global_scatter  # noqa: F401
+
+
+class distributed:  # paddle.incubate.distributed.models.moe path parity
+    class models:
+        from . import moe

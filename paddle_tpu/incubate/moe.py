@@ -1,0 +1,190 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference capability (SURVEY.md §2.3 "Expert parallel (EP/MoE)"):
+`python/paddle/incubate/distributed/models/moe/moe_layer.py` — gshard/switch
+gating with capacity, `global_scatter`/`global_gather` all-to-all dispatch
+ops (CUDA), per-rank expert FFNs.
+
+TPU-native design (GShard formulation): gating produces dispatch/combine
+tensors; dispatch is an einsum into a dense [experts, capacity, hidden]
+buffer, experts run as ONE batched matmul over the expert dim (MXU-friendly,
+no ragged loops), combine is the transpose einsum. The expert dim is sharded
+over a mesh axis, so GSPMD emits the token all-to-all that the reference's
+global_scatter/global_gather implement by hand. Static capacity keeps shapes
+XLA-compatible; dropped tokens (over capacity) pass through the residual,
+exactly like capacity-factor semantics in the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+from ..distributed import mesh as _mesh
+
+
+def _expert_axis() -> Optional[str]:
+    """Mesh axis carrying the expert dim: prefer a dedicated data axis."""
+    m = _mesh.get_global_mesh()
+    if m is None:
+        return None
+    for name in ("sharding", "dp", "sep"):
+        if name in m.shape and m.shape[name] > 1:
+            return name
+    return None
+
+
+@defop(name="moe_gate_dispatch")
+def _gshard_gating(logits, key, k, capacity, use_aux_noise):
+    """Top-k gating with static capacity (gshard/switch).
+
+    logits: [G, E] (G tokens). Returns (combine [G,E,C], dispatch bool
+    [G,E,C], aux_loss scalar).
+    """
+    g, e = logits.shape
+    if use_aux_noise and key is not None:
+        logits = logits + jax.random.gumbel(key, logits.shape) * 0.01
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((g, e), jnp.float32)
+    remaining = probs
+    position_in_expert = jnp.zeros((g, e), jnp.int32)
+    fill = jnp.zeros((e,), jnp.int32)
+    masks = []
+    gates = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)  # [G]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        gates.append((probs * onehot).sum(-1))
+        # position of each token within its chosen expert queue
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + fill[None, :].astype(jnp.float32)
+        pos = (pos * onehot).sum(-1).astype(jnp.int32)  # [G]
+        keep = pos < capacity
+        masks.append((onehot, pos, keep))
+        fill = fill + onehot.sum(0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+
+    # aux load-balancing loss (gshard): E * mean(fraction)·mean(prob)
+    density = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32), 0)
+    density_proxy = jnp.mean(probs, 0)
+    aux = jnp.sum(density * density_proxy) * (e * e) / max(k, 1)
+
+    denom = sum(gt * m[2] for gt, m in zip(gates, masks))
+    denom = jnp.maximum(denom, 1e-9)
+    dispatch = jnp.zeros((g, e, capacity), bool)
+    combine3 = jnp.zeros((g, e, capacity), jnp.float32)
+    for gt, (onehot, pos, keep) in zip(gates, masks):
+        w = (gt / denom) * keep.astype(jnp.float32)
+        sel = onehot.astype(bool) & keep[:, None]
+        oh_cap = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [G, C]
+        combine3 = combine3 + w[:, None, None] * onehot[:, :, None] * oh_cap[:, None, :]
+        dispatch = dispatch | (sel[:, :, None] & (oh_cap[:, None, :] > 0))
+    return combine3, dispatch, aux
+
+
+class MoELayer(nn.Layer):
+    """GShard-style MoE FFN (paddle.incubate MoELayer parity).
+
+    experts: number of expert FFNs (global). Weights are stored stacked
+    [E, ...] with the expert dim sharded over the expert-parallel mesh axis.
+    """
+
+    def __init__(
+        self,
+        d_model: int,
+        d_hidden: int,
+        num_experts: int,
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+        gate: str = "gshard",
+        aux_loss_weight: float = 1e-2,
+        activation=None,
+    ):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = 1 if gate == "switch" else top_k
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+        self.act = activation or F.gelu
+        self.gate = nn.Linear(d_model, num_experts)
+        init = I.XavierNormal()
+        self.w_in = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=init
+        )
+        self.b_in = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w_out = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=init
+        )
+        self.b_out = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        ax = _expert_axis()
+        if ax is not None and num_experts % _mesh.mesh_axis_size(ax) == 0:
+            for p in (self.w_in, self.b_in, self.w_out, self.b_out):
+                p.dist_spec = P(ax)
+                p.is_distributed = True
+        self.last_aux_loss = None
+
+    def forward(self, x):
+        b, t, h = x.shape
+        g = b * t
+        capacity = max(
+            self.top_k, int(math.ceil(self.top_k * self.capacity_factor * g / self.num_experts))
+        )
+        flat = x.reshape([g, h])
+        logits = self.gate(flat)
+        from ..framework import rng as _rng
+
+        key = _rng.next_key() if self.training else None
+        combine, dispatch, aux = _gshard_gating(
+            logits, key, self.top_k, capacity, self.training
+        )
+        self.last_aux_loss = aux * self.aux_loss_weight
+        out = _moe_apply(
+            flat, combine, dispatch, self.w_in, self.b_in, self.w_out, self.b_out,
+            self.act,
+        )
+        return out.reshape([b, t, h])
+
+
+@defop(name="moe_apply")
+def _moe_apply(flat, combine, dispatch, w_in, b_in, w_out, b_out, act):
+    # dispatch tokens into per-expert buffers: [E, C, h]
+    expert_in = jnp.einsum("gec,gh->ech", dispatch.astype(flat.dtype), flat)
+    spec = None
+    m = _mesh.get_global_mesh()
+    ax = _expert_axis()
+    if m is not None and ax is not None and expert_in.shape[0] % m.shape[ax] == 0:
+        # pin the expert buffers to the expert axis — this is the all-to-all
+        expert_in = _mesh.sharding_constraint(expert_in, P(ax))
+    hidden = raw(act(jnp.einsum("ech,ehf->ecf", expert_in, w_in) + b_in))
+    expert_out = jnp.einsum("ecf,efh->ech", hidden, w_out) + b_out
+    if m is not None and ax is not None and expert_out.shape[0] % m.shape[ax] == 0:
+        expert_out = _mesh.sharding_constraint(expert_out, P(ax))
+    # combine back to tokens
+    return jnp.einsum("gec,ech->gh", combine.astype(flat.dtype), expert_out)
+
+
+# ------------------------------------------------- global_scatter / gather --
+def global_scatter(x, local_count=None, global_count=None, group=None):
+    """Reference `global_scatter` op parity: the token all-to-all. Under SPMD
+    this is a resharding of the expert-major buffer onto the expert axis."""
+    ax = _expert_axis()
+    if ax is None:
+        return x
+    return Tensor(_mesh.sharding_constraint(raw(x), P(ax)))
+
+
+def global_gather(x, local_count=None, global_count=None, group=None):
+    ax = _expert_axis()
+    if ax is None:
+        return x
+    return Tensor(_mesh.sharding_constraint(raw(x), P()))
