@@ -1,0 +1,111 @@
+"""Elastic / fault-tolerant training (fleet.elastic parity, TPU-shaped).
+
+Reference capability (SURVEY.md §5 "Failure detection/elastic"): etcd-backed
+`ElasticManager` — node registry, heartbeat watch, scale events trigger
+re-rendezvous and relaunch; training resumes from the last checkpoint.
+
+TPU-native design (SURVEY.md §7 "Hard parts — Elastic"): TPU slices fail as
+a UNIT, so elasticity is not rank replacement but **fast checkpoint-resume**:
+a `CheckpointManager`-style loop (orbax-backed, async, sharded) snapshots
+every N steps with bounded retention; on restart — same or different
+topology — `latest_step()` + `restore()` re-shards onto the live mesh and
+training continues. The etcd membership machinery has no analogue to port:
+membership is the job scheduler's concern (GKE/Borg restart the slice).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..checkpoint import load_state_dict, save_state_dict
+
+
+class ElasticManager:
+    """Checkpoint-resume driver. API kept close to a paddle training loop:
+
+        elastic = ElasticManager(ckpt_dir, save_interval=100)
+        start = elastic.resume(model, optimizer)          # 0 if fresh
+        for step in range(start, total):
+            loss = train_step(...)
+            elastic.maybe_save(step, model, optimizer)
+    """
+
+    def __init__(self, ckpt_dir: str, save_interval: int = 100, max_to_keep: int = 3,
+                 async_save: bool = False):
+        self.ckpt_dir = os.path.abspath(ckpt_dir)
+        self.save_interval = max(1, int(save_interval))
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        self._pending = None
+
+    # -- discovery ----------------------------------------------------------
+    def _step_dirs(self):
+        out = {}
+        for name in os.listdir(self.ckpt_dir):
+            if name.startswith("step_") and name[5:].isdigit():
+                out[int(name[5:])] = os.path.join(self.ckpt_dir, name)
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._step_dirs()
+        return max(steps) if steps else None
+
+    # -- save/restore -------------------------------------------------------
+    def _state(self, model, optimizer=None, extra: Optional[Dict[str, Any]] = None):
+        state = dict(model.state_dict())
+        if optimizer is not None:
+            if hasattr(optimizer, "functional_states"):
+                optimizer.functional_states()  # materialize accumulators so
+                # a fresh optimizer's restore target matches the snapshot
+            for k, v in optimizer.state_dict().items():
+                state[f"__opt__.{k}"] = v
+        if extra:
+            for k, v in extra.items():
+                state[f"__extra__.{k}"] = v
+        return state
+
+    def maybe_save(self, step: int, model, optimizer=None, extra=None) -> bool:
+        if (step + 1) % self.save_interval != 0:
+            return False
+        self.save(step, model, optimizer, extra)
+        return True
+
+    def save(self, step: int, model, optimizer=None, extra=None):
+        path = os.path.join(self.ckpt_dir, f"step_{step}")
+        if self._pending is not None:
+            try:
+                self._pending.wait_until_finished()
+            except Exception:
+                pass
+        self._pending = save_state_dict(
+            self._state(model, optimizer, extra), path, async_save=self.async_save
+        )
+        self._gc()
+
+    def _gc(self):
+        steps = sorted(self._step_dirs())
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            import shutil
+
+            shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{victim}"), ignore_errors=True)
+
+    def resume(self, model, optimizer=None) -> int:
+        """Restore latest snapshot (re-sharding onto the live mesh); returns
+        the next step index to run (0 when no checkpoint exists)."""
+        step = self.latest_step()
+        if step is None:
+            return 0
+        path = os.path.join(self.ckpt_dir, f"step_{step}")
+        state = self._state(model, optimizer)
+        load_state_dict(path, state)
+        # push optimizer entries back
+        if optimizer is not None:
+            opt_state = {
+                k[len("__opt__."):]: v for k, v in state.items() if k.startswith("__opt__.")
+            }
+            if opt_state:
+                optimizer.set_state_dict(opt_state)
+        model.set_state_dict({k: v for k, v in state.items() if not k.startswith("__")})
+        return step + 1

@@ -1,0 +1,222 @@
+"""paddle.profiler parity — tracing & performance summaries.
+
+Reference capability (SURVEY.md §5 "Tracing/profiling"):
+`paddle.profiler.Profiler` with host tracer (scoped `RecordEvent`) + CUPTI
+device tracer, Chrome-trace export, scheduler (`make_scheduler`), and
+`summary()` tables.
+
+TPU-native design: the device tracer is the XLA/PJRT profiler
+(`jax.profiler.start_trace` → XPlane, viewable in TensorBoard/Perfetto/xprof);
+host annotations are `jax.profiler.TraceAnnotation`s, which the runtime
+stitches into the same timeline. The host-side op timer used for `summary()`
+is a lightweight wall-clock aggregator (the per-op C++ timer of the
+reference is meaningless under whole-program XLA execution — the compiled
+step is the unit)."""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int = 0, ready: int = 0, record: int = 1, repeat: int = 0, skip_first: int = 0):
+    """paddle.profiler.make_scheduler parity: step-state machine."""
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory: keep the XPlane/trace files under dir_name."""
+
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+class RecordEvent:
+    """Scoped host annotation (reference: platform::RecordEvent).
+
+    Shows up in the XLA trace timeline and in Profiler.summary().
+    """
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        _host_events[self.name][0] += 1
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _host_events[self.name][1] += time.perf_counter() - self._t0
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+_host_events = collections.defaultdict(lambda: [0, 0.0])  # name -> [count, secs]
+
+
+class Profiler:
+    def __init__(
+        self,
+        *,
+        targets: Optional[Iterable] = None,
+        scheduler=None,
+        on_trace_ready: Optional[Callable] = None,
+        timer_only: bool = False,
+        record_shapes: bool = False,
+        profile_memory: bool = False,
+        with_flops: bool = False,
+    ):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo, repeat=1)
+        else:
+            self._scheduler = None
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._export_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._step = 0
+        self._tracing = False
+        self._step_times = []
+        self._last_step_t = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._last_step_t = time.perf_counter()
+        if not self._timer_only and self._scheduler is None:
+            self._start_trace()
+        return self
+
+    def stop(self):
+        if self._tracing:
+            self._stop_trace()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        return self
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+        if self._scheduler is not None and not self._timer_only:
+            state = self._scheduler(self._step)
+            if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                if not self._tracing:
+                    self._start_trace()
+            elif self._tracing:
+                self._stop_trace()
+
+    def _start_trace(self):
+        try:
+            jax.profiler.start_trace(self._export_dir)
+            self._tracing = True
+        except Exception:
+            self._tracing = False
+
+    def _stop_trace(self):
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting ----------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        lines = ["-- paddle_tpu profiler summary " + "-" * 30]
+        if self._step_times:
+            ts = self._step_times
+            lines.append(
+                f"steps: {len(ts)}  avg: {sum(ts) / len(ts) * 1e3:.2f} ms  "
+                f"min: {min(ts) * 1e3:.2f} ms  max: {max(ts) * 1e3:.2f} ms"
+            )
+        if _host_events:
+            lines.append(f"{'event':40s} {'count':>8s} {'total ms':>12s}")
+            for name, (cnt, secs) in sorted(_host_events.items(), key=lambda kv: -kv[1][1]):
+                lines.append(f"{name:40s} {cnt:8d} {secs * 1e3:12.2f}")
+        if self._tracing or os.path.isdir(self._export_dir):
+            lines.append(f"device trace (XPlane): {self._export_dir}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+@contextlib.contextmanager
+def profile(dir_name: str = "/tmp/paddle_tpu_profile"):
+    """Simple context: trace everything inside to `dir_name`."""
+    jax.profiler.start_trace(dir_name)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler(dir_name: str = "/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(dir_name)
+
+
+def stop_profiler(*a, **k):
+    jax.profiler.stop_trace()
+
+
+load_profiler_result = None  # chrome-trace reload: covered by TensorBoard/xprof
