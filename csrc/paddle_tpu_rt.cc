@@ -1,0 +1,795 @@
+// paddle_tpu native runtime core.
+//
+// TPU-native equivalents of the reference's C++ runtime services
+// (SURVEY.md §2.1 / §2.4):
+//   * Arena allocator  — auto-growth best-fit caching allocator with stats
+//     (reference capability: paddle/fluid/memory/allocation/
+//      auto_growth_best_fit_allocator.cc). On TPU the device HBM is managed
+//     by PJRT/XLA; what the framework still owns is *host* staging memory
+//     for the input pipeline — batch assembly buffers that feed
+//     device_put. This allocator backs those.
+//   * TCPStore         — coordination KV service for multi-host bootstrap
+//     (reference capability: paddle/phi/core/distributed/store/tcp_store.cc).
+//     master listens; clients set/get/add/wait; barriers built on add+wait.
+//   * Batch stacker    — parallel memcpy of N sample buffers into one
+//     contiguous batch (the hot loop of DataLoader collate; the reference
+//     does this in its C++ dataloader workers + shared memory).
+//   * Trace buffer     — host-side RecordEvent ring with chrome-trace
+//     export (reference capability: paddle/fluid/platform/profiler/
+//      host_tracer.cc + chrometracing_logger.cc).
+//
+// Exposed as a plain C API consumed via ctypes (no pybind11 in this image).
+// Everything is thread-safe unless noted.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+// ===========================================================================
+// Arena allocator (auto-growth best-fit with coalescing free)
+// ===========================================================================
+namespace {
+
+constexpr size_t kAlign = 64;
+
+static size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+struct Chunk;
+
+struct Block {
+  char* ptr;
+  size_t size;
+  bool free_;
+  Chunk* chunk;
+  Block* prev;  // address-ordered neighbors within the chunk
+  Block* next;
+  std::multimap<size_t, Block*>::iterator free_it;  // valid iff free_
+};
+
+struct Chunk {
+  char* base;
+  size_t size;
+};
+
+class Arena {
+ public:
+  explicit Arena(size_t chunk_size) : chunk_size_(chunk_size) {}
+
+  ~Arena() {
+    for (auto& c : chunks_) ::free(c->base);
+    for (auto& c : chunks_) delete c;
+    for (auto* b : all_blocks_) delete b;
+  }
+
+  void* alloc(size_t n) {
+    n = align_up(n ? n : 1);
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_blocks_.lower_bound(n);
+    Block* b;
+    if (it == free_blocks_.end()) {
+      b = grow(n);
+      if (!b) return nullptr;
+    } else {
+      b = it->second;
+      free_blocks_.erase(it);
+      b->free_ = false;
+    }
+    maybe_split(b, n);
+    allocated_ += b->size;
+    peak_ = std::max(peak_, allocated_);
+    ++alloc_count_;
+    live_.emplace(b->ptr, b);
+    return b->ptr;
+  }
+
+  void free(void* p) {
+    if (!p) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = live_.find(static_cast<char*>(p));
+    if (it == live_.end()) return;  // double free / foreign pointer: ignore
+    Block* b = it->second;
+    live_.erase(it);
+    allocated_ -= b->size;
+    b->free_ = true;
+    // coalesce with address neighbors
+    if (b->next && b->next->free_) {
+      Block* n = b->next;
+      free_blocks_.erase(n->free_it);
+      b->size += n->size;
+      unlink(n);
+    }
+    if (b->prev && b->prev->free_) {
+      Block* pr = b->prev;
+      free_blocks_.erase(pr->free_it);
+      pr->size += b->size;
+      unlink(b);
+      b = pr;
+    }
+    b->free_it = free_blocks_.emplace(b->size, b);
+  }
+
+  // out: allocated, reserved, peak_allocated, alloc_count
+  void stats(uint64_t out[4]) {
+    std::lock_guard<std::mutex> g(mu_);
+    out[0] = allocated_;
+    out[1] = reserved_;
+    out[2] = peak_;
+    out[3] = alloc_count_;
+  }
+
+ private:
+  Block* grow(size_t n) {
+    size_t sz = std::max(n, chunk_size_);
+    char* base = static_cast<char*>(::malloc(sz));
+    if (!base) return nullptr;
+    auto* c = new Chunk{base, sz};
+    chunks_.push_back(c);
+    reserved_ += sz;
+    auto* b = new Block{base, sz, false, c, nullptr, nullptr, {}};
+    all_blocks_.push_back(b);
+    return b;
+  }
+
+  void maybe_split(Block* b, size_t n) {
+    if (b->size >= n + kAlign * 2) {
+      auto* rest = new Block{b->ptr + n, b->size - n, true,
+                             b->chunk,   b,           b->next, {}};
+      all_blocks_.push_back(rest);
+      if (b->next) b->next->prev = rest;
+      b->next = rest;
+      b->size = n;
+      rest->free_it = free_blocks_.emplace(rest->size, rest);
+    }
+  }
+
+  void unlink(Block* b) {
+    if (b->prev) b->prev->next = b->next;
+    if (b->next) b->next->prev = b->prev;
+    b->size = 0;
+    b->free_ = false;  // dead block, kept in all_blocks_ for cleanup
+  }
+
+  std::mutex mu_;
+  size_t chunk_size_;
+  std::multimap<size_t, Block*> free_blocks_;
+  std::unordered_map<char*, Block*> live_;
+  std::vector<Chunk*> chunks_;
+  std::vector<Block*> all_blocks_;
+  uint64_t allocated_ = 0, reserved_ = 0, peak_ = 0, alloc_count_ = 0;
+};
+
+}  // namespace
+
+PT_EXPORT void* pt_arena_create(uint64_t chunk_size) {
+  return new Arena(chunk_size ? chunk_size : (64u << 20));
+}
+PT_EXPORT void pt_arena_destroy(void* a) { delete static_cast<Arena*>(a); }
+PT_EXPORT void* pt_arena_alloc(void* a, uint64_t n) {
+  return static_cast<Arena*>(a)->alloc(n);
+}
+PT_EXPORT void pt_arena_free(void* a, void* p) {
+  static_cast<Arena*>(a)->free(p);
+}
+PT_EXPORT void pt_arena_stats(void* a, uint64_t out[4]) {
+  static_cast<Arena*>(a)->stats(out);
+}
+
+// ===========================================================================
+// Thread pool + batch stacker
+// ===========================================================================
+namespace {
+
+class Pool {
+ public:
+  explicit Pool(int n) {
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this] { run(); });
+  }
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+  void submit(std::function<void()> f) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push_back(std::move(f));
+    }
+    cv_.notify_one();
+  }
+  size_t size() const { return workers_.size(); }
+
+ private:
+  void run() {
+    for (;;) {
+      std::function<void()> f;
+      {
+        std::unique_lock<std::mutex> l(mu_);
+        cv_.wait(l, [this] { return stop_ || !q_.empty(); });
+        if (stop_ && q_.empty()) return;
+        f = std::move(q_.front());
+        q_.pop_front();
+      }
+      f();
+    }
+  }
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> q_;
+  std::vector<std::thread> workers_;
+  bool stop_ = false;
+};
+
+Pool* global_pool(int nthreads) {
+  static Pool* p = new Pool(std::max(
+      1, nthreads > 0 ? nthreads
+                      : static_cast<int>(std::thread::hardware_concurrency())));
+  return p;
+}
+
+}  // namespace
+
+// Stack n equally-sized sample buffers into dst (contiguous batch).
+// Parallelized over samples via the shared pool; caller may release the GIL.
+PT_EXPORT void pt_stack(void* dst, void* const* srcs, int64_t n,
+                        uint64_t bytes_per_sample, int nthreads) {
+  char* d = static_cast<char*>(dst);
+  if (n <= 0) return;
+  // Small batches: do it inline, the pool handoff would dominate.
+  if (static_cast<uint64_t>(n) * bytes_per_sample < (1u << 20) || n == 1) {
+    for (int64_t i = 0; i < n; ++i)
+      memcpy(d + i * bytes_per_sample, srcs[i], bytes_per_sample);
+    return;
+  }
+  Pool* pool = global_pool(nthreads);
+  int shards = static_cast<int>(std::min<int64_t>(n, pool->size()));
+  std::atomic<int> done{0};
+  std::mutex mu;
+  std::condition_variable cv;
+  int64_t per = (n + shards - 1) / shards;
+  for (int s = 0; s < shards; ++s) {
+    int64_t lo = s * per, hi = std::min<int64_t>(n, lo + per);
+    pool->submit([=, &done, &mu, &cv] {
+      for (int64_t i = lo; i < hi; ++i)
+        memcpy(d + i * bytes_per_sample, srcs[i], bytes_per_sample);
+      if (done.fetch_add(1) + 1 == shards) {
+        std::lock_guard<std::mutex> g(mu);
+        cv.notify_all();
+      }
+    });
+  }
+  std::unique_lock<std::mutex> l(mu);
+  cv.wait(l, [&] { return done.load() == shards; });
+}
+
+// ===========================================================================
+// Trace buffer (host RecordEvent ring + chrome trace export)
+// ===========================================================================
+namespace {
+
+struct TraceEvent {
+  std::string name;
+  std::string cat;
+  int64_t ts_ns;
+  int64_t dur_ns;
+  int64_t tid;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<TraceEvent> events;
+  bool on = false;
+  size_t cap = 1u << 20;
+};
+
+Tracer& tracer() {
+  static Tracer t;
+  return t;
+}
+
+void json_escape(const std::string& s, std::string* out) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (static_cast<unsigned char>(c) >= 0x20) {
+      out->push_back(c);
+    }
+  }
+}
+
+}  // namespace
+
+PT_EXPORT int64_t pt_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+PT_EXPORT void pt_trace_start() {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> g(t.mu);
+  t.events.clear();
+  t.on = true;
+}
+
+PT_EXPORT void pt_trace_stop() {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> g(t.mu);
+  t.on = false;
+}
+
+PT_EXPORT int pt_trace_enabled() { return tracer().on ? 1 : 0; }
+
+PT_EXPORT void pt_trace_record(const char* name, const char* cat,
+                               int64_t ts_ns, int64_t dur_ns, int64_t tid) {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> g(t.mu);
+  if (!t.on || t.events.size() >= t.cap) return;
+  t.events.push_back(TraceEvent{name ? name : "", cat ? cat : "op", ts_ns,
+                                dur_ns, tid});
+}
+
+PT_EXPORT int64_t pt_trace_count() {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> g(t.mu);
+  return static_cast<int64_t>(t.events.size());
+}
+
+// Export chrome-trace "traceEvents" JSON array into out (utf-8).
+// Returns bytes needed; writes at most cap bytes. Call with cap=0 to size.
+PT_EXPORT int64_t pt_trace_export(char* out, int64_t cap) {
+  auto& t = tracer();
+  std::lock_guard<std::mutex> g(t.mu);
+  std::string s = "[";
+  for (size_t i = 0; i < t.events.size(); ++i) {
+    auto& e = t.events[i];
+    if (i) s += ",";
+    s += "{\"name\":\"";
+    json_escape(e.name, &s);
+    s += "\",\"cat\":\"";
+    json_escape(e.cat, &s);
+    s += "\",\"ph\":\"X\",\"pid\":0,\"tid\":" + std::to_string(e.tid) +
+         ",\"ts\":" + std::to_string(e.ts_ns / 1000.0) +
+         ",\"dur\":" + std::to_string(e.dur_ns / 1000.0) + "}";
+  }
+  s += "]";
+  int64_t need = static_cast<int64_t>(s.size());
+  if (out && cap > 0) memcpy(out, s.data(), std::min<int64_t>(need, cap));
+  return need;
+}
+
+// ===========================================================================
+// TCPStore — coordination KV service
+// ===========================================================================
+namespace {
+
+// wire protocol (all little-endian):
+//   request:  u8 cmd | u32 klen | key | (u64 vlen | val)? | (f64 timeout)?
+//   cmds: 1 SET(key,val) 2 GET(key,timeout) 3 ADD(key,i64 delta)
+//         4 WAIT(key,timeout) 5 CHECK(key) 6 DEL(key)
+//   response: SET/DEL/CHECK/WAIT -> u8 status; GET -> i64 len,bytes;
+//             ADD -> i64 newval
+enum Cmd : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, CHECK = 5, DEL = 6 };
+
+// Resolve a hostname or dotted quad to an IPv4 address (network order).
+// Returns false if unresolvable.
+bool resolve_ipv4(const char* host, in_addr* out) {
+  in_addr_t a = inet_addr(host);
+  if (a != INADDR_NONE) {
+    out->s_addr = a;
+    return true;
+  }
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) return false;
+  *out = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  // Returns bound port, or -1.
+  int start(const char* host, int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (host && *host) {
+      // Bind the master's address specifically: on a host that does not own
+      // it, bind fails (EADDRNOTAVAIL) and the caller correctly falls back
+      // to the client role — the basis of master election in launch.
+      if (!resolve_ipv4(host, &addr.sin_addr)) {
+        ::close(listen_fd_);
+        listen_fd_ = -1;
+        return -1;
+      }
+    } else {
+      addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    }
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return -1;
+    }
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return port_;
+  }
+
+  ~StoreServer() {
+    stop_ = true;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // Graceful drain: shut down only the READ side of live connections, so
+    // a handler blocked in recv wakes up (recv returns 0) while a response
+    // it is mid-way through sending still reaches the peer — a hard
+    // SHUT_RDWR here would RST in-flight response bytes (observed: a
+    // barrier participant's final ack lost when the master exits first).
+    // Each handler closes its own fd on exit (atomic exchange below).
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      for (auto& c : conns_) {
+        int fd = c->load();
+        if (fd >= 0) ::shutdown(fd, SHUT_RD);
+      }
+    }
+    for (auto& t : conn_threads_) t.join();
+  }
+
+  int port() const { return port_; }
+
+ private:
+  void accept_loop() {
+    while (!stop_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto conn = std::make_shared<std::atomic<int>>(fd);
+      std::lock_guard<std::mutex> g(threads_mu_);
+      conns_.push_back(conn);
+      conn_threads_.emplace_back([this, fd, conn] {
+        serve(fd);
+        int f = conn->exchange(-1);
+        if (f >= 0) ::close(f);
+      });
+    }
+  }
+
+  void serve(int fd) {
+    for (;;) {
+      uint8_t cmd;
+      uint32_t klen;
+      if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &klen, 4)) return;
+      if (klen > (1u << 20)) return;
+      std::string key(klen, '\0');
+      if (!recv_all(fd, key.data(), klen)) return;
+      switch (cmd) {
+        case SET: {
+          uint64_t vlen;
+          if (!recv_all(fd, &vlen, 8) || vlen > (1ull << 32)) return;
+          std::vector<uint8_t> val(vlen);
+          if (vlen && !recv_all(fd, val.data(), vlen)) return;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            data_[key] = std::move(val);
+            cv_.notify_all();
+          }
+          uint8_t ok = 1;
+          if (!send_all(fd, &ok, 1)) return;
+          break;
+        }
+        case GET: {
+          double timeout;
+          if (!recv_all(fd, &timeout, 8)) return;
+          std::vector<uint8_t> val;
+          bool found = wait_for_key(key, timeout, &val);
+          int64_t len = found ? static_cast<int64_t>(val.size()) : -1;
+          if (!send_all(fd, &len, 8)) return;
+          if (found && !val.empty() && !send_all(fd, val.data(), val.size()))
+            return;
+          break;
+        }
+        case ADD: {
+          int64_t delta;
+          if (!recv_all(fd, &delta, 8)) return;
+          int64_t nv;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end() && it->second.size() == 8)
+              memcpy(&cur, it->second.data(), 8);
+            nv = cur + delta;
+            std::vector<uint8_t> v(8);
+            memcpy(v.data(), &nv, 8);
+            data_[key] = std::move(v);
+            cv_.notify_all();
+          }
+          if (!send_all(fd, &nv, 8)) return;
+          break;
+        }
+        case WAIT: {
+          double timeout;
+          if (!recv_all(fd, &timeout, 8)) return;
+          uint8_t ok = wait_for_key(key, timeout, nullptr) ? 1 : 0;
+          if (!send_all(fd, &ok, 1)) return;
+          break;
+        }
+        case CHECK: {
+          uint8_t ok;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            ok = data_.count(key) ? 1 : 0;
+          }
+          if (!send_all(fd, &ok, 1)) return;
+          break;
+        }
+        case DEL: {
+          uint8_t ok;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            ok = data_.erase(key) ? 1 : 0;
+          }
+          if (!send_all(fd, &ok, 1)) return;
+          break;
+        }
+        default:
+          return;
+      }
+    }
+  }
+
+  bool wait_for_key(const std::string& key, double timeout_s,
+                    std::vector<uint8_t>* out) {
+    std::unique_lock<std::mutex> l(mu_);
+    auto pred = [&] { return stop_ || data_.count(key) > 0; };
+    if (timeout_s <= 0) {
+      cv_.wait(l, pred);
+    } else if (!cv_.wait_for(
+                   l, std::chrono::duration<double>(timeout_s), pred)) {
+      return false;
+    }
+    // A stop_ wake-up still succeeds when the key exists — a waiter must
+    // not observe "timeout" for a key that was set before shutdown.
+    if (!data_.count(key)) return false;
+    if (out) *out = data_[key];
+    return true;
+  }
+
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<std::shared_ptr<std::atomic<int>>> conns_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, std::vector<uint8_t>> data_;
+};
+
+class StoreClient {
+ public:
+  bool connect_to(const char* host, int port, double timeout_s) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (!resolve_ipv4(host, &addr.sin_addr)) return false;
+    for (;;) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      if (std::chrono::steady_clock::now() > deadline) return false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool set(const std::string& key, const void* val, uint64_t n) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!send_req(SET, key)) return false;
+    if (!send_all(fd_, &n, 8)) return false;
+    if (n && !send_all(fd_, val, n)) return false;
+    uint8_t ok;
+    return recv_all(fd_, &ok, 1) && ok;
+  }
+
+  int64_t get(const std::string& key, void* out, int64_t cap,
+              double timeout_s) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!send_req(GET, key) || !send_all(fd_, &timeout_s, 8)) return -2;
+    int64_t len;
+    if (!recv_all(fd_, &len, 8)) return -2;
+    if (len < 0) return -1;  // timeout
+    std::vector<uint8_t> buf(len);
+    if (len && !recv_all(fd_, buf.data(), len)) return -2;
+    if (out && cap > 0) memcpy(out, buf.data(), std::min<int64_t>(len, cap));
+    return len;
+  }
+
+  int64_t add(const std::string& key, int64_t delta) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!send_req(ADD, key) || !send_all(fd_, &delta, 8)) return INT64_MIN;
+    int64_t nv;
+    if (!recv_all(fd_, &nv, 8)) return INT64_MIN;
+    return nv;
+  }
+
+  int wait(const std::string& key, double timeout_s) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!send_req(WAIT, key) || !send_all(fd_, &timeout_s, 8)) return -1;
+    uint8_t ok;
+    if (!recv_all(fd_, &ok, 1)) return -1;
+    return ok ? 1 : 0;
+  }
+
+  int check(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!send_req(CHECK, key)) return -1;
+    uint8_t ok;
+    if (!recv_all(fd_, &ok, 1)) return -1;
+    return ok;
+  }
+
+  int del(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!send_req(DEL, key)) return -1;
+    uint8_t ok;
+    if (!recv_all(fd_, &ok, 1)) return -1;
+    return ok;
+  }
+
+ private:
+  bool send_req(uint8_t cmd, const std::string& key) {
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    return send_all(fd_, &cmd, 1) && send_all(fd_, &klen, 4) &&
+           send_all(fd_, key.data(), klen);
+  }
+  int fd_ = -1;
+  std::mutex mu_;  // one request in flight per client
+};
+
+struct Store {
+  StoreServer* server = nullptr;  // non-null on master
+  StoreClient client;
+};
+
+}  // namespace
+
+// is_master!=0: start server on (host,port) AND connect a local client.
+// port==0 picks an ephemeral port (query with pt_store_port).
+PT_EXPORT void* pt_store_create(const char* host, int port, int is_master,
+                                double timeout_s) {
+  auto* s = new Store;
+  const char* chost = host && *host ? host : "127.0.0.1";
+  if (is_master) {
+    s->server = new StoreServer;
+    // Bind the given address (not INADDR_ANY): master election relies on
+    // only the host that owns the master IP winning the bind.
+    int p = s->server->start(chost, port);
+    if (p < 0) {
+      delete s->server;
+      delete s;
+      return nullptr;
+    }
+    port = p;
+  }
+  if (!s->client.connect_to(chost, port, timeout_s)) {
+    delete s->server;
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+PT_EXPORT int pt_store_port(void* sp) {
+  auto* s = static_cast<Store*>(sp);
+  return s->server ? s->server->port() : -1;
+}
+
+PT_EXPORT void pt_store_destroy(void* sp) {
+  auto* s = static_cast<Store*>(sp);
+  delete s->server;
+  delete s;
+}
+
+PT_EXPORT int pt_store_set(void* sp, const char* key, const void* val,
+                           uint64_t n) {
+  return static_cast<Store*>(sp)->client.set(key, val, n) ? 0 : -1;
+}
+
+PT_EXPORT int64_t pt_store_get(void* sp, const char* key, void* out,
+                               int64_t cap, double timeout_s) {
+  return static_cast<Store*>(sp)->client.get(key, out, cap, timeout_s);
+}
+
+PT_EXPORT int64_t pt_store_add(void* sp, const char* key, int64_t delta) {
+  return static_cast<Store*>(sp)->client.add(key, delta);
+}
+
+PT_EXPORT int pt_store_wait(void* sp, const char* key, double timeout_s) {
+  return static_cast<Store*>(sp)->client.wait(key, timeout_s);
+}
+
+PT_EXPORT int pt_store_check(void* sp, const char* key) {
+  return static_cast<Store*>(sp)->client.check(key);
+}
+
+PT_EXPORT int pt_store_del(void* sp, const char* key) {
+  return static_cast<Store*>(sp)->client.del(key);
+}

@@ -55,6 +55,8 @@ from . import jit  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import runtime  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 

@@ -201,3 +201,11 @@ class tpu:
     def memory_stats(device=None):
         d = jax.devices()[0]
         return getattr(d, "memory_stats", lambda: {})() or {}
+
+
+def host_memory_stats() -> dict:
+    """Host staging-arena stats from the native runtime (csrc allocator);
+    the host-side analogue of paddle.device.cuda.memory_stats."""
+    from .. import runtime
+
+    return runtime.host_memory_stats()

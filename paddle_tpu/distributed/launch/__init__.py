@@ -34,6 +34,49 @@ def build_parser():
     return p
 
 
+def negotiate_rank(master: str, nnodes: int, timeout: float = 300.0):
+    """Negotiate this host's process id through the TCPStore at `master`.
+
+    Mirrors the reference's rank-table exchange (SURVEY.md §3.5 step 2: the
+    master negotiates a global rank table over its KV endpoint). The host
+    that binds the master port becomes process 0 and runs the store server;
+    every other host draws the next id from a shared counter. Returns
+    (rank, store) — the store stays alive for user-level barriers.
+    """
+    from ...runtime import TCPStore
+
+    host, port = master.rsplit(":", 1)
+    try:
+        store = TCPStore(host=host, port=int(port), is_master=True, timeout=5.0)
+        rank = 0
+    except (ConnectionError, OSError):
+        store = TCPStore(host=host, port=int(port), is_master=False, timeout=timeout)
+        rank = store.add("__launch/rank_counter", 1)
+    if rank >= nnodes:
+        store.close()
+        raise RuntimeError(
+            f"negotiate_rank: {rank + 1} processes joined a {nnodes}-node job "
+            f"at {master} — stale store or wrong --nnodes"
+        )
+    # Asymmetric handshake: clients finish with an acknowledged `set` (no
+    # trailing request left in flight), the master finishes with `wait`s for
+    # every client ack — so the master cannot tear the store down (by
+    # exiting) while any client still has an unanswered request. A symmetric
+    # counter barrier is racy here: the master may pass it and exit before a
+    # slow client's final wait reaches the server.
+    if rank == 0:
+        for r in range(1, nnodes):
+            store.wait(f"__launch/arrived/{r}", timeout)
+        store.set("__launch/go", b"1")
+        for r in range(1, nnodes):
+            store.wait(f"__launch/ack/{r}", timeout)
+    else:
+        store.set(f"__launch/arrived/{rank}", b"1")
+        store.wait("__launch/go", timeout)
+        store.set(f"__launch/ack/{rank}", b"1")
+    return rank, store
+
+
 def launch(argv=None):
     args = build_parser().parse_args(argv)
     nnodes = int(str(args.nnodes).split(":")[0])
@@ -44,7 +87,20 @@ def launch(argv=None):
         os.environ.setdefault("PADDLE_MASTER", args.master)
         os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nnodes))
         os.environ.setdefault("JAX_NUM_PROCESSES", str(nnodes))
-        rank = args.rank if args.rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        if args.rank is not None:
+            rank = args.rank
+        elif "PADDLE_TRAINER_ID" in os.environ:
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+        else:
+            # Negotiate on master_port+1: the master port itself belongs to
+            # the JAX distributed coordinator that init_parallel_env starts
+            # (a store server still bound there would make process 0's
+            # coordinator bind fail). The store is closed before the user
+            # script runs — the asymmetric handshake guarantees no client
+            # has an outstanding request by then.
+            host, port = args.master.rsplit(":", 1)
+            rank, _store = negotiate_rank(f"{host}:{int(port) + 1}", nnodes)
+            _store.close()
         os.environ["PADDLE_TRAINER_ID"] = str(rank)
         os.environ["JAX_PROCESS_ID"] = str(rank)
     sys.argv = [args.training_script] + list(args.training_script_args)

@@ -230,11 +230,15 @@ class DistributedBatchSampler(BatchSampler):
 
 
 def default_collate_fn(batch):
+    from ..runtime import stack_samples
+
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(raw(b)) for b in batch]))
+        return Tensor(stack_samples([np.asarray(raw(b)) for b in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        # batch assembly through the native parallel stacker (csrc pt_stack);
+        # falls back to np.stack when the native lib is unavailable
+        return Tensor(stack_samples(batch))
     if isinstance(sample, (int, float, np.integer, np.floating)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):

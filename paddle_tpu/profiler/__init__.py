@@ -23,6 +23,8 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+from .. import runtime as _runtime
+
 
 class ProfilerTarget(Enum):
     CPU = 0
@@ -85,12 +87,22 @@ class RecordEvent:
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         self._t0 = time.perf_counter()
+        self._t0_ns = _runtime.now_ns()
         _host_events[self.name][0] += 1
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             _host_events[self.name][1] += time.perf_counter() - self._t0
+            # native host tracer (chrome-trace export) — no-op unless tracing
+            import threading as _threading
+
+            _runtime.trace_record(
+                self.name,
+                self._t0_ns,
+                _runtime.now_ns() - self._t0_ns,
+                tid=_threading.get_ident() % (1 << 31),
+            )
             self._ann = None
 
     def __enter__(self):
@@ -161,6 +173,7 @@ class Profiler:
                 self._stop_trace()
 
     def _start_trace(self):
+        _runtime.trace_start()
         try:
             jax.profiler.start_trace(self._export_dir)
             self._tracing = True
@@ -173,6 +186,16 @@ class Profiler:
         except Exception:
             pass
         self._tracing = False
+        _runtime.trace_stop()
+        # Export host RecordEvents as a chrome trace alongside the XPlane
+        # files (reference: chrometracing_logger.cc output).
+        events = _runtime.trace_export()
+        if events:
+            import json
+
+            os.makedirs(self._export_dir, exist_ok=True)
+            with open(os.path.join(self._export_dir, "host_trace.json"), "w") as f:
+                json.dump({"traceEvents": events}, f)
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,345 @@
+"""paddle_tpu.runtime — native runtime services (C++ core + Python surface).
+
+TPU-native equivalents of the reference's L1 runtime layer (SURVEY.md §1 L1,
+§2.4): host staging allocator with stats, TCPStore coordination service,
+parallel batch assembly, and the host trace buffer behind
+``paddle_tpu.profiler``. Device (HBM) memory itself is owned by PJRT/XLA —
+what remains framework-owned on TPU is the host side, which is what lives
+here.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from . import native
+
+__all__ = [
+    "native_available",
+    "HostArena",
+    "default_arena",
+    "host_memory_stats",
+    "stack_samples",
+    "TCPStore",
+    "trace_start",
+    "trace_stop",
+    "trace_record",
+    "trace_export",
+]
+
+
+def native_available() -> bool:
+    return native.available()
+
+
+# ---------------------------------------------------------------------------
+# Host arena allocator
+# ---------------------------------------------------------------------------
+class HostArena:
+    """Auto-growth best-fit caching allocator for host staging buffers.
+
+    Reference capability: ``AutoGrowthBestFitAllocator``
+    (``paddle/fluid/memory/allocation/`` — SURVEY.md §2.1 "Memory"); here it
+    backs input-pipeline batch buffers that feed ``jax.device_put``.
+    """
+
+    def __init__(self, chunk_bytes: int = 64 << 20):
+        lib = native.get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pt_arena_create(chunk_bytes)
+
+    def alloc_array(self, shape, dtype):
+        """Allocate arena-backed storage; returns ``(ndarray, ptr)``.
+
+        The array views arena memory — keep it alive only while the arena
+        lives, and release with ``free(ptr)`` when done.
+        """
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = self._lib.pt_arena_alloc(self._h, max(nbytes, 1))
+        if not ptr:
+            raise MemoryError(f"arena alloc of {nbytes} bytes failed")
+        buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape))).reshape(shape)
+        arr.flags.writeable = True
+        return arr, ptr
+
+    def alloc(self, nbytes: int) -> int:
+        ptr = self._lib.pt_arena_alloc(self._h, max(int(nbytes), 1))
+        if not ptr:
+            raise MemoryError(f"arena alloc of {nbytes} bytes failed")
+        return ptr
+
+    def free(self, ptr: int):
+        self._lib.pt_arena_free(self._h, ptr)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.pt_arena_stats(self._h, ctypes.byref(out))
+        return {
+            "allocated_bytes": int(out[0]),
+            "reserved_bytes": int(out[1]),
+            "peak_allocated_bytes": int(out[2]),
+            "alloc_count": int(out[3]),
+        }
+
+    def __del__(self):
+        try:
+            self._lib.pt_arena_destroy(self._h)
+        except Exception:
+            pass
+
+
+_default_arena: Optional[HostArena] = None
+_arena_lock = threading.Lock()
+
+
+def default_arena() -> Optional[HostArena]:
+    global _default_arena
+    if not native.available():
+        return None
+    with _arena_lock:
+        if _default_arena is None:
+            _default_arena = HostArena()
+    return _default_arena
+
+
+def host_memory_stats() -> dict:
+    """paddle.device.cuda.memory_stats analogue for host staging memory."""
+    a = default_arena()
+    if a is None:
+        return {
+            "allocated_bytes": 0,
+            "reserved_bytes": 0,
+            "peak_allocated_bytes": 0,
+            "alloc_count": 0,
+        }
+    return a.stats()
+
+
+# ---------------------------------------------------------------------------
+# Parallel batch assembly (DataLoader collate hot loop)
+# ---------------------------------------------------------------------------
+def stack_samples(samples, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """np.stack over equally-shaped sample arrays via the native thread pool.
+
+    Falls back to np.stack when the native lib is missing or inputs are not
+    contiguous same-shape arrays. Reference capability: C++ dataloader
+    workers assembling batches into shared memory (SURVEY.md §2.2 "Data").
+    """
+    lib = native.get_lib()
+    n = len(samples)
+    if n == 0:
+        raise ValueError("empty batch")
+    first = samples[0]
+    if (
+        lib is None
+        or not all(
+            isinstance(s, np.ndarray)
+            and s.shape == first.shape
+            and s.dtype == first.dtype
+            and s.flags.c_contiguous
+            for s in samples
+        )
+    ):
+        return np.stack([np.asarray(s) for s in samples])
+    if out is None:
+        out = np.empty((n,) + first.shape, dtype=first.dtype)
+    ptrs = (ctypes.c_void_p * n)(*[s.ctypes.data for s in samples])
+    lib.pt_stack(
+        out.ctypes.data_as(ctypes.c_void_p), ptrs, n, first.nbytes, 0
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+class TCPStore:
+    """Coordination KV store (reference:
+    ``paddle/phi/core/distributed/store/tcp_store.cc`` — SURVEY.md §2.3
+    "Rendezvous / store").
+
+    The master process runs the server; every process (master included)
+    talks to it through a client connection. Used by
+    ``paddle_tpu.distributed.launch`` to negotiate the rank table before
+    ``jax.distributed.initialize``, mirroring the reference's
+    TCPStore + NCCL-unique-id exchange.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        is_master: bool = False,
+        timeout: float = 60.0,
+    ):
+        lib = native.get_lib()
+        self._py_fallback = None
+        if lib is None:
+            from . import py_store
+
+            self._py_fallback = py_store.PyTCPStore(host, port, is_master, timeout)
+            self.port = self._py_fallback.port
+            return
+        self._lib = lib
+        self._h = lib.pt_store_create(
+            host.encode(), int(port), 1 if is_master else 0, float(timeout)
+        )
+        if not self._h:
+            raise ConnectionError(f"TCPStore: could not bind/connect {host}:{port}")
+        self.port = lib.pt_store_port(self._h) if is_master else int(port)
+
+    def set(self, key: str, value) -> None:
+        if self._py_fallback:
+            return self._py_fallback.set(key, value)
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        if self._lib.pt_store_set(self._h, key.encode(), data, len(data)) != 0:
+            raise ConnectionError("TCPStore.set failed")
+
+    def get(self, key: str, timeout: float = 60.0) -> bytes:
+        if self._py_fallback:
+            return self._py_fallback.get(key, timeout)
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pt_store_get(self._h, key.encode(), buf, cap, float(timeout))
+            if n == -1:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            if n < -1:
+                raise ConnectionError("TCPStore.get failed")
+            if n <= cap:
+                return buf.raw[:n]
+            cap = int(n)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._py_fallback:
+            return self._py_fallback.add(key, delta)
+        v = self._lib.pt_store_add(self._h, key.encode(), int(delta))
+        if v == -(2**63):
+            raise ConnectionError("TCPStore.add failed")
+        return int(v)
+
+    def wait(self, key: str, timeout: float = 60.0) -> None:
+        if self._py_fallback:
+            return self._py_fallback.wait(key, timeout)
+        r = self._lib.pt_store_wait(self._h, key.encode(), float(timeout))
+        if r != 1:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def check(self, key: str) -> bool:
+        if self._py_fallback:
+            return self._py_fallback.check(key)
+        return self._lib.pt_store_check(self._h, key.encode()) == 1
+
+    def delete_key(self, key: str) -> bool:
+        if self._py_fallback:
+            return self._py_fallback.delete_key(key)
+        return self._lib.pt_store_del(self._h, key.encode()) == 1
+
+    def barrier(self, name: str, world_size: int, timeout: float = 60.0) -> None:
+        """All `world_size` participants rendezvous on `name`.
+
+        Two-phase (arrive + ack) so no participant — in particular the
+        master, whose exit tears down the store server — can leave the
+        barrier until every participant has confirmed passing it.
+        """
+        n = self.add(f"__barrier/{name}/count", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        self.wait(f"__barrier/{name}/done", timeout)
+        m = self.add(f"__barrier/{name}/acks", 1)
+        if m == world_size:
+            self.set(f"__barrier/{name}/fin", b"1")
+        self.wait(f"__barrier/{name}/fin", timeout)
+
+    def close(self):
+        if self._py_fallback:
+            return self._py_fallback.close()
+        if getattr(self, "_h", None):
+            self._lib.pt_store_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Host tracer
+# ---------------------------------------------------------------------------
+def trace_start():
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_trace_start()
+
+
+def trace_stop():
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_trace_stop()
+
+
+def trace_record(name: str, ts_ns: int, dur_ns: int, cat: str = "op", tid: int = 0):
+    lib = native.get_lib()
+    if lib is not None:
+        lib.pt_trace_record(name.encode(), cat.encode(), ts_ns, dur_ns, tid)
+
+
+def trace_export() -> list:
+    """Drain the native trace buffer as a list of chrome-trace event dicts."""
+    import json
+
+    lib = native.get_lib()
+    if lib is None:
+        return []
+    # Events may land between the sizing call and the export; loop until the
+    # buffer was large enough for what was actually written.
+    cap = int(lib.pt_trace_export(None, 0))
+    while True:
+        buf = ctypes.create_string_buffer(max(cap, 2))
+        n = int(lib.pt_trace_export(buf, max(cap, 2)))
+        if n <= max(cap, 2):
+            return json.loads(buf.raw[:n].decode())
+        cap = n
+
+
+def now_ns() -> int:
+    lib = native.get_lib()
+    if lib is not None:
+        return lib.pt_now_ns()
+    import time
+
+    return time.perf_counter_ns()
+
+
+class RecordEvent:
+    """Low-level scoped host trace event feeding the native buffer directly.
+
+    The user-facing scoped annotation is ``paddle_tpu.profiler.RecordEvent``
+    (which also tags the XLA timeline and the summary table); this class is
+    the primitive it builds on (reference: ``platform::RecordEvent`` —
+    SURVEY.md §5 "Tracing/profiling")."""
+
+    def __init__(self, name: str, cat: str = "op"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._t0 = now_ns()
+        return self
+
+    def __exit__(self, *exc):
+        lib = native.get_lib()
+        if lib is not None and lib.pt_trace_enabled():
+            t1 = now_ns()
+            trace_record(self.name, self._t0, t1 - self._t0, self.cat, threading.get_ident() % (1 << 31))
+        return False

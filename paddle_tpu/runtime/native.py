@@ -1,0 +1,123 @@
+"""ctypes loader for the native runtime core (csrc/paddle_tpu_rt.cc).
+
+The reference ships its runtime services (allocator, TCPStore, dataloader
+workers, host profiler) as C++ linked into the wheel
+(``paddle/fluid/memory/``, ``paddle/phi/core/distributed/store/``,
+``paddle/fluid/platform/profiler/`` — SURVEY.md §2.1). Here the equivalent
+library is built from ``csrc/`` on first use (g++ is part of the toolchain)
+and loaded via ctypes; every caller in the Python layer degrades gracefully
+when the toolchain is unavailable (``available() == False``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+_LIB_PATH = os.path.join(_CSRC, "build", "libpaddle_tpu_rt.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_CSRC, "paddle_tpu_rt.cc")
+    if not os.path.exists(src):
+        return False
+    # Serialize concurrent first imports (e.g. simultaneously launched
+    # ranks) across processes: without the lock one process can dlopen a
+    # half-written .so while another is still compiling it.
+    import fcntl
+
+    lock_path = os.path.join(_CSRC, ".build.lock")
+    try:
+        lock_fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
+    except OSError:
+        lock_fd = None
+    try:
+        if lock_fd is not None:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+            return True
+        try:
+            subprocess.run(
+                ["make", "-C", _CSRC],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            return os.path.exists(_LIB_PATH)
+        except Exception:
+            return False
+    finally:
+        if lock_fd is not None:
+            fcntl.flock(lock_fd, fcntl.LOCK_UN)
+            os.close(lock_fd)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, i64, f64 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_double
+    p, cp = ctypes.c_void_p, ctypes.c_char_p
+
+    lib.pt_arena_create.argtypes = [u64]
+    lib.pt_arena_create.restype = p
+    lib.pt_arena_destroy.argtypes = [p]
+    lib.pt_arena_alloc.argtypes = [p, u64]
+    lib.pt_arena_alloc.restype = p
+    lib.pt_arena_free.argtypes = [p, p]
+    lib.pt_arena_stats.argtypes = [p, ctypes.POINTER(u64 * 4)]
+
+    lib.pt_stack.argtypes = [p, ctypes.POINTER(p), i64, u64, ctypes.c_int]
+
+    lib.pt_now_ns.restype = i64
+    lib.pt_trace_record.argtypes = [cp, cp, i64, i64, i64]
+    lib.pt_trace_export.argtypes = [p, i64]
+    lib.pt_trace_export.restype = i64
+    lib.pt_trace_count.restype = i64
+    lib.pt_trace_enabled.restype = ctypes.c_int
+
+    lib.pt_store_create.argtypes = [cp, ctypes.c_int, ctypes.c_int, f64]
+    lib.pt_store_create.restype = p
+    lib.pt_store_port.argtypes = [p]
+    lib.pt_store_port.restype = ctypes.c_int
+    lib.pt_store_destroy.argtypes = [p]
+    lib.pt_store_set.argtypes = [p, cp, p, u64]
+    lib.pt_store_set.restype = ctypes.c_int
+    lib.pt_store_get.argtypes = [p, cp, p, i64, f64]
+    lib.pt_store_get.restype = i64
+    lib.pt_store_add.argtypes = [p, cp, i64]
+    lib.pt_store_add.restype = i64
+    lib.pt_store_wait.argtypes = [p, cp, f64]
+    lib.pt_store_wait.restype = ctypes.c_int
+    lib.pt_store_check.argtypes = [p, cp]
+    lib.pt_store_check.restype = ctypes.c_int
+    lib.pt_store_del.argtypes = [p, cp]
+    lib.pt_store_del.restype = ctypes.c_int
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+            return None
+        if _build():
+            try:
+                _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            except OSError:
+                _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,156 @@
+"""Pure-Python TCPStore fallback (same semantics as the native store).
+
+Used only when the native runtime can't be built (no toolchain); keeps
+``paddle_tpu.distributed.launch`` rendezvous working everywhere. Protocol is
+line-oriented and private to this module (the native and Python stores don't
+interoperate — a job uses one or the other on all ranks).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        c = sock.recv(8 - len(hdr))
+        if not c:
+            raise ConnectionError("store connection closed")
+        hdr += c
+    (n,) = struct.unpack("<Q", hdr)
+    data = b""
+    while len(data) < n:
+        c = sock.recv(min(1 << 16, n - len(data)))
+        if not c:
+            raise ConnectionError("store connection closed")
+        data += c
+    return pickle.loads(data)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        self.kv = {}
+        self.cv = threading.Condition()
+        super().__init__(addr, _Handler)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: _Server = self.server
+        while True:
+            try:
+                cmd, key, arg = _recv_msg(self.request)
+            except (ConnectionError, EOFError, OSError):
+                return
+            if cmd == "set":
+                with srv.cv:
+                    srv.kv[key] = arg
+                    srv.cv.notify_all()
+                _send_msg(self.request, True)
+            elif cmd == "get":
+                deadline = time.monotonic() + arg if arg > 0 else None
+                with srv.cv:
+                    while key not in srv.kv:
+                        remaining = None if deadline is None else deadline - time.monotonic()
+                        if remaining is not None and remaining <= 0:
+                            break
+                        srv.cv.wait(remaining)
+                    _send_msg(self.request, srv.kv.get(key))
+            elif cmd == "add":
+                with srv.cv:
+                    cur = int.from_bytes(srv.kv.get(key, b"\0" * 8), "little", signed=True)
+                    nv = cur + arg
+                    srv.kv[key] = nv.to_bytes(8, "little", signed=True)
+                    srv.cv.notify_all()
+                _send_msg(self.request, nv)
+            elif cmd == "check":
+                with srv.cv:
+                    _send_msg(self.request, key in srv.kv)
+            elif cmd == "del":
+                with srv.cv:
+                    _send_msg(self.request, srv.kv.pop(key, None) is not None)
+            else:
+                return
+
+
+class PyTCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, timeout=60.0):
+        self._server = None
+        if is_master:
+            # Bind the master address specifically (not 0.0.0.0): master
+            # election depends on non-owners failing this bind.
+            self._server = _Server((host, port))
+            self.port = self._server.server_address[1]
+            threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        else:
+            self.port = port
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, self.port), timeout=timeout)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise ConnectionError(f"PyTCPStore: cannot reach {host}:{self.port}")
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def _rpc(self, cmd, key, arg=None):
+        with self._lock:
+            _send_msg(self._sock, (cmd, key, arg))
+            return _recv_msg(self._sock)
+
+    def set(self, key, value):
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        self._rpc("set", key, data)
+
+    def get(self, key, timeout=60.0):
+        v = self._rpc("get", key, float(timeout))
+        if v is None:
+            raise TimeoutError(f"PyTCPStore.get({key!r}) timed out")
+        return v
+
+    def add(self, key, delta=1):
+        return self._rpc("add", key, int(delta))
+
+    def wait(self, key, timeout=60.0):
+        self.get(key, timeout)
+
+    def check(self, key):
+        return self._rpc("check", key)
+
+    def delete_key(self, key):
+        return self._rpc("del", key)
+
+    def barrier(self, name, world_size, timeout=60.0):
+        n = self.add(f"__barrier/{name}/count", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        self.wait(f"__barrier/{name}/done", timeout)
+        m = self.add(f"__barrier/{name}/acks", 1)
+        if m == world_size:
+            self.set(f"__barrier/{name}/fin", b"1")
+        self.wait(f"__barrier/{name}/fin", timeout)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

@@ -1,0 +1,55 @@
+"""Launch rendezvous tests — TestDistBase-style localhost subprocesses
+(SURVEY.md §4 "Distributed tests without a real cluster").
+
+Each subprocess negotiates its rank through the TCPStore the way
+``paddle_tpu.distributed.launch`` does for multi-host jobs; ranks must come
+out unique and complete, with the master-port binder at rank 0.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import sys
+from paddle_tpu.distributed.launch import negotiate_rank
+master, nnodes = sys.argv[1], int(sys.argv[2])
+rank, store = negotiate_rank(master, nnodes, timeout=30.0)
+print(f"RANK={rank}")
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("nnodes", [2, 4])
+def test_rank_negotiation_subprocesses(nnodes):
+    master = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, master, str(nnodes)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for _ in range(nnodes)
+    ]
+    ranks = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err
+        for line in out.splitlines():
+            if line.startswith("RANK="):
+                ranks.append(int(line.split("=")[1]))
+    assert sorted(ranks) == list(range(nnodes))
