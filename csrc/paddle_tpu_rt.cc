@@ -138,6 +138,23 @@ class Arena {
   }
 
  private:
+  // Block records killed by coalescing are recycled through block_pool_;
+  // without recycling every alloc/free cycle that splits+coalesces would
+  // retain one dead record forever (unbounded growth in a long-lived arena).
+  Block* new_block(char* ptr, size_t size, bool is_free, Chunk* c, Block* prev,
+                   Block* next) {
+    Block* b;
+    if (!block_pool_.empty()) {
+      b = block_pool_.back();
+      block_pool_.pop_back();
+    } else {
+      b = new Block;
+      all_blocks_.push_back(b);
+    }
+    *b = Block{ptr, size, is_free, c, prev, next, {}};
+    return b;
+  }
+
   Block* grow(size_t n) {
     size_t sz = std::max(n, chunk_size_);
     char* base = static_cast<char*>(::malloc(sz));
@@ -145,16 +162,13 @@ class Arena {
     auto* c = new Chunk{base, sz};
     chunks_.push_back(c);
     reserved_ += sz;
-    auto* b = new Block{base, sz, false, c, nullptr, nullptr, {}};
-    all_blocks_.push_back(b);
-    return b;
+    return new_block(base, sz, false, c, nullptr, nullptr);
   }
 
   void maybe_split(Block* b, size_t n) {
     if (b->size >= n + kAlign * 2) {
-      auto* rest = new Block{b->ptr + n, b->size - n, true,
-                             b->chunk,   b,           b->next, {}};
-      all_blocks_.push_back(rest);
+      Block* rest =
+          new_block(b->ptr + n, b->size - n, true, b->chunk, b, b->next);
       if (b->next) b->next->prev = rest;
       b->next = rest;
       b->size = n;
@@ -166,7 +180,8 @@ class Arena {
     if (b->prev) b->prev->next = b->next;
     if (b->next) b->next->prev = b->prev;
     b->size = 0;
-    b->free_ = false;  // dead block, kept in all_blocks_ for cleanup
+    b->free_ = false;
+    block_pool_.push_back(b);
   }
 
   std::mutex mu_;
@@ -174,7 +189,8 @@ class Arena {
   std::multimap<size_t, Block*> free_blocks_;
   std::unordered_map<char*, Block*> live_;
   std::vector<Chunk*> chunks_;
-  std::vector<Block*> all_blocks_;
+  std::vector<Block*> all_blocks_;   // ownership (for ~Arena)
+  std::vector<Block*> block_pool_;   // dead records available for reuse
   uint64_t allocated_ = 0, reserved_ = 0, peak_ = 0, alloc_count_ = 0;
 };
 
@@ -266,7 +282,10 @@ PT_EXPORT void pt_stack(void* dst, void* const* srcs, int64_t n,
   }
   Pool* pool = global_pool(nthreads);
   int shards = static_cast<int>(std::min<int64_t>(n, pool->size()));
-  std::atomic<int> done{0};
+  // done is incremented under mu: if it were bumped outside, the caller's
+  // wait predicate could observe completion and destroy mu/cv while the
+  // last worker is still about to lock/notify them (use-after-free).
+  int done = 0;
   std::mutex mu;
   std::condition_variable cv;
   int64_t per = (n + shards - 1) / shards;
@@ -275,14 +294,15 @@ PT_EXPORT void pt_stack(void* dst, void* const* srcs, int64_t n,
     pool->submit([=, &done, &mu, &cv] {
       for (int64_t i = lo; i < hi; ++i)
         memcpy(d + i * bytes_per_sample, srcs[i], bytes_per_sample);
-      if (done.fetch_add(1) + 1 == shards) {
+      {
         std::lock_guard<std::mutex> g(mu);
-        cv.notify_all();
+        ++done;
       }
+      cv.notify_all();
     });
   }
   std::unique_lock<std::mutex> l(mu);
-  cv.wait(l, [&] { return done.load() == shards; });
+  cv.wait(l, [&] { return done == shards; });
 }
 
 // ===========================================================================

@@ -52,6 +52,7 @@ from . import device  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401

@@ -1,0 +1,199 @@
+"""paddle.inference parity: Config / create_predictor serving path.
+
+Reference: ``paddle/fluid/inference/`` AnalysisPredictor + C API
+(``paddle_inference_api.h``) — load a saved program + params, run IR
+optimization passes, execute with zero-copy input/output handles
+(SURVEY.md §2.1 "Inference engine", §2.4 item 14). TPU-native design: the
+saved artifact is already the optimized program (StableHLO from jit.save);
+"analysis passes" are XLA's compilation pipeline, so the predictor is a thin
+executable cache with Paddle's handle-based API on top. Works on TPU or CPU
+PJRT backends; batch-size changes just select a new cached executable (or
+reuse one, if the model was exported batch-polymorphic).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..jit.save_load import TranslatedLayer, load as _jit_load
+
+
+class Config:
+    """paddle.inference.Config parity (GPU/TensorRT knobs are accepted and
+    recorded but are no-ops: XLA owns optimization on TPU)."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # paddle accepts Config(model_dir) or Config(prog_file, params_file);
+        # we accept a path PREFIX (as written by jit.save) in either slot.
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._memory_optim = True
+        self._ir_optim = True
+        self._device = None  # None → default jax backend
+        self._num_threads = 1
+        self._tensorrt = False
+
+    # --- model location ---
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # --- device selection ---
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # "GPU" slot maps to the accelerator backend (TPU here)
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._num_threads = n
+
+    # --- optimization knobs (XLA always optimizes; recorded for parity) ---
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        pass
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+    def enable_tensorrt_engine(self, *args, **kwargs):
+        self._tensorrt = True  # no-op: XLA fusion replaces TRT subgraphs
+
+    def tensorrt_engine_enabled(self):
+        return self._tensorrt
+
+    def summary(self):
+        return (
+            f"Config(prefix={self._prefix}, device={self._device or 'default'}, "
+            f"memory_optim={self._memory_optim}, ir_optim={self._ir_optim})"
+        )
+
+
+class _IOHandle:
+    """Zero-copy-style input/output handle (copy_from_cpu/copy_to_cpu parity).
+
+    Reference: ``ZeroCopyTensor`` in paddle_inference_api.h — named handles
+    that stage host buffers in and device buffers out.
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+        self._shape = None
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        arr = np.asarray(arr)
+        if self._shape is not None and tuple(arr.shape) != self._shape:
+            arr = arr.reshape(self._shape)
+        self._value = arr
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self):
+        v = self._value
+        return list(v.shape) if v is not None else list(self._shape or [])
+
+
+class Predictor:
+    """paddle.inference predictor over a jit.save'd StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        if not config._prefix:
+            raise ValueError("Config has no model path; use Config(prefix) or set_model")
+        self._config = config
+        self._layer: TranslatedLayer = _jit_load(config._prefix)
+        self._input_names = self._layer.input_names
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in self._input_names
+        }
+        self._outputs: Dict[str, _IOHandle] = {}
+        self._output_names: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either stage inputs via handles then run(), or pass a list
+        of arrays positionally (newer paddle.inference allows both)."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs, model expects "
+                    f"{len(self._input_names)}: {self._input_names}"
+                )
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        missing = [n for n in self._input_names if self._inputs[n]._value is None]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._layer.forward(*[self._inputs[n]._value for n in self._input_names])
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: hasattr(x, "_value")
+        )
+        self._output_names = [f"fetch_{i}" for i in range(len(leaves))]
+        self._outputs = {}
+        for n, leaf in zip(self._output_names, leaves):
+            h = _IOHandle(n)
+            h._value = np.asarray(leaf._value if hasattr(leaf, "_value") else leaf)
+            self._outputs[n] = h
+        if inputs is not None:
+            return [self._outputs[n].copy_to_cpu() for n in self._output_names]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name) -> _IOHandle:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """paddle.inference.PredictorPool parity: N predictors over one artifact
+    (each has its own handle staging; the compiled executable is shared via
+    jax's global compilation cache)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+def get_version():
+    import jax
+
+    return f"paddle_tpu-inference (jax {jax.__version__})"

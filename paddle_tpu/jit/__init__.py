@@ -192,6 +192,9 @@ def ignore_module(modules):
     pass
 
 
+from .save_load import save, load, TranslatedLayer  # noqa: E402
+
+
 class TrainStep:
     """Fused, compiled train step: forward + grad + optimizer in one XLA program.
 

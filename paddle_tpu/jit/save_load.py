@@ -1,0 +1,198 @@
+"""paddle.jit.save / paddle.jit.load — portable compiled-model serialization.
+
+Reference: ``python/paddle/jit/api.py`` (jit.save serializes the dy2static
+Program + params; jit.load returns a TranslatedLayer) and the inference flow
+``save_inference_model`` → AnalysisPredictor (SURVEY.md §2.1 "Inference
+engine", §2.4 item 14). TPU-native design: the portable artifact is a
+**serialized StableHLO module** produced by ``jax.export`` — the exact program
+XLA will compile — plus a separate params file. Loading re-hydrates a callable
+that compiles once per shape signature and runs on any PJRT backend (TPU/CPU),
+which is the reference's "save program + params, run with a predictor" workflow
+without a custom protobuf IR.
+
+Artifacts for prefix ``path``:
+  - ``path.pdmodel``   — serialized StableHLO (jax.export bytes)
+  - ``path.pdiparams`` — pickled {name: numpy array} state (params + buffers)
+  - ``path.pdmeta``    — pickled metadata: input names/specs, output treedef
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..framework import rng as _rng
+from ..framework.core import Tensor
+from ..nn.layer import Layer
+
+_MODEL_SUFFIX = ".pdmodel"
+_PARAMS_SUFFIX = ".pdiparams"
+_META_SUFFIX = ".pdmeta"
+
+
+def _input_specs_to_sds(input_spec, scope):
+    """Convert paddle InputSpecs / example Tensors to jax.ShapeDtypeStruct,
+    mapping unknown dims (None / -1) to shared symbolic dimensions so the
+    exported module is batch-polymorphic."""
+    from . import InputSpec
+
+    sds, names = [], []
+    for i, spec in enumerate(input_spec):
+        if isinstance(spec, Tensor):
+            sds.append(jax.ShapeDtypeStruct(spec._value.shape, spec._value.dtype))
+            names.append(getattr(spec, "name", None) or f"x{i}")
+            continue
+        if not isinstance(spec, InputSpec):
+            arr = jnp.asarray(spec)
+            sds.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+            names.append(f"x{i}")
+            continue
+        dims = []
+        for j, d in enumerate(spec.shape):
+            if d is None or (isinstance(d, int) and d < 0):
+                sym = "batch" if j == 0 else f"d{i}_{j}"
+                dims.append(jax_export.symbolic_shape(sym, scope=scope)[0])
+            else:
+                dims.append(d)
+        sds.append(jax.ShapeDtypeStruct(tuple(dims), spec.dtype))
+        names.append(spec.name or f"x{i}")
+    return sds, names
+
+
+def _lift_layer(layer: Layer):
+    """Lift a stateful Layer into pure(state_vals, *input_vals) -> flat outputs.
+
+    Same state-swap pattern as jit.TracedLayer; traced in eval mode with a
+    fixed RNG key (inference is deterministic; dropout layers are no-ops in
+    eval mode anyway).
+    """
+    state_names, state = [], []
+    for n, p in layer.named_parameters():
+        state_names.append(n)
+        state.append(p)
+    for n, b in layer.named_buffers():
+        state_names.append(n)
+        state.append(b)
+    out_tree_box = [None]
+
+    def pure(state_vals, *input_vals):
+        originals = [t._value for t in state]
+        with _rng.trace_key_scope(jax.random.PRNGKey(0)):
+            try:
+                for t, v in zip(state, state_vals):
+                    t._value = v
+                inputs = [Tensor(v) for v in input_vals]
+                out = layer.forward(*inputs)
+            finally:
+                for t, v in zip(state, originals):
+                    t._value = v
+        leaves, tree = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        out_tree_box[0] = tree
+        return tuple(
+            leaf._value if isinstance(leaf, Tensor) else leaf for leaf in leaves
+        )
+
+    return pure, state, state_names, out_tree_box
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """paddle.jit.save parity: export ``layer`` (or a TracedLayer / function
+    whose forward was wrapped by to_static) as StableHLO + params.
+
+    input_spec: list of InputSpec / example Tensors. Required unless the layer
+    was already called (in which case pass the example inputs here too — the
+    export needs concrete avals).
+    """
+    from . import TracedLayer
+
+    if isinstance(layer, TracedLayer):
+        # unwrap: the underlying fn is a bound Layer.forward
+        owner = layer._layers[0] if layer._layers else None
+        if owner is None:
+            raise ValueError("jit.save of a bare traced function needs a Layer")
+        layer = owner
+    if not isinstance(layer, Layer):
+        raise TypeError(f"jit.save expects a Layer, got {type(layer)}")
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec=[InputSpec(...), ...]")
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        pure, state, state_names, out_tree_box = _lift_layer(layer)
+        scope = jax_export.SymbolicScope()
+        in_sds, in_names = _input_specs_to_sds(input_spec, scope)
+        state_sds = [
+            jax.ShapeDtypeStruct(t._value.shape, t._value.dtype) for t in state
+        ]
+        exported = jax_export.export(jax.jit(pure))(state_sds, *in_sds)
+    finally:
+        if was_training:
+            layer.train()
+
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path + _MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    params_np = {n: np.asarray(t._value) for n, t in zip(state_names, state)}
+    with open(path + _PARAMS_SUFFIX, "wb") as f:
+        pickle.dump(params_np, f, protocol=4)
+    meta = {
+        "state_names": state_names,
+        "input_names": in_names,
+        "out_tree": out_tree_box[0],
+        "format": "stablehlo-v1",
+    }
+    with open(path + _META_SUFFIX, "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+    return path
+
+
+class TranslatedLayer(Layer):
+    """paddle.jit.load product: a Layer whose forward runs the deserialized
+    StableHLO module (compiled & cached per input-shape signature)."""
+
+    def __init__(self, exported, params_np, meta):
+        super().__init__()
+        self._exported = exported
+        self._meta = meta
+        self._state_vals = [
+            jnp.asarray(params_np[n]) for n in meta["state_names"]
+        ]
+        # params are frozen constants of the serving artifact; expose them as
+        # buffers so state_dict round-trips but nothing is trainable.
+        for n, v in zip(meta["state_names"], self._state_vals):
+            self.register_buffer(n.replace(".", "__"), Tensor(v))
+        self._call = jax.jit(exported.call)
+
+    @property
+    def input_names(self) -> List[str]:
+        return list(self._meta["input_names"])
+
+    def forward(self, *inputs):
+        vals = [t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in inputs]
+        outs = self._call(self._state_vals, *vals)
+        wrapped = [Tensor(o) for o in outs]
+        tree = self._meta.get("out_tree")
+        if tree is not None and tree.num_leaves == len(wrapped):
+            return jax.tree_util.tree_unflatten(tree, wrapped)
+        return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    """paddle.jit.load parity: returns a TranslatedLayer."""
+    with open(path + _MODEL_SUFFIX, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + _PARAMS_SUFFIX, "rb") as f:
+        params_np = pickle.load(f)
+    with open(path + _META_SUFFIX, "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, params_np, meta)

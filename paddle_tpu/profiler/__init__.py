@@ -87,22 +87,25 @@ class RecordEvent:
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         self._t0 = time.perf_counter()
-        self._t0_ns = _runtime.now_ns()
+        # FFI timestamp only when the native tracer is actually recording —
+        # two ctypes calls + a mutex per event is real overhead in an
+        # untraced training loop.
+        self._t0_ns = _runtime.now_ns() if _runtime.trace_enabled() else None
         _host_events[self.name][0] += 1
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             _host_events[self.name][1] += time.perf_counter() - self._t0
-            # native host tracer (chrome-trace export) — no-op unless tracing
-            import threading as _threading
+            if self._t0_ns is not None and _runtime.trace_enabled():
+                import threading as _threading
 
-            _runtime.trace_record(
-                self.name,
-                self._t0_ns,
-                _runtime.now_ns() - self._t0_ns,
-                tid=_threading.get_ident() % (1 << 31),
-            )
+                _runtime.trace_record(
+                    self.name,
+                    self._t0_ns,
+                    _runtime.now_ns() - self._t0_ns,
+                    tid=_threading.get_ident() % (1 << 31),
+                )
             self._ann = None
 
     def __enter__(self):
@@ -178,6 +181,10 @@ class Profiler:
             jax.profiler.start_trace(self._export_dir)
             self._tracing = True
         except Exception:
+            # keep the native host tracer symmetric with the failed device
+            # trace — otherwise it stays on (and accumulating) for the rest
+            # of the process.
+            _runtime.trace_stop()
             self._tracing = False
 
     def _stop_trace(self):

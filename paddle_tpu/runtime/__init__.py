@@ -288,6 +288,11 @@ def trace_stop():
         lib.pt_trace_stop()
 
 
+def trace_enabled() -> bool:
+    lib = native.get_lib()
+    return lib is not None and bool(lib.pt_trace_enabled())
+
+
 def trace_record(name: str, ts_ns: int, dur_ns: int, cat: str = "op", tid: int = 0):
     lib = native.get_lib()
     if lib is not None:

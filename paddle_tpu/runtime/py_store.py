@@ -55,11 +55,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 cmd, key, arg = _recv_msg(self.request)
             except (ConnectionError, EOFError, OSError):
                 return
+            # Responses are sent OUTSIDE srv.cv: a client with a full TCP
+            # buffer would otherwise block sendall while holding the global
+            # lock, stalling every other rank's store op.
             if cmd == "set":
                 with srv.cv:
                     srv.kv[key] = arg
                     srv.cv.notify_all()
-                _send_msg(self.request, True)
+                resp = True
             elif cmd == "get":
                 deadline = time.monotonic() + arg if arg > 0 else None
                 with srv.cv:
@@ -68,22 +71,23 @@ class _Handler(socketserver.BaseRequestHandler):
                         if remaining is not None and remaining <= 0:
                             break
                         srv.cv.wait(remaining)
-                    _send_msg(self.request, srv.kv.get(key))
+                    resp = srv.kv.get(key)
             elif cmd == "add":
                 with srv.cv:
                     cur = int.from_bytes(srv.kv.get(key, b"\0" * 8), "little", signed=True)
                     nv = cur + arg
                     srv.kv[key] = nv.to_bytes(8, "little", signed=True)
                     srv.cv.notify_all()
-                _send_msg(self.request, nv)
+                resp = nv
             elif cmd == "check":
                 with srv.cv:
-                    _send_msg(self.request, key in srv.kv)
+                    resp = key in srv.kv
             elif cmd == "del":
                 with srv.cv:
-                    _send_msg(self.request, srv.kv.pop(key, None) is not None)
+                    resp = srv.kv.pop(key, None) is not None
             else:
                 return
+            _send_msg(self.request, resp)
 
 
 class PyTCPStore:
@@ -136,15 +140,8 @@ class PyTCPStore:
     def delete_key(self, key):
         return self._rpc("del", key)
 
-    def barrier(self, name, world_size, timeout=60.0):
-        n = self.add(f"__barrier/{name}/count", 1)
-        if n == world_size:
-            self.set(f"__barrier/{name}/done", b"1")
-        self.wait(f"__barrier/{name}/done", timeout)
-        m = self.add(f"__barrier/{name}/acks", 1)
-        if m == world_size:
-            self.set(f"__barrier/{name}/fin", b"1")
-        self.wait(f"__barrier/{name}/fin", timeout)
+    # barrier lives on the TCPStore facade (runtime/__init__.py), composed
+    # from add/set/wait which already delegate here.
 
     def close(self):
         try:

@@ -147,7 +147,10 @@ def set_program_state(program, state):
     pass
 
 
-# save/load of inference models: ride the jit/orbax paths
+# save/load of inference models ride the jit StableHLO-export path
+# (reference: python/paddle/static/io.py save_inference_model → program +
+# params files consumed by AnalysisPredictor; here jit.save → .pdmodel
+# StableHLO + .pdiparams consumed by paddle_tpu.inference.Predictor).
 def save(program, model_path, protocol=4):
     raise NotImplementedError("use paddle_tpu.save / paddle_tpu.jit.save")
 
@@ -156,13 +159,29 @@ def load(program, model_path, executor=None, var_list=None):
     raise NotImplementedError("use paddle_tpu.load / paddle_tpu.jit.load")
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
-    raise NotImplementedError(
-        "save_inference_model maps to paddle_tpu.jit.save (StableHLO export)"
-    )
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, *, model=None, input_spec=None, **kwargs):
+    """Static-mode export. The TPU-native artifact needs the model object (the
+    program IS the traced model): pass ``model=`` (a Layer) plus
+    ``input_spec=`` (or feed_vars as InputSpecs/example Tensors)."""
+    from .. import jit as _jit
+    from ..nn.layer import Layer as _Layer
+
+    target = model
+    if target is None and isinstance(fetch_vars, _Layer):
+        target = fetch_vars
+    if target is None:
+        raise ValueError(
+            "save_inference_model needs model=<Layer> (TPU-native export "
+            "serializes the traced model as StableHLO via paddle_tpu.jit.save)"
+        )
+    spec = input_spec if input_spec is not None else feed_vars
+    return _jit.save(target, path_prefix, input_spec=spec)
 
 
-def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "load_inference_model maps to paddle_tpu.jit.load"
-    )
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (TranslatedLayer, feed_names, fetch_names) — the loaded layer
+    plays the role of the inference Program."""
+    from .. import jit as _jit
+
+    layer = _jit.load(path_prefix)
+    return layer, layer.input_names, None

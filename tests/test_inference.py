@@ -1,0 +1,112 @@
+"""Inference stack tests: jit.save/load (StableHLO export) + Predictor.
+
+Mirrors the reference's inference tests (SURVEY.md §4 "Inference tests":
+C++ predictors over small saved models) — save a small model, reload in a
+fresh object, check numerical identity and the handle-based predictor API.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = SmallNet()
+    net.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 16).astype("float32"))
+    ref = _np(net(x))
+
+    prefix = str(tmp_path / "small")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([3, 16], "float32", "x")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(x)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-5, atol=1e-5)
+    assert loaded.input_names == ["x"]
+
+
+def test_jit_save_batch_polymorphic(tmp_path):
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "poly")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 16], "float32", "x")])
+    loaded = paddle.jit.load(prefix)
+    for bs in (1, 5, 9):
+        x = paddle.to_tensor(np.ones((bs, 16), "float32"))
+        out = loaded(x)
+        assert tuple(_np(out).shape) == (bs, 4)
+        np.testing.assert_allclose(_np(out), _np(net(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_handles(tmp_path):
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "pred")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 16], "float32", "input")])
+
+    from paddle_tpu import inference
+
+    config = inference.Config(prefix)
+    config.enable_memory_optim()
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["input"]
+
+    x = np.random.RandomState(1).randn(2, 16).astype("float32")
+    h = predictor.get_input_handle("input")
+    h.copy_from_cpu(x)
+    predictor.run()
+    names = predictor.get_output_names()
+    assert len(names) == 1
+    out = predictor.get_output_handle(names[0]).copy_to_cpu()
+    ref = _np(net(paddle.to_tensor(x)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_positional_run(tmp_path):
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "pos")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 16], "float32")])
+    from paddle_tpu import inference
+
+    predictor = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+    x = np.zeros((4, 16), "float32")
+    outs = predictor.run([x])
+    assert outs[0].shape == (4, 4)
+
+
+def test_save_inference_model_wiring(tmp_path):
+    net = SmallNet()
+    prefix = str(tmp_path / "static_export")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([2, 16], "float32", "x")], None, model=net
+    )
+    layer, feed_names, _ = paddle.static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    x = paddle.to_tensor(np.ones((2, 16), "float32"))
+    net.eval()
+    np.testing.assert_allclose(_np(layer(x)), _np(net(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_translated_layer_state_dict(tmp_path):
+    net = SmallNet()
+    prefix = str(tmp_path / "sd")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([1, 16], "float32")])
+    loaded = paddle.jit.load(prefix)
+    sd = loaded.state_dict()
+    assert len(sd) == 4  # fc1/fc2 weight+bias as frozen buffers
