@@ -297,8 +297,11 @@ PT_EXPORT void pt_stack(void* dst, void* const* srcs, int64_t n,
       {
         std::lock_guard<std::mutex> g(mu);
         ++done;
+        // notify while holding mu: the caller can only re-check the
+        // predicate (and destroy mu/cv on return) after we release, so the
+        // worker is guaranteed done touching both by then.
+        cv.notify_all();
       }
-      cv.notify_all();
     });
   }
   std::unique_lock<std::mutex> l(mu);

@@ -53,6 +53,10 @@ from . import distributed  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401

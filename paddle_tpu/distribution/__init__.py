@@ -1,0 +1,912 @@
+"""paddle.distribution parity — probability distributions, transforms, KL.
+
+Reference: ``python/paddle/distribution/`` (Distribution base with
+sample/rsample/log_prob/entropy, the named distribution family, the Transform
+family, and a (p,q)-type-registered ``kl_divergence``). TPU-native design:
+every density / sampler is a pure jnp expression over ``jax.random`` keys
+(drawn from the framework RNG so sampling is reproducible under seed() and
+traceable under jit), so distributions compose with jit/vmap/grad — reparam
+(rsample) gradients come for free from the functional form.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..framework import rng as _rng
+from ..framework.core import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+def _wrap(v):
+    return Tensor(v)
+
+
+def _shape(sample_shape):
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    """Base class (reference: distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        # default: sampling without grad = rsample with stopped gradient
+        return _wrap(jax.lax.stop_gradient(_val(self.rsample(shape))))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_val(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return _rng.next_key()
+
+
+# ---------------------------------------------------------------------------
+# Continuous
+# ---------------------------------------------------------------------------
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale**2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        dtype = jnp.result_type(self.loc, self.scale)
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        eps = jax.random.normal(self._key(), shape, dtype)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale**2
+        return _wrap(
+            -((v - self.loc) ** 2) / (2 * var)
+            - jnp.log(self.scale)
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(out, self.batch_shape))
+
+    def cdf(self, value):
+        v = _val(value)
+        return _wrap(0.5 * (1 + jsp.erf((v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, q):
+        qv = _val(q)
+        return _wrap(self.loc + self.scale * math.sqrt(2) * jsp.erfinv(2 * qv - 1))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale**2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(_val(self._base.rsample(shape))))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(_val(self._base.log_prob(jnp.log(v))) - jnp.log(v))
+
+    def entropy(self):
+        return _wrap(_val(self._base.entropy()) + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale**2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(math.sqrt(2) * self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=-0.5, maxval=0.5)
+        return _wrap(self.loc - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 + jnp.log(2 * self.scale), self.batch_shape))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc + self.scale * jnp.euler_gamma, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(math.pi**2 / 6 * self.scale**2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        g = jax.random.gumbel(self._key(), shape)
+        return _wrap(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.scale) + 1 + jnp.euler_gamma, self.batch_shape))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(z**2))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return _wrap(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1 / self.rate**2)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        e = jax.random.exponential(self._key(), shape)
+        return _wrap(e / self.rate)
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(1 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(
+            jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+        )
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate**2)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        g = jax.random.gamma(self._key(), jnp.broadcast_to(self.concentration, shape))
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        v = _val(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _wrap(a - jnp.log(b) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s**2 * (s + 1)))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        k1, k2 = jax.random.split(self._key())
+        ga = jax.random.gamma(k1, jnp.broadcast_to(self.alpha, shape))
+        gb = jax.random.gamma(k2, jnp.broadcast_to(self.beta, shape))
+        return _wrap(ga / (ga + gb))
+
+    def log_prob(self, value):
+        v = _val(value)
+        a, b = self.alpha, self.beta
+        return _wrap((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - jsp.betaln(a, b))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return _wrap(
+            jsp.betaln(a, b)
+            - (a - 1) * jsp.digamma(a)
+            - (b - 1) * jsp.digamma(b)
+            + (a + b - 2) * jsp.digamma(a + b)
+        )
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = a.sum(-1, keepdims=True)
+        return _wrap(a * (a0 - a) / (a0**2 * (a0 + 1)))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape + self.event_shape
+        g = jax.random.gamma(self._key(), jnp.broadcast_to(self.concentration, shape))
+        return _wrap(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value):
+        v = _val(value)
+        a = self.concentration
+        norm = jsp.gammaln(a.sum(-1)) - jsp.gammaln(a).sum(-1)
+        return _wrap(((a - 1) * jnp.log(v)).sum(-1) + norm)
+
+    def entropy(self):
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        lnB = jsp.gammaln(a).sum(-1) - jsp.gammaln(a0)
+        return _wrap(
+            lnB
+            + (a0 - k) * jsp.digamma(a0)
+            - ((a - 1) * jsp.digamma(a)).sum(-1)
+        )
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _val(df)
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(
+            jnp.broadcast_shapes(self.df.shape, self.loc.shape, self.scale.shape)
+        )
+
+    @property
+    def mean(self):
+        return _wrap(jnp.where(self.df > 1, jnp.broadcast_to(self.loc, self.batch_shape), jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(
+            self.df > 2,
+            self.scale**2 * self.df / (self.df - 2),
+            jnp.where(self.df > 1, jnp.inf, jnp.nan),
+        )
+        return _wrap(jnp.broadcast_to(v, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        t = jax.random.t(self._key(), jnp.broadcast_to(self.df, shape), shape)
+        return _wrap(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        df = self.df
+        return _wrap(
+            jsp.gammaln((df + 1) / 2)
+            - jsp.gammaln(df / 2)
+            - 0.5 * jnp.log(df * math.pi)
+            - jnp.log(self.scale)
+            - (df + 1) / 2 * jnp.log1p(z**2 / df)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Discrete
+# ---------------------------------------------------------------------------
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _val(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _val(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(
+            jax.random.bernoulli(self._key(), self.probs, shape).astype(jnp.float32)
+        )
+
+    def rsample(self, shape=(), temperature=1.0):
+        # relaxed Bernoulli (Gumbel-sigmoid), matching paddle's rsample
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=1e-6, maxval=1 - 1e-6)
+        l = jnp.log(u) - jnp.log1p(-u)
+        return _wrap(jax.nn.sigmoid((self.logits + l) / temperature))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(v * jax.nn.log_sigmoid(self.logits) + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-(jsp.xlogy(p, p) + jsp.xlogy(1 - p, 1 - p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        # paddle's Categorical(logits) treats logits as unnormalized log-probs
+        if logits is not None:
+            lv = _val(logits)
+            self.logits = lv - jsp.logsumexp(lv, -1, keepdims=True)
+        elif probs is not None:
+            self.logits = jnp.log(_val(probs) / _val(probs).sum(-1, keepdims=True))
+        else:
+            raise ValueError("pass logits or probs")
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.categorical(self._key(), self.logits, shape=shape))
+
+    def log_prob(self, value):
+        v = _val(value).astype(jnp.int32)
+        return _wrap(jnp.take_along_axis(self.logits, v[..., None], -1)[..., 0])
+
+    def probabilities(self):
+        return _wrap(self.probs)
+
+    def entropy(self):
+        return _wrap(-(self.probs * self.logits).sum(-1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _val(probs)
+        self.probs = p / p.sum(-1, keepdims=True)
+        self.logits = jnp.log(self.probs)
+        super().__init__(p.shape[:-1], p.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        draws = jax.random.categorical(
+            self._key(), self.logits, shape=(self.total_count,) + shape + self.batch_shape
+        )
+        k = self.event_shape[0]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        v = _val(value)
+        coeff = jsp.gammaln(jnp.asarray(self.total_count + 1.0)) - jsp.gammaln(v + 1).sum(-1)
+        return _wrap(coeff + jsp.xlogy(v, self.probs).sum(-1))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _val(total_count)
+        self.probs = _val(probs)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.total_count), self.probs.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(
+            jax.random.binomial(
+                self._key(), jnp.broadcast_to(self.total_count, shape), self.probs
+            )
+        )
+
+    def log_prob(self, value):
+        v = _val(value)
+        n, p = self.total_count, self.probs
+        coeff = jsp.gammaln(n + 1) - jsp.gammaln(v + 1) - jsp.gammaln(n - v + 1)
+        return _wrap(coeff + jsp.xlogy(v, p) + jsp.xlogy(n - v, 1 - p))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0,1,...} (paddle counts failures)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs**2)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-(jsp.xlogy(1 - p, 1 - p) + jsp.xlogy(p, p)) / p)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.poisson(self._key(), self.rate, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return _wrap(jsp.xlogy(v, self.rate) - self.rate - jsp.gammaln(v + 1))
+
+
+# ---------------------------------------------------------------------------
+# Wrappers
+# ---------------------------------------------------------------------------
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` batch dims as
+    event dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[: len(bs) - self.rank], bs[len(bs) - self.rank :] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _val(self.base.log_prob(value))
+        return _wrap(lp.sum(tuple(range(lp.ndim - self.rank, lp.ndim))))
+
+    def entropy(self):
+        e = _val(self.base.entropy())
+        return _wrap(e.sum(tuple(range(e.ndim - self.rank, e.ndim))))
+
+
+# ---------------------------------------------------------------------------
+# Transforms (reference: distribution/transform.py)
+# ---------------------------------------------------------------------------
+class Transform:
+    def forward(self, x):
+        return _wrap(self._forward(_val(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_val(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._fldj(_val(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _val(y)
+        return _wrap(-self._fldj(self._inverse(yv)))
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2 * (math.log(2) - x - jax.nn.softplus(-2 * x))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _val(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms) if len(transforms) != 1 else transforms[0]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = _val(self.base.rsample(shape))
+        return _wrap(self.transform._forward(x))
+
+    def sample(self, shape=()):
+        return _wrap(jax.lax.stop_gradient(_val(self.rsample(shape))))
+
+    def log_prob(self, value):
+        yv = _val(value)
+        x = self.transform._inverse(yv)
+        return _wrap(_val(self.base.log_prob(x)) - self.transform._fldj(x))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: distribution/kl.py register_kl)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})"
+    )
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    vr = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _wrap(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs, q.probs
+    eps = 1e-7
+    return _wrap(
+        a * (jnp.log(a + eps) - jnp.log(b + eps))
+        + (1 - a) * (jnp.log(1 - a + eps) - jnp.log(1 - b + eps))
+    )
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return _wrap((p.probs * (p.logits - q.logits)).sum(-1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return _wrap(
+        jsp.betaln(a2, b2)
+        - jsp.betaln(a1, b1)
+        + (a1 - a2) * jsp.digamma(a1)
+        + (b1 - b2) * jsp.digamma(b1)
+        + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1)
+    )
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    return _wrap(
+        jsp.gammaln(a0)
+        - jsp.gammaln(b.sum(-1))
+        - (jsp.gammaln(a) - jsp.gammaln(b)).sum(-1)
+        + ((a - b) * (jsp.digamma(a) - jsp.digamma(a0)[..., None])).sum(-1)
+    )
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return _wrap(
+        (a1 - a2) * jsp.digamma(a1)
+        - jsp.gammaln(a1)
+        + jsp.gammaln(a2)
+        + a2 * (jnp.log(b1) - jnp.log(b2))
+        + a1 * (b2 / b1 - 1)
+    )
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    return _wrap(
+        jnp.log(q.scale / p.scale)
+        + d / q.scale
+        + p.scale / q.scale * jnp.exp(-d / p.scale)
+        - 1
+    )
+
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Uniform", "Laplace", "Gumbel",
+    "Cauchy", "Exponential", "Gamma", "Beta", "Dirichlet", "StudentT",
+    "Bernoulli", "Categorical", "Multinomial", "Binomial", "Geometric",
+    "Poisson", "Independent", "TransformedDistribution", "Transform",
+    "ExpTransform", "AffineTransform", "SigmoidTransform", "TanhTransform",
+    "AbsTransform", "PowerTransform", "ChainTransform", "kl_divergence",
+    "register_kl",
+]

@@ -1,0 +1,108 @@
+"""paddle.fft parity — discrete Fourier transforms.
+
+Reference: ``python/paddle/fft.py`` (fft/ifft/rfft/…/fftshift over phi FFT
+kernels backed by cuFFT). TPU-native: jnp.fft lowers to XLA's FFT HLO, which
+runs on-device; norm conventions ("backward"/"ortho"/"forward") match numpy
+and the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap1(fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return Tensor(fn(_val(x), n=n, axis=axis, norm=norm))
+
+    return op
+
+
+def _wrap2(fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return Tensor(fn(_val(x), s=s, axes=axes, norm=norm))
+
+    return op
+
+
+def _wrapn(fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return Tensor(fn(_val(x), s=s, axes=axes, norm=norm))
+
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+# Hermitian 2-D transforms via the identity hfftn(x, s) = irfftn(conj(x), s)
+# * prod(s) (numpy/scipy define hfft this way; numpy has no hfft2/hfftn, so
+# these are built from jnp primitives and stay jit-traceable).
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    if norm != "backward":
+        raise NotImplementedError("hfft2: only norm='backward' is supported")
+    xv = _val(x)
+    out = jnp.fft.irfftn(jnp.conj(xv), s=s, axes=axes)
+    scale = 1.0
+    for ax in axes:
+        scale *= out.shape[ax]
+    return Tensor(out * scale)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    if norm != "backward":
+        raise NotImplementedError("ihfft2: only norm='backward' is supported")
+    xv = _val(x)
+    out = jnp.conj(jnp.fft.rfftn(xv, s=s, axes=axes))
+    scale = 1.0
+    if s is not None:
+        for n in s:
+            scale *= n
+    else:
+        for ax in axes:
+            scale *= xv.shape[ax]
+    return Tensor(out / scale)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.fftshift(_val(x), axes=axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.ifftshift(_val(x), axes=axes))
+
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]

@@ -122,11 +122,11 @@ class _IOHandle:
 class Predictor:
     """paddle.inference predictor over a jit.save'd StableHLO artifact."""
 
-    def __init__(self, config: Config):
-        if not config._prefix:
+    def __init__(self, config: Config, _layer: Optional[TranslatedLayer] = None):
+        if _layer is None and not config._prefix:
             raise ValueError("Config has no model path; use Config(prefix) or set_model")
         self._config = config
-        self._layer: TranslatedLayer = _jit_load(config._prefix)
+        self._layer: TranslatedLayer = _layer if _layer is not None else _jit_load(config._prefix)
         self._input_names = self._layer.input_names
         self._inputs: Dict[str, _IOHandle] = {
             n: _IOHandle(n) for n in self._input_names
@@ -182,12 +182,13 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class PredictorPool:
-    """paddle.inference.PredictorPool parity: N predictors over one artifact
-    (each has its own handle staging; the compiled executable is shared via
-    jax's global compilation cache)."""
+    """paddle.inference.PredictorPool parity: N predictors over ONE loaded
+    artifact — the deserialized module and its jit-compiled executable are
+    shared; each pool member only has its own input/output handle staging."""
 
     def __init__(self, config: Config, size: int = 1):
-        self._preds = [create_predictor(config) for _ in range(size)]
+        shared = _jit_load(config._prefix)
+        self._preds = [Predictor(config, _layer=shared) for _ in range(size)]
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx]

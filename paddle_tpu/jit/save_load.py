@@ -113,11 +113,17 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
     from . import TracedLayer
 
     if isinstance(layer, TracedLayer):
-        # unwrap: the underlying fn is a bound Layer.forward
-        owner = layer._layers[0] if layer._layers else None
-        if owner is None:
-            raise ValueError("jit.save of a bare traced function needs a Layer")
-        layer = owner
+        # Unwrap only the unambiguous case: a TracedLayer over one Layer's
+        # bound forward. A traced free function touching several layers
+        # can't be reduced to any single layer's forward — exporting one of
+        # them would silently serialize the wrong computation.
+        if len(layer._layers) != 1:
+            raise ValueError(
+                "jit.save of a traced function spanning "
+                f"{len(layer._layers)} layers is ambiguous; wrap the "
+                "computation in a single Layer and save that"
+            )
+        layer = layer._layers[0]
     if not isinstance(layer, Layer):
         raise TypeError(f"jit.save expects a Layer, got {type(layer)}")
     if input_spec is None:

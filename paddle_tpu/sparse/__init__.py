@@ -1,0 +1,282 @@
+"""paddle.sparse parity — COO/CSR sparse tensors and sparse ops.
+
+Reference: ``paddle/phi/core/sparse_coo_tensor.h`` / ``sparse_csr_tensor.h``
+and the ``paddle.sparse`` Python API (``python/paddle/sparse/``): creation
+(sparse_coo_tensor / sparse_csr_tensor), conversion (to_dense/to_sparse_coo),
+elementwise ops, matmul, and sparse activations (SURVEY.md §2.1 "PHI core":
+SparseCooTensor). TPU-native design: storage is ``jax.experimental.sparse``
+BCOO/BCSR, whose ops lower to XLA gather/scatter/dot_general — so sparse
+compute stays on-device and composes with jit/grad. XLA has no true sparse
+MXU path; for the block-sparse attention case use the Pallas kernels in
+``paddle_tpu.ops.pallas`` instead (that is the TPU-idiomatic answer for hot
+sparse compute; this module covers API/semantics parity).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor
+from ..framework.dtypes import convert_dtype
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseTensor:
+    """Common wrapper over BCOO (coo) / BCSR (csr) with paddle's surface."""
+
+    def __init__(self, mat, fmt: str):
+        self._mat = mat
+        self._fmt = fmt
+
+    # --- paddle.Tensor sparse surface ---
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self):
+        return self._fmt == "csr"
+
+    def indices(self):
+        if self._fmt != "coo":
+            raise ValueError("indices() is for COO; use crows()/cols()")
+        return Tensor(self._mat.indices.T)  # paddle layout: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._mat.data)
+
+    def crows(self):
+        if self._fmt != "csr":
+            raise ValueError("crows() is for CSR")
+        return Tensor(self._mat.indptr)
+
+    def cols(self):
+        if self._fmt != "csr":
+            raise ValueError("cols() is for CSR")
+        return Tensor(self._mat.indices)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseTensor":
+        if self._fmt == "coo":
+            return self
+        return SparseTensor(self._mat.to_bcoo(), "coo")
+
+    def to_sparse_csr(self) -> "SparseTensor":
+        if self._fmt == "csr":
+            return self
+        return SparseTensor(jsparse.BCSR.from_bcoo(self._mat), "csr")
+
+    def coalesce(self) -> "SparseTensor":
+        if self._fmt != "coo":
+            return self
+        return SparseTensor(self._mat.sum_duplicates(), "coo")
+
+    # arithmetic sugar
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __repr__(self):
+        return f"SparseTensor(fmt={self._fmt}, shape={self.shape}, nnz={self.nnz()})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor: indices [sparse_ndim, nnz], values [nnz, ...]."""
+    idx = _val(indices).astype(jnp.int32)
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(convert_dtype(dtype))
+    if idx.ndim != 2:
+        raise ValueError("indices must be [sparse_ndim, nnz]")
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + vals.shape[1:]
+    mat = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    return SparseTensor(mat, "coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(convert_dtype(dtype))
+    mat = jsparse.BCSR(
+        (vals, _val(cols).astype(jnp.int32), _val(crows).astype(jnp.int32)),
+        shape=tuple(shape),
+    )
+    return SparseTensor(mat, "csr")
+
+
+def to_sparse(t, fmt="coo"):
+    """Dense Tensor → SparseTensor (paddle: Tensor.to_sparse_coo())."""
+    dense = _val(t)
+    coo = jsparse.BCOO.fromdense(dense)
+    st = SparseTensor(coo, "coo")
+    return st if fmt == "coo" else st.to_sparse_csr()
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseTensor):
+        return x._mat if x._fmt == "coo" else x._mat.to_bcoo()
+    raise TypeError("expected SparseTensor")
+
+
+# ---------------------------------------------------------------------------
+# ops (python/paddle/sparse/binary.py, unary.py)
+# ---------------------------------------------------------------------------
+def matmul(x: SparseTensor, y) -> Tensor:
+    """sparse @ dense → dense (the main sparse compute path)."""
+    if isinstance(y, SparseTensor):
+        out = _as_bcoo(x) @ _as_bcoo(y)
+        return SparseTensor(out, "coo")
+    return Tensor(_as_bcoo(x) @ _val(y))
+
+
+def masked_matmul(x, y, mask: SparseTensor) -> SparseTensor:
+    """dense @ dense evaluated only at mask's nonzero positions (SDDMM)."""
+    xm, ym = _val(x), _val(y)
+    m = _as_bcoo(mask).sum_duplicates()
+    rows, cols_ = m.indices[:, 0], m.indices[:, 1]
+    vals = (xm[rows] * ym[:, cols_].T).sum(-1)
+    return SparseTensor(jsparse.BCOO((vals, m.indices), shape=m.shape), "coo")
+
+
+def add(x: SparseTensor, y: SparseTensor) -> SparseTensor:
+    out = (_as_bcoo(x) + _as_bcoo(y)).sum_duplicates()
+    return SparseTensor(out, "coo")
+
+
+def subtract(x: SparseTensor, y: SparseTensor) -> SparseTensor:
+    yb = _as_bcoo(y)
+    neg = jsparse.BCOO((-yb.data, yb.indices), shape=yb.shape)
+    return SparseTensor((_as_bcoo(x) + neg).sum_duplicates(), "coo")
+
+
+def multiply(x: SparseTensor, y) -> SparseTensor:
+    if isinstance(y, SparseTensor):
+        # elementwise product of two sparse operands via sparsify
+        f = jsparse.sparsify(lambda a, b: a * b)
+        return SparseTensor(f(_as_bcoo(x), _as_bcoo(y)), "coo")
+    xb = _as_bcoo(x)
+    yv = _val(y)
+    if yv.ndim == 0:
+        return SparseTensor(jsparse.BCOO((xb.data * yv, xb.indices), shape=xb.shape), "coo")
+    vals = xb.data * yv[tuple(xb.indices[:, i] for i in range(xb.indices.shape[1]))]
+    return SparseTensor(jsparse.BCOO((vals, xb.indices), shape=xb.shape), "coo")
+
+
+def divide(x: SparseTensor, y) -> SparseTensor:
+    xb = _as_bcoo(x)
+    yv = _val(y)
+    if yv.ndim == 0:
+        return SparseTensor(jsparse.BCOO((xb.data / yv, xb.indices), shape=xb.shape), "coo")
+    vals = xb.data / yv[tuple(xb.indices[:, i] for i in range(xb.indices.shape[1]))]
+    return SparseTensor(jsparse.BCOO((vals, xb.indices), shape=xb.shape), "coo")
+
+
+def transpose(x: SparseTensor, perm: Sequence[int]) -> SparseTensor:
+    return SparseTensor(_as_bcoo(x).transpose(tuple(perm)), "coo")
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _unary(fn):
+    def op(x: SparseTensor) -> SparseTensor:
+        xb = _as_bcoo(x)
+        return SparseTensor(jsparse.BCOO((fn(xb.data), xb.indices), shape=xb.shape), "coo")
+
+    return op
+
+
+# value-wise unaries that preserve sparsity (f(0)=0), as in paddle.sparse
+relu = _unary(jax.nn.relu)
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+abs = _unary(jnp.abs)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+neg = _unary(jnp.negative)
+
+
+def pow(x: SparseTensor, factor) -> SparseTensor:
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x: SparseTensor, index_dtype=None, value_dtype=None) -> SparseTensor:
+    xb = _as_bcoo(x)
+    data = xb.data if value_dtype is None else xb.data.astype(convert_dtype(value_dtype))
+    idx = xb.indices if index_dtype is None else xb.indices.astype(convert_dtype(index_dtype))
+    return SparseTensor(jsparse.BCOO((data, idx), shape=xb.shape), "coo")
+
+
+class _SparseNN:
+    """paddle.sparse.nn subset: activation layers over SparseTensor."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over the last dim, only at stored positions
+        (paddle.sparse.nn.Softmax semantics on 2D CSR/COO)."""
+
+        def __init__(self, axis=-1):
+            if axis != -1:
+                raise NotImplementedError("sparse softmax: axis=-1 only")
+
+        def __call__(self, x):
+            xb = _as_bcoo(x).sum_duplicates()
+            if len(xb.shape) != 2:
+                raise NotImplementedError("sparse softmax: 2D only")
+            rows = xb.indices[:, 0]
+            nrows = xb.shape[0]
+            rowmax = jnp.full(nrows, -jnp.inf, xb.data.dtype).at[rows].max(xb.data)
+            e = jnp.exp(xb.data - rowmax[rows])
+            denom = jnp.zeros(nrows, xb.data.dtype).at[rows].add(e)
+            return SparseTensor(
+                jsparse.BCOO((e / denom[rows], xb.indices), shape=xb.shape), "coo"
+            )
+
+
+nn = _SparseNN()
+
+__all__ = [
+    "SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor", "to_sparse",
+    "matmul", "masked_matmul", "add", "subtract", "multiply", "divide",
+    "transpose", "is_same_shape", "relu", "tanh", "sqrt", "square", "abs",
+    "sin", "tan", "asin", "atan", "sinh", "asinh", "atanh", "expm1", "log1p",
+    "neg", "pow", "cast", "nn",
+]

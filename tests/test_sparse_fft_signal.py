@@ -1,0 +1,167 @@
+"""paddle.sparse / paddle.fft / paddle.signal tests vs numpy/scipy references
+(SURVEY.md §4 op-vs-reference pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal, sparse
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+def _rand_coo(rs, shape=(6, 5), nnz=8):
+    dense = np.zeros(shape, "float32")
+    rows = rs.randint(0, shape[0], nnz)
+    cols = rs.randint(0, shape[1], nnz)
+    vals = rs.randn(nnz).astype("float32")
+    for r, c, v in zip(rows, cols, vals):
+        dense[r, c] += v
+    idx = np.stack([rows, cols])
+    return idx, vals, dense
+
+
+def test_sparse_coo_roundtrip():
+    rs = np.random.RandomState(0)
+    idx, vals, dense = _rand_coo(rs)
+    st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    assert st.is_sparse_coo() and not st.is_sparse_csr()
+    np.testing.assert_allclose(_np(st.to_dense()), dense, rtol=1e-6)
+    co = st.coalesce()
+    assert co.nnz() <= st.nnz()
+    np.testing.assert_allclose(_np(co.to_dense()), dense, rtol=1e-6)
+
+
+def test_sparse_csr_and_conversion():
+    crows = np.array([0, 2, 3, 5], "int32")
+    cols = np.array([0, 2, 1, 0, 2], "int32")
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0], "float32")
+    st = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+    dense = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], "float32")
+    np.testing.assert_allclose(_np(st.to_dense()), dense)
+    coo = st.to_sparse_coo()
+    assert coo.is_sparse_coo()
+    np.testing.assert_allclose(_np(coo.to_dense()), dense)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(_np(back.to_dense()), dense)
+
+
+def test_sparse_matmul_and_elementwise():
+    rs = np.random.RandomState(1)
+    idx, vals, dense = _rand_coo(rs)
+    st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    y = rs.randn(5, 3).astype("float32")
+    np.testing.assert_allclose(_np(sparse.matmul(st, y)), dense @ y, rtol=1e-5, atol=1e-6)
+
+    idx2, vals2, dense2 = _rand_coo(rs)
+    st2 = sparse.sparse_coo_tensor(idx2, vals2, dense2.shape)
+    np.testing.assert_allclose(
+        _np(sparse.add(st, st2).to_dense()), dense + dense2, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        _np(sparse.subtract(st, st2).to_dense()), dense - dense2, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        _np(sparse.multiply(st, 2.0).to_dense()), dense * 2, rtol=1e-6
+    )
+
+
+def test_sparse_unary_and_softmax():
+    rs = np.random.RandomState(2)
+    idx, vals, dense = _rand_coo(rs)
+    st = sparse.sparse_coo_tensor(idx, vals, dense.shape).coalesce()
+    np.testing.assert_allclose(
+        _np(sparse.relu(st).to_dense()), np.maximum(dense, 0), rtol=1e-6
+    )
+    sm = sparse.nn.Softmax()(st)
+    out = _np(sm.to_dense())
+    mask = _np(st.to_dense()) != 0
+    # each nonzero row sums to 1 over stored positions
+    row_sums = out.sum(-1)[mask.any(-1)]
+    np.testing.assert_allclose(row_sums, 1.0, rtol=1e-5)
+
+
+def test_masked_matmul():
+    rs = np.random.RandomState(3)
+    x = rs.randn(4, 6).astype("float32")
+    y = rs.randn(6, 5).astype("float32")
+    idx, vals, dense = _rand_coo(rs, shape=(4, 5), nnz=6)
+    mask = sparse.sparse_coo_tensor(idx, vals, (4, 5)).coalesce()
+    out = sparse.masked_matmul(x, y, mask)
+    full = x @ y
+    got = _np(out.to_dense())
+    m = _np(mask.to_dense()) != 0
+    np.testing.assert_allclose(got[m], full[m], rtol=1e-5, atol=1e-5)
+    assert np.all(got[~m] == 0)
+
+
+# ---------------------------------------------------------------------------
+# fft
+# ---------------------------------------------------------------------------
+def test_fft_family_matches_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 16).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(_np(fft.fft(t)), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(_np(fft.rfft(t)), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        _np(fft.ifft(fft.fft(t))), x.astype("complex64"), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        _np(fft.irfft(fft.rfft(t))), x, rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(_np(fft.fft2(t)), np.fft.fft2(x), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(
+        _np(fft.fft(t, norm="ortho")), np.fft.fft(x, norm="ortho"), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(_np(fft.fftfreq(16, 0.5)), np.fft.fftfreq(16, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(_np(fft.fftshift(t)), np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_hfft2_ihfft2_match_scipy():
+    import scipy.fft as sfft
+
+    rs = np.random.RandomState(0)
+    z = (rs.randn(6, 5) + 1j * rs.randn(6, 5)).astype("complex64")
+    np.testing.assert_allclose(
+        _np(fft.hfft2(paddle.to_tensor(z))), sfft.hfft2(z), rtol=1e-3, atol=1e-3
+    )
+    xr = rs.randn(6, 8).astype("float32")
+    np.testing.assert_allclose(
+        _np(fft.ihfft2(paddle.to_tensor(xr))), sfft.ihfft2(xr), rtol=1e-4, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# signal
+# ---------------------------------------------------------------------------
+def test_frame_overlap_add_roundtrip():
+    x = np.arange(32, dtype="float32")
+    f = signal.frame(paddle.to_tensor(x), 8, 8)  # non-overlapping
+    assert _np(f).shape == (8, 4)
+    y = signal.overlap_add(f, 8)
+    np.testing.assert_allclose(_np(y), x)
+
+
+def test_stft_matches_manual_dft():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 64).astype("float32")
+    n_fft, hop = 16, 8
+    out = _np(signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop, center=False))
+    assert out.shape == (2, n_fft // 2 + 1, (64 - n_fft) // hop + 1)
+    # frame 0 of batch 0 == rfft of x[0, :16]
+    np.testing.assert_allclose(out[0, :, 0], np.fft.rfft(x[0, :n_fft]), rtol=1e-4, atol=1e-4)
+
+
+def test_stft_istft_roundtrip():
+    rs = np.random.RandomState(1)
+    x = rs.randn(3, 128).astype("float32")
+    n_fft, hop = 32, 8
+    win = np.hanning(n_fft).astype("float32")
+    spec = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop, window=paddle.to_tensor(win))
+    y = signal.istft(spec, n_fft, hop_length=hop, window=paddle.to_tensor(win), length=128)
+    np.testing.assert_allclose(_np(y), x, rtol=1e-3, atol=1e-3)
