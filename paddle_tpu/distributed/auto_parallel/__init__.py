@@ -169,3 +169,153 @@ def get_mesh() -> Optional[ProcessMesh]:
 
 def set_mesh(mesh: ProcessMesh):
     _mesh.set_global_mesh(mesh.jax_mesh)
+
+
+class Strategy:
+    """auto_parallel Strategy parity. `amp` is applied by Engine (auto_cast
+    around the compiled loss); `recompute`/`gradient_merge` are accepted but
+    emit a warning when enabled (use fleet's recompute_helper / manual grad
+    accumulation); `sharding`/`pipeline` degrees are owned by the fleet
+    hybrid mesh config."""
+
+    class _Section(dict):
+        __getattr__ = dict.get
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.amp = self._Section(enable=False, dtype="bfloat16")
+        self.recompute = self._Section(enable=False)
+        self.sharding = self._Section(enable=False, degree=1, stage=1)
+        self.gradient_merge = self._Section(enable=False, k_steps=1)
+        self.pipeline = self._Section(enable=False, schedule_mode="1F1B")
+
+
+class Engine:
+    """auto_parallel.Engine parity (reference:
+    python/paddle/distributed/auto_parallel/static/engine.py): fit/evaluate/
+    predict driving a model + loss + optimizer over a dataset. TPU-native:
+    the 'planner/partitioner/reshard' passes are GSPMD; the Engine is a thin
+    training driver over the fleet DistTrainStep (one compiled SPMD program
+    per shape signature)."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        self._step = None
+
+    def _get_step(self):
+        if self._step is None:
+            from ..fleet import DistTrainStep
+
+            loss_fn = self._loss
+            strat = self._strategy
+            for knob in ("recompute", "gradient_merge"):
+                if getattr(strat, knob, None) and getattr(strat, knob).get("enable"):
+                    import warnings
+
+                    warnings.warn(
+                        f"auto_parallel Strategy.{knob} is not applied by this "
+                        "Engine (use fleet recompute_helper / manual grad "
+                        "accumulation); continuing without it"
+                    )
+            amp_on = bool(strat.amp.get("enable"))
+            amp_dtype = strat.amp.get("dtype") or "bfloat16"
+
+            def compute_loss(model, *batch):
+                *xs, y = batch
+                if amp_on:
+                    from ... import amp as _amp
+
+                    with _amp.auto_cast(enable=True, dtype=amp_dtype):
+                        out = model(*xs)
+                        return loss_fn(out, y)
+                out = model(*xs)
+                return loss_fn(out, y)
+
+            self._step = DistTrainStep(self._model, compute_loss, self._optimizer)
+        return self._step
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None, log_freq=10, verbose=1, **kwargs):
+        history = {"loss": []}
+        step_fn = self._get_step()
+        for epoch in range(epochs):
+            for i, batch in enumerate(_iter_batches(train_data, batch_size)):
+                loss = step_fn(*batch)
+                history["loss"].append(float(np.asarray(raw(loss))))
+                if verbose and i % log_freq == 0:
+                    print(f"[Engine] epoch {epoch} step {i} loss {history['loss'][-1]:.5f}")
+                if steps_per_epoch is not None and i + 1 >= steps_per_epoch:
+                    break
+        return history
+
+    def evaluate(self, valid_data, batch_size=None, steps=None, **kwargs):
+        was_training = self._model.training
+        self._model.eval()
+        losses = []
+        try:
+            for i, batch in enumerate(_iter_batches(valid_data, batch_size)):
+                *xs, y = batch
+                out = self._model(*xs)
+                losses.append(float(np.asarray(raw(self._loss(out, y)))))
+                if steps is not None and i + 1 >= steps:
+                    break
+        finally:
+            if was_training:
+                self._model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size=None, steps=None, **kwargs):
+        was_training = self._model.training
+        self._model.eval()
+        outs = []
+        try:
+            for i, batch in enumerate(_iter_batches(test_data, batch_size)):
+                xs = batch if isinstance(batch, (list, tuple)) else (batch,)
+                outs.append(self._model(*xs))
+                if steps is not None and i + 1 >= steps:
+                    break
+        finally:
+            if was_training:
+                self._model.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ... import save as _save
+
+        _save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ... import load as _load
+
+        self._model.set_state_dict(_load(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+
+def _iter_batches(data, batch_size):
+    """Accept a DataLoader-like iterable, a list of batch tuples, or an
+    (x, y) pair of whole arrays (sliced by batch_size)."""
+    if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
+        yield from data
+        return
+    if isinstance(data, (tuple, list)):
+        if data and isinstance(data[0], (tuple, list)):
+            # materialized loader: [(x1, y1), (x2, y2), ...]
+            yield from data
+            return
+        xs = [raw(d) if isinstance(d, Tensor) else np.asarray(d) for d in data]
+        n = xs[0].shape[0]
+        bs = batch_size or n
+        for i in range(0, n, bs):
+            yield tuple(Tensor(jax.numpy.asarray(x[i : i + bs])) for x in xs)

@@ -80,6 +80,7 @@ class SpmdPipeline(Layer):
         num_stages: Optional[int] = None,
         num_microbatches: Optional[int] = None,
         recompute_block: bool = False,
+        num_virtual_stages: int = 1,
     ):
         super().__init__()
         blocks = list(blocks)
@@ -92,12 +93,28 @@ class SpmdPipeline(Layer):
         self.num_layers = len(blocks)
         m = _mesh.get_global_mesh()
         self.num_stages = num_stages or _mesh.mesh_axis_size("pp")
-        if self.num_layers % max(self.num_stages, 1) != 0:
+        self.num_virtual_stages = max(int(num_virtual_stages), 1)
+        n_chunks = max(self.num_stages, 1) * self.num_virtual_stages
+        if self.num_layers % n_chunks != 0:
             raise ValueError(
-                f"{self.num_layers} layers not divisible by {self.num_stages} stages"
+                f"{self.num_layers} layers not divisible by {self.num_stages} "
+                f"stages x {self.num_virtual_stages} virtual stages"
             )
         self.num_microbatches = num_microbatches
         self.recompute_block = recompute_block
+        # Interleaved (virtual-pp) layout: chunk c of layer range lives on
+        # physical stage c % S (reference: interleaved 1F1B — SURVEY.md §2.3
+        # "Pipeline parallel" / virtual-pp). Stacking order is s-major so a
+        # P("pp") shard of the leading dim hands stage s its V chunks
+        # contiguously; _layer_order maps stacked position -> original layer.
+        S, V = max(self.num_stages, 1), self.num_virtual_stages
+        chunk_len = self.num_layers // n_chunks
+        order = sorted(
+            range(self.num_layers),
+            key=lambda l: ((l // chunk_len) % S, (l // chunk_len) // S, l),
+        )
+        self._layer_order = order
+        self._inv_order = np.argsort(order)
         # template block is NOT a registered sublayer (its params are absorbed
         # into the stacked ones); hide it from Layer.__setattr__.
         self._template_holder = [blocks[0]]
@@ -106,7 +123,7 @@ class SpmdPipeline(Layer):
         self._stacked: List[Parameter] = []
         for i, (n, tp) in enumerate(zip(names, self._tparams)):
             vals = [raw([q for _, q in b.named_parameters()][i]) for b in blocks]
-            stacked = jnp.stack(vals, axis=0)
+            stacked = jnp.stack([vals[l] for l in order], axis=0)
             sp = Parameter(stacked, trainable=tp.trainable, name=f"stacked_{n}")
             base_spec = list(getattr(tp, "dist_spec", None) or P())
             base_spec += [None] * (stacked.ndim - 1 - len(base_spec))
@@ -148,21 +165,28 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
 
     if S <= 1 or m is None or "pp" not in m.shape or m.shape["pp"] < S:
         # layer-stacked scan (the idiomatic big-model pattern: one block
-        # compiled once, scanned over the layer dim)
+        # compiled once, scanned over the layer dim); un-permute the
+        # interleaved stacking back to original layer order first
+        if pipe.num_virtual_stages > 1:
+            inv = jnp.asarray(pipe._inv_order)
+            ordered = tuple(v[inv] for v in stacked_vals)
+        else:
+            ordered = tuple(stacked_vals)
+
         def body(h, leaves):
             return block(leaves, h), None
 
-        h, _ = lax.scan(body, x, tuple(stacked_vals))
+        h, _ = lax.scan(body, x, ordered)
         return h
 
     # ---- circular micro-batch schedule over the pp axis --------------------
+    V = pipe.num_virtual_stages
     M = pipe.num_microbatches or S
     B = x.shape[0]
     if B % M != 0:
         M = 1
     mb = B // M
     xm = x.reshape((M, mb) + x.shape[1:])
-    L_per = pipe.num_layers // S
 
     def stage_apply(local_leaves, h):
         def body(h, leaves):
@@ -196,6 +220,56 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
             jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)), "pp"
         )
         return out_buf
+
+    def spmd_fn_interleaved(local_stacked, xm_all):
+        """Interleaved (virtual-pp) LAYOUT schedule: stage s holds V chunks
+        (global chunk v*S + s); each micro-batch makes V laps around the
+        ppermute ring, with all V in-flight micro-batches advancing one chunk
+        per step (vmap over slots). This reproduces the reference's
+        interleaved layer-to-stage ASSIGNMENT (checkpoint/layout parity with
+        interleaved-1F1B-trained models — SURVEY.md §2.3 "PP, dygraph").
+        NOTE on cost: per-step work equals the V=1 schedule (V chunks of
+        1/V size) over M + S*V - 1 steps, so this revision does NOT shrink
+        the (S-1)-step bubble; the bubble-optimal phased schedule (one chunk
+        per step with double-buffered slots) is future work."""
+        stage = lax.axis_index("pp")
+        L_chunk = pipe.num_layers // (S * V)
+        local_v = tuple(
+            l.reshape((V, L_chunk) + l.shape[1:]) for l in local_stacked
+        )
+        h0 = jnp.zeros((V, mb) + x.shape[1:], x.dtype)
+        out_buf = jnp.zeros_like(xm_all)
+
+        def step(t, carry):
+            h_, out_ = carry
+            # inject the next micro-batch at (stage 0, virtual slot 0)
+            fresh = xm_all[jnp.minimum(t, M - 1)]
+            h_ = h_.at[0].set(jnp.where(stage == 0, fresh, h_[0]))
+            # every stage advances all V in-flight micro-batches one chunk
+            o = jax.vmap(stage_apply)(local_v, h_)  # [V, mb, ...]
+            o_next = lax.ppermute(o, "pp", [(i, (i + 1) % S) for i in range(S)])
+            # chunk S*V-1 lives on stage S-1 slot V-1; its output arrives at
+            # stage 0 — that is the completed micro-batch
+            widx = t - (S * V - 1)
+            valid = (stage == 0) & (widx >= 0)
+            wi = jnp.clip(widx, 0, M - 1)
+            old = lax.dynamic_slice_in_dim(out_, wi, 1, 0)[0]
+            out_ = lax.dynamic_update_slice_in_dim(
+                out_, jnp.where(valid, o_next[V - 1], old)[None], wi, 0
+            )
+            # wrap-around at stage 0: an activation arriving from stage S-1
+            # in slot v moves on to chunk (v+1)*S, i.e. local slot v+1
+            h_new = jnp.where(stage == 0, jnp.roll(o_next, 1, axis=0), o_next)
+            return h_new, out_
+
+        _, out_buf = lax.fori_loop(0, M + S * V - 1, step, (h0, out_buf))
+        out_buf = lax.psum(
+            jnp.where(stage == 0, out_buf, jnp.zeros_like(out_buf)), "pp"
+        )
+        return out_buf
+
+    if V > 1:
+        spmd_fn = spmd_fn_interleaved
 
     mapped = jax.shard_map(
         spmd_fn,
@@ -267,11 +341,21 @@ class PipelineLayer(Layer):
         lo, hi = best
         n_run = hi - lo + 1
         self._segments: List[Layer] = []
-        if (
-            self.num_stages > 1
-            and n_run >= self.num_stages
-            and n_run % self.num_stages == 0
-        ):
+        n_virtual = max(num_virtual_pipeline_stages or 1, 1)
+        n_chunks = self.num_stages * n_virtual
+        if n_virtual > 1 and (n_run < n_chunks or n_run % n_chunks != 0) and n_run % self.num_stages == 0:
+            # virtual stages don't divide the run — fall back to V=1 rather
+            # than silently disabling pipelining altogether
+            import warnings
+
+            warnings.warn(
+                f"num_virtual_pipeline_stages={n_virtual} does not divide the "
+                f"{n_run}-block run over {self.num_stages} stages; falling "
+                "back to non-interleaved pipeline"
+            )
+            n_virtual = 1
+            n_chunks = self.num_stages
+        if self.num_stages > 1 and n_run >= n_chunks and n_run % n_chunks == 0:
             for l in built[:lo]:
                 self._segments.append(l)
             self._segments.append(
@@ -279,6 +363,7 @@ class PipelineLayer(Layer):
                     built[lo : hi + 1],
                     num_stages=self.num_stages,
                     recompute_block=recompute_interval > 0,
+                    num_virtual_stages=n_virtual,
                 )
             )
             for l in built[hi + 1 :]:

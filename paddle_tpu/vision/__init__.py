@@ -1,2 +1,2 @@
 """paddle.vision parity: models, transforms, datasets."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401

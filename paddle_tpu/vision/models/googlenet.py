@@ -1,0 +1,114 @@
+"""GoogLeNet / Inception-v1 (paddle.vision.models.googlenet parity).
+
+Reference: ``python/paddle/vision/models/googlenet.py`` — returns
+(main_out, aux1, aux2) in train mode like the reference.
+"""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D,
+    AvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Linear,
+    MaxPool2D,
+    ReLU,
+    Sequential,
+)
+from ...nn.layer import Layer
+from ...tensor.manipulation import concat
+
+
+class _BasicConv(Layer):
+    def __init__(self, in_ch, out_ch, k, **kwargs):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, k, bias_attr=False, **kwargs)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _BasicConv(in_ch, c1, 1)
+        self.b2 = Sequential(_BasicConv(in_ch, c3r, 1), _BasicConv(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_BasicConv(in_ch, c5r, 1), _BasicConv(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1), _BasicConv(in_ch, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class _AuxHead(Layer):
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        # adaptive 4x4 (the reference's AvgPool2D(5, stride=3) yields 4x4 at
+        # the canonical 224 input; adaptive keeps the head usable at any size)
+        self.pool = AdaptiveAvgPool2D((4, 4))
+        self.conv = _BasicConv(in_ch, 128, 1)
+        self.fc1 = Linear(2048, 1024)
+        self.relu = ReLU()
+        self.dropout = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x)).flatten(1)
+        return self.fc2(self.dropout(self.relu(self.fc1(x))))
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _BasicConv(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            _BasicConv(64, 64, 1),
+            _BasicConv(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        self.aux1 = _AuxHead(512, num_classes) if num_classes > 0 else None
+        self.aux2 = _AuxHead(528, num_classes) if num_classes > 0 else None
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.pool3(self.inc3b(self.inc3a(self.stem(x))))
+        x = self.inc4a(x)
+        aux1 = self.aux1(x) if (self.training and self.aux1 is not None) else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if (self.training and self.aux2 is not None) else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        if self.training and aux1 is not None:
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled (offline build)")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,167 @@
+"""Inception-v3 (paddle.vision.models.inceptionv3 parity).
+
+Reference: ``python/paddle/vision/models/inceptionv3.py``.
+"""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D,
+    AvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Linear,
+    MaxPool2D,
+    ReLU,
+    Sequential,
+)
+from ...nn.layer import Layer
+from ...tensor.manipulation import concat
+
+
+class _BasicConv(Layer):
+    def __init__(self, in_ch, out_ch, k, **kwargs):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, k, bias_attr=False, **kwargs)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionA(Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1 = _BasicConv(in_ch, 64, 1)
+        self.b5 = Sequential(_BasicConv(in_ch, 48, 1), _BasicConv(48, 64, 5, padding=2))
+        self.b3 = Sequential(
+            _BasicConv(in_ch, 64, 1),
+            _BasicConv(64, 96, 3, padding=1),
+            _BasicConv(96, 96, 3, padding=1),
+        )
+        self.bp = Sequential(
+            AvgPool2D(3, stride=1, padding=1), _BasicConv(in_ch, pool_features, 1)
+        )
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionB(Layer):
+    """Grid reduction 35→17."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _BasicConv(in_ch, 384, 3, stride=2)
+        self.b3d = Sequential(
+            _BasicConv(in_ch, 64, 1),
+            _BasicConv(64, 96, 3, padding=1),
+            _BasicConv(96, 96, 3, stride=2),
+        )
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.b1 = _BasicConv(in_ch, 192, 1)
+        self.b7 = Sequential(
+            _BasicConv(in_ch, c7, 1),
+            _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv(c7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b7d = Sequential(
+            _BasicConv(in_ch, c7, 1),
+            _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv(c7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1), _BasicConv(in_ch, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class InceptionD(Layer):
+    """Grid reduction 17→8."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = Sequential(_BasicConv(in_ch, 192, 1), _BasicConv(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _BasicConv(in_ch, 192, 1),
+            _BasicConv(192, 192, (1, 7), padding=(0, 3)),
+            _BasicConv(192, 192, (7, 1), padding=(3, 0)),
+            _BasicConv(192, 192, 3, stride=2),
+        )
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _BasicConv(in_ch, 320, 1)
+        self.b3_stem = _BasicConv(in_ch, 384, 1)
+        self.b3_a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = Sequential(_BasicConv(in_ch, 448, 1), _BasicConv(448, 384, 3, padding=1))
+        self.b3d_a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1), _BasicConv(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        b3 = concat([self.b3_a(s), self.b3_b(s)], axis=1)
+        d = self.b3d_stem(x)
+        b3d = concat([self.b3d_a(d), self.b3d_b(d)], axis=1)
+        return concat([self.b1(x), b3, b3d, self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _BasicConv(3, 32, 3, stride=2),
+            _BasicConv(32, 32, 3),
+            _BasicConv(32, 64, 3, padding=1),
+            MaxPool2D(3, stride=2),
+            _BasicConv(64, 80, 1),
+            _BasicConv(80, 192, 3),
+            MaxPool2D(3, stride=2),
+        )
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled (offline build)")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,352 @@
+"""paddle.vision.ops parity — detection/vision operators.
+
+Reference: ``python/paddle/vision/ops.py`` (nms, roi_align, roi_pool,
+box_coder, yolo_box, deform_conv2d — phi CUDA kernels). TPU-native design:
+every op is expressed in fixed-shape jnp so it traces under jit — NMS is the
+classic data-dependent op; here it is a lax.scan over score-sorted boxes with
+a suppression mask (static shapes, MXU-friendly IoU matrix), returning a
+validity mask alongside indices instead of a dynamic-length result.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _val(boxes)
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N, M] for xyxy boxes (helper used by nms; torchvision-style)."""
+    a, b = _val(boxes1), _val(boxes2)
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / (area1[:, None] + area2[None, :] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """paddle.vision.ops.nms: returns kept indices (sorted by score desc).
+
+    Implemented as a sequential suppression scan over the full IoU matrix —
+    O(N²) memory but fully static shapes, so it compiles once and runs
+    on-device (no host round-trip per box as in the CUDA reference).
+    """
+    b = _val(boxes)
+    n = b.shape[0]
+    s = jnp.arange(n, 0, -1).astype(jnp.float32) if scores is None else _val(scores)
+    if category_idxs is not None:
+        # category-aware NMS: offset boxes per category so cross-category
+        # pairs never overlap (standard batched-NMS trick)
+        cidx = _val(category_idxs).astype(b.dtype)
+        offset = (b.max() - b.min() + 1.0) * cidx
+        b = b + offset[:, None]
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+    iou = _val(box_iou(b_sorted, b_sorted))
+
+    def body(keep_mask, i):
+        # suppressed if any higher-scored kept box overlaps > threshold
+        overlaps = (iou[i] > iou_threshold) & keep_mask & (jnp.arange(n) < i)
+        keep_i = ~overlaps.any()
+        keep_mask = keep_mask.at[i].set(keep_i)
+        return keep_mask, keep_i
+
+    keep_mask, _ = lax.scan(body, jnp.zeros(n, bool), jnp.arange(n))
+    kept_sorted_pos = jnp.nonzero(keep_mask, size=n, fill_value=n)[0]
+    kept = jnp.where(kept_sorted_pos < n, order[jnp.minimum(kept_sorted_pos, n - 1)], -1)
+    kept = kept[kept >= 0]  # host-side trim (API returns variable length)
+    if top_k is not None:
+        if category_idxs is not None and categories is not None:
+            # paddle semantics: top_k applies PER category
+            cid = _val(category_idxs)
+            import numpy as _np
+
+            kept_np = _np.asarray(kept)
+            cid_np = _np.asarray(cid)
+            out = []
+            for c in categories:
+                out.append(kept_np[cid_np[kept_np] == c][:top_k])
+            kept = jnp.asarray(_np.concatenate(out)) if out else kept[:0]
+        else:
+            kept = kept[:top_k]
+    return Tensor(kept)
+
+
+def _bilinear_sample(feat, y, x):
+    """Sample feat [C, H, W] at float coords (y, x) arrays with bilinear interp."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy1 = jnp.clip(y - y0, 0.0, 1.0)
+    wx1 = jnp.clip(x - x0, 0.0, 1.0)
+    y0i, y1i, x0i, x1i = y0.astype(int), y1.astype(int), x0.astype(int), x1.astype(int)
+    v00 = feat[..., y0i, x0i]
+    v01 = feat[..., y0i, x1i]
+    v10 = feat[..., y1i, x0i]
+    v11 = feat[..., y1i, x1i]
+    return (
+        v00 * (1 - wy1) * (1 - wx1)
+        + v01 * (1 - wy1) * wx1
+        + v10 * wy1 * (1 - wx1)
+        + v11 * wy1 * wx1
+    )
+
+
+def _bilinear_sample_zeropad(feat, y, x):
+    """Like _bilinear_sample but with zero-padding semantics: taps outside
+    the feature map contribute 0 (the DCN reference convention), so a sample
+    partially overlapping the border is correctly down-weighted. roi_align
+    keeps the border-clamp variant (its reference convention)."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+
+    def tap(yi, xi, w):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(int)
+        xc = jnp.clip(xi, 0, W - 1).astype(int)
+        return feat[..., yc, xc] * (w * valid)
+
+    return (
+        tap(y0, x0, (1 - wy1) * (1 - wx1))
+        + tap(y0, x0 + 1, (1 - wy1) * wx1)
+        + tap(y0 + 1, x0, wy1 * (1 - wx1))
+        + tap(y0 + 1, x0 + 1, wy1 * wx1)
+    )
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1, aligned=True):
+    """paddle.vision.ops.roi_align over NCHW input; boxes [R, 4] xyxy."""
+    xv, bv = _val(x), _val(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = _val(boxes_num)
+    # map each roi to its batch image
+    img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn, total_repeat_length=bv.shape[0])
+    off = 0.5 if aligned else 0.0
+    ratio = 1 if sampling_ratio <= 0 else sampling_ratio
+
+    def one_roi(box, img_i):
+        feat = xv[img_i]  # [C, H, W]
+        x1, y1, x2, y2 = box * spatial_scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        rw = jnp.maximum(x2 - x1, 1e-4)
+        rh = jnp.maximum(y2 - y1, 1e-4)
+        bin_h, bin_w = rh / ph, rw / pw
+        # ratio×ratio samples per bin, averaged
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(ratio)[None, :] + 0.5) / ratio)  # [ph, r]
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(ratio)[None, :] + 0.5) / ratio)
+        ys = y1 + iy * bin_h  # [ph, r]
+        xs = x1 + ix * bin_w  # [pw, r]
+        yy = ys[:, :, None, None]  # [ph, r, 1, 1]
+        xx = xs[None, None, :, :]  # [1, 1, pw, r]
+        yb = jnp.broadcast_to(yy, (ph, ratio, pw, ratio))
+        xb = jnp.broadcast_to(xx, (ph, ratio, pw, ratio))
+        samples = _bilinear_sample(feat, yb, xb)  # [C, ph, r, pw, r]
+        return samples.mean(axis=(2, 4))  # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(bv, img_idx)
+    return Tensor(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """paddle.vision.ops.roi_pool (max pooling per bin, quantized bounds)."""
+    xv, bv = _val(x), _val(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = _val(boxes_num)
+    img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn, total_repeat_length=bv.shape[0])
+    H, W = xv.shape[-2], xv.shape[-1]
+
+    def one_roi(box, img_i):
+        feat = xv[img_i]
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        # dense grid of H×W positions, mask-reduce per bin (static shapes)
+        ys = jnp.arange(H, dtype=xv.dtype)
+        xs = jnp.arange(W, dtype=xv.dtype)
+        ybin = jnp.floor((ys - y1) / bin_h)  # [H]
+        xbin = jnp.floor((xs - x1) / bin_w)  # [W]
+        out = jnp.full((feat.shape[0], ph, pw), -jnp.inf, xv.dtype)
+        ymask = (ybin[None, :] == jnp.arange(ph)[:, None]) & (ys >= y1) & (ys <= y2)  # [ph, H]
+        xmask = (xbin[None, :] == jnp.arange(pw)[:, None]) & (xs >= x1) & (xs <= x2)  # [pw, W]
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # [ph, pw, H, W]
+        vals = jnp.where(m[None], feat[:, None, None, :, :], -jnp.inf)
+        out = vals.max(axis=(-2, -1))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(one_roi)(bv, img_idx)
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, axis=0):
+    """paddle.vision.ops.box_coder: encode/decode boxes vs priors."""
+    pb, tb = _val(prior_box), _val(target_box)
+    pv = _val(prior_box_var) if prior_box_var is not None else jnp.ones(4, pb.dtype)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack(
+            [
+                (tcx - pcx) / pw / pv[..., 0],
+                (tcy - pcy) / ph / pv[..., 1],
+                jnp.log(tw / pw) / pv[..., 2],
+                jnp.log(th / ph) / pv[..., 3],
+            ],
+            -1,
+        )
+    elif code_type == "decode_center_size":
+        dcx = tb[..., 0] * pv[..., 0] * pw + pcx
+        dcy = tb[..., 1] * pv[..., 1] * ph + pcy
+        dw = jnp.exp(tb[..., 2] * pv[..., 2]) * pw
+        dh = jnp.exp(tb[..., 3] * pv[..., 3]) * ph
+        out = jnp.stack(
+            [dcx - dw * 0.5, dcy - dh * 0.5, dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], -1
+        )
+    else:
+        raise ValueError(f"unknown code_type {code_type!r}")
+    return Tensor(out)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """paddle.vision.ops.yolo_box: decode YOLO head output [N, A*(5+C), H, W]."""
+    xv = _val(x)
+    img = _val(img_size)  # [N, 2] (h, w)
+    n, _, h, w = xv.shape
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, xv.dtype).reshape(na, 2)  # (w, h) pairs
+    p = xv.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=xv.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=xv.dtype)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / w
+    by = (sig(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / h
+    bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / (w * downsample_ratio)
+    bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / (h * downsample_ratio)
+    conf = sig(p[:, :, 4])
+    prob = sig(p[:, :, 5:]) * conf[:, :, None]
+    img_h = img[:, 0].reshape(n, 1, 1, 1)
+    img_w = img[:, 1].reshape(n, 1, 1, 1)
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, na * h * w, 4)
+    scores = prob.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w, class_num)
+    mask = conf.reshape(n, na * h * w, 1) > conf_thresh
+    boxes = jnp.where(mask, boxes, 0.0)
+    scores = jnp.where(mask, scores, 0.0)
+    return Tensor(boxes), Tensor(scores)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1, deformable_groups=1, groups=1, mask=None):
+    """paddle.vision.ops.deform_conv2d (DCNv1/v2 when mask given).
+
+    Gather-based: build the deformed im2col via bilinear sampling, then one
+    big matmul — the MXU-friendly formulation of deformable conv.
+    """
+    xv, ov, wv = _val(x), _val(offset), _val(weight)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("deform_conv2d: groups/deformable_groups == 1 only")
+    n, cin, H, W = xv.shape
+    cout, _, kh, kw = wv.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph_, pw_ = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    oh = (H + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+    xp = jnp.pad(xv, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+    base_y = jnp.arange(oh) * sh
+    base_x = jnp.arange(ow) * sw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    # sampling grid [oh, ow, kh, kw]
+    gy = base_y[:, None, None, None] + ky[None, None, :, None]
+    gx = base_x[None, :, None, None] + kx[None, None, None, :]
+    off = ov.reshape(n, kh * kw, 2, oh, ow)  # (dy, dx) per kernel tap
+    dy = off[:, :, 0].transpose(0, 2, 3, 1).reshape(n, oh, ow, kh, kw)
+    dx = off[:, :, 1].transpose(0, 2, 3, 1).reshape(n, oh, ow, kh, kw)
+    yy = gy[None].astype(xv.dtype) + dy
+    xx = gx[None].astype(xv.dtype) + dx
+
+    def per_image(feat, yyi, xxi):
+        return _bilinear_sample_zeropad(feat, yyi, xxi)  # [C, oh, ow, kh, kw]
+
+    cols = jax.vmap(per_image)(xp, yy, xx)  # [N, C, oh, ow, kh, kw]
+    if mask is not None:
+        mv = _val(mask).reshape(n, kh * kw, oh, ow).transpose(0, 2, 3, 1).reshape(n, oh, ow, kh, kw)
+        cols = cols * mv[:, None]
+    cols = cols.transpose(0, 2, 3, 1, 4, 5).reshape(n, oh, ow, cin * kh * kw)
+    wmat = wv.reshape(cout, cin * kh * kw)
+    out = jnp.einsum("nhwk,ck->nchw", cols, wmat)
+    if bias is not None:
+        out = out + _val(bias).reshape(1, cout, 1, 1)
+    return Tensor(out)
+
+
+from ..nn.layer import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """paddle.vision.ops.DeformConv2D — a Layer, so weight/bias register in
+    parameters()/state_dict() and train with the rest of the model."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, deformable_groups=1, groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import Conv2D as _C
+
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size, kernel_size)
+        helper = _C(in_channels, out_channels, k, stride=stride,
+                    weight_attr=weight_attr, bias_attr=bias_attr)
+        self.weight = helper.weight
+        self.bias = helper.bias
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, mask=mask, **self._cfg)
+
+
+__all__ = [
+    "nms", "box_iou", "box_area", "roi_align", "roi_pool", "box_coder",
+    "yolo_box", "deform_conv2d", "DeformConv2D",
+]

@@ -1,0 +1,89 @@
+"""Interleaved (virtual-pp) pipeline schedule: output parity with the plain
+scan and the V=1 circular schedule on an 8-device CPU mesh (SURVEY.md §4
+"distributed tests without a real cluster")."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import SpmdPipeline
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def _init(pp):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs["dp_degree"] = 8 // pp
+    s.hybrid_configs["pp_degree"] = pp
+    fleet.init(is_collective=True, strategy=s)
+
+
+def _blocks(n, d=16, seed=0):
+    paddle.seed(seed)
+    return [nn.Sequential(nn.Linear(d, d), nn.Tanh()) for _ in range(n)]
+
+
+def test_interleaved_matches_sequential():
+    _init(pp=4)
+    blocks = _blocks(8)
+    # reference: run the blocks sequentially
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16).astype("float32"))
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+    pipe = SpmdPipeline(blocks, num_stages=4, num_microbatches=4, num_virtual_stages=2)
+    out = pipe(x)
+    np.testing.assert_allclose(_np(out), _np(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_interleaved_matches_v1_schedule():
+    _init(pp=4)
+    blocks = _blocks(8, seed=1)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(8, 16).astype("float32"))
+    out_v1 = SpmdPipeline(blocks, num_stages=4, num_microbatches=4)(x)
+    out_v2 = SpmdPipeline(_blocks(8, seed=1), num_stages=4, num_microbatches=4, num_virtual_stages=2)(x)
+    np.testing.assert_allclose(_np(out_v2), _np(out_v1), rtol=2e-4, atol=2e-5)
+
+
+def test_interleaved_training_decreases_loss():
+    _init(pp=2)
+    blocks = _blocks(4, seed=2)
+    pipe = SpmdPipeline(blocks, num_stages=2, num_microbatches=2, num_virtual_stages=2)
+    head = nn.Linear(16, 1)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=pipe.parameters() + head.parameters())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randn(8, 1).astype("float32"))
+    loss_fn = nn.MSELoss()
+    losses = []
+    for _ in range(6):
+        loss = loss_fn(head(pipe(x)), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_virtual_stage_divisibility_error():
+    _init(pp=4)
+    with pytest.raises(ValueError):
+        SpmdPipeline(_blocks(6), num_stages=4, num_virtual_stages=2)
+
+
+def test_fallback_scan_unpermutes_interleaved_order():
+    # mesh has no pp axis wide enough: the V>1 pipeline falls back to the
+    # layer scan, which must un-permute the interleaved stacking (order for
+    # S=4, V=2 is [0,4,1,5,2,6,3,7] — a real permutation)
+    _init(pp=1)
+    blocks = _blocks(8, seed=3)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(4, 16).astype("float32"))
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+    pipe = SpmdPipeline(blocks, num_stages=4, num_microbatches=1, num_virtual_stages=2)
+    assert pipe._layer_order == [0, 4, 1, 5, 2, 6, 3, 7]
+    np.testing.assert_allclose(_np(pipe(x)), _np(ref), rtol=2e-4, atol=2e-5)

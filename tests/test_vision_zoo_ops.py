@@ -1,0 +1,202 @@
+"""New vision models (forward shape + trainability) and vision.ops numerics
+(vs brute-force numpy references — SURVEY.md §4 pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, ops
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def _fwd(model, hw=64):
+    model.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, hw, hw).astype("float32"))
+    return _np(model(x))
+
+
+@pytest.mark.parametrize(
+    "ctor,kwargs,hw",
+    [
+        (models.alexnet, dict(num_classes=10), 64),
+        (models.squeezenet1_0, dict(num_classes=10), 64),
+        (models.squeezenet1_1, dict(num_classes=10), 64),
+        (models.densenet121, dict(num_classes=10), 64),
+        (models.googlenet, dict(num_classes=10), 64),
+        (models.inception_v3, dict(num_classes=10), 96),
+        (models.shufflenet_v2_x0_25, dict(num_classes=10), 64),
+        (models.shufflenet_v2_swish, dict(num_classes=10), 64),
+        (models.mobilenet_v3_small, dict(num_classes=10), 64),
+        (models.mobilenet_v3_large, dict(num_classes=10), 64),
+    ],
+)
+def test_model_forward_shapes(ctor, kwargs, hw):
+    out = _fwd(ctor(**kwargs), hw)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out).all()
+
+
+def test_googlenet_train_mode_aux_heads():
+    m = models.googlenet(num_classes=7)
+    m.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 96, 96).astype("float32"))
+    out, aux1, aux2 = m(x)
+    assert _np(out).shape == _np(aux1).shape == _np(aux2).shape == (2, 7)
+
+
+def test_densenet_params_train():
+    m = models.densenet121(num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(3):
+        loss = loss_fn(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+def _nms_ref(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = (boxes[rest, 2] - boxes[rest, 0]) * (boxes[rest, 3] - boxes[rest, 1])
+        iou = inter / (a1 + a2 - inter + 1e-10)
+        order = rest[iou <= thr]
+    return np.array(keep)
+
+
+def test_nms_matches_reference():
+    rs = np.random.RandomState(0)
+    xy = rs.rand(40, 2) * 50
+    wh = rs.rand(40, 2) * 20 + 1
+    boxes = np.concatenate([xy, xy + wh], 1).astype("float32")
+    scores = rs.rand(40).astype("float32")
+    got = _np(ops.nms(paddle.to_tensor(boxes), 0.4, scores=paddle.to_tensor(scores)))
+    ref = _nms_ref(boxes, scores, 0.4)
+    np.testing.assert_array_equal(np.sort(got), np.sort(ref))
+
+
+def test_box_iou_and_area():
+    a = np.array([[0, 0, 2, 2]], "float32")
+    b = np.array([[1, 1, 3, 3], [4, 4, 5, 5]], "float32")
+    iou = _np(ops.box_iou(paddle.to_tensor(a), paddle.to_tensor(b)))
+    np.testing.assert_allclose(iou, [[1 / 7, 0.0]], rtol=1e-5)
+    np.testing.assert_allclose(_np(ops.box_area(paddle.to_tensor(b))), [4.0, 1.0])
+
+
+def test_roi_align_constant_feature():
+    # constant feature map -> every pooled value equals the constant
+    x = np.full((1, 3, 16, 16), 2.5, "float32")
+    boxes = np.array([[2.0, 2.0, 10.0, 10.0], [0.0, 0.0, 15.0, 15.0]], "float32")
+    out = _np(ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                            paddle.to_tensor(np.array([2])), output_size=4))
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+
+def test_roi_align_linear_gradient_field():
+    # f(y, x) = x -> pooled bin centers must equal their x coordinates
+    W = 16
+    x = np.broadcast_to(np.arange(W, dtype="float32"), (1, 1, W, W)).copy()
+    boxes = np.array([[4.0, 4.0, 12.0, 12.0]], "float32")
+    out = _np(ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                            paddle.to_tensor(np.array([1])), output_size=2, aligned=False))
+    # bin centers at x = 4 + {1, 3}/4 * 8 = 6, 10 (sample at center of each 4-wide bin)
+    np.testing.assert_allclose(out[0, 0, 0], [6.0, 10.0], atol=0.5)
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 6, 6] = 7.0
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+    out = _np(ops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                           paddle.to_tensor(np.array([1])), output_size=2))
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] == 5.0 and out[0, 0, 1, 1] == 7.0
+
+
+def test_box_coder_roundtrip():
+    rs = np.random.RandomState(0)
+    priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], "float32")
+    targets = np.array([[1, 1, 12, 9], [6, 4, 22, 30]], "float32")
+    enc = ops.box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(targets))
+    dec = ops.box_coder(paddle.to_tensor(priors), None, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(_np(dec), targets, rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_box_shapes():
+    n, na, c, h, w = 1, 3, 4, 5, 5
+    x = np.random.RandomState(0).randn(n, na * (5 + c), h, w).astype("float32")
+    boxes, scores = ops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([[320, 320]])),
+        anchors=[10, 13, 16, 30, 33, 23], class_num=c, conf_thresh=0.01,
+    )
+    assert _np(boxes).shape == (n, na * h * w, 4)
+    assert _np(scores).shape == (n, na * h * w, c)
+    b = _np(boxes)
+    assert (b >= 0).all() and (b <= 319).all()
+
+
+def test_deform_conv2d_layer_registers_params():
+    dcn = ops.DeformConv2D(2, 4, 3)
+    names = [n for n, _ in dcn.named_parameters()]
+    assert "weight" in names and "bias" in names
+
+
+def test_deform_conv2d_out_of_bounds_samples_are_zero():
+    # huge offsets push every tap outside the input -> output must be 0
+    x = np.ones((1, 1, 6, 6), "float32")
+    w = np.ones((1, 1, 3, 3), "float32")
+    offset = np.full((1, 2 * 9, 4, 4), 100.0, "float32")
+    out = _np(ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset), paddle.to_tensor(w)))
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_nms_per_category_top_k():
+    # two categories of well-separated boxes; top_k=1 keeps one PER category
+    boxes = np.array(
+        [[0, 0, 1, 1], [10, 10, 11, 11], [20, 20, 21, 21], [30, 30, 31, 31]],
+        "float32",
+    )
+    scores = np.array([0.9, 0.8, 0.7, 0.6], "float32")
+    cids = np.array([0, 0, 1, 1])
+    kept = _np(
+        ops.nms(
+            paddle.to_tensor(boxes), 0.5, scores=paddle.to_tensor(scores),
+            category_idxs=paddle.to_tensor(cids), categories=[0, 1], top_k=1,
+        )
+    )
+    assert set(kept.tolist()) == {0, 2}
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    import jax
+    rs = np.random.RandomState(0)
+    x = rs.randn(1, 2, 8, 8).astype("float32")
+    w = rs.randn(4, 2, 3, 3).astype("float32")
+    offset = np.zeros((1, 2 * 9, 6, 6), "float32")
+    out = _np(ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset), paddle.to_tensor(w)))
+    ref = jax.lax.conv_general_dilated(x, w, (1, 1), "VALID")
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-4)
