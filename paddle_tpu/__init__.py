@@ -58,6 +58,9 @@ from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
@@ -68,6 +71,28 @@ from . import vision  # noqa: E402,F401
 from .device import get_device, is_compiled_with_cuda, is_compiled_with_tpu, set_device  # noqa: E402,F401
 from .framework.io_state import load, save  # noqa: E402,F401
 from .hapi_model import Model  # noqa: E402,F401
+from .hapi.model_summary import flops, summary  # noqa: E402,F401
+
+
+def iinfo(dtype):
+    import numpy as _np
+
+    from .framework.dtypes import convert_dtype as _cd
+
+    return _np.iinfo(_np.dtype(str(_cd(dtype))))
+
+
+def finfo(dtype):
+    import numpy as _np
+
+    from .framework.dtypes import convert_dtype as _cd
+
+    d = _cd(dtype)
+    if str(d) == "bfloat16":
+        import ml_dtypes
+
+        return ml_dtypes.finfo("bfloat16")
+    return _np.finfo(_np.dtype(str(d)))
 
 is_tensor = tensor.is_tensor  # noqa: F811
 

@@ -1,0 +1,21 @@
+"""paddle.audio parity — spectral feature layers and functional helpers.
+
+Reference: ``python/paddle/audio/`` (features: Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC layers; functional: mel scale + window + dct
+helpers; backends for file IO). Feature compute rides paddle_tpu.signal.stft
+(one fused frame→window→rfft XLA program); file-IO backends are gated (no
+soundfile in this image).
+"""
+from . import functional  # noqa: F401
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401
+
+
+def load(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle_tpu.audio.load: no audio IO backend in this build; decode "
+        "with soundfile/scipy.io.wavfile and pass arrays to the feature layers"
+    )
+
+
+def save(*args, **kwargs):
+    raise NotImplementedError("paddle_tpu.audio.save: no audio IO backend in this build")

@@ -1,0 +1,122 @@
+"""paddle.audio.features parity — Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers.
+
+Reference: ``python/paddle/audio/features/layers.py``. Each layer is a thin
+Layer over signal.stft + the functional helpers, so the whole feature
+pipeline fuses into one XLA program per input shape.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer import Layer
+from ..signal import stft
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(
+        self,
+        n_fft: int = 512,
+        hop_length: Optional[int] = None,
+        win_length: Optional[int] = None,
+        window: str = "hann",
+        power: float = 2.0,
+        center: bool = True,
+        pad_mode: str = "reflect",
+        dtype: str = "float32",
+    ):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window", F.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        spec = stft(
+            x,
+            self.n_fft,
+            hop_length=self.hop_length,
+            win_length=self.win_length,
+            window=self.window,
+            center=self.center,
+            pad_mode=self.pad_mode,
+        )
+        mag = jnp.abs(spec._value)
+        if self.power != 1.0:
+            mag = mag**self.power
+        return Tensor(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(
+        self,
+        sr: int = 22050,
+        n_fft: int = 512,
+        hop_length: Optional[int] = None,
+        win_length: Optional[int] = None,
+        window: str = "hann",
+        power: float = 2.0,
+        center: bool = True,
+        pad_mode: str = "reflect",
+        n_mels: int = 64,
+        f_min: float = 50.0,
+        f_max: Optional[float] = None,
+        htk: bool = False,
+        norm: Union[str, float] = "slaney",
+        dtype: str = "float32",
+    ):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window, power, center, pad_mode, dtype)
+        self.register_buffer(
+            "fbank",
+            F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype),
+        )
+
+    def forward(self, x):
+        spec = self.spectrogram(x)._value  # [..., freq, time]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank._value, spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None, window="hann",
+                 power=2.0, center=True, pad_mode="reflect", n_mels=64, f_min=50.0,
+                 f_max=None, htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel_spectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self.mel_spectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect", n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney", ref_value=1.0,
+                 amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype,
+        )
+        self.register_buffer("dct", F.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        logmel = self.log_mel(x)._value  # [..., mel, time]
+        out = jnp.einsum("mk,...mt->...kt", self.dct._value, logmel)
+        return Tensor(out)

@@ -1,0 +1,144 @@
+"""paddle.audio.functional parity (mel scale, fbank, dct, windows).
+
+Reference: ``python/paddle/audio/functional/functional.py``, ``window.py``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = _val(freq) if isinstance(freq, Tensor) else jnp.asarray(freq, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        # Slaney formula (librosa/paddle default)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = jnp.where(
+            f >= min_log_hz, min_log_mel + jnp.log(f / min_log_hz) / logstep, mels
+        )
+        out = mels
+    return Tensor(out) if isinstance(freq, Tensor) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _val(mel) if isinstance(mel, Tensor) else jnp.asarray(mel, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = jnp.where(
+            m >= min_log_mel, min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs
+        )
+        out = freqs
+    return Tensor(out) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0, htk: bool = False, dtype="float32"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(
+    sr: int,
+    n_fft: int,
+    n_mels: int = 64,
+    f_min: float = 0.0,
+    f_max: Optional[float] = None,
+    htk: bool = False,
+    norm: Union[str, float] = "slaney",
+    dtype="float32",
+):
+    """[n_mels, n_fft//2+1] triangular mel filterbank (librosa-compatible)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = _val(fft_frequencies(sr, n_fft))
+    melfreqs = _val(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2 : n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            jnp.linalg.norm(weights, ord=norm, axis=-1, keepdims=True), 1e-10
+        )
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10, top_db: Optional[float] = 80.0):
+    s = _val(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix (paddle layout: mels @ dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, 1.0 / math.sqrt(n_mels), math.sqrt(2.0 / n_mels))
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/kaiser/gaussian(std)/taylor→gated."""
+    n = win_length
+    sym = not fftbins
+    M = n + 1 if not sym else n
+
+    def trim(w):
+        return w[:-1] if not sym else w
+
+    i = jnp.arange(M, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / (M - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / (M - 1))
+    elif window == "blackman":
+        w = (
+            0.42
+            - 0.5 * jnp.cos(2 * math.pi * i / (M - 1))
+            + 0.08 * jnp.cos(4 * math.pi * i / (M - 1))
+        )
+    elif window == "bartlett":
+        w = 1.0 - jnp.abs(2 * i / (M - 1) - 1.0)
+    elif window == "rectangular" or window == "boxcar":
+        w = jnp.ones(M)
+    elif isinstance(window, tuple) and window[0] == "gaussian":
+        std = window[1]
+        w = jnp.exp(-0.5 * ((i - (M - 1) / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(trim(w).astype(dtype))

@@ -166,3 +166,177 @@ def set_grad_enabled(mode):
 
 def is_grad_enabled_fn():
     return is_grad_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Functional higher-order autodiff (paddle.autograd/incubate.autograd parity:
+# jvp, vjp, Jacobian, Hessian). These ride jax's transforms directly — the
+# TPU-native answer to the reference's prim/composite-op double-backward
+# machinery (paddle/fluid/prim — SURVEY.md §2.1 "JIT / Prim").
+# ---------------------------------------------------------------------------
+def _fn_on_vals(func):
+    """Lift a Tensor->Tensor function to raw-array world."""
+
+    def f(*vals):
+        args = [Tensor(v) for v in vals]
+        out = func(*args)
+        if isinstance(out, Tensor):
+            return raw(out)
+        return tuple(raw(o) if isinstance(o, Tensor) else o for o in out)
+
+    return f
+
+
+def vjp(func, xs, v=None):
+    """paddle.incubate.autograd.vjp parity: (outputs, vjp_result)."""
+    import jax
+
+    single = isinstance(xs, Tensor)
+    vals = [raw(xs)] if single else [raw(x) for x in xs]
+    out_val, vjp_fn = jax.vjp(_fn_on_vals(func), *vals)
+    if v is None:
+        ct = jnp.ones_like(out_val) if not isinstance(out_val, tuple) else tuple(
+            jnp.ones_like(o) for o in out_val
+        )
+    else:
+        ct = raw(v) if isinstance(v, Tensor) else (
+            tuple(raw(c) for c in v) if isinstance(v, (list, tuple)) else jnp.asarray(v)
+        )
+    grads = vjp_fn(ct)
+    outs = Tensor(out_val) if not isinstance(out_val, tuple) else tuple(Tensor(o) for o in out_val)
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    """paddle.incubate.autograd.jvp parity: (outputs, jvp_result)."""
+    import jax
+
+    single = isinstance(xs, Tensor)
+    vals = [raw(xs)] if single else [raw(x) for x in xs]
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    elif isinstance(v, Tensor):
+        tangents = (raw(v),)
+    else:
+        tangents = tuple(raw(t) for t in v)
+    out_val, jv = jax.jvp(_fn_on_vals(func), tuple(vals), tangents)
+    outs = Tensor(out_val) if not isinstance(out_val, tuple) else tuple(Tensor(o) for o in out_val)
+    jvs = Tensor(jv) if not isinstance(jv, tuple) else tuple(Tensor(j) for j in jv)
+    return outs, jvs
+
+
+class Jacobian:
+    """paddle.autograd.Jacobian parity: lazy full Jacobian of func at xs.
+
+    Indexing J[i, j] slices the materialized matrix; J[:] gives the whole
+    [out_size, in_size] matrix (batched dims flattened, paddle convention
+    for single input/output)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax
+
+        single = isinstance(xs, Tensor)
+        vals = [raw(xs)] if single else [raw(x) for x in xs]
+        self._is_batched = is_batched
+        if is_batched:
+            # per-sample Jacobian [B, out/B, in/B]: vmap jacrev over the
+            # batch dim (paddle's batched semantics — no cross-sample blocks)
+            if len(vals) != 1:
+                raise NotImplementedError("batched Jacobian supports one input")
+
+            f1 = _fn_on_vals(func)
+
+            def per_sample(v):
+                return f1(v[None])[0]
+
+            jac = jax.vmap(jax.jacrev(per_sample))(vals[0])
+            b = jac.shape[0]
+            out_nd = jac.ndim - vals[0][0].ndim - 1
+            out_sz = 1
+            for d in jac.shape[1 : 1 + out_nd] or (1,):
+                out_sz *= d
+            in_sz = 1
+            for d in jac.shape[1 + out_nd :] or (1,):
+                in_sz *= d
+            self._mat = jac.reshape(b, out_sz, in_sz)
+            self._in_ndim = None
+            return
+        self._in_ndim = vals[0].ndim
+        jac = jax.jacrev(_fn_on_vals(func))(*vals)
+        self._mat = jac[0] if isinstance(jac, tuple) else jac
+
+    @property
+    def matrix(self) -> Tensor:
+        """[out_size, in_size]; batched: [B, out_size_per_sample, in_size_per_sample]."""
+        m = self._mat
+        if self._is_batched:
+            return Tensor(m)
+        out_dims = m.ndim - self._in_ndim
+        out_sz = 1
+        for d in m.shape[:out_dims]:
+            out_sz *= d
+        in_sz = 1
+        for d in m.shape[out_dims:]:
+            in_sz *= d
+        return Tensor(m.reshape(out_sz, in_sz))
+
+    def __getitem__(self, idx):
+        return Tensor(self.matrix._value[idx])
+
+    @property
+    def shape(self):
+        return list(self.matrix._value.shape)
+
+
+class Hessian:
+    """paddle.autograd.Hessian parity: Hessian of a scalar-valued func."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax
+
+        if not isinstance(xs, Tensor):
+            xs = list(xs)
+            if len(xs) != 1:
+                raise NotImplementedError(
+                    "Hessian over multiple inputs is not supported; concatenate "
+                    "them into one flat input"
+                )
+            xs = xs[0]
+        val = raw(xs)
+        self._is_batched = is_batched
+
+        def scalar_f(v):
+            out = _fn_on_vals(func)(v)
+            return out.reshape(()) if hasattr(out, "reshape") else out
+
+        if is_batched:
+            # per-sample Hessian [B, n, n] of f applied per sample
+            def per_sample(v):
+                out = _fn_on_vals(func)(v[None])
+                return out.reshape(()) if hasattr(out, "reshape") else out
+
+            h = jax.vmap(jax.hessian(per_sample))(val)
+            b, n = h.shape[0], 1
+            for d in val.shape[1:]:
+                n *= d
+            self._mat = h.reshape(b, n, n)
+        else:
+            self._mat = jax.hessian(scalar_f)(val)
+
+    @property
+    def matrix(self):
+        m = self._mat
+        if self._is_batched:
+            return Tensor(m)
+        import numpy as _np
+
+        n = int(_np.sqrt(_np.prod(m.shape)))
+        return Tensor(m.reshape(n, n))
+
+    def __getitem__(self, idx):
+        return Tensor(self.matrix._value[idx])
+
+    @property
+    def shape(self):
+        return list(self.matrix._value.shape)

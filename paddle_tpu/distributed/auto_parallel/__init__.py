@@ -244,6 +244,9 @@ class Engine:
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None, log_freq=10, verbose=1, **kwargs):
         history = {"loss": []}
         step_fn = self._get_step()
+        if epochs > 1 and iter(train_data) is iter(train_data):
+            # one-shot iterator: materialize so epochs 2..N see data
+            train_data = list(train_data)
         for epoch in range(epochs):
             for i, batch in enumerate(_iter_batches(train_data, batch_size)):
                 loss = step_fn(*batch)

@@ -1,6 +1,7 @@
 """paddle.incubate parity namespace: MoE and experimental distributed models
 (SURVEY.md §2.2 "Incubate")."""
 from . import moe  # noqa: F401
+from . import nn  # noqa: F401
 from .moe import MoELayer, global_gather, global_scatter  # noqa: F401
 
 

@@ -1,0 +1,210 @@
+"""paddle.quantization parity — PTQ observers + QAT fake-quant (int8 sim).
+
+Reference: ``python/paddle/quantization/`` (QuantConfig, PTQ, QAT,
+FakeQuanterWithAbsMaxObserver, AbsmaxObserver; quanted layer wrappers in
+``nn/quant/``). TPU-native design: fake-quantization is a pure jnp
+round-clamp with a straight-through estimator, so QAT training still
+compiles into the one fused train-step program; "conversion" freezes scales
+as buffers. True int8 serving on TPU means feeding XLA int8 matmuls —
+out of scope here; this module covers the quantization *workflow* parity.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+from ..nn.layer import Layer
+
+
+@defop(name="fake_quantize_dequantize_abs_max")
+def _fake_quant(x, scale=None, bits=8):
+    """Symmetric fake-quant with straight-through estimator. Registered as a
+    framework op so the eager autograd tape records it (the STE gradient is
+    identity wrt x); `scale` arrives as a raw array (non-differentiable)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer: tracks running abs-max of what flows through."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.register_buffer("absmax", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        v = raw(x) if isinstance(x, Tensor) else jnp.asarray(x)
+        self.absmax._value = jnp.maximum(self.absmax._value, jnp.abs(v).max())
+        return x
+
+    def scale(self):
+        return self.absmax._value
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: EMA abs-max scale + fake-quantize (STE) in forward."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("initialized", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        v = jax.lax.stop_gradient(raw(xt))
+        cur = jnp.abs(v).max().astype(jnp.float32)
+        if self.training:
+            r = self.moving_rate
+            init = self.initialized._value
+            new_scale = jnp.where(init > 0, r * self.scale._value + (1 - r) * cur, cur)
+            self.scale._value = new_scale
+            self.initialized._value = jnp.ones((), jnp.float32)
+        s = jnp.where(self.scale._value > 0, self.scale._value, cur)
+        # the op wrapper records the STE on the autograd tape (x is the only
+        # Tensor arg; s is a raw array, non-differentiable by design)
+        return _fake_quant(xt, scale=s, bits=self.quant_bits)
+
+
+class QuantConfig:
+    """paddle.quantization.QuantConfig parity (subset): default activation /
+    weight quanter factories plus per-layer-type overrides."""
+
+    def __init__(self, activation=None, weight=None):
+        self._activation = activation
+        self._weight = weight
+        self._type_configs: Dict[Type, dict] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]:
+            self._type_configs[t] = {"activation": activation, "weight": weight}
+
+    def _for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg.get("activation") or self._activation, cfg.get("weight") or self._weight
+        return self._activation, self._weight
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) else factory
+
+
+class QuantedWrapper(Layer):
+    """Wraps a Linear/Conv-like layer: fake-quant weight + input activation."""
+
+    def __init__(self, inner: Layer, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        if self.weight_quanter is not None:
+            w = self.inner.weight
+            orig = w._value
+            try:
+                w._value = raw(self.weight_quanter(Tensor(orig)))
+                return self.inner(x)
+            finally:
+                w._value = orig
+        return self.inner(x)
+
+
+def _quantizable(layer: Layer) -> bool:
+    from ..nn import Conv1D, Conv2D, Conv3D, Linear
+
+    return isinstance(layer, (Linear, Conv1D, Conv2D, Conv3D))
+
+
+def _wrap_model(model: Layer, config: QuantConfig, act_factory_default, weight_factory_default):
+    for name, child in list(model.named_children()):
+        if _quantizable(child):
+            act_f, w_f = config._for(child)
+            wrapper = QuantedWrapper(
+                child,
+                _make(act_f if act_f is not None else act_factory_default),
+                _make(w_f if w_f is not None else weight_factory_default),
+            )
+            model.add_sublayer(name, wrapper)
+            setattr(model, name, wrapper)
+        else:
+            _wrap_model(child, config, act_factory_default, weight_factory_default)
+    return model
+
+
+class QAT:
+    """paddle.quantization.QAT parity: wrap quantizable layers with fake
+    quanters; train as usual; the quanters learn scales via EMA."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=True):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _wrap_model(
+            model,
+            self._config,
+            lambda: FakeQuanterWithAbsMaxObserver(),
+            lambda: FakeQuanterWithAbsMaxObserver(),
+        )
+
+    def convert(self, model: Layer, inplace=True):
+        """Freeze: quanters stop updating (eval mode) — scales become fixed."""
+        model.eval()
+        return model
+
+
+class PTQ:
+    """paddle.quantization.PTQ parity: insert observers; run calibration
+    batches through the model; convert() swaps observers for fixed-scale
+    fake-quanters."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self._config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=True):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _wrap_model(
+            model, self._config, lambda: AbsmaxObserver(), lambda: AbsmaxObserver()
+        )
+
+    def convert(self, model: Layer, inplace=True):
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, QuantedWrapper):
+                for attr in ("act_quanter", "weight_quanter"):
+                    q = getattr(sub, attr)
+                    if isinstance(q, AbsmaxObserver):
+                        fq = FakeQuanterWithAbsMaxObserver(quant_bits=q.quant_bits)
+                        fq.scale._value = q.scale()
+                        fq.initialized._value = jnp.ones((), jnp.float32)
+                        fq.eval()
+                        sub.add_sublayer(attr, fq)
+                        setattr(sub, attr, fq)
+        model.eval()
+        return model
+
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "QuantedWrapper",
+    "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver",
+]

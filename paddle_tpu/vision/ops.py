@@ -2,10 +2,11 @@
 
 Reference: ``python/paddle/vision/ops.py`` (nms, roi_align, roi_pool,
 box_coder, yolo_box, deform_conv2d — phi CUDA kernels). TPU-native design:
-every op is expressed in fixed-shape jnp so it traces under jit — NMS is the
-classic data-dependent op; here it is a lax.scan over score-sorted boxes with
-a suppression mask (static shapes, MXU-friendly IoU matrix), returning a
-validity mask alongside indices instead of a dynamic-length result.
+ops are expressed in fixed-shape jnp; NMS computes its suppression mask as a
+lax.scan over score-sorted boxes (static-shape IoU matrix on-device), then
+does a final host-side trim to paddle's variable-length index list — so the
+O(N²) work jits, but the nms() API itself is a host boundary (call it outside
+jit, like the reference's dynamic-shape NMS op).
 """
 from __future__ import annotations
 
@@ -144,7 +145,10 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_rati
     # map each roi to its batch image
     img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn, total_repeat_length=bv.shape[0])
     off = 0.5 if aligned else 0.0
-    ratio = 1 if sampling_ratio <= 0 else sampling_ratio
+    # sampling_ratio<=0: the reference adapts samples-per-bin to each ROI's
+    # size (ceil(roi/out)), which is data-dependent and unjittable; 2x2 is
+    # the standard static choice (detectron2 uses it) and stays close
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
 
     def one_roi(box, img_i):
         feat = xv[img_i]  # [C, H, W]
@@ -207,7 +211,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, axis=0):
-    """paddle.vision.ops.box_coder: encode/decode boxes vs priors."""
+    """paddle.vision.ops.box_coder: encode/decode boxes vs priors. For a 3-D
+    decode target, `axis` selects which target dim the priors broadcast
+    along (0 or 1), matching the reference semantics. Encode here is
+    elementwise (target i vs prior i); the reference's all-pairs [N, M, 4]
+    encode is expressible by pre-broadcasting the inputs."""
     pb, tb = _val(prior_box), _val(target_box)
     pv = _val(prior_box_var) if prior_box_var is not None else jnp.ones(4, pb.dtype)
     norm = 0.0 if box_normalized else 1.0
@@ -215,6 +223,12 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     ph = pb[:, 3] - pb[:, 1] + norm
     pcx = pb[:, 0] + pw * 0.5
     pcy = pb[:, 1] + ph * 0.5
+    if tb.ndim == 3:
+        # priors along target axis `axis`: insert the broadcast dim opposite it
+        exp = (slice(None), None) if axis == 0 else (None, slice(None))
+        pw, ph, pcx, pcy = (t[exp] for t in (pw, ph, pcx, pcy))
+        if pv.ndim == 2:
+            pv = pv[exp + (slice(None),)]
     if code_type == "encode_center_size":
         tw = tb[:, 2] - tb[:, 0] + norm
         th = tb[:, 3] - tb[:, 1] + norm

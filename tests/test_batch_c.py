@@ -1,0 +1,204 @@
+"""Tests for autograd Jacobian/Hessian/jvp/vjp, summary/flops, audio
+features (vs librosa-style formulas / scipy), quantization, fused layers."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, nn, quantization
+from paddle_tpu.autograd import Hessian, Jacobian, jvp, vjp
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+# ---------------------------------------------------------------------------
+# functional autodiff
+# ---------------------------------------------------------------------------
+def test_vjp_jvp():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+
+    def f(t):
+        return t * t
+
+    out, g = vjp(f, x, paddle.to_tensor(np.ones(3, "float32")))
+    np.testing.assert_allclose(_np(out), [1, 4, 9], rtol=1e-6)
+    np.testing.assert_allclose(_np(g), [2, 4, 6], rtol=1e-6)
+    out, jv = jvp(f, x, paddle.to_tensor(np.array([1.0, 0.0, 0.0], "float32")))
+    np.testing.assert_allclose(_np(jv), [2, 0, 0], rtol=1e-6)
+
+
+def test_jacobian_matrix():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+
+    def f(t):
+        import paddle_tpu.tensor as T
+
+        return T.concat([t * t, (t[0] * t[1]).reshape([1])])
+
+    J = Jacobian(f, x)
+    expect = np.array([[2.0, 0.0], [0.0, 4.0], [2.0, 1.0]], "float32")
+    np.testing.assert_allclose(_np(J.matrix), expect, rtol=1e-5)
+    assert J.shape == [3, 2]
+    np.testing.assert_allclose(_np(J[0]), expect[0], rtol=1e-5)
+
+
+def test_batched_jacobian_and_hessian():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3) + 1)
+    J = Jacobian(lambda t: t * t, x, is_batched=True)
+    m = _np(J.matrix)
+    assert m.shape == (2, 3, 3)  # per-sample blocks, no cross-batch columns
+    np.testing.assert_allclose(m[0], np.diag([2.0, 4.0, 6.0]), rtol=1e-5)
+    np.testing.assert_allclose(m[1], np.diag([8.0, 10.0, 12.0]), rtol=1e-5)
+
+    H = Hessian(lambda t: (t * t).sum(), x, is_batched=True)
+    hm = _np(H.matrix)
+    assert hm.shape == (2, 3, 3)
+    np.testing.assert_allclose(hm[0], 2 * np.eye(3), rtol=1e-5)
+
+
+def test_hessian_quadratic():
+    A = np.array([[2.0, 1.0], [1.0, 3.0]], "float32")
+    x = paddle.to_tensor(np.array([0.5, -1.0], "float32"))
+
+    def f(t):
+        import paddle_tpu.tensor as T
+
+        return (t * (paddle.to_tensor(A) @ t)).sum() * 0.5
+
+    H = Hessian(f, x)
+    np.testing.assert_allclose(_np(H.matrix), (A + A.T) / 2 * 1.0, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# summary / flops
+# ---------------------------------------------------------------------------
+def test_summary_and_flops(capsys):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = paddle.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+    assert "Total params" in capsys.readouterr().out
+    n_flops = paddle.flops(net, input_size=(1, 8))
+    # at least the two matmuls: 2*(1*8*16 + 1*16*2)
+    assert n_flops >= 2 * (8 * 16 + 16 * 2)
+
+
+# ---------------------------------------------------------------------------
+# audio
+# ---------------------------------------------------------------------------
+def test_mel_scale_roundtrip_and_fbank():
+    from paddle_tpu.audio import functional as AF
+
+    freqs = np.array([100.0, 440.0, 4000.0], "float32")
+    back = AF.mel_to_hz(AF.hz_to_mel(freqs))
+    np.testing.assert_allclose(np.asarray(back), freqs, rtol=1e-4)
+    fb = _np(AF.compute_fbank_matrix(16000, 512, n_mels=40))
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all() and fb.sum() > 0
+
+
+def test_spectrogram_and_mfcc_shapes():
+    sr, n_fft, hop = 16000, 256, 128
+    wave = paddle.to_tensor(
+        np.sin(2 * np.pi * 440 * np.arange(sr // 4) / sr).astype("float32")[None]
+    )
+    spec = audio.features.Spectrogram(n_fft=n_fft, hop_length=hop)(wave)
+    assert _np(spec).shape[1] == n_fft // 2 + 1
+    # 440 Hz peak lands in the right bin
+    bin_hz = sr / n_fft
+    peak = _np(spec)[0].mean(-1).argmax()
+    assert abs(peak * bin_hz - 440) < bin_hz * 1.5
+    mfcc = audio.features.MFCC(sr=sr, n_mfcc=13, n_fft=n_fft, hop_length=hop, n_mels=40)(wave)
+    assert _np(mfcc).shape[1] == 13
+
+
+def test_window_matches_scipy():
+    import scipy.signal as ss
+
+    from paddle_tpu.audio.functional import get_window
+
+    for w in ("hann", "hamming", "blackman"):
+        np.testing.assert_allclose(
+            _np(get_window(w, 64)), ss.get_window(w, 64), rtol=1e-5, atol=1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+def test_qat_trains_and_quantizes():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    q = quantization.QAT(quantization.QuantConfig())
+    net = q.quantize(net)
+    # quantizable layers got wrapped
+    kinds = [type(s).__name__ for _, s in net.named_sublayers()]
+    assert kinds.count("QuantedWrapper") == 2
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(32, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randn(32, 1).astype("float32"))
+    mse = nn.MSELoss()
+    first_w_before = _np(net.parameters()[0]).copy()
+    losses = []
+    for _ in range(10):
+        loss = mse(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]  # STE lets grads flow
+    # the FIRST layer must train too — catches the fake-quant op detaching
+    # the tape for everything upstream of it
+    assert np.abs(_np(net.parameters()[0]) - first_w_before).max() > 1e-6
+    q.convert(net)
+    out = _np(net(x))
+    assert np.isfinite(out).all()
+
+
+def test_ptq_calibration_and_convert():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 4))
+    ptq = quantization.PTQ()
+    net = ptq.quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 4).astype("float32") * 3)
+    ref = _np(net(x))  # observers pass through unchanged
+    ptq.convert(net)
+    got = _np(net(x))
+    # int8 fake-quant error is small but nonzero
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert 0 < err < 0.1
+
+
+# ---------------------------------------------------------------------------
+# fused layers
+# ---------------------------------------------------------------------------
+def test_fused_transformer_encoder_layer():
+    paddle.seed(0)
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+    layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    layer.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 6, 32).astype("float32"))
+    out = layer(x)
+    assert _np(out).shape == (2, 6, 32)
+    assert np.isfinite(_np(out)).all()
+    # trains: EVERY parameter (incl. qkv fused weight) must receive gradient
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=layer.parameters())
+    layer.train()
+    y = paddle.to_tensor(np.random.RandomState(1).randn(2, 6, 32).astype("float32"))
+    mse = nn.MSELoss()
+    before = [_np(p).copy() for p in layer.parameters()]
+    losses = []
+    for _ in range(5):
+        loss = mse(layer(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]
+    after = [_np(p) for p in layer.parameters()]
+    for b, a in zip(before, after):
+        assert np.abs(a - b).max() > 0, "a parameter received no gradient"
