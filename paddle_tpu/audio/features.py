@@ -39,6 +39,8 @@ class Spectrogram(Layer):
         self.register_buffer("window", F.get_window(window, self.win_length, dtype=dtype))
 
     def forward(self, x):
+        from ..tensor import abs as t_abs
+
         spec = stft(
             x,
             self.n_fft,
@@ -48,10 +50,10 @@ class Spectrogram(Layer):
             center=self.center,
             pad_mode=self.pad_mode,
         )
-        mag = jnp.abs(spec._value)
+        mag = t_abs(spec)
         if self.power != 1.0:
             mag = mag**self.power
-        return Tensor(mag)
+        return mag
 
 
 class MelSpectrogram(Layer):
@@ -80,9 +82,10 @@ class MelSpectrogram(Layer):
         )
 
     def forward(self, x):
-        spec = self.spectrogram(x)._value  # [..., freq, time]
-        mel = jnp.einsum("mf,...ft->...mt", self.fbank._value, spec)
-        return Tensor(mel)
+        from ..tensor import einsum
+
+        spec = self.spectrogram(x)  # [..., freq, time]
+        return einsum("mf,...ft->...mt", self.fbank, spec)
 
 
 class LogMelSpectrogram(Layer):
@@ -117,6 +120,7 @@ class MFCC(Layer):
         self.register_buffer("dct", F.create_dct(n_mfcc, n_mels, dtype=dtype))
 
     def forward(self, x):
-        logmel = self.log_mel(x)._value  # [..., mel, time]
-        out = jnp.einsum("mk,...mt->...kt", self.dct._value, logmel)
-        return Tensor(out)
+        from ..tensor import einsum
+
+        logmel = self.log_mel(x)  # [..., mel, time]
+        return einsum("mk,...mt->...kt", self.dct, logmel)

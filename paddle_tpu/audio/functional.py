@@ -91,13 +91,18 @@ def compute_fbank_matrix(
     return Tensor(weights.astype(dtype))
 
 
+from ..framework.op import defop as _defop
+
+
+@_defop(name="power_to_db_op")
 def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10, top_db: Optional[float] = 80.0):
-    s = _val(spect)
-    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    """Registered as a framework op so gradients flow through log-mel
+    pipelines (the tape records the vjp)."""
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
     log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
     if top_db is not None:
         log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
-    return Tensor(log_spec)
+    return log_spec
 
 
 def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho", dtype="float32"):

@@ -262,24 +262,33 @@ class Jacobian:
             self._mat = jac.reshape(b, out_sz, in_sz)
             self._in_ndim = None
             return
-        self._in_ndim = vals[0].ndim
-        jac = jax.jacrev(_fn_on_vals(func))(*vals)
-        self._mat = jac[0] if isinstance(jac, tuple) else jac
+        # full Jacobian over ALL inputs and ALL outputs, assembled as the
+        # block matrix [sum(out_sizes), sum(in_sizes)] (paddle semantics)
+        import numpy as _np
+
+        f = _fn_on_vals(func)
+        jac = jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals)
+        probe = jax.eval_shape(f, *vals)
+        multi_out = isinstance(probe, tuple)
+        out_blocks = jac if multi_out else (jac,)  # per-output tuples over inputs
+        out_shapes = [tuple(p.shape) for p in (probe if multi_out else (probe,))]
+        in_sizes = [int(_np.prod(v.shape or (1,))) for v in vals]
+        rows = []
+        for o_i, blocks in enumerate(out_blocks):
+            blocks = blocks if isinstance(blocks, tuple) else (blocks,)
+            out_sz = int(_np.prod(out_shapes[o_i] or (1,)))
+            rows.append(
+                jnp.concatenate(
+                    [b.reshape(out_sz, in_sizes[i]) for i, b in enumerate(blocks)],
+                    axis=1,
+                )
+            )
+        self._mat = jnp.concatenate(rows, axis=0)
 
     @property
     def matrix(self) -> Tensor:
         """[out_size, in_size]; batched: [B, out_size_per_sample, in_size_per_sample]."""
-        m = self._mat
-        if self._is_batched:
-            return Tensor(m)
-        out_dims = m.ndim - self._in_ndim
-        out_sz = 1
-        for d in m.shape[:out_dims]:
-            out_sz *= d
-        in_sz = 1
-        for d in m.shape[out_dims:]:
-            in_sz *= d
-        return Tensor(m.reshape(out_sz, in_sz))
+        return Tensor(self._mat)
 
     def __getitem__(self, idx):
         return Tensor(self.matrix._value[idx])

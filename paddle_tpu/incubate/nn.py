@@ -45,7 +45,9 @@ class FusedMultiHeadAttention(Layer):
     ):
         super().__init__()
         if embed_dim % num_heads:
-            raise ValueError("embed_dim must divide num_heads")
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim ({embed_dim})"
+            )
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads

@@ -3,8 +3,10 @@
 Reference: ``python/paddle/signal.py`` (stft/istft with torch-style
 conventions: center padding, per-frame window, onesided rfft; frame and
 overlap_add helpers). Implemented directly as frame→window→rfft so the whole
-transform is one fused XLA program (gather + batched FFT), rather than
-wrapping scipy conventions.
+transform is one fused XLA program (gather + batched FFT). Every public
+function is a registered framework op (defop), so the eager autograd tape
+records it — gradients flow through spectrogram pipelines (vocoder losses,
+adversarial audio, trainable frontends).
 """
 from __future__ import annotations
 
@@ -12,41 +14,55 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from .framework.core import Tensor
+from .framework.op import defop
 
 
-def _val(x):
-    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
-
-
-def frame(x, frame_length: int, hop_length: int, axis=-1, name=None):
-    """Split into overlapping frames along the last axis → [..., frame_length, n_frames]."""
-    xv = _val(x)
-    if axis not in (-1, xv.ndim - 1):
-        raise NotImplementedError("frame: axis=-1 only")
+def _frame_val(xv, frame_length: int, hop_length: int):
     n = xv.shape[-1]
     n_frames = 1 + (n - frame_length) // hop_length
     starts = jnp.arange(n_frames) * hop_length
     idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [F, L]
     frames = xv[..., idx]  # [..., F, L]
-    return Tensor(jnp.moveaxis(frames, -2, -1))  # [..., L, F]
+    return jnp.moveaxis(frames, -2, -1)  # [..., L, F]
 
 
-def overlap_add(x, hop_length: int, axis=-1, name=None):
-    """Inverse of frame: [..., frame_length, n_frames] → [..., output_len]."""
-    xv = _val(x)
-    if axis not in (-1, xv.ndim - 1):
-        raise NotImplementedError("overlap_add: axis=-1 only")
+def _overlap_add_val(xv, hop_length: int):
     frame_length, n_frames = xv.shape[-2], xv.shape[-1]
     out_len = (n_frames - 1) * hop_length + frame_length
     out = jnp.zeros(xv.shape[:-2] + (out_len,), xv.dtype)
     starts = jnp.arange(n_frames) * hop_length
     idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [F, L]
-    # scatter-add every frame at its offset
-    out = out.at[..., idx].add(jnp.moveaxis(xv, -1, -2))
-    return Tensor(out)
+    return out.at[..., idx].add(jnp.moveaxis(xv, -1, -2))
 
 
+@defop(name="frame_op")
+def frame(x, frame_length: int, hop_length: int, axis=-1, name=None):
+    """Split into overlapping frames along the last axis → [..., frame_length, n_frames]."""
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame: axis=-1 only")
+    return _frame_val(x, frame_length, hop_length)
+
+
+@defop(name="overlap_add_op")
+def overlap_add(x, hop_length: int, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, n_frames] → [..., output_len]."""
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add: axis=-1 only")
+    return _overlap_add_val(x, hop_length)
+
+
+def _window_to_nfft(window, n_fft, win_length, dtype):
+    if window is None:
+        win = jnp.ones(win_length, dtype)
+    else:
+        win = window.astype(dtype)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    return win
+
+
+@defop(name="stft_op")
 def stft(
     x,
     n_fft: int,
@@ -60,20 +76,13 @@ def stft(
     name=None,
 ):
     """→ complex [..., n_fft//2+1 (or n_fft), n_frames], torch/paddle layout."""
-    xv = _val(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    if window is None:
-        win = jnp.ones(win_length, xv.dtype)
-    else:
-        win = _val(window).astype(xv.dtype)
-    if win_length < n_fft:  # center-pad window to n_fft
-        lp = (n_fft - win_length) // 2
-        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    win = _window_to_nfft(window, n_fft, win_length, x.dtype)
     if center:
-        pad = [(0, 0)] * (xv.ndim - 1) + [(n_fft // 2, n_fft // 2)]
-        xv = jnp.pad(xv, pad, mode=pad_mode)
-    framed = _val(frame(Tensor(xv), n_fft, hop_length))  # [..., n_fft, F]
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    framed = _frame_val(x, n_fft, hop_length)  # [..., n_fft, F]
     framed = framed * win[:, None]
     spec = (
         jnp.fft.rfft(framed, axis=-2)
@@ -82,9 +91,10 @@ def stft(
     )
     if normalized:
         spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
-    return Tensor(spec)
+    return spec
 
 
+@defop(name="istft_op")
 def istft(
     x,
     n_fft: int,
@@ -98,34 +108,27 @@ def istft(
     return_complex: bool = False,
     name=None,
 ):
-    xv = _val(x)  # [..., freq, F]
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
     if normalized:
-        xv = xv * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
     frames = (
-        jnp.fft.irfft(xv, n=n_fft, axis=-2)
+        jnp.fft.irfft(x, n=n_fft, axis=-2)
         if onesided
-        else jnp.fft.ifft(xv, axis=-2).real
+        else jnp.fft.ifft(x, axis=-2).real
     )  # [..., n_fft, F]
-    if window is None:
-        win = jnp.ones(win_length, frames.dtype)
-    else:
-        win = _val(window).astype(frames.dtype)
-    if win_length < n_fft:
-        lp = (n_fft - win_length) // 2
-        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    win = _window_to_nfft(window, n_fft, win_length, frames.dtype)
     frames = frames * win[:, None]
-    y = _val(overlap_add(Tensor(frames), hop_length))
+    y = _overlap_add_val(frames, hop_length)
     # window-envelope normalization (COLA correction)
     wsq = jnp.broadcast_to((win**2)[:, None], (n_fft, frames.shape[-1]))
-    env = _val(overlap_add(Tensor(wsq), hop_length))
+    env = _overlap_add_val(wsq, hop_length)
     y = y / jnp.where(env > 1e-11, env, 1.0)
     if center:
         y = y[..., n_fft // 2 : y.shape[-1] - n_fft // 2]
     if length is not None:
         y = y[..., :length]
-    return Tensor(y)
+    return y
 
 
 __all__ = ["frame", "overlap_add", "stft", "istft"]

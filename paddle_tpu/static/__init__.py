@@ -17,6 +17,9 @@ from ..framework.core import Tensor
 from ..framework.op import raw
 from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
 
+# paddle.static.nn (imported lazily at the bottom to avoid a cycle with
+# paddle_tpu.nn, which imports framework pieces this module also uses)
+
 
 class Program:
     """A recorded computation: ops are captured by running the build function
@@ -185,3 +188,6 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
     layer = _jit.load(path_prefix)
     return layer, layer.input_names, None
+
+
+from . import nn  # noqa: E402,F401  (paddle.static.nn)

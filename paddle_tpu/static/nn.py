@@ -1,0 +1,130 @@
+"""paddle.static.nn parity shims (fc / conv2d / batch_norm / embedding ...).
+
+Reference: ``python/paddle/static/nn/common.py`` — program-building ops that
+create parameters in the Program's scope. TPU-native: our "static graph" is
+the jit trace (see paddle_tpu.static), so these are functional wrappers that
+create the corresponding nn Layer ONCE per (name) and reuse it across calls
+— the parameter-reuse semantics of a static Program without a ProgramDesc.
+Layers are registered on the default Program so they survive across steps.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn as _nn
+from . import default_main_program
+
+
+def _layer_cache():
+    prog = default_main_program()
+    if not hasattr(prog, "_static_nn_layers"):
+        prog._static_nn_layers = {}
+    return prog._static_nn_layers
+
+
+def _get(name, factory):
+    cache = _layer_cache()
+    if name not in cache:
+        cache[name] = factory()
+    return cache[name]
+
+
+def _auto(prefix, name):
+    """Layer identity for unnamed calls: keyed by the CALLER'S code location,
+    so the same static.nn call re-executed each step (our Executor re-runs
+    the build function eagerly) reuses its parameters — the positional
+    parameter identity a static Program gives for free."""
+    if name:
+        return name
+    import sys
+
+    f = sys._getframe(2)  # the user's call site (past _auto and the op fn)
+    return f"{prefix}@{f.f_code.co_filename}:{f.f_lineno}"
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
+    in_f = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_f *= d
+    key = _auto("fc", name)
+    layer = _get(key, lambda: _nn.Linear(in_f, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        h = x.reshape(list(x.shape[:num_flatten_dims]) + [in_f])
+    out = layer(h)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None, act=None, name=None, data_format="NCHW"):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    key = _auto("conv2d", name)
+    layer = _get(key, lambda: _nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                                         padding=padding, dilation=dilation, groups=groups,
+                                         weight_attr=param_attr, bias_attr=bias_attr,
+                                         data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None, stride=1, padding=0, groups=1, param_attr=None, bias_attr=None, act=None, name=None, data_format="NCHW"):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    key = _auto("conv2d_transpose", name)
+    layer = _get(key, lambda: _nn.Conv2DTranspose(in_ch, num_filters, filter_size, stride=stride,
+                                                  padding=padding, groups=groups,
+                                                  weight_attr=param_attr, bias_attr=bias_attr,
+                                                  data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None, data_layout="NCHW", is_test=False, name=None, **kwargs):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    key = _auto("batch_norm", name)
+    layer = _get(key, lambda: _nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                                              weight_attr=param_attr, bias_attr=bias_attr))
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5, param_attr=None, bias_attr=None, act=None, name=None):
+    shape = list(input.shape[begin_norm_axis:])
+    key = _auto("layer_norm", name)
+    layer = _get(key, lambda: _nn.LayerNorm(shape, epsilon=epsilon,
+                                            weight_attr=param_attr if scale else False,
+                                            bias_attr=bias_attr if shift else False))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None, dtype="float32", name=None):
+    key = _auto("embedding", name)
+    layer = _get(key, lambda: _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                                            weight_attr=param_attr))
+    return layer(input)
+
+
+def static_parameters(program=None):
+    """All parameters created by static.nn calls on `program` (default main)."""
+    prog = program or default_main_program()
+    params = []
+    for layer in getattr(prog, "_static_nn_layers", {}).values():
+        params.extend(layer.parameters())
+    return params
+
+
+__all__ = [
+    "fc", "conv2d", "conv2d_transpose", "batch_norm", "layer_norm",
+    "embedding", "static_parameters",
+]

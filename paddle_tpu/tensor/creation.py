@@ -194,3 +194,12 @@ def one_hot(x, num_classes, name=None):
 
 def complex(real, imag, name=None):
     return Tensor(jnp.asarray(raw(real)) + 1j * jnp.asarray(raw(imag)))
+
+
+@defop(name="vander_op")
+def _vander(x, n, increasing):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _vander(x, n=n, increasing=bool(increasing))

@@ -672,3 +672,72 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     lo = shard_id * shard_size
     in_shard = (input >= lo) & (input < lo + shard_size)
     return jnp.where(in_shard, input - lo, ignore_value)
+
+
+@defop(name="take_op")
+def _take(x, index, mode):
+    flat = x.reshape(-1)
+    idx = index.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    return flat[idx].reshape(index.shape)
+
+
+def take(x, index, mode="raise", name=None):
+    """paddle.take: flat-index gather with wrap/clip OOB modes ('raise'
+    checks host-side when values are concrete)."""
+    if mode == "raise":
+        import numpy as _np
+
+        iv = raw(index)
+        if not is_tracer_value(iv):
+            n = int(_np.prod(raw(x).shape))
+            if (_np.asarray(iv) >= n).any() or (_np.asarray(iv) < -n).any():
+                raise IndexError("take: index out of range")
+        mode = "wrap"  # negative indices behave pythonically
+    return _take(x, index, mode=mode)
+
+
+@defop(name="index_fill_op")
+def _index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    return _index_fill(x, index, axis=int(axis), value=float(raw(value)) if not hasattr(raw(value), "ndim") else raw(value))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x._value = out._value
+    return x
+
+
+@defop(name="unfold_op")
+def _unfold(x, axis, size, step):
+    n = x.shape[axis]
+    starts = jnp.arange(0, n - size + 1, step)
+    windows = [jnp.take(x, starts + i, axis=axis) for i in range(size)]
+    return jnp.stack(windows, axis=-1)
+
+
+def unfold(x, axis, size, step, name=None):
+    """paddle.unfold (Tensor.unfold): sliding windows along axis appended as
+    a trailing dim."""
+    return _unfold(x, axis=int(axis), size=int(size), step=int(step))
+
+
+@defop(name="tensordot_op")
+def _tensordot(x, y, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return _tensordot(x, y, axes=axes)

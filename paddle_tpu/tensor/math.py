@@ -644,3 +644,34 @@ def broadcast_shape_helper(x, y):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@defop
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@defop
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@defop(name="renorm_op")
+def _renorm(x, p, axis, max_norm):
+    # p-norm over all dims except `axis`; rows exceeding max_norm are scaled
+    dims = tuple(d for d in range(x.ndim) if d != axis)
+    norms = (jnp.abs(x) ** p).sum(dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-12), 1.0)
+    return x * factor
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _renorm(x, p=float(p), axis=int(axis), max_norm=float(max_norm))
+
+
+@defop
+def frexp(x, name=None):
+    m, e = jnp.frexp(x)
+    return m, e

@@ -4,5 +4,8 @@ Reference: `python/paddle/text/` (datasets) and the PaddleNLP model zoo the
 BASELINE workloads are drawn from (SURVEY.md §6): BERT-base MLM, ERNIE-3.0
 fine-tune, GPT-3 pretraining configs.
 """
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from .models import *  # noqa: F401,F403
+from .datasets import Imdb, UCIHousing  # noqa: F401
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

@@ -1,0 +1,79 @@
+"""paddle.text.viterbi_decode / ViterbiDecoder parity.
+
+Reference: ``python/paddle/text/viterbi_decode.py`` (phi viterbi_decode
+kernel). TPU-native: the DP recursion is a lax.scan over time — one compiled
+program, batch-parallel on the MXU (the [B, N, N] score broadcast is a
+batched matrix of adds, not a Python loop).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+
+
+@defop(name="viterbi_decode_op")
+def _viterbi(potentials, transition, lengths, include_bos_eos_tag):
+    """potentials [B, T, N]; transition [N, N]; lengths [B] → (scores [B],
+    paths [B, T])."""
+    B, T, N = potentials.shape
+    if include_bos_eos_tag:
+        # reference convention: last two tags are BOS(start)/EOS(stop); the
+        # BOS transition row scores starting in each tag
+        bos = N - 2
+        alpha0 = potentials[:, 0] + transition[bos][None, :]
+    else:
+        alpha0 = potentials[:, 0]
+
+    def step(alpha, t):
+        # score[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, t, j]
+        scores = alpha[:, :, None] + transition[None, :, :]
+        best_prev = scores.argmax(axis=1)  # [B, N]
+        best_score = scores.max(axis=1) + potentials[:, t]
+        # positions past a sequence's length keep their alpha (masked)
+        active = (t < lengths)[:, None]
+        alpha_new = jnp.where(active, best_score, alpha)
+        back = jnp.where(active, best_prev, jnp.arange(N)[None, :])
+        return alpha_new, back
+
+    alpha, backs = lax.scan(step, alpha0, jnp.arange(1, T))  # backs: [T-1, B, N]
+
+    if include_bos_eos_tag:
+        alpha = alpha + transition[:, N - 1][None, :]
+
+    last_tag = alpha.argmax(axis=-1)  # [B]
+    scores = alpha.max(axis=-1)
+
+    def backtrack(carry, back_t):
+        # carry = tag at time t+1; back_t[b, j] = best tag at t given j at t+1
+        prev = jnp.take_along_axis(back_t, carry[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = lax.scan(backtrack, last_tag, backs, reverse=True)
+    paths = jnp.concatenate(
+        [path_rev, last_tag[None, :]], axis=0
+    ).T  # [B, T]
+    return scores, paths.astype(jnp.int32)
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):
+    scores, paths = _viterbi(
+        potentials, transition_params, lengths,
+        include_bos_eos_tag=bool(include_bos_eos_tag),
+    )
+    return scores, paths
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder parity (callable layer-like)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths, self.include_bos_eos_tag
+        )

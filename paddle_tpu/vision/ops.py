@@ -224,8 +224,9 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     pcx = pb[:, 0] + pw * 0.5
     pcy = pb[:, 1] + ph * 0.5
     if tb.ndim == 3:
-        # priors along target axis `axis`: insert the broadcast dim opposite it
-        exp = (slice(None), None) if axis == 0 else (None, slice(None))
+        # paddle semantics: `axis` is the target dim the priors BROADCAST
+        # along (axis=0: target [N, M, 4] with priors [M, 4] aligned to dim 1)
+        exp = (None, slice(None)) if axis == 0 else (slice(None), None)
         pw, ph, pcx, pcy = (t[exp] for t in (pw, ph, pcx, pcy))
         if pv.ndim == 2:
             pv = pv[exp + (slice(None),)]

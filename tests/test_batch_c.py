@@ -114,6 +114,36 @@ def test_spectrogram_and_mfcc_shapes():
     assert _np(mfcc).shape[1] == 13
 
 
+def test_audio_features_gradient_flows_to_input():
+    # adversarial-audio / vocoder-loss use case: d(mel)/d(wave) must exist
+    wave = paddle.to_tensor(
+        np.sin(np.linspace(0, 20, 512)).astype("float32")[None], stop_gradient=False
+    )
+    mel = audio.features.LogMelSpectrogram(sr=8000, n_fft=128, hop_length=64, n_mels=16, f_min=20.0)(wave)
+    loss = (mel * mel).mean()
+    loss.backward()
+    g = wave.grad
+    assert g is not None and np.abs(_np(g)).max() > 0
+
+
+def test_jacobian_multi_output_and_multi_input():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    y = paddle.to_tensor(np.array([3.0], "float32"))
+
+    # two outputs: rows stack [d(2a); d(3a)]
+    J = Jacobian(lambda a: (a * 2, a * 3), x)
+    np.testing.assert_allclose(
+        _np(J.matrix),
+        np.vstack([2 * np.eye(2), 3 * np.eye(2)]).astype("float32"),
+        rtol=1e-6,
+    )
+    # two inputs: cols concat [d/da, d/db] of a*b0
+    J2 = Jacobian(lambda a, b: a * b[0], [x, y])
+    np.testing.assert_allclose(
+        _np(J2.matrix), np.array([[3, 0, 1], [0, 3, 2]], "float32"), rtol=1e-6
+    )
+
+
 def test_window_matches_scipy():
     import scipy.signal as ss
 

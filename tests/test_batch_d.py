@@ -1,0 +1,177 @@
+"""Batch D: new tensor ops vs numpy, viterbi decode vs brute force,
+text datasets, static.nn parameter reuse."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.tensor as T
+from paddle_tpu import nn, static, text
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_new_tensor_ops_match_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 6).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(_np(T.trapezoid(t)), np.trapezoid(x, axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(T.nanmedian(t, axis=1)), np.nanmedian(x, axis=1), rtol=1e-6
+    )
+    v = np.array([1.0, 2.0, 3.0], "float32")
+    np.testing.assert_allclose(_np(T.vander(paddle.to_tensor(v))), np.vander(v), rtol=1e-5)
+    m, e = T.frexp(t)
+    np.testing.assert_allclose(_np(m) * 2.0 ** _np(e), x, rtol=1e-6)
+    np.testing.assert_allclose(
+        _np(T.tensordot(t, paddle.to_tensor(x), axes=2)),
+        np.tensordot(x, x, axes=2), rtol=1e-4,
+    )
+
+
+def test_take_and_index_fill_and_unfold():
+    x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    idx = paddle.to_tensor(np.array([0, 5, -1]))
+    np.testing.assert_allclose(_np(T.take(x, idx)), [0, 5, 11])
+    np.testing.assert_allclose(
+        _np(T.take(x, paddle.to_tensor(np.array([13])), mode="wrap")), [1]
+    )
+    np.testing.assert_allclose(
+        _np(T.take(x, paddle.to_tensor(np.array([20])), mode="clip")), [11]
+    )
+    with pytest.raises(IndexError):
+        T.take(x, paddle.to_tensor(np.array([12])))
+
+    filled = T.index_fill(x, paddle.to_tensor(np.array([0, 2])), 0, -1.0)
+    assert (_np(filled)[[0, 2]] == -1).all() and (_np(filled)[1] == [4, 5, 6, 7]).all()
+
+    u = T.unfold(paddle.to_tensor(np.arange(6, dtype="float32")), 0, 3, 2)
+    np.testing.assert_allclose(_np(u), [[0, 1, 2], [2, 3, 4]])
+
+
+def test_renorm():
+    x = np.array([[3.0, 4.0], [0.3, 0.4]], "float32")  # row norms 5, 0.5
+    out = _np(T.renorm(paddle.to_tensor(x), p=2.0, axis=0, max_norm=1.0))
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), [1.0, 0.5], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# viterbi
+# ---------------------------------------------------------------------------
+def _viterbi_ref(emit, trans, length):
+    T_, N = emit.shape
+    dp = emit[0].copy()
+    back = np.zeros((T_, N), int)
+    for t in range(1, length):
+        scores = dp[:, None] + trans
+        back[t] = scores.argmax(0)
+        dp = scores.max(0) + emit[t]
+    tag = int(dp.argmax())
+    path = [tag]
+    for t in range(length - 1, 0, -1):
+        tag = int(back[t][tag])
+        path.append(tag)
+    return float(dp.max()), path[::-1]
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rs = np.random.RandomState(0)
+    B, T_, N = 3, 7, 5
+    emit = rs.randn(B, T_, N).astype("float32")
+    trans = rs.randn(N, N).astype("float32")
+    lengths = np.array([7, 7, 7], "int32")
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=False,
+    )
+    for b in range(B):
+        ref_score, ref_path = _viterbi_ref(emit[b], trans, 7)
+        np.testing.assert_allclose(float(_np(scores)[b]), ref_score, rtol=1e-4)
+        np.testing.assert_array_equal(_np(paths)[b], ref_path)
+
+
+def test_viterbi_variable_lengths():
+    rs = np.random.RandomState(2)
+    emit = rs.randn(2, 6, 4).astype("float32")
+    trans = rs.randn(4, 4).astype("float32")
+    lengths = np.array([6, 4], "int32")
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=False,
+    )
+    for b, L in enumerate(lengths):
+        ref_score, ref_path = _viterbi_ref(emit[b], trans, int(L))
+        np.testing.assert_allclose(float(_np(scores)[b]), ref_score, rtol=1e-4)
+        np.testing.assert_array_equal(_np(paths)[b][:L], ref_path)
+
+
+def test_viterbi_decoder_class():
+    rs = np.random.RandomState(1)
+    emit = rs.randn(2, 5, 4).astype("float32")
+    trans = rs.randn(4, 4).astype("float32")
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(emit), paddle.to_tensor(np.array([5, 5], "int32")))
+    assert _np(paths).shape == (2, 5)
+
+
+# ---------------------------------------------------------------------------
+# text datasets
+# ---------------------------------------------------------------------------
+def test_uci_housing_synthetic_trains():
+    ds = text.UCIHousing(mode="train")
+    assert len(ds) > 300
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    loader = paddle.io.DataLoader(ds, batch_size=64, shuffle=True)
+    net = nn.Linear(13, 1)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    mse = nn.MSELoss()
+    losses = []
+    for epoch in range(3):
+        for xb, yb in loader:
+            loss = mse(net(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_imdb_synthetic():
+    ds = text.Imdb(mode="train")
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert len(ds) == 2000
+
+
+def test_gated_datasets_raise():
+    with pytest.raises(RuntimeError, match="no network egress"):
+        text.datasets.Movielens()
+
+
+# ---------------------------------------------------------------------------
+# static.nn
+# ---------------------------------------------------------------------------
+def test_static_nn_fc_param_reuse():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        out1 = static.nn.fc(x, 4, name="fc1")
+        out2 = static.nn.fc(x, 4, name="fc1")  # same name -> same params
+        np.testing.assert_allclose(_np(out1), _np(out2))
+        params = static.nn.static_parameters(prog)
+        assert len(params) == 2  # one weight + one bias
+
+
+def test_static_nn_conv_bn():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32"))
+        h = static.nn.conv2d(x, 4, 3, padding=1, act="relu", name="c1")
+        h = static.nn.batch_norm(h, name="bn1")
+        assert list(_np(h).shape) == [2, 4, 8, 8]
+        emb = static.nn.embedding(
+            paddle.to_tensor(np.array([[1, 2]])), size=[10, 6], name="emb"
+        )
+        assert list(_np(emb).shape) == [1, 2, 6]

@@ -146,6 +146,22 @@ def test_box_coder_roundtrip():
     np.testing.assert_allclose(_np(dec), targets, rtol=1e-4, atol=1e-4)
 
 
+def test_box_coder_3d_decode_axis():
+    rs = np.random.RandomState(0)
+    M, N = 5, 3
+    priors = np.abs(rs.randn(M, 4)).astype("float32")
+    priors[:, 2:] += priors[:, :2] + 1
+    deltas = (rs.randn(N, M, 4) * 0.1).astype("float32")
+    # axis=0: priors align with target dim 1, broadcast over dim 0
+    out = _np(ops.box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(deltas),
+                            code_type="decode_center_size", axis=0))
+    assert out.shape == (N, M, 4)
+    # each slice along dim 0 decodes against the same priors
+    ref0 = _np(ops.box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(deltas[0]),
+                             code_type="decode_center_size"))
+    np.testing.assert_allclose(out[0], ref0, rtol=1e-5)
+
+
 def test_yolo_box_shapes():
     n, na, c, h, w = 1, 3, 4, 5, 5
     x = np.random.RandomState(0).randn(n, na * (5 + c), h, w).astype("float32")
