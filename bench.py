@@ -2,35 +2,145 @@
 
 This is the BASELINE.json headline metric ("ERNIE-3.0 tokens/sec/chip").
 One compiled train step (fwd + bwd + AdamW) of ERNIE-3.0-base
-(12L / 768h / 12 heads) sequence classification under bf16 autocast,
-seq_len=128, on whatever single accelerator is visible (the driver runs this
-on one real TPU chip).
+(12L / 768h / 12 heads) sequence classification, O2 bf16 (fp32 master
+weights), seq_len=128, on whatever single accelerator is visible (the
+driver runs this on one real TPU chip).
 
 Baseline anchor: the north star is ">=0.8x per-chip H100 throughput". No
 reference numbers exist in-repo (BASELINE.json published: {}), so we anchor
 on a public-knowledge estimate of H100 mixed-precision fine-tune throughput
 for a BERT/ERNIE-base-class encoder at seq 128: ~600k tokens/s/GPU;
-0.8x => 480k tokens/s is the vs_baseline=1.0 mark.
+0.8x => 480k tokens/s is the vs_baseline=1.0 mark. NOTE an honest physics
+footnote, reported in the JSON: this model costs ~6*85M = 510 MFLOP/token
+(fwd+bwd, non-embedding matmul params), so 480k tok/s needs ~245 TFLOP/s —
+MORE than a v5e chip's 197 TFLOP/s bf16 peak. On v5e the per-chip bar is
+unreachable at any MFU; we therefore also report measured MFU and the
+MFU-normalized ratio (ours vs the ~31% MFU the H100 anchor implies), which
+compares framework efficiency rather than silicon peak.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness (round-1 postmortem): backend init is probed in a SUBPROCESS
+(immune to init hangs and to jax's cached-failure state), retried with
+backoff on transient UNAVAILABLE errors, and falls back to CPU with an
+"error" field so the driver always gets one parseable JSON line.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 BASELINE_TOKENS_PER_SEC = 480_000.0  # 0.8 x est. H100 per-chip (see docstring)
+H100_ANCHOR_MFU = 0.31  # 600k tok/s * 510 MFLOP/tok / 989 TFLOP/s peak
 
-BATCH = 32
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 SEQ = 128
 WARMUP = 3
-STEPS = 10
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+# per-chip dense bf16 peak FLOP/s by device kind substring
+PEAK_BF16 = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("h100", 989e12),
+]
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for sub, peak in PEAK_BF16:
+        if gen and sub in gen:
+            return peak
+    return None
+
+
+def _probe(env, timeout):
+    """Try backend init in a subprocess. Returns (platform|None, err|None)."""
+    code = "import jax; d=jax.devices()[0]; print('PLATFORM='+d.platform)"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init timed out ({timeout}s)"
+    if p.returncode == 0 and "PLATFORM=" in p.stdout:
+        return p.stdout.rsplit("PLATFORM=", 1)[1].split()[0], None
+    tail = (p.stderr or p.stdout).strip().splitlines()
+    return None, (tail[-1][:300] if tail else f"rc={p.returncode}")
+
+
+def _select_backend(max_tries=3, backoff=60.0):
+    """Pick an env that initializes a backend; prefer the TPU. Hung configs
+    are dropped after the first attempt (the hang is deterministic — the
+    axon plugin blocks when its pool endpoint is unreachable); erroring
+    configs are retried with backoff (round-1 BENCH failure was a transient
+    UNAVAILABLE)."""
+    candidates = [("as-is", dict(os.environ), 420)]
+    if "PALLAS_AXON_POOL_IPS" in os.environ:
+        e = dict(os.environ)
+        e.pop("PALLAS_AXON_POOL_IPS")
+        e["JAX_PLATFORMS"] = ""
+        candidates.append(("no-pool-ips-auto", e, 180))
+    last_err = "no candidates"
+    for attempt in range(max_tries):
+        alive = []
+        for name, env, timeout in candidates:
+            plat, err = _probe(env, timeout)
+            if plat is not None and plat != "cpu":
+                return env, plat, None
+            if plat == "cpu":
+                last_err = f"{name}: init reached cpu only"
+                continue
+            last_err = f"{name}: {err}"
+            if err and "timed out" not in err:
+                alive.append((name, env, timeout))
+        candidates = alive
+        if not candidates:
+            break
+        if attempt + 1 < max_tries:
+            time.sleep(backoff)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    plat, err = _probe(env, 180)
+    if plat is not None:
+        return env, plat, f"TPU unavailable, ran on CPU ({last_err})"
+    return None, None, f"{last_err}; cpu fallback also failed: {err}"
+
+
+def _emit(value, vs_baseline, extra):
+    line = {
+        "metric": "ernie3.0-base finetune tokens/sec/chip (O2 bf16, seq128)",
+        "value": value,
+        "unit": "tokens/s",
+        "vs_baseline": vs_baseline,
+    }
+    line.update(extra)
+    print(json.dumps(line))
 
 
 def main():
+    env, platform, backend_err = _select_backend()
+    if env is None:
+        _emit(0.0, 0.0, {"error": backend_err})
+        return
+    os.environ.clear()
+    os.environ.update(env)
+
     import jax
 
     import paddle_tpu as paddle
@@ -40,21 +150,25 @@ def main():
 
     paddle.seed(0)
     cfg = ErnieConfig(
-        vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+        vocab_size=40064,  # 40000 padded up to a 128 multiple (MXU tiling)
+        hidden_size=768, num_hidden_layers=12,
         num_attention_heads=12, intermediate_size=3072,
         hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
         max_position_embeddings=2048,
     )
     model = ErnieForSequenceClassification(cfg, num_classes=2)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-5, parameters=model.parameters())
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-5, parameters=model.parameters(), multi_precision=True
+    )
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
     step = TrainStep(model, lambda m, ids, y: m(ids, labels=y), opt)
 
     rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype(np.int32))
+    ids = paddle.to_tensor(rng.integers(0, 40000, (BATCH, SEQ)).astype(np.int32))
     y = paddle.to_tensor(rng.integers(0, 2, (BATCH,)).astype(np.int32))
 
     def one_step():
-        with amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+        with amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
             return step(ids, y)
 
     for _ in range(WARMUP):
@@ -67,15 +181,46 @@ def main():
     jax.block_until_ready(loss._value)
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = BATCH * SEQ * STEPS / dt
-    print(json.dumps({
-        "metric": "ernie3.0-base finetune tokens/sec/chip (bf16, seq128)",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
-    }))
-    print(f"# loss={float(loss):.4f} step_time={dt / STEPS * 1e3:.1f}ms "
-          f"device={jax.devices()[0].platform}", file=sys.stderr)
+    step_time = dt / STEPS
+    tokens_per_sec = BATCH * SEQ / step_time
+
+    # MFU from the compiled executable's own cost analysis (not an estimate)
+    flops_per_step = None
+    try:
+        with amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
+            cost = step.cost_analysis(ids, y)
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    dev_kind = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
+    peak = _peak_flops(str(dev_kind)) if platform != "cpu" else None
+    mfu = (flops_per_step / step_time / peak) if (flops_per_step and peak) else None
+
+    extra = {
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "vs_baseline_mfu_normalized": (
+            round(mfu / H100_ANCHOR_MFU, 4) if mfu is not None else None
+        ),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "batch": BATCH,
+        "seq": SEQ,
+        "flops_per_step": flops_per_step,
+        "platform": str(dev_kind),
+        "note": (
+            "480k tok/s baseline needs ~245 TFLOP/s for this model; v5e bf16 "
+            "peak is 197 TFLOP/s, so vs_baseline<1.0 on v5e is a silicon "
+            "ceiling - see vs_baseline_mfu_normalized for framework efficiency"
+        ),
+    }
+    if backend_err:
+        extra["error"] = backend_err
+    _emit(
+        round(tokens_per_sec, 1),
+        round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
+        extra,
+    )
+    print(f"# loss={float(loss):.4f} step_time={step_time * 1e3:.1f}ms "
+          f"device={dev_kind}", file=sys.stderr)
 
 
 if __name__ == "__main__":

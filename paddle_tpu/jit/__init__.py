@@ -247,6 +247,33 @@ class TrainStep:
         (fleet.DistTrainStep)."""
         return batch_vals
 
+    def cost_analysis(self, *batch):
+        """XLA cost analysis (flops, bytes accessed) of the compiled step for
+        this batch signature. Feeds MFU reporting (bench.py); the reference
+        has no per-program cost introspection — this rides XLA's
+        ``compiled.cost_analysis()`` (same source as hapi.flops)."""
+        p_vals = [p._value for p in self._params]
+        b_vals = [b._value for b in self._buffers + self._extra_params]
+        opt_states = self._opt.functional_states()
+        batch_vals = [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        batch_vals = self._place_batch(batch_vals)
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        key = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
+        jitted = self._cache.get(key)
+        if jitted is None:
+            jitted = self._compile()
+            self._cache[key] = jitted
+        rng_key = _rng.next_key()
+        cost = (
+            jitted.lower(p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
+            .compile()
+            .cost_analysis()
+        )
+        # jax returns either a dict or a one-element list of dicts
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return dict(cost or {})
+
     def _compile(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
         params, buffers = self._params, self._buffers + self._extra_params
