@@ -45,11 +45,25 @@ def _sdpa_reference(q, k, v, mask, dropout_p, causal, scale, key=None):
 
 @defop(amp="white", name="sdpa_op")
 def _sdpa(q, k, v, mask, key, dropout_p, causal, scale, use_pallas):
-    if use_pallas and mask is None and dropout_p == 0.0:
+    if mask is not None and mask.dtype != jnp.bool_:
+        # mask semantics on every path: never differentiated (keeps grads
+        # identical between the Pallas route and the reference fallback)
+        mask = jax.lax.stop_gradient(mask)
+    pallas_ok = use_pallas and dropout_p == 0.0 and (
+        mask is None or getattr(mask, "ndim", 0) == 4
+    )
+    if pallas_ok:
         try:
             from ...ops.pallas.flash_attention import flash_attention as _fa
 
-            return _fa(q, k, v, causal=causal, scale=scale)
+            if mask is None:
+                return _fa(q, k, v, causal=causal, scale=scale)
+            if mask.dtype == jnp.bool_:
+                return _fa(q, k, v, causal=causal, scale=scale, mask=mask)
+            # paddle attn_mask semantics: an additive mask, not a trained
+            # bias — skip the O(B*H*T^2) dbias pass in backward
+            return _fa(q, k, v, causal=causal, scale=scale, bias=mask,
+                       bias_needs_grad=False)
         except Exception:
             pass
     return _sdpa_reference(q, k, v, mask, dropout_p, causal, scale, key)
@@ -61,9 +75,27 @@ def scaled_dot_product_attention(
     """paddle.nn.functional.scaled_dot_product_attention parity.
 
     Layout [batch, seq, heads, head_dim] (matches paddle flash attention).
+    `attn_mask` carries mask semantics (paddle parity): it is never
+    differentiated, on any backend path. Use
+    `ops.pallas.flash_attention.flash_attention(bias=...)` for a trained
+    attention bias.
     """
     from ...framework import rng as _rng
 
+    if (
+        attn_mask is not None
+        and getattr(attn_mask, "stop_gradient", True) is False
+        and getattr(attn_mask, "dtype", None) != jnp.bool_
+    ):
+        import warnings
+
+        warnings.warn(
+            "attn_mask has stop_gradient=False but scaled_dot_product_"
+            "attention treats float masks as non-differentiable (mask "
+            "semantics); its gradient will be zero. Use ops.pallas."
+            "flash_attention.flash_attention(bias=...) for a trained bias.",
+            stacklevel=2,
+        )
     p = float(dropout_p) if training else 0.0
     rng_key = _rng.next_key() if p > 0 else None
     return _sdpa(

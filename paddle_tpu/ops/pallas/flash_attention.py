@@ -1,20 +1,33 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels (forward AND backward).
 
 Reference capability (SURVEY.md §2.3 "CP" row, §5 "Long-context"): Paddle
 wraps the external flashattn CUDA library
-(`paddle/phi/kernels/gpu/flash_attn_kernel.cu`,
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu` and
+`flash_attn_grad_kernel.cu`, exposed via
 `python/paddle/nn/functional/flash_attention.py`).
 
-TPU-native design: an online-softmax blockwise kernel (the flash-attention
-recurrence) written in Pallas. Q/K/V blocks stream HBM→VMEM per grid step;
-the MXU does the [block_q, d] x [d, block_k] logits and [block_q, block_k] x
-[block_k, d] accumulation in fp32; running max/denominator live in VMEM
-scratch across the innermost (key) grid dimension. Causal masking skips
-whole key blocks above the diagonal (predicated with pl.when), so compute is
-~halved for causal LM — the same tiling strategy as splash attention.
+TPU-native design: online-softmax blockwise kernels written in Pallas.
+Q/K/V blocks stream HBM→VMEM per grid step; the MXU does the
+[block_q, d] x [d, block_k] logits and the [block_q, block_k] x [block_k, d]
+accumulation in fp32; running max/denominator live in VMEM scratch across
+the innermost grid dimension.
 
-Backward: jax.custom_vjp whose bwd differentiates the jnp reference (XLA
-fuses it well); a dedicated bwd kernel is a later optimization.
+Causal block skipping is done in the BlockSpec index maps, not just with
+pl.when: grid steps whose K/V block lies entirely above the diagonal have
+their index map clamped to the last valid block, and Pallas elides the
+HBM→VMEM copy when consecutive steps map to the same block — so dead blocks
+cost neither bandwidth nor MXU time (compute is additionally gated with
+pl.when).
+
+Backward is the standard recompute-based flash backward: the forward also
+emits the per-row logsumexp (LSE); backward recomputes P = exp(S - LSE)
+blockwise (no O(T^2) HBM tensor is ever materialized) and accumulates
+dQ in one kernel (grid over K blocks innermost) and dK/dV in a second
+kernel (grid over Q blocks innermost), all in fp32 VMEM scratch.
+
+Supported: causal (incl. tq != tk, bottom-right aligned), additive bias /
+boolean mask broadcastable over batch and head, GQA/MQA (num_kv_heads
+divides num_heads), bias gradient.
 """
 from __future__ import annotations
 
@@ -35,11 +48,63 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
-def _fa_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, seq_len: int, block_q: int, block_k: int,
-    num_k_blocks: int,
+def _ceil8(n):
+    return max(8, (n + 7) // 8 * 8)
+
+
+def _scratch(shape):
+    vmem = pltpu.VMEM if pltpu is not None else pl.ANY
+    return vmem(shape, jnp.float32)
+
+
+def _causal_run(qi, ki, block_q, block_k, tq, tk):
+    """kv block `ki` overlaps q block `qi`'s visible region (bottom-right
+    aligned). Single source of truth for every pl.when gate; the index-map
+    clamps below are its inverse images, so gates and clamps cannot drift."""
+    return ki * block_k <= qi * block_q + block_q - 1 + (tk - tq)
+
+
+def _causal_last_kv(qi, block_q, block_k, tq, tk, nk):
+    """Largest kv block with _causal_run(qi, ki) true (clamped to grid)."""
+    last = (qi * block_q + block_q - 1 + (tk - tq)) // block_k
+    return jnp.minimum(nk - 1, jnp.maximum(last, 0))
+
+
+def _causal_first_q(ki, block_q, block_k, tq, tk, nq):
+    """Smallest q block with _causal_run(qi, ki) true (clamped to grid)."""
+    first = (ki * block_k - (tk - tq)) // block_q
+    return jnp.minimum(jnp.maximum(first, 0), nq - 1)
+
+
+def _mask_for(qi, ki, block_q, block_k, tq, tk, causal, shape):
+    """Validity mask for a [block_q, block_k] logits tile."""
+    q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_idx = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = jnp.logical_and(k_idx < tk, q_idx < tq)
+    if causal:
+        mask = jnp.logical_and(mask, k_idx <= q_idx + (tk - tq))
+    return mask
+
+
+def _logits(q, k, scale, bias_ref):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    return s
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(
+    *refs, scale, causal, tq, tk, block_q, block_k, num_k_blocks, has_bias,
 ):
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -49,22 +114,16 @@ def _fa_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: key block strictly above the diagonal contributes nothing
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else (ki >= 0)
+    # causal: a key block strictly above the diagonal contributes nothing
+    run = _causal_run(qi, ki, block_q, block_k, tq, tk) if causal else (ki >= 0)
 
     @pl.when(run)
     def _step():
         q = q_ref[0]  # [block_q, d]
         k = k_ref[0]  # [block_k, d]
         v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k]
-        q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_idx = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_idx < seq_len
-        if causal:
-            mask = jnp.logical_and(mask, k_idx <= q_idx)
+        s = _logits(q, k, scale, bias_ref)
+        mask = _mask_for(qi, ki, block_q, block_k, tq, tk, causal, s.shape)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # [block_q, 1]
@@ -72,7 +131,10 @@ def _fa_kernel(
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # dead rows (all keys at NEG_INF, e.g. a fully-masked-out query via a
+        # bool-mask-folded bias) would get p = exp(s - m_new) = 1 for EVERY
+        # key; gate on the raw logit so they contribute l = 0 and emit zeros
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -83,88 +145,510 @@ def _fa_kernel(
 
     @pl.when(ki == num_k_blocks - 1)
     def _emit():
-        l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        l = l_scr[:, :1]
+        safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_scr[:, :1] + jnp.log(safe), NEG_INF)
+        lse_ref[0] = lse[:, 0]
 
 
-def _fa_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
-    """q,k,v: [BH, T, D] → o: [BH, T, D]."""
-    bh, t, d = q.shape
-    block_q = min(block_q, max(t, 8))
-    block_k = min(block_k, max(t, 8))
-    pad_q = (-t) % block_q
-    pad_k = (-t) % block_k
-    tq, tk = t + pad_q, t + pad_k
+def _bh_kv(b, n_heads, n_kv_heads):
+    """Flattened-[batch*head] index → flattened-[batch*kv_head] index."""
+    group = n_heads // n_kv_heads
+    return b // n_heads * n_kv_heads + (b % n_heads) // group
+
+
+def _bh_bias(b, n_heads, bias_b, bias_h):
+    return (b // n_heads) % bias_b * bias_h + (b % n_heads) % bias_h
+
+
+def _make_index_maps(causal, tq, tk, nq, nk, block_q, block_k, n_heads,
+                     n_kv_heads, bias_b, bias_h, bias_tq, bias_tk):
+    """Shared K/V + bias BlockSpec index maps with the causal diagonal clamp.
+
+    Grid steps whose K/V block is entirely above the diagonal are clamped to
+    the last valid block; Pallas elides the HBM copy for repeated indices,
+    so dead blocks cost no bandwidth. Used identically by the forward and
+    the dQ backward so their block-skipping can never diverge.
+
+    Bias pages keep singleton broadcast dims (batch/head via _bh_bias,
+    Tq/Tk by pinning the block index to 0) so a (B,1,1,Tk) padding mask is
+    never materialized to O(B*H*Tq*Tk).
+    """
+
+    def kv_index(b, i, j):
+        bkv = _bh_kv(b, n_heads, n_kv_heads)
+        if causal:
+            j = jnp.minimum(j, _causal_last_kv(i, block_q, block_k, tq, tk, nk))
+        return (bkv, j, 0)
+
+    def bias_index(b, i, j):
+        _, jj, _ = kv_index(b, i, j)
+        return (
+            _bh_bias(b, n_heads, bias_b, bias_h),
+            i if bias_tq > 1 else 0,
+            jj if bias_tk > 1 else 0,
+        )
+
+    return kv_index, bias_index
+
+
+def _bias_block(block_q, block_k, bias_tq, bias_tk):
+    return (1, block_q if bias_tq > 1 else 1, block_k if bias_tk > 1 else 1)
+
+
+def _pad_bias(bias, pad_q, pad_k):
+    return jnp.pad(bias, (
+        (0, 0),
+        (0, pad_q if bias.shape[1] > 1 else 0),
+        (0, pad_k if bias.shape[2] > 1 else 0),
+    ))
+
+
+def _fa_forward(q, k, v, bias, causal, scale, n_heads, n_kv_heads,
+                bias_b, bias_h, block_q, block_k, interpret):
+    """q: [B*H, Tq, D]; k,v: [B*Hkv, Tk, D]; bias: [Bb*Hb, Tq, Tk] or None.
+
+    Returns (o [B*H, Tq, D], lse [B*H, Tq_padded] fp32).
+    """
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, _ceil8(tq))
+    block_k = min(block_k, _ceil8(tk))
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
-    nq, nk = tq // block_q, tk // block_k
+    bias_tq = bias.shape[1] if bias is not None else 1
+    bias_tk = bias.shape[2] if bias is not None else 1
+    if bias is not None and (pad_q or pad_k):
+        bias = _pad_bias(bias, pad_q, pad_k)
+    nq, nk = (tq + pad_q) // block_q, (tk + pad_k) // block_k
+
+    kv_index, bias_index = _make_index_maps(
+        causal, tq, tk, nq, nk, block_q, block_k, n_heads, n_kv_heads,
+        bias_b, bias_h, bias_tq, bias_tk,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec(_bias_block(block_q, block_k, bias_tq, bias_tk),
+                         bias_index)
+        )
+        args.append(bias)
 
     kernel = functools.partial(
-        _fa_kernel, scale=scale, causal=causal, seq_len=t,
+        _fwd_kernel, scale=scale, causal=causal, tq=tq, tk=tk,
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        has_bias=bias is not None,
     )
-    vmem = pltpu.VMEM if pltpu is not None else pl.ANY
-    out = pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq + pad_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq + pad_q), jnp.float32),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
         scratch_shapes=[
-            vmem((block_q, 128), jnp.float32),
-            vmem((block_q, 128), jnp.float32),
-            vmem((block_q, d), jnp.float32),
+            _scratch((block_q, 128)),
+            _scratch((block_q, 128)),
+            _scratch((block_q, d)),
         ],
         interpret=interpret,
-    )(q, k, v)
-    return out[:, :t] if pad_q else out
+    )(*args)
+    return (o[:, :tq] if pad_q else o), lse
 
 
-def _reference(q, k, v, causal, scale):
-    # [BH, T, D] reference used only for the backward pass
-    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        t = s.shape[-1]
-        cm = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(cm, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bqk,bkd->bqd", p, v)
+# ---------------------------------------------------------------- backward
+
+def _bwd_p_ds(q, k, v, do, lse, delta, bias_ref, mask, scale):
+    """Recompute P and dS for one [block_q, block_k] tile (all fp32)."""
+    s = _logits(q, k, scale, bias_ref)
+    # the s-threshold gate mirrors the forward: dead rows (lse == NEG_INF,
+    # s ~= NEG_INF) must recompute p = 0, not exp(s - lse) = 1
+    p = jnp.where(
+        jnp.logical_and(mask, s > NEG_INF * 0.5), jnp.exp(s - lse), 0.0
+    )  # lse: [block_q, 1]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)  # delta: [block_q, 1]
+    return p, ds
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fa(q, k, v, causal, scale, interpret):
-    return _fa_forward(q, k, v, causal, scale, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+def _dq_kernel(
+    *refs, scale, causal, tq, tk, block_q, block_k, num_k_blocks, has_bias,
+    has_dbias,
+):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    bias_ref = refs[i] if has_bias else None
+    i += int(has_bias)
+    do_ref, lse_ref, delta_ref, dq_ref = refs[i:i + 4]
+    i += 4
+    dbias_ref = refs[i] if has_dbias else None
+    acc_scr = refs[-1]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = _causal_run(qi, ki, block_q, block_k, tq, tk) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _step():
+        mask = _mask_for(qi, ki, block_q, block_k, tq, tk, causal,
+                         (block_q, block_k))
+        _, ds = _bwd_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(jnp.float32),
+            lse_ref[0][:, None], delta_ref[0][:, None], bias_ref, mask, scale,
+        )
+        if dbias_ref is not None:
+            dbias_ref[0] = ds.astype(dbias_ref.dtype)
+        acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if dbias_ref is not None:
+        @pl.when(jnp.logical_not(run))
+        def _dead_bias():
+            dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _emit():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _fa_fwd(q, k, v, causal, scale, interpret):
-    return _fa(q, k, v, causal, scale, interpret), (q, k, v)
+def _dkv_kernel(
+    *refs, scale, causal, tq, tk, block_q, block_k, num_q_blocks, has_bias,
+):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
+    ki = pl.program_id(1)
+    qj = pl.program_id(2)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = _causal_run(qj, ki, block_q, block_k, tq, tk) if causal else (qj >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        mask = _mask_for(qj, ki, block_q, block_k, tq, tk, causal,
+                         (block_q, block_k))
+        p, ds = _bwd_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do,
+            lse_ref[0][:, None], delta_ref[0][:, None], bias_ref, mask, scale,
+        )
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+    @pl.when(qj == num_q_blocks - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _fa_bwd(causal, scale, interpret, res, do):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal, scale), q, k, v)
-    return vjp(do)
+def _fa_backward(q, k, v, bias, o, lse, do, causal, scale, n_heads,
+                 n_kv_heads, bias_b, bias_h, bias_grad, block_q, block_k,
+                 interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, _ceil8(tq))
+    block_k = min(block_k, _ceil8(tk))
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    tqp, tkp = tq + pad_q, tk + pad_k
+
+    # delta_i = rowsum(dO * O) — tiny elementwise reduce; let XLA fuse it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, pad_q), (0, 0)))
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+        # lse is produced padded by the forward
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    bias_tq = bias.shape[1] if bias is not None else 1
+    bias_tk = bias.shape[2] if bias is not None else 1
+    if bias is not None and (pad_q or pad_k):
+        bias = _pad_bias(bias, pad_q, pad_k)
+    if lse.shape[1] != tqp:
+        lse = jnp.pad(lse, ((0, 0), (0, tqp - lse.shape[1])))
+    nq, nk = tqp // block_q, tkp // block_k
+    has_bias = bias is not None
+    # dbias needs a per-(batch*q-head) [Tq, Tk] dS tensor in HBM — O(B*H*T^2),
+    # far beyond the bias itself. Only pay it when the bias actually needs a
+    # gradient (mask-derived biases never do).
+    want_dbias = has_bias and bias_grad
+
+    # ---- dQ: grid (bh, q blocks, k blocks innermost)
+    kv_index, bias_index = _make_index_maps(
+        causal, tq, tk, nq, nk, block_q, block_k, n_heads, n_kv_heads,
+        bias_b, bias_h, bias_tq, bias_tk,
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    in_specs = [
+        q_spec,
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec(_bias_block(block_q, block_k, bias_tq, bias_tk),
+                         bias_index)
+        )
+        args.append(bias)
+    in_specs += [q_spec, row_spec, row_spec]
+    args += [do, lse, delta]
+
+    out_shape = [jax.ShapeDtypeStruct((bh, tqp, d), q.dtype)]
+    out_specs = [q_spec]
+    if want_dbias:
+        out_shape.append(jax.ShapeDtypeStruct((bh, tqp, tkp), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, block_k), lambda b, i, j: (b, i, j))
+        )
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, tq=tq, tk=tk,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, has_bias=has_bias,
+        has_dbias=want_dbias,
+    )
+    dq_out = pl.pallas_call(
+        dq_kernel,
+        out_shape=out_shape,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[_scratch((block_q, d))],
+        interpret=interpret,
+    )(*args)
+    if want_dbias:
+        dq, ds_full = dq_out
+        dbias = ds_full[:, :tq, :tk].reshape(
+            bh // n_heads, n_heads, tq, tk
+        )
+        if bias_b == 1:
+            dbias = dbias.sum(0, keepdims=True)
+        if bias_h == 1:
+            dbias = dbias.sum(1, keepdims=True)
+        if bias_tq == 1:
+            dbias = dbias.sum(2, keepdims=True)
+        if bias_tk == 1:
+            dbias = dbias.sum(3, keepdims=True)
+        dbias = dbias.reshape(bias_b * bias_h, bias_tq, bias_tk)
+    else:
+        (dq,) = dq_out
+        dbias = None
+    dq = dq[:, :tq]
+
+    # ---- dK/dV: grid (bh over *q heads*, k blocks, q blocks innermost);
+    # GQA: per-q-head partials are group-summed after the kernel.
+    def kv_index2(b, i, j):
+        return (_bh_kv(b, n_heads, n_kv_heads), i, 0)
+
+    def q_index2(b, i, j):
+        if causal:
+            j = jnp.maximum(j, _causal_first_q(i, block_q, block_k, tq, tk, nq))
+        return (b, j, 0)
+
+    def row_index2(b, i, j):
+        _, jj, _ = q_index2(b, i, j)
+        return (b, jj)
+
+    in_specs2 = [
+        pl.BlockSpec((1, block_q, d), q_index2),
+        pl.BlockSpec((1, block_k, d), kv_index2),
+        pl.BlockSpec((1, block_k, d), kv_index2),
+    ]
+    args2 = [q, k, v]
+    if has_bias:
+        def bias_index2(b, i, j):
+            _, jj, _ = q_index2(b, i, j)
+            return (
+                _bh_bias(b, n_heads, bias_b, bias_h),
+                jj if bias_tq > 1 else 0,
+                i if bias_tk > 1 else 0,
+            )
+
+        in_specs2.append(
+            pl.BlockSpec(_bias_block(block_q, block_k, bias_tq, bias_tk),
+                         bias_index2)
+        )
+        args2.append(bias)
+    in_specs2 += [
+        pl.BlockSpec((1, block_q, d), q_index2),
+        pl.BlockSpec((1, block_q), row_index2),
+        pl.BlockSpec((1, block_q), row_index2),
+    ]
+    args2 += [do, lse, delta]
+
+    kv_out_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, tq=tq, tk=tk,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq, has_bias=has_bias,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tkp, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tkp, d), jnp.float32),
+        ],
+        grid=(bh, nk, nq),
+        in_specs=in_specs2,
+        out_specs=[kv_out_spec, kv_out_spec],
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+        interpret=interpret,
+    )(*args2)
+    dk, dv = dk[:, :tk], dv[:, :tk]
+    group = n_heads // n_kv_heads
+    if group > 1:
+        batch = bh // n_heads
+        dk = dk.reshape(batch, n_kv_heads, group, tk, d).sum(2).reshape(-1, tk, d)
+        dv = dv.reshape(batch, n_kv_heads, group, tk, d).sum(2).reshape(-1, tk, d)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), dbias
+
+
+# ---------------------------------------------------------------- custom vjp
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _fa(q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b, bias_h,
+        bias_grad, interpret):
+    o, _ = _fa_forward(
+        q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b, bias_h,
+        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+    )
+    return o
+
+
+def _fa_fwd(q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b,
+            bias_h, bias_grad, interpret):
+    o, lse = _fa_forward(
+        q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b, bias_h,
+        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+    )
+    return o, (q, k, v, bias, o, lse)
+
+
+def _fa_bwd(causal, scale, n_heads, n_kv_heads, bias_b, bias_h, bias_grad,
+            interpret, res, do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, dbias = _fa_backward(
+        q, k, v, bias, o, lse, do, causal, scale, n_heads, n_kv_heads,
+        bias_b, bias_h, bias_grad, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+        interpret,
+    )
+    if bias is None:
+        dbias = None
+    elif dbias is None:  # bias present but bias_grad=False: zero cotangent
+        dbias = jnp.zeros_like(bias)
+    else:
+        dbias = dbias.astype(bias.dtype)
+    return dq, dk, dv, dbias
 
 
 _fa.defvjp(_fa_fwd, _fa_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, scale=None):
-    """q, k, v: [B, T, H, D] (paddle flash-attention layout) → [B, T, H, D]."""
-    b, t, h, d = q.shape
+# ---------------------------------------------------------------- public API
+
+def flash_attention(q, k, v, causal: bool = False, scale=None, bias=None,
+                    mask=None, bias_needs_grad: bool = True):
+    """Blockwise (flash) attention.
+
+    Args:
+      q: [B, Tq, H, D] (paddle flash-attention layout).
+      k, v: [B, Tk, Hkv, D]; Hkv may divide H (GQA/MQA).
+      causal: bottom-right-aligned causal masking.
+      scale: logits scale, default 1/sqrt(D).
+      bias: additive logits bias, [B|1, H|1, Tq|1, Tk|1]. Broadcast
+        (singleton) dims are honored inside the kernel via the BlockSpec
+        index maps — a (B,1,1,Tk) padding mask stays O(B*Tk) in HBM.
+      mask: boolean keep-mask, same broadcastable shape; folded into bias
+        (never differentiated).
+      bias_needs_grad: set False for non-trained biases — the dbias pass
+        materializes an O(B*H*Tq*Tk) buffer that is then skipped entirely.
+
+    Query rows with no visible keys (causal with Tq > Tk, or a fully-masked
+    row) return zeros (the reference dense softmax would produce NaN).
+
+    Returns [B, Tq, H, D].
+    """
+    b, tq, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv != 0:
+        raise ValueError(f"num_heads {h} not divisible by num_kv_heads {hkv}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     interpret = jax.default_backend() != "tpu"
 
-    def fold(x):
-        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+    bias_grad = bias_needs_grad and bias is not None
+    if mask is not None:
+        neg = jnp.asarray(NEG_INF, jnp.float32)
+        m = jnp.where(mask, 0.0, neg)
+        bias = m if bias is None else bias + m
 
-    o = _fa(fold(q), fold(k), fold(v), bool(causal), float(scale), interpret)
-    return jnp.swapaxes(o.reshape(b, h, t, d), 1, 2)
+    bias_b = bias_h = 1
+    if bias is not None:
+        bias = jnp.asarray(bias)
+        if bias.ndim != 4:
+            raise ValueError(f"bias must be rank-4, got {bias.shape}")
+        bias_b, bias_h = int(bias.shape[0]), int(bias.shape[1])
+        if bias_b not in (1, b) or bias_h not in (1, h):
+            raise ValueError(
+                f"bias dims ({bias_b}, {bias_h}) must broadcast over "
+                f"batch={b} / heads={h} (per-kv-head bias pages are "
+                "unsupported)"
+            )
+        if (bias.shape[2] not in (1, tq)
+                or bias.shape[3] not in (1, k.shape[1])):
+            raise ValueError(
+                f"bias seq dims {bias.shape[2:]} must broadcast over "
+                f"(Tq={tq}, Tk={k.shape[1]})"
+            )
+        # merge batch/head pages; keep Tq/Tk singleton dims un-materialized
+        bias = bias.reshape(bias_b * bias_h, bias.shape[2], bias.shape[3])
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(-1, x.shape[1], x.shape[-1])
+
+    o = _fa(
+        fold(q), fold(k), fold(v), bias, bool(causal), float(scale),
+        h, hkv, bias_b, bias_h, bias_grad, interpret,
+    )
+    return jnp.swapaxes(o.reshape(b, h, tq, d), 1, 2)

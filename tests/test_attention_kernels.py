@@ -45,6 +45,178 @@ def test_flash_attention_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+def test_flash_attention_bias_and_mask():
+    q, k, v = _rand(2, 96, 2, 16, seed=3)
+    rng = np.random.default_rng(4)
+    bias = jnp.asarray(rng.standard_normal((1, 2, 96, 96)), jnp.float32)
+    out = flash_attention(q, k, v, bias=bias)
+    ref = _sdpa_reference(q, k, v, jnp.swapaxes(bias, 0, 0), 0.0, False, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    keep = jnp.asarray(rng.random((2, 1, 96, 96)) > 0.3)
+    out = flash_attention(q, k, v, mask=keep)
+    ref = _sdpa_reference(q, k, v, keep, 0.0, False, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_bias_grad():
+    q, k, v = _rand(1, 48, 2, 16, seed=5)
+    rng = np.random.default_rng(6)
+    bias = jnp.asarray(rng.standard_normal((1, 1, 48, 48)), jnp.float32)
+
+    def f_pl(q, bias):
+        return (flash_attention(q, k, v, causal=True, bias=bias) ** 2).mean()
+
+    def f_ref(q, bias):
+        return (_sdpa_reference(q, k, v, bias, 0.0, True, None) ** 2).mean()
+
+    g_pl = jax.grad(f_pl, argnums=(0, 1))(q, bias)
+    g_ref = jax.grad(f_ref, argnums=(0, 1))(q, bias)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_broadcast_padding_mask():
+    """(B,1,1,Tk) padding mask rides the kernel without materialization."""
+    q, k, v = _rand(2, 64, 2, 16, seed=10)
+    rng = np.random.default_rng(11)
+    keep = np.ones((2, 1, 1, 64), bool)
+    keep[:, :, :, 48:] = False  # pad out the tail keys
+    keep = jnp.asarray(keep)
+    out = flash_attention(q, k, v, mask=keep)
+    ref = _sdpa_reference(q, k, v, keep, 0.0, False, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    # grads through the masked kernel still match (mask itself has no grad)
+    g = jax.grad(lambda q_: (flash_attention(q_, k, v, mask=keep) ** 2).mean())(q)
+    gr = jax.grad(lambda q_: (_sdpa_reference(q_, k, v, keep, 0.0, False, None) ** 2).mean())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_fully_masked_rows_zero():
+    """A query row with NO visible keys returns zeros with zero grads
+    (the dense softmax reference would produce NaN there)."""
+    q, k, v = _rand(1, 32, 2, 16, seed=20)
+    keep = np.ones((1, 1, 32, 32), bool)
+    keep[0, 0, 5, :] = False  # row 5 sees nothing
+    keep = jnp.asarray(keep)
+    out = flash_attention(q, k, v, mask=keep)
+    np.testing.assert_array_equal(np.asarray(out)[0, 5], 0.0)
+    assert not np.isnan(np.asarray(out)).any()
+
+    g = jax.grad(lambda q_: (flash_attention(q_, k, v, mask=keep) ** 2).sum())(q)
+    np.testing.assert_array_equal(np.asarray(g)[0, 5], 0.0)
+    assert not np.isnan(np.asarray(g)).any()
+
+    # causal with tq > tk: leading rows see no keys -> zeros, not NaN
+    q2, k2, v2 = _rand(1, 20, 1, 8, seed=21)
+    out2 = flash_attention(q2, k2[:, :15], v2[:, :15], causal=True)
+    np.testing.assert_array_equal(np.asarray(out2)[0, :4], 0.0)
+    assert not np.isnan(np.asarray(out2)).any()
+
+
+def test_flash_attention_singleton_tq_bias_grad():
+    q, k, v = _rand(1, 32, 2, 16, seed=12)
+    rng = np.random.default_rng(13)
+    bias = jnp.asarray(rng.standard_normal((1, 1, 1, 32)), jnp.float32)
+
+    g_pl = jax.grad(
+        lambda b_: (flash_attention(q, k, v, bias=b_) ** 2).mean()
+    )(bias)
+    g_ref = jax.grad(
+        lambda b_: (_sdpa_reference(q, k, v, b_, 0.0, False, None) ** 2).mean()
+    )(bias)
+    np.testing.assert_allclose(np.asarray(g_pl), np.asarray(g_ref), rtol=1e-4, atol=1e-6)
+
+
+def test_sdpa_float_mask_never_differentiated():
+    """Float attn_mask is mask-semantics: zero grad on EVERY backend path."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional import attention as attn_mod
+
+    q, k, v = _rand(1, 32, 2, 16, seed=14)
+    mask = paddle.to_tensor(
+        np.random.default_rng(15).standard_normal((1, 2, 32, 32)).astype("float32")
+    )
+    mask.stop_gradient = False
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        attn_mask=mask,
+    )
+    (out ** 2).mean().backward()
+    assert mask.grad is None or float(np.abs(np.asarray(mask.grad._value)).max()) == 0.0
+
+
+def test_flash_attention_gqa():
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((2, 64, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 64, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 64, 2, 16)), jnp.float32)
+    krep = jnp.repeat(k, 2, axis=2)
+    vrep = jnp.repeat(v, 2, axis=2)
+
+    out = flash_attention(q, k, v, causal=True)
+    ref = _sdpa_reference(q, krep, vrep, None, 0.0, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    # GQA grads: dk/dv group-sum path
+    g_pl = jax.grad(
+        lambda k_, v_: (flash_attention(q, k_, v_, causal=True) ** 2).mean(),
+        argnums=(0, 1),
+    )(k, v)
+    g_ref = jax.grad(
+        lambda k_, v_: (
+            _sdpa_reference(q, jnp.repeat(k_, 2, 2), jnp.repeat(v_, 2, 2),
+                            None, 0.0, True, None) ** 2
+        ).mean(),
+        argnums=(0, 1),
+    )(k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_cross_length():
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((1, 40, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 96, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 96, 2, 16)), jnp.float32)
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal)
+        ref = _sdpa_reference(q, k, v, None, 0.0, causal, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_long_seq_grads():
+    """VERDICT #3 'done' criterion: grad parity vs dense at T>=4k.
+
+    Uses one head / d=32 to keep the interpreted-kernel runtime sane; the
+    block structure exercised is the same as production shapes.
+    """
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    rng = np.random.default_rng(9)
+    t = 4096
+    q = jnp.asarray(rng.standard_normal((1, t, 1, 32)) * 0.1, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, t, 1, 32)) * 0.1, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, t, 1, 32)) * 0.1, jnp.float32)
+
+    old_bq, old_bk = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
+    fa.DEFAULT_BLOCK_Q = fa.DEFAULT_BLOCK_K = 512
+    try:
+        g_pl = jax.grad(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    finally:
+        fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = old_bq, old_bk
+    g_ref = jax.grad(
+        lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, None, 0.0, True, None).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
 def test_sdpa_routes_to_flash_kernel():
     """The public functional uses the Pallas kernel when mask/dropout allow."""
     import paddle_tpu.nn.functional as F
