@@ -89,7 +89,7 @@ class HybridCommunicateGroup:
         names = topology.get_hybrid_group_names()
         mesh_axes = tuple(_AXIS_ALIAS[n] for n in names)
         dims = tuple(topology.get_dim(n) for n in names)
-        self.mesh = _mesh.build_mesh(dims, mesh_axes)
+        self.mesh = _mesh.build_hybrid_mesh(dims, mesh_axes)
         _mesh.set_global_mesh(self.mesh)
 
         self.global_rank = 0  # single-controller: coordinate of device 0

@@ -55,6 +55,124 @@ def build_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def _device_slice_ids(devices, num_slices: Optional[int]):
+    """Slice id per device. Real multi-slice TPU devices expose
+    `.slice_index`; `num_slices` (or PADDLE_TPU_NUM_SLICES) overrides with a
+    contiguous split for simulation/testing."""
+    import os
+
+    if num_slices is None:
+        env = os.environ.get("PADDLE_TPU_NUM_SLICES")
+        if env:
+            num_slices = int(env)
+    if num_slices is not None and num_slices > 1:
+        if len(devices) % num_slices != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by "
+                f"num_slices={num_slices}"
+            )
+        per = len(devices) // num_slices
+        return [i // per for i in range(len(devices))]
+    return [getattr(d, "slice_index", 0) or 0 for d in devices]
+
+
+def _ici_device_array(dims, devices) -> np.ndarray:
+    """Arrange `devices` (one slice) into `dims` honoring the physical ICI
+    torus when coords are available (TPU); plain reshape otherwise (CPU)."""
+    try:
+        from jax.experimental import mesh_utils
+
+        return np.asarray(
+            mesh_utils.create_device_mesh(
+                tuple(dims), devices=list(devices),
+                allow_split_physical_axes=True,
+            )
+        )
+    except Exception:
+        return np.array(devices).reshape(tuple(dims))
+
+
+# Axes allowed to cross DCN (slice boundaries), in preference order. The
+# reference encodes the same rule by axis ordering in
+# `fleet/base/topology.py`: gradient-sync (dp) tolerates the slow fabric,
+# pipeline stage hops tolerate it next, ZeRO gathers after that; sep/mp
+# collectives are per-layer and must stay on ICI.
+DCN_CAPABLE_AXES = ("dp", "pp", "sharding")
+
+
+def build_hybrid_mesh(
+    axis_dims: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: Optional[int] = None,
+) -> Mesh:
+    """ICI/DCN-topology-aware hybrid mesh (SURVEY.md §2.3 "Hybrid topology":
+    "ICI-aware axis assignment is the key added value").
+
+    Single slice: devices are arranged so the innermost axes (mp, sep) land
+    on physically adjacent chips of the ICI torus.
+
+    Multi-slice (slice_index present, or simulated): the slice count is
+    factored into the outermost DCN-capable axes ([dp, pp, sharding] in that
+    order) so ONLY those axes' collectives cross DCN; each slice internally
+    holds a contiguous ICI-arranged sub-mesh for the remaining axis extents.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    dims = [int(d) for d in axis_dims]
+    if int(np.prod(dims)) != len(devices):
+        raise ValueError(
+            f"mesh axis dims {tuple(dims)} require {int(np.prod(dims))} "
+            f"devices, got {len(devices)}"
+        )
+    slice_ids = _device_slice_ids(devices, num_slices)
+    uniq = sorted(set(slice_ids))
+    n_slices = len(uniq)
+    if n_slices <= 1:
+        return Mesh(_ici_device_array(dims, devices), tuple(axis_names))
+
+    by_slice = {s: [] for s in uniq}
+    for d, sid in zip(devices, slice_ids):
+        by_slice[sid].append(d)
+    per_slice_n = len(devices) // n_slices
+    if any(len(g) != per_slice_n for g in by_slice.values()):
+        raise ValueError(
+            f"uneven slices: {[len(by_slice[s]) for s in uniq]} devices per "
+            "slice; hybrid mesh needs equal slice sizes"
+        )
+
+    # factor n_slices into the outer DCN-capable axes, in order
+    import math
+
+    dcn = [1] * len(dims)
+    rem = n_slices
+    for i, (name, dim) in enumerate(zip(axis_names, dims)):
+        if rem == 1:
+            break
+        if name in DCN_CAPABLE_AXES:
+            f = math.gcd(dim, rem)
+            dcn[i] = f
+            rem //= f
+    if rem != 1:
+        raise ValueError(
+            f"cannot place {n_slices} slices onto DCN-capable axes "
+            f"{DCN_CAPABLE_AXES} with degrees "
+            f"{dict(zip(axis_names, dims))}: the slice count must divide "
+            "their product (dp/pp/sharding are the axes allowed to span DCN)"
+        )
+    per_dims = [d // f for d, f in zip(dims, dcn)]
+
+    # per-slice ICI sub-meshes, composed so dcn coords are the OUTER part of
+    # each axis: axis i index = dcn_i * per_dims[i] + ici_i
+    subs = np.stack(
+        [_ici_device_array(per_dims, by_slice[s]) for s in uniq]
+    )  # [n_slices, *per_dims]
+    k = len(dims)
+    subs = subs.reshape(tuple(dcn) + tuple(per_dims))
+    perm = [j for i in range(k) for j in (i, k + i)]
+    arr = subs.transpose(perm).reshape(tuple(dims))
+    return Mesh(arr, tuple(axis_names))
+
+
 def set_global_mesh(mesh: Optional[Mesh]):
     _state.mesh = mesh
 

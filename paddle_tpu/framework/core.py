@@ -124,6 +124,13 @@ def XPUPlace(device_id: int = 0):
 # --------------------------------------------------------------------------
 # Tape
 # --------------------------------------------------------------------------
+class TraceHostSyncError(RuntimeError):
+    """Raised when a host sync point (`.numpy()`, `float()`, `if tensor:`)
+    is hit on a traced value inside a captured program. `jit.to_static`
+    catches this to fall back to eager execution (the dy2static guard
+    story — SURVEY.md §7 hard-part #1)."""
+
+
 class TapeNode:
     """One recorded op: pullback closure + graph edges.
 
@@ -404,9 +411,13 @@ class Tensor:
     # -- materialization -------------------------------------------------
     def numpy(self) -> np.ndarray:
         if isinstance(self._value, _Tracer):
-            raise RuntimeError(
+            raise TraceHostSyncError(
                 "Tensor.numpy() is not allowed inside a captured (jit) program; "
-                "this is a host sync point. Move it outside paddle_tpu.jit."
+                "this is a host sync point. paddle_tpu.jit.to_static catches "
+                "this and falls back to eager execution with a warning; under "
+                "raw jax.jit, move the sync outside the traced region or use "
+                "paddle_tpu.static.nn.cond/while_loop for data-dependent "
+                "control flow."
             )
         return np.asarray(self._value)
 

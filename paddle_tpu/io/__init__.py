@@ -275,6 +275,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._mp_pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -310,6 +314,24 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._gen_batches()
             return
+        if not self._iterable_mode and self.collate_fn is default_collate_fn:
+            # worker PROCESSES + shared-memory transport (the reference's
+            # multiprocess DataLoader design): Python-heavy transforms scale
+            # past the GIL. Custom collate_fns stay on the thread path (they
+            # may create Tensors, and jax must not run in forked workers).
+            # Falls back to threads if process setup fails (e.g. unpicklable
+            # dataset under a spawn-only platform).
+            try:
+                yield from self._iter_multiprocess()
+                return
+            except _MpSetupError as e:
+                import warnings
+
+                warnings.warn(
+                    f"multiprocess DataLoader unavailable ({e.__cause__}); "
+                    "falling back to worker threads (GIL-bound for "
+                    "Python-heavy transforms)"
+                )
         # threaded prefetch pipeline
         q: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         stop = object()
@@ -346,7 +368,10 @@ class DataLoader:
                     i, idxs = idx_q.get_nowait()
                 except queue.Empty:
                     return
-                out = self.collate_fn([self.dataset[j] for j in idxs])
+                try:
+                    out = self.collate_fn([self.dataset[j] for j in idxs])
+                except BaseException as e:  # propagate, else consumer hangs
+                    out = _WorkerFailure(e)
                 with res_cv:
                     results[i] = out
                     res_cv.notify_all()
@@ -358,8 +383,78 @@ class DataLoader:
             with res_cv:
                 while i not in results:
                     res_cv.wait()
-                yield results.pop(i)
+                out = results.pop(i)
+            if isinstance(out, _WorkerFailure):
+                raise RuntimeError(
+                    f"DataLoader worker failed on batch {i}"
+                ) from out.exc
+            yield out
+
+    # ------------------------------------------------- multiprocess path ----
+    def _get_mp_pool(self):
+        from .multiprocess import MultiprocessWorkerPool, _np_collate
+
+        pool = self._mp_pool
+        if pool is not None and not pool._closed:
+            return pool
+        collate = self.collate_fn
+        if collate is default_collate_fn:
+            collate = _np_collate  # workers must stay numpy-only (no jax)
+        try:
+            pool = MultiprocessWorkerPool(
+                self.dataset,
+                collate,
+                self.num_workers,
+                self.prefetch_factor,
+                worker_init_fn=self.worker_init_fn,
+                use_shared_memory=self.use_shared_memory,
+            )
+        except Exception as e:  # process/pickling setup failure → threads
+            raise _MpSetupError() from e
+        self._mp_pool = pool
+        return pool
+
+    def _iter_multiprocess(self):
+        from .multiprocess import MultiprocessWorkerPool
+
+        pool = self._get_mp_pool()
+        try:
+            for tree, opened in pool.run_epoch(self.batch_sampler):
+                out = _wrap_np_tree(tree)
+                MultiprocessWorkerPool.release(opened)
+                yield out
+        finally:
+            if not self.persistent_workers:
+                pool.close()
+                self._mp_pool = None
+
+
+class _MpSetupError(Exception):
+    pass
+
+
+class _WorkerFailure:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+
+
+def _wrap_np_tree(tree):
+    """numpy leaves → Tensor, mirroring default_collate_fn's output types."""
+    if isinstance(tree, np.ndarray):
+        # explicit host copy: the source may be a view over a shared-memory
+        # segment that is unlinked right after this batch is yielded, and
+        # jnp.asarray may alias host buffers on the CPU backend
+        return Tensor(np.array(tree))
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_wrap_np_tree(x) for x in tree)
+    if isinstance(tree, dict):
+        return {k: _wrap_np_tree(v) for k, v in tree.items()}
+    return tree
 
 
 def get_worker_info():
-    return None
+    from .multiprocess import get_worker_info as _gwi
+
+    return _gwi()

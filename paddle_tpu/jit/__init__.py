@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import rng as _rng
-from ..framework.core import Tensor, no_grad
+from ..framework.core import Tensor, TraceHostSyncError, no_grad
 from ..framework.op import raw
 from ..nn.layer import Layer
 
@@ -75,6 +75,7 @@ class TracedLayer:
         self._layers = list(layers) if layers is not None else _collect_layers(fn)
         self._cache = {}
         self._last_out_tree = None
+        self._eager_fallback = False
         functools.update_wrapper(self, fn, updated=[])
 
     def _state_tensors(self):
@@ -94,6 +95,29 @@ class TracedLayer:
         return tensors, is_buffer
 
     def __call__(self, *args, **kwargs):
+        if self._eager_fallback:
+            return self._fn(*args, **kwargs)
+        try:
+            return self._traced_call(*args, **kwargs)
+        except TraceHostSyncError:
+            # dy2static guard semantics (SURVEY.md §7 hard-part #1): a host
+            # sync (`.numpy()`, `if tensor:`) inside the function cannot be
+            # captured — run eagerly from now on instead of failing, exactly
+            # like the reference's dy2static falls back to dygraph.
+            import warnings
+
+            warnings.warn(
+                f"to_static({getattr(self._fn, '__name__', self._fn)!r}): a "
+                "host sync point (.numpy()/float()/`if tensor:`) was hit "
+                "during tracing; falling back to EAGER execution for this "
+                "callable. Use paddle_tpu.static.nn.cond/while_loop/"
+                "switch_case to keep data-dependent control flow compiled.",
+                stacklevel=2,
+            )
+            self._eager_fallback = True
+            return self._fn(*args, **kwargs)
+
+    def _traced_call(self, *args, **kwargs):
         state, is_buffer = self._state_tensors()
         state_vals = [t._value for t in state]
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)

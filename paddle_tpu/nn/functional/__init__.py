@@ -12,4 +12,5 @@ from .norm import (  # noqa: F401
     local_response_norm,
     normalize,
     rms_norm,
+    spectral_norm_weight,
 )

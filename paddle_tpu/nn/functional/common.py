@@ -318,4 +318,37 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: planned with the EP/MoE work")
+    """PartialFC-style class-center sampling (reference:
+    ``paddle/phi/kernels/gpu/class_center_sample_kernel.cu`` via
+    ``python/paddle/nn/functional/common.py``).
+
+    Keeps every positive class present in `label` and pads with uniformly
+    sampled negative classes up to `num_samples`. Returns
+    (remapped_label, sampled_class_indices) where remapped_label indexes
+    into the sorted sampled set. Host-side (eager-only): the output size is
+    data-dependent, which cannot live inside a compiled TPU program — call
+    it outside the jit boundary, as the per-step sampling step.
+    """
+    import numpy as np
+
+    from ...framework.core import Tensor
+    from ...framework.op import raw
+
+    lab = np.asarray(raw(label)).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        import jax as _jax
+
+        from ...framework import rng as _rng
+
+        neg = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                           assume_unique=True)
+        # negative sampling rides the framework RNG stream → reproducible
+        # under paddle.seed() like the reference op
+        perm = np.asarray(_jax.random.permutation(_rng.next_key(), len(neg)))
+        extra = neg[perm[: num_samples - len(pos)]]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remapped = np.searchsorted(sampled, lab)
+    return Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled))

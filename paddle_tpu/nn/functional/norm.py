@@ -181,3 +181,34 @@ def _lrn(x, size, alpha, beta, k):
     sq = jnp.pad(sq, pads)
     acc = sum(sq[:, i : i + c] for i in range(size))
     return x / jnp.power(k + alpha * acc, beta)
+
+
+@defop(name="spectral_norm_weight")
+def spectral_norm_weight(weight, u, dim=0, power_iters=1, eps=1e-12):
+    """Spectral normalization: weight / sigma_max(weight), sigma estimated by
+    power iteration warm-started from the persistent vector `u`.
+
+    Reference capability: ``paddle/phi/kernels/spectral_norm_kernel`` family
+    (exposed via ``python/paddle/nn/utils/spectral_norm_hook.py``). The
+    iteration runs under stop_gradient (gradients flow only through the
+    final `w / sigma`, the standard SN-GAN formulation). Returns
+    (normalized_weight, new_u).
+    """
+    import jax
+
+    nd = weight.ndim
+    dim = dim % nd
+    perm = (dim,) + tuple(i for i in range(nd) if i != dim)
+    mat = jnp.transpose(weight, perm).reshape(weight.shape[dim], -1)
+
+    def _l2(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    u_c = jax.lax.stop_gradient(jnp.asarray(u))
+    w_c = jax.lax.stop_gradient(mat)
+    v_c = None
+    for _ in range(max(int(power_iters), 1)):
+        v_c = _l2(w_c.T @ u_c)
+        u_c = _l2(w_c @ v_c)
+    sigma = jnp.einsum("i,ij,j->", u_c, mat, v_c)
+    return weight / sigma, u_c

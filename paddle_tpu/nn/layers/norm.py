@@ -175,6 +175,34 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12, dtype="float32"):
+    """paddle.nn.SpectralNorm parity: forward(weight) -> weight / sigma_max.
+
+    Power-iteration vector `u` persists as a buffer across calls
+    (reference: spectral_norm op + python/paddle/nn/layer/norm.py).
+    """
+
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm layer: planned (vision GAN parity)")
+        import numpy as np
+
+        from ...framework.core import Tensor as _T
+
+        self._axis = axis % len(weight_shape)
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = int(weight_shape[self._axis])
+        rng = np.random.default_rng(0)
+        u0 = rng.standard_normal(h).astype(dtype)
+        u0 /= np.linalg.norm(u0) + epsilon
+        self.register_buffer("weight_u", _T(jnp.asarray(u0)))
+
+    def forward(self, weight):
+        from ...framework.op import raw as _raw
+
+        w, new_u = F.spectral_norm_weight(
+            weight, self.weight_u, dim=self._axis,
+            power_iters=self._power_iters, eps=self._epsilon,
+        )
+        self.weight_u._rebind(_raw(new_u))
+        return w

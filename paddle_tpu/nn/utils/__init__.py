@@ -48,13 +48,132 @@ def vector_to_parameters(vec, parameters, name=None):
         offset += n
 
 
+# ---------------------------------------------------------------------------
+# weight_norm / spectral_norm reparameterizations.
+# Reference: python/paddle/nn/utils/weight_norm_hook.py and
+# spectral_norm_hook.py — the param is split (v, g) / (orig + power-iter
+# buffers) and the effective weight is recomputed by a forward pre-hook, so
+# the reparameterized weight participates in autograd every call.
+# ---------------------------------------------------------------------------
+
+
+def _norm_except_dim(v, dim):
+    # L2 norm reduced over every axis except `dim` (paddle semantics);
+    # dim=None → scalar full norm. Returned broadcastable against v.
+    nd = len(v.shape)
+    if dim is None:
+        axes = tuple(range(nd))
+    else:
+        dim = dim % nd
+        axes = tuple(i for i in range(nd) if i != dim)
+    sq = (v * v).sum(axis=list(axes), keepdim=True) if axes else v * v
+    return sq.sqrt()
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute_weight(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        return v * (g / _norm_except_dim(v, self.dim))
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute_weight(layer))
+
+
 def weight_norm(layer, name="weight", dim=0):
-    raise NotImplementedError("weight_norm: planned")
+    """Reparameterize `layer.<name>` as direction*magnitude (w = g * v/|v|)."""
+    from ..layer import Parameter
+
+    if getattr(layer, "_weight_norm_hooks", None) and name in layer._weight_norm_hooks:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    hook = _WeightNormHook(name, dim)
+    g0 = _norm_except_dim(w, dim)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", Parameter(raw(g0), trainable=w.trainable,
+                                               name=f"{name}_g"))
+    layer.add_parameter(name + "_v", Parameter(raw(w), trainable=w.trainable,
+                                               name=f"{name}_v"))
+    object.__setattr__(layer, name, hook.compute_weight(layer))
+    remover = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        object.__setattr__(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, remover)
+    return layer
 
 
 def remove_weight_norm(layer, name="weight"):
-    raise NotImplementedError("weight_norm: planned")
+    """Fold (g, v) back into a single plain parameter."""
+    from ..layer import Parameter
+
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    hook, remover = hooks.pop(name)
+    w = hook.compute_weight(layer)
+    remover.remove()
+    g = layer._parameters.pop(name + "_g")
+    del layer._parameters[name + "_v"]
+    object.__setattr__(layer, name + "_g", None)
+    object.__setattr__(layer, name + "_v", None)
+    layer.add_parameter(name, Parameter(raw(w), trainable=g.trainable, name=name))
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def compute_weight(self, layer):
+        from ..functional import spectral_norm_weight
+
+        orig = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        w, new_u = spectral_norm_weight(
+            orig, u, dim=self.dim, power_iters=self.n, eps=self.eps
+        )
+        u._rebind(raw(new_u))
+        return w
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute_weight(layer))
 
 
 def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
-    raise NotImplementedError("spectral_norm: planned")
+    """Reparameterize `layer.<name>` with its spectral norm divided out
+    (power iteration, persistent `u` buffer — GAN Lipschitz control)."""
+    import numpy as np
+
+    from ..layer import Parameter
+
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is None:
+        # paddle default: dim 1 for Linear-style [in, out], else 0
+        dim = 1 if type(layer).__name__ in ("Linear", "Embedding") else 0
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    h = w.shape[dim]
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype("float32")
+    u0 /= np.linalg.norm(u0) + eps
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(raw(w), trainable=w.trainable,
+                                                  name=f"{name}_orig"))
+    u = Tensor(jnp.asarray(u0))
+    layer.register_buffer(name + "_u", u)
+    object.__setattr__(layer, name, hook.compute_weight(layer))
+    remover = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        object.__setattr__(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, remover)
+    return layer

@@ -124,7 +124,177 @@ def static_parameters(program=None):
     return params
 
 
+# --------------------------------------------------------------------------
+# Control flow (reference: ``paddle/fluid/operators/controlflow/`` —
+# conditional_block_op, while_op, select/case).
+#
+# TPU-native guard semantics: when the predicate is CONCRETE (eager mode)
+# the chosen branch runs as plain Python — the autograd tape records through
+# it untouched. When the predicate is a TRACED value (inside jit/to_static),
+# the op lowers to the XLA-native structured control flow (`lax.cond`,
+# `lax.while_loop`, `lax.switch`) so data-dependent branching stays inside
+# ONE compiled program — the capability the reference's control-flow ops
+# provide to its static graph.
+# --------------------------------------------------------------------------
+
+def _is_tensor(x):
+    from ..framework.core import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _unwrap(tree):
+    import jax
+
+    from ..framework.op import raw
+
+    return jax.tree_util.tree_map(raw, tree, is_leaf=_is_tensor)
+
+
+def _wrap(tree):
+    import jax
+
+    from ..framework.core import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if hasattr(v, "dtype") else v, tree
+    )
+
+
+def _pred_value(pred):
+    from ..framework.core import is_tracer_value
+    from ..framework.op import raw
+
+    p = raw(pred)
+    return p, is_tracer_value(p)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond parity (conditional_block_op capability).
+
+    Eager predicate: runs the taken branch in Python (tape-recorded).
+    Traced predicate: lowers to ``lax.cond`` — both branches trace, outputs
+    must match in structure/shape/dtype (same contract as the reference's
+    static-graph cond).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    p, traced = _pred_value(pred)
+    if not traced:
+        taken = true_fn if bool(jnp.asarray(p).reshape(())) else false_fn
+        return taken() if taken is not None else None
+
+    def branch(fn):
+        def inner(_):
+            return _unwrap(fn() if fn is not None else ())
+
+        return inner
+
+    out = jax.lax.cond(
+        jnp.asarray(p).reshape(()).astype(bool), branch(true_fn),
+        branch(false_fn), 0,
+    )
+    return _wrap(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case parity: first true predicate wins."""
+    import functools as _ft
+
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    chain = default
+    for p, fn in reversed(list(pred_fn_pairs)):
+        chain = _ft.partial(cond, p, fn, chain)
+    return chain() if callable(chain) else chain
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case parity (select-based dispatch).
+
+    `branch_fns` is a dict {int: fn} or list of (int, fn) / fns. Traced
+    index lowers to ``lax.switch``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    idx, traced = _pred_value(branch_index)
+    if not traced:
+        i = int(jnp.asarray(idx).reshape(()))
+        return dict(items).get(i, default)()
+
+    # map the sparse branch keys onto a dense lax.switch table; unmatched
+    # indices hit the default in the final slot
+    table = fns + [default]
+    key_arr = jnp.asarray(keys, jnp.int32)
+    dense = jnp.where(
+        key_arr == jnp.asarray(idx, jnp.int32).reshape(()),
+        jnp.arange(len(keys), dtype=jnp.int32),
+        len(table) - 1,
+    ).min()
+
+    out = jax.lax.switch(dense, [lambda _, f=f: _unwrap(f()) for f in table], 0)
+    return _wrap(out)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop parity (while_op capability).
+
+    Eager loop state: a plain Python while (tape-recorded, fully
+    differentiable). Traced loop state: lowers to ``lax.while_loop`` —
+    compiled, but like XLA itself, not reverse-mode differentiable; use a
+    bounded loop + cond for training-time control flow.
+    """
+    import jax
+
+    from ..framework.core import is_tracer_value
+
+    loop_vars = list(loop_vars) if isinstance(loop_vars, (list, tuple)) else [loop_vars]
+    flat0 = _unwrap(loop_vars)
+    traced = any(
+        is_tracer_value(l) for l in jax.tree_util.tree_leaves(flat0)
+    )
+    if not traced:
+        # probe the predicate once; if it is concrete we can stay eager
+        c0 = cond_fn(*loop_vars)
+        p, p_traced = _pred_value(c0)
+        if not p_traced:
+            vars_ = loop_vars
+            import jax.numpy as jnp
+
+            while bool(jnp.asarray(_unwrap(cond_fn(*vars_))).reshape(())):
+                out = body(*vars_)
+                vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+            return vars_
+
+    def lax_cond(carry):
+        import jax.numpy as jnp
+
+        return jnp.asarray(_unwrap(cond_fn(*_wrap(list(carry))))).reshape(())
+
+    def lax_body(carry):
+        out = body(*_wrap(list(carry)))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(_unwrap(out))
+
+    final = jax.lax.while_loop(lax_cond, lax_body, tuple(flat0))
+    return _wrap(list(final))
+
+
 __all__ = [
     "fc", "conv2d", "conv2d_transpose", "batch_norm", "layer_norm",
     "embedding", "static_parameters",
+    "cond", "case", "switch_case", "while_loop",
 ]

@@ -166,6 +166,11 @@ def pinv(x, rcond=1e-15, hermitian=False, name=None):
     return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
 
 
+def pinverse(x, rcond=1e-15, name=None):
+    """Alias of pinv (torch-style name, probed by migration scripts)."""
+    return pinv(x, rcond=rcond)
+
+
 @defop
 def solve(x, y, name=None):
     return jnp.linalg.solve(x, y)

@@ -20,3 +20,27 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run tests marked slow (full vision-zoo compile sweep)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: XLA-compile-heavy tests skipped by default "
+        "(run with --runslow)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="compile-heavy; use --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,146 @@
+"""Data-dependent control flow in captured programs (SURVEY.md §7
+hard-part #1; reference: ``paddle/fluid/operators/controlflow/``).
+
+Three layers of behavior under test:
+  1. eager: cond/while_loop/switch_case run as plain Python, tape intact;
+  2. traced (to_static / jit): they lower to lax.cond / lax.while_loop /
+     lax.switch — data-dependent branching inside ONE compiled program;
+  3. guard fallback: a host sync (.numpy(), `if tensor:`) during tracing
+     makes to_static fall back to eager with a warning, not an error.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.static import nn as static_nn
+
+
+def test_cond_eager_and_tape():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    out = static_nn.cond(
+        paddle.to_tensor(True),
+        lambda: x * 3.0,
+        lambda: x * 5.0,
+    )
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_cond_traced_under_to_static():
+    calls = {"n": 0}
+
+    @jit.to_static
+    def f(x):
+        calls["n"] += 1
+        pred = (x.sum() > 0.0)
+        return static_nn.cond(pred, lambda: x * 2.0, lambda: x - 1.0)
+
+    xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(xp).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+    # ONE trace served both branches: the predicate is inside the program
+    assert calls["n"] == 1
+
+
+def test_while_loop_traced():
+    @jit.to_static
+    def f(x):
+        i = paddle.to_tensor(np.int32(0))
+        i, x = static_nn.while_loop(
+            lambda i, x: i < 3,
+            lambda i, x: (i + 1, x * 2.0),
+            [i, x],
+        )
+        return x
+
+    x = paddle.to_tensor(np.array([1.0, 1.5], np.float32))
+    np.testing.assert_allclose(f(x).numpy(), [8.0, 12.0])
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.int32(0))
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    i2, x2 = static_nn.while_loop(
+        lambda i, x: i < 4,
+        lambda i, x: (i + 1, x * 3.0),
+        [i, x],
+    )
+    np.testing.assert_allclose(x2.numpy(), [81.0])
+    # eager loop is tape-recorded end to end
+    x2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [81.0])
+
+
+def test_switch_case_traced():
+    @jit.to_static
+    def f(idx, x):
+        return static_nn.switch_case(
+            idx,
+            {0: lambda: x + 1.0, 2: lambda: x * 10.0},
+            default=lambda: x * 0.0,
+        )
+
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.int32(0)), x).numpy(), [4.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.int32(2)), x).numpy(), [30.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.int32(7)), x).numpy(), [0.0])
+
+
+def test_case_first_true_wins():
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    out = static_nn.case(
+        [
+            (paddle.to_tensor(False), lambda: x * 2.0),
+            (paddle.to_tensor(True), lambda: x * 3.0),
+            (paddle.to_tensor(True), lambda: x * 4.0),
+        ],
+        default=lambda: x,
+    )
+    np.testing.assert_allclose(out.numpy(), [3.0])
+
+
+def test_numpy_sync_falls_back_to_eager():
+    @jit.to_static
+    def f(x):
+        if float(x.sum().numpy()) > 0:  # host sync inside the trace
+            return x * 2.0
+        return x - 1.0
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = f(x)
+        assert any("falling back to EAGER" in str(i.message) for i in w)
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+    # both branches now work (python control flow, eager)
+    xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+
+
+def test_cond_grad_through_traced_program():
+    """Gradients flow through lax.cond inside a compiled train step."""
+    import jax
+
+    from paddle_tpu.framework.op import raw
+
+    def loss_fn(x):
+        pred = x.sum() > 0.0
+        out = static_nn.cond(pred, lambda: (x * x).sum(), lambda: x.sum())
+        return raw(out)
+
+    g = jax.grad(lambda v: loss_fn(paddle.to_tensor(v)))(
+        np.array([1.0, 2.0], np.float32)
+    )
+    np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+    g2 = jax.grad(lambda v: loss_fn(paddle.to_tensor(v)))(
+        np.array([-1.0, -2.0], np.float32)
+    )
+    np.testing.assert_allclose(np.asarray(g2), [1.0, 1.0])

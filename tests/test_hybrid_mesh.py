@@ -1,0 +1,92 @@
+"""Multi-slice / DCN-aware mesh construction (SURVEY.md §2.3 "Hybrid
+topology": ICI-aware axis assignment; reference: fleet/base/topology.py's
+comm-locality axis ordering).
+
+Simulated 2-slice topology on the 8-device CPU mesh: devices 0-3 are
+"slice 0", 4-7 "slice 1" (contiguous split override). Asserts the axis →
+device layout: only DCN-capable axes (dp, then pp, then sharding) span
+slices; mp/sep groups always stay inside one slice.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.distributed import mesh as M
+
+
+def _dev_id(d):
+    return d.id
+
+
+def _slice_of(did, n_slices=2, n_dev=8):
+    return did // (n_dev // n_slices)
+
+
+def test_single_slice_plain():
+    m = M.build_hybrid_mesh([2, 1, 1, 1, 4], M.HYBRID_AXES)
+    assert dict(m.shape) == {"dp": 2, "pp": 1, "sharding": 1, "sep": 1, "mp": 4}
+
+
+def test_two_slice_dp_spans_dcn():
+    m = M.build_hybrid_mesh([2, 1, 1, 1, 4], M.HYBRID_AXES, num_slices=2)
+    arr = np.vectorize(_dev_id)(m.devices)
+    # dp index 0 -> slice 0 devices, dp index 1 -> slice 1 devices
+    assert {_slice_of(i) for i in arr[0].ravel()} == {0}
+    assert {_slice_of(i) for i in arr[1].ravel()} == {1}
+    # each mp group (fixed dp) lives inside ONE slice
+    for dp in range(2):
+        row = arr[dp, 0, 0, 0, :]
+        assert len({_slice_of(i) for i in row}) == 1
+
+
+def test_two_slice_prefers_dp_over_pp():
+    # dp=2 can absorb both slices; pp stays intra-slice
+    m = M.build_hybrid_mesh([2, 2, 1, 1, 2], M.HYBRID_AXES, num_slices=2)
+    arr = np.vectorize(_dev_id)(m.devices)
+    for dp in range(2):
+        sub = arr[dp].ravel()
+        assert len({_slice_of(i) for i in sub}) == 1, (
+            "pp/mp must not cross slices when dp can absorb the DCN dim")
+
+
+def test_two_slice_pp_absorbs_when_dp_is_1():
+    m = M.build_hybrid_mesh([1, 2, 1, 1, 4], M.HYBRID_AXES, num_slices=2)
+    arr = np.vectorize(_dev_id)(m.devices)
+    assert {_slice_of(i) for i in arr[0, 0].ravel()} == {0}
+    assert {_slice_of(i) for i in arr[0, 1].ravel()} == {1}
+
+
+def test_four_slice_factors_across_dp_and_pp():
+    m = M.build_hybrid_mesh([2, 2, 1, 1, 2], M.HYBRID_AXES, num_slices=4)
+    arr = np.vectorize(_dev_id)(m.devices)
+    # every (dp, pp) coordinate pins one slice; mp never crosses
+    for dp in range(2):
+        for pp in range(2):
+            sub = arr[dp, pp].ravel()
+            assert len({_slice_of(i, 4) for i in sub}) == 1
+
+
+def test_mp_cannot_span_dcn():
+    with pytest.raises(ValueError, match="DCN-capable"):
+        M.build_hybrid_mesh([1, 1, 1, 1, 8], M.HYBRID_AXES, num_slices=2)
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NUM_SLICES", "2")
+    m = M.build_hybrid_mesh([2, 1, 1, 1, 4], M.HYBRID_AXES)
+    arr = np.vectorize(_dev_id)(m.devices)
+    assert {_slice_of(i) for i in arr[0].ravel()} == {0}
+
+
+def test_fleet_init_uses_hybrid_mesh(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NUM_SLICES", "2")
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(dp_degree=2, mp_degree=4)
+    fleet.init(is_collective=True, strategy=s)
+    m = M.get_global_mesh()
+    arr = np.vectorize(_dev_id)(m.devices)
+    assert {_slice_of(i) for i in arr[0].ravel()} == {0}
+    assert {_slice_of(i) for i in arr[1].ravel()} == {1}

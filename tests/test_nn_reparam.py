@@ -1,0 +1,100 @@
+"""weight_norm / spectral_norm reparameterizations + class_center_sample
+(reference: python/paddle/nn/utils/weight_norm_hook.py, spectral_norm_hook.py,
+phi class_center_sample kernel)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.utils import remove_weight_norm, spectral_norm, weight_norm
+
+
+def test_weight_norm_forward_matches_plain():
+    paddle.seed(0)
+    lin = nn.Linear(6, 4)
+    w0 = lin.weight.numpy().copy()
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((3, 6)).astype("float32"))
+    y0 = lin(x).numpy()
+    weight_norm(lin, dim=0)
+    names = {n for n, _ in lin.named_parameters()}
+    assert "weight_g" in names and "weight_v" in names and "weight" not in names
+    y1 = lin(x).numpy()
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-6)
+    # g/v recompose to the original weight
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5, atol=1e-6)
+
+
+def test_weight_norm_trains_g_and_v():
+    paddle.seed(1)
+    lin = nn.Linear(4, 4)
+    weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal((8, 4)).astype("float32"))
+    y = paddle.to_tensor(np.random.default_rng(2).standard_normal((8, 4)).astype("float32"))
+    g0 = lin.weight_g.numpy().copy()
+    v0 = lin.weight_v.numpy().copy()
+    loss = nn.MSELoss()(lin(x), y)
+    loss.backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+    opt.step()
+    assert not np.allclose(lin.weight_g.numpy(), g0)
+    assert not np.allclose(lin.weight_v.numpy(), v0)
+
+
+def test_remove_weight_norm_roundtrip():
+    paddle.seed(2)
+    lin = nn.Linear(5, 3)
+    x = paddle.to_tensor(np.random.default_rng(3).standard_normal((2, 5)).astype("float32"))
+    y0 = lin(x).numpy()
+    weight_norm(lin, dim=1)
+    remove_weight_norm(lin)
+    names = {n for n, _ in lin.named_parameters()}
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(lin(x).numpy(), y0, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(3)
+    lin = nn.Linear(8, 8)
+    # inflate the weight so sigma >> 1
+    lin.weight._rebind(lin.weight._value * 10.0)
+    spectral_norm(lin, n_power_iterations=5)
+    x = paddle.to_tensor(np.eye(8, dtype="float32"))
+    lin(x)  # pre-hook recomputes weight
+    w = lin.weight.numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05, f"spectral norm {sigma} not ~1"
+    # training: gradient reaches weight_orig
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    assert lin.weight_orig.grad is not None
+
+
+def test_spectral_norm_layer():
+    sn = nn.SpectralNorm([4, 6], axis=0, power_iters=10)
+    w = paddle.to_tensor(
+        (np.random.default_rng(5).standard_normal((4, 6)) * 3).astype("float32")
+    )
+    out = sn(w)
+    sigma = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05
+    # u buffer persists (warm start)
+    u1 = sn.weight_u.numpy().copy()
+    sn(w)
+    assert not np.allclose(u1, 0)
+
+
+def test_class_center_sample():
+    label = paddle.to_tensor(np.array([3, 1, 3, 7], np.int64))
+    remapped, sampled = F.class_center_sample(label, 10, 6)
+    s = sampled.numpy()
+    assert len(s) == 6 and set([1, 3, 7]) <= set(s.tolist())
+    assert (np.sort(s) == s).all()
+    np.testing.assert_array_equal(s[remapped.numpy()], label.numpy())
+
+
+def test_pinverse():
+    a = np.random.default_rng(6).standard_normal((4, 3)).astype("float32")
+    out = paddle.pinverse(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(out, np.linalg.pinv(a), rtol=1e-4, atol=1e-5)
