@@ -1,0 +1,153 @@
+// Native-runtime smoke test, intended to run under ASAN/TSAN/UBSAN
+// (`make asan` / `make tsan` — SURVEY.md §5 "Race detection/sanitizers":
+// the reference wires SANITIZER_TYPE through its CMake; here the sanitizer
+// matrix covers the only hand-written native code in the framework).
+//
+// Exercises, concurrently where it matters:
+//   * arena: multithreaded alloc/free with coalescing, stats invariants
+//   * pt_stack: parallel batch stacking vs a serial reference
+//   * tracer: concurrent record + export
+//   * TCPStore: server + N client threads doing set/get/add/wait
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* pt_arena_create(uint64_t chunk_size);
+void pt_arena_destroy(void* a);
+void* pt_arena_alloc(void* a, uint64_t n);
+void pt_arena_free(void* a, void* p);
+void pt_arena_stats(void* a, uint64_t out[4]);
+void pt_stack(void* dst, void* const* srcs, int64_t n,
+              uint64_t bytes_per_sample, int nthreads);
+void pt_trace_start();
+void pt_trace_stop();
+void pt_trace_record(const char* name, const char* cat, int64_t ts_ns,
+                     int64_t dur_ns, int64_t tid);
+int64_t pt_trace_count();
+int64_t pt_trace_export(char* out, int64_t cap);
+void* pt_store_create(const char* host, int port, int is_master,
+                      int world_size, double timeout_s);
+int pt_store_port(void* sp);
+void pt_store_destroy(void* sp);
+int pt_store_set(void* sp, const char* key, const void* val, int64_t len);
+int64_t pt_store_get(void* sp, const char* key, void* out, int64_t cap,
+                     double timeout_s);
+int64_t pt_store_add(void* sp, const char* key, int64_t delta);
+int pt_store_wait(void* sp, const char* key, double timeout_s);
+}
+
+static void test_arena() {
+  void* a = pt_arena_create(1 << 20);
+  const int kThreads = 4, kIters = 2000;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([a, t] {
+      std::vector<void*> live;
+      for (int i = 0; i < kIters; ++i) {
+        size_t n = 64 + ((t * 1315423911u + i * 2654435761u) % 4096);
+        void* p = pt_arena_alloc(a, n);
+        assert(p);
+        memset(p, t, n);  // ASAN: must be writable, non-overlapping
+        live.push_back(p);
+        if (live.size() > 32) {
+          pt_arena_free(a, live.front());
+          live.erase(live.begin());
+        }
+      }
+      for (void* p : live) pt_arena_free(a, p);
+    });
+  }
+  for (auto& th : ts) th.join();
+  uint64_t st[4];  // {allocated, reserved, peak, alloc_count}
+  pt_arena_stats(a, st);
+  assert(st[0] == 0 && "all blocks freed => allocated == 0");
+  assert(st[3] == (uint64_t)kThreads * kIters);
+  pt_arena_destroy(a);
+  printf("arena ok\n");
+}
+
+static void test_stack() {
+  const int64_t n = 64;
+  const uint64_t bytes = 64 * 1024;  // > 1MB total => parallel path
+  std::vector<std::vector<char>> samples(n, std::vector<char>(bytes));
+  std::vector<void*> srcs(n);
+  for (int64_t i = 0; i < n; ++i) {
+    memset(samples[i].data(), static_cast<int>(i), bytes);
+    srcs[i] = samples[i].data();
+  }
+  std::vector<char> dst(n * bytes), ref(n * bytes);
+  for (int64_t i = 0; i < n; ++i)
+    memcpy(ref.data() + i * bytes, srcs[i], bytes);
+  pt_stack(dst.data(), srcs.data(), n, bytes, 4);
+  assert(memcmp(dst.data(), ref.data(), dst.size()) == 0);
+  printf("stack ok\n");
+}
+
+static void test_tracer() {
+  pt_trace_start();
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([t] {
+      for (int i = 0; i < 500; ++i)
+        pt_trace_record("ev", "cat", 1000 + i, 10, t);
+    });
+  }
+  for (auto& th : ts) th.join();
+  assert(pt_trace_count() == 2000);
+  std::string out(1 << 20, '\0');
+  int64_t len = pt_trace_export(out.data(), (int64_t)out.size());
+  assert(len > 0);
+  pt_trace_stop();
+  printf("tracer ok\n");
+}
+
+static void test_store() {
+  void* server = pt_store_create("127.0.0.1", 0, /*is_master=*/1,
+                                 /*world_size=*/1, 10.0);
+  assert(server);
+  int port = pt_store_port(server);
+  assert(port > 0);
+  const int kClients = 4;
+  std::vector<std::thread> ts;
+  for (int c = 0; c < kClients; ++c) {
+    ts.emplace_back([port, c] {
+      void* cli = pt_store_create("127.0.0.1", port, 0, 1, 10.0);
+      assert(cli);
+      std::string key = "k" + std::to_string(c);
+      std::string val = "v" + std::to_string(c);
+      assert(pt_store_set(cli, key.c_str(), val.data(),
+                          (int64_t)val.size()) == 0);
+      char buf[64];
+      int64_t n = pt_store_get(cli, key.c_str(), buf, sizeof(buf), 5.0);
+      assert(n == (int64_t)val.size() && memcmp(buf, val.data(), n) == 0);
+      for (int i = 0; i < 50; ++i) pt_store_add(cli, "ctr", 1);
+      pt_store_destroy(cli);
+    });
+  }
+  for (auto& th : ts) th.join();
+  void* cli = pt_store_create("127.0.0.1", port, 0, 1, 10.0);
+  char buf[64];
+  assert(pt_store_wait(cli, "ctr", 5.0) == 1);  // 1 = key present
+  int64_t n = pt_store_get(cli, "ctr", buf, sizeof(buf), 5.0);
+  assert(n == 8);  // counters are int64 payloads
+  int64_t v;
+  memcpy(&v, buf, 8);
+  assert(v == kClients * 50);
+  pt_store_destroy(cli);
+  pt_store_destroy(server);
+  printf("store ok\n");
+}
+
+int main() {
+  test_arena();
+  test_stack();
+  test_tracer();
+  test_store();
+  printf("RT_TEST PASS\n");
+  return 0;
+}

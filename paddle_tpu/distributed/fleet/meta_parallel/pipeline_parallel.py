@@ -68,6 +68,8 @@ class SharedLayerDesc(LayerDesc):
 def _param_sig(layer: Layer):
     return tuple(
         (n, tuple(raw(p).shape), str(raw(p).dtype)) for n, p in layer.named_parameters()
+    ) + tuple(
+        (n, tuple(raw(b).shape), str(raw(b).dtype)) for n, b in layer.named_buffers()
     )
 
 
@@ -118,39 +120,65 @@ class SpmdPipeline(Layer):
         # template block is NOT a registered sublayer (its params are absorbed
         # into the stacked ones); hide it from Layer.__setattr__.
         self._template_holder = [blocks[0]]
+
+        def stack_leaves(list_fn):
+            """Stack each (name, leaf) of the template across all blocks in
+            interleaved `order` along a new leading layer dim."""
+            per_block = [[raw(v) for _, v in list_fn(b)] for b in blocks]
+            out = []
+            for i, (n, tmpl_leaf) in enumerate(list_fn(blocks[0])):
+                stacked = jnp.stack(
+                    [per_block[l][i] for l in order], axis=0
+                )
+                out.append((n, tmpl_leaf, stacked))
+            return out
+
         self._tparams = [p for _, p in blocks[0].named_parameters()]
-        names = [n for n, _ in blocks[0].named_parameters()]
         self._stacked: List[Parameter] = []
-        for i, (n, tp) in enumerate(zip(names, self._tparams)):
-            vals = [raw([q for _, q in b.named_parameters()][i]) for b in blocks]
-            stacked = jnp.stack([vals[l] for l in order], axis=0)
+        for n, tp, stacked in stack_leaves(lambda b: list(b.named_parameters())):
             sp = Parameter(stacked, trainable=tp.trainable, name=f"stacked_{n}")
             base_spec = list(getattr(tp, "dist_spec", None) or P())
             base_spec += [None] * (stacked.ndim - 1 - len(base_spec))
             sp.dist_spec = P("pp", *base_spec)
             self.add_parameter(n.replace(".", "__"), sp)
             self._stacked.append(sp)
-        # buffers must be stage-invariant (none in standard transformer blocks)
-        if list(blocks[0].named_buffers()):
-            raise ValueError("SpmdPipeline blocks with buffers are not supported")
+        # read-only buffers (rotary caches, masks, ...) stack like params;
+        # buffer MUTATION inside pipelined blocks (train-mode batchnorm) is
+        # not supported — the schedule compiles the blocks functionally
+        self._tbuffers = [b for _, b in blocks[0].named_buffers()]
+        self._stacked_bufs: List[Tensor] = []
+        for n, _, stacked in stack_leaves(lambda b: list(b.named_buffers())):
+            sb = Tensor(stacked)
+            sb.dist_spec = P("pp", *([None] * (stacked.ndim - 1)))
+            self.register_buffer(n.replace(".", "__") + "_stacked", sb)
+            self._stacked_bufs.append(sb)
 
     # -- functional application of the template with given leaf values -------
     def _apply_block(self, leaf_vals, x):
         tmpl = self._template_holder[0]
+        nb = len(self._tbuffers)
+        p_vals = leaf_vals[: len(leaf_vals) - nb] if nb else leaf_vals
+        b_vals = leaf_vals[len(leaf_vals) - nb:] if nb else ()
         originals = [p._value for p in self._tparams]
+        orig_bufs = [b._value for b in self._tbuffers]
         try:
-            for p, v in zip(self._tparams, leaf_vals):
+            for p, v in zip(self._tparams, p_vals):
                 p._value = v
+            for b, v in zip(self._tbuffers, b_vals):
+                b._value = v
             out = tmpl(Tensor(x))
             return raw(out)
         finally:
             for p, v in zip(self._tparams, originals):
                 p._value = v
+            for b, v in zip(self._tbuffers, orig_bufs):
+                b._value = v
 
     def forward(self, x):
         return _pipeline_forward(
             raw(x) if isinstance(x, Tensor) else x,
             *[p for p in self._stacked],
+            *[b for b in self._stacked_bufs],
             pipe=self,
         )
 

@@ -25,6 +25,21 @@ from .core import Tensor, TapeNode, is_grad_enabled, is_tracer_value
 
 OP_REGISTRY: Dict[str, Callable] = {}
 
+# Static-graph capture (reference: op recording into ProgramDesc under
+# enable_static — SURVEY.md §2.1 "Legacy framework"). When a
+# paddle_tpu.static.Program build is active (program_guard), every defop
+# call also appends a replayable record to it; Executor.run later replays
+# the list as ONE jit-compiled program with feeds substituted. `None` when
+# no capture is active — a single attribute check on the eager hot path.
+_capture_program = None
+
+
+def set_capture_program(prog):
+    global _capture_program
+    prev = _capture_program
+    _capture_program = prog
+    return prev
+
 # AMP op lists (mirrors the reference's white/black lists in
 # ``python/paddle/amp/amp_lists.py``): "white" ops run in the low-precision
 # dtype (MXU-bound: matmul/conv), "black" ops are kept in float32 for
@@ -126,7 +141,12 @@ def defop(fn=None, *, name: Optional[str] = None, amp: Optional[str] = None):
             if not record:
                 a, k = jax.tree_util.tree_unflatten(treedef, vals)
                 out = f(*a, **k)
-                return _wrap_outputs(out, node=None, any_tracer=any_tracer)
+                res = _wrap_outputs(out, node=None, any_tracer=any_tracer)
+                if _capture_program is not None and not any_tracer:
+                    _record_capture(
+                        _capture_program, f, treedef, leaves, vals, res
+                    )
+                return res
 
             const_vals = list(vals)
 
@@ -141,7 +161,10 @@ def defop(fn=None, *, name: Optional[str] = None, amp: Optional[str] = None):
             out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
             metas = [(tuple(o.shape), o.dtype) for o in out_leaves]
             node = TapeNode(opname, vjp_fn, tuple(diff_tensors), metas, out_treedef)
-            return _wrap_outputs(out, node=node, any_tracer=False)
+            res = _wrap_outputs(out, node=node, any_tracer=False)
+            if _capture_program is not None:
+                _record_capture(_capture_program, f, treedef, leaves, vals, res)
+            return res
 
         wrapper.op_name = opname
         wrapper.raw_fn = f
@@ -169,6 +192,36 @@ def _wrap_outputs(out, node, any_tracer):
         node.out_uids = tuple(uids)
     res = jax.tree_util.tree_unflatten(out_treedef, wrapped)
     return res
+
+
+def _record_capture(prog, f, treedef, leaves, vals, res):
+    """Append one replayable op record to the active static Program.
+
+    Tensor inputs are recorded by uid (resolved at replay time to the fed
+    value, an earlier op's output, or the tensor's CURRENT live value — so
+    parameters update without re-capturing); everything else is a constant.
+    The dtype each tensor leaf was actually fed to the kernel with (i.e.
+    AFTER the AMP cast in the wrapper) is recorded so replay reproduces
+    auto_cast behavior exactly.
+    """
+    import weakref
+
+    descs = []
+    for leaf, v in zip(leaves, vals):
+        if isinstance(leaf, Tensor):
+            descs.append(("t", leaf._uid, str(v.dtype)))
+            prog._tensor_refs[leaf._uid] = weakref.ref(leaf)
+        else:
+            descs.append(("c", leaf))
+    out_leaves = jax.tree_util.tree_leaves(res, is_leaf=_is_tensor_leaf)
+    out_uids = []
+    for o in out_leaves:
+        if isinstance(o, Tensor):
+            out_uids.append(o._uid)
+            prog._tensor_refs[o._uid] = weakref.ref(o)  # name-based fetch
+        else:
+            out_uids.append(None)
+    prog._ops.append((f, treedef, tuple(descs), tuple(out_uids)))
 
 
 def raw(x):

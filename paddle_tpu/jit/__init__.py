@@ -95,6 +95,13 @@ class TracedLayer:
         return tensors, is_buffer
 
     def __call__(self, *args, **kwargs):
+        from ..framework import op as _op
+
+        if _op._capture_program is not None:
+            # static Program capture is active: run eagerly so this
+            # callable's ops are recorded (a jit trace would freeze its
+            # output as a capture-time constant)
+            return self._fn(*args, **kwargs)
         if self._eager_fallback:
             return self._fn(*args, **kwargs)
         try:

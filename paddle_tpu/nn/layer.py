@@ -137,6 +137,8 @@ class Layer:
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        elif tensor is not None:
+            tensor.persistable = True  # buffers are state, not activations
         object.__setattr__(self, name, tensor)
         return tensor
 

@@ -22,18 +22,30 @@ from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
 
 
 class Program:
-    """A recorded computation: ops are captured by running the build function
-    lazily at first Executor.run (trace-on-first-use, like InterpreterCore's
-    first-run instruction build — SURVEY.md §3.4)."""
+    """A recorded computation — the ProgramDesc equivalent.
+
+    Ops executed while this program is active (inside ``program_guard``)
+    are captured by the defop dispatch gateway as replayable records
+    (reference: op recording into ProgramDesc under static mode —
+    SURVEY.md §2.1 "Legacy framework", §3.4 InterpreterCore). Executor.run
+    replays the op list as ONE jit-compiled XLA program with feeds bound
+    to their placeholders and parameters passed by live value.
+    """
 
     def __init__(self):
-        self._build_fns = []  # callables invoked with feeds
+        self._ops = []  # (f, in_treedef, input_descs, out_uids) records
+        self._tensor_refs: Dict[int, Any] = {}  # uid -> weakref(Tensor)
         self._feed_specs: Dict[str, InputSpec] = {}
+        self._feed_uids: Dict[str, int] = {}
         self._fetch: List[Tensor] = []
+        self._exec_cache: Dict[Any, Any] = {}
         self.random_seed = None
 
     def global_block(self):
         return self
+
+    def num_ops(self):
+        return len(self._ops)
 
     def clone(self, for_test=False):
         import copy
@@ -55,14 +67,18 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
+    from ..framework import op as _op
+
     global _default_main, _default_startup
     prev_m, prev_s = _default_main, _default_startup
     _default_main = main_program
     if startup_program is not None:
         _default_startup = startup_program
+    prev_cap = _op.set_capture_program(main_program)
     try:
         yield
     finally:
+        _op.set_capture_program(prev_cap)
         _default_main, _default_startup = prev_m, prev_s
 
 
@@ -76,29 +92,183 @@ def data(name, shape, dtype="float32", lod_level=0):
     t = Tensor(jnp.zeros(spec_shape, convert_dtype(dtype)))
     t.name = name
     _default_main._feed_specs[name] = InputSpec(shape, dtype, name)
+    _default_main._feed_uids[name] = t._uid
     return t
 
 
 class Executor:
-    """Eager-executing Executor: feeds are bound to placeholder names and the
-    model functions re-run; for compiled execution use paddle_tpu.jit."""
+    """Replays a captured Program as one jit-compiled XLA program
+    (the StandaloneExecutor/InterpreterCore role — SURVEY.md §3.4): first
+    run per (feed-signature, fetch-set) compiles; steady state is a single
+    cached executable call. Parameters enter by live value, so updates
+    between runs are honored without re-capture."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        import jax
+        import jax.numpy as jnp
+
         feed = feed or {}
-        results = []
-        for f in fetch_list or []:
-            if callable(f):
-                out = f(**feed)
+        program = program or _default_main
+        fetch_list = list(fetch_list or [])
+
+        # legacy path: callables (or no captured ops) execute eagerly
+        if not getattr(program, "_ops", None) or any(
+            callable(f) and not isinstance(f, Tensor) for f in fetch_list
+        ):
+            results = []
+            for f in fetch_list:
+                out = f(**feed) if callable(f) else f
+                if isinstance(out, Tensor):
+                    results.append(np.asarray(raw(out)) if return_numpy else out)
+                else:
+                    results.append(out)
+            return results
+
+        import weakref
+
+        fetch_uids = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                program._tensor_refs.setdefault(f._uid, weakref.ref(f))
+                fetch_uids.append(f._uid)
+            elif isinstance(f, str):
+                fetch_uids.append(self._resolve_name(program, f))
             else:
-                out = f
-            if isinstance(out, Tensor):
-                results.append(np.asarray(raw(out)) if return_numpy else out)
-            else:
-                results.append(out)
-        return results
+                raise TypeError(
+                    f"fetch_list entries must be Tensors, names, or "
+                    f"callables; got {type(f).__name__}"
+                )
+        fetch_uids = tuple(fetch_uids)
+        feed_names = tuple(sorted(feed))
+        feed_vals = [jnp.asarray(raw(feed[n])) for n in feed_names]
+        key = (
+            fetch_uids, feed_names,
+            tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
+        )
+        entry = program._exec_cache.get(key)
+        if entry is None:
+            entry = self._compile(program, feed_names, fetch_uids)
+            program._exec_cache[key] = entry
+        jitted, ext_uids = entry
+        ext_vals = [self._live_value(program, u) for u in ext_uids]
+        outs = jitted(feed_vals, ext_vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    @staticmethod
+    def _resolve_name(program, name):
+        for uid, ref in program._tensor_refs.items():
+            t = ref()
+            if t is not None and t.name == name:
+                return uid
+        raise ValueError(
+            f"fetch name {name!r} does not match any tensor captured by "
+            "this Program"
+        )
+
+    @staticmethod
+    def _live_value(program, uid):
+        ref = program._tensor_refs.get(uid)
+        t = ref() if ref is not None else None
+        if t is None:
+            raise RuntimeError(
+                f"static Program references tensor uid={uid} that no longer "
+                "exists (was it created outside the program and deleted?)"
+            )
+        return t._value
+
+    def _compile(self, program, feed_names, fetch_uids):
+        import jax
+
+        feed_uid_list = []
+        for n in feed_names:
+            uid = program._feed_uids.get(n)
+            if uid is None:
+                raise KeyError(
+                    f"feed {n!r} does not name a paddle.static.data "
+                    f"placeholder of this Program (have "
+                    f"{sorted(program._feed_uids)})"
+                )
+            feed_uid_list.append(uid)
+        produced = set()
+        ext_uids = []
+        seen_ext = set()
+        placeholder_uids = {u: n for n, u in program._feed_uids.items()}
+
+        def classify_ext(uid):
+            if uid in produced or uid in feed_uid_list or uid in seen_ext:
+                return
+            if uid in placeholder_uids:
+                raise KeyError(
+                    f"program uses placeholder "
+                    f"{placeholder_uids[uid]!r} but it was not fed"
+                )
+            seen_ext.add(uid)
+            ext_uids.append(uid)
+
+        for _, _, descs, out_uids in program._ops:
+            for d in descs:
+                if d[0] == "t":
+                    classify_ext(d[1])
+            produced.update(u for u in out_uids if u is not None)
+        # fetches that no captured op produced (e.g. a tape gradient) enter
+        # as live external values too — with the frozen-value warning below
+        for u in fetch_uids:
+            classify_ext(u)
+        self._warn_frozen_externals(program, ext_uids)
+        ops = list(program._ops)
+
+        def replay(feed_vals, ext_vals):
+            env = dict(zip(feed_uid_list, feed_vals))
+            env.update(zip(ext_uids, ext_vals))
+
+            for f, treedef, descs, out_uids in ops:
+                rebuilt = [
+                    # .astype: the dtype the op actually saw at capture
+                    # (reproduces the wrapper's AMP cast under auto_cast)
+                    env[d[1]].astype(d[2]) if d[0] == "t" else d[1]
+                    for d in descs
+                ]
+                a, k = jax.tree_util.tree_unflatten(treedef, rebuilt)
+                out = f(*a, **k)
+                for uid, ov in zip(
+                    out_uids, jax.tree_util.tree_leaves(out)
+                ):
+                    if uid is not None:
+                        env[uid] = ov
+            return [env[u] for u in fetch_uids]
+
+        return jax.jit(replay), tuple(ext_uids)
+
+    @staticmethod
+    def _warn_frozen_externals(program, ext_uids):
+        """Externals that are not Parameters/buffers were COMPUTED outside
+        the capture (a jit/to_static call, a tape gradient): replay sees
+        their live value, it does not recompute them. Say so loudly."""
+        from ..nn.layer import Parameter
+
+        sus = []
+        for uid in ext_uids:
+            ref = program._tensor_refs.get(uid)
+            t = ref() if ref is not None else None
+            if t is not None and not isinstance(t, Parameter) \
+                    and not getattr(t, "persistable", False):
+                sus.append(t.name or f"uid={uid}")
+        if sus:
+            import warnings
+
+            warnings.warn(
+                f"static Program uses externally-computed tensors {sus[:5]} "
+                "as fixed inputs: Executor.run reads their CURRENT value "
+                "but will NOT recompute them from feeds. Build every "
+                "feed-dependent computation from captured ops (avoid "
+                "jit/to_static calls and .backward() inside program_guard).",
+                stacklevel=3,
+            )
 
     def close(self):
         pass

@@ -39,7 +39,9 @@ def _auto(prefix, name):
     import sys
 
     f = sys._getframe(2)  # the user's call site (past _auto and the op fn)
-    return f"{prefix}@{f.f_code.co_filename}:{f.f_lineno}"
+    # f_lasti (bytecode offset) disambiguates multiple calls on ONE source
+    # line, e.g. fc(fc(x, 32), 2) — same line, two distinct layers
+    return f"{prefix}@{f.f_code.co_filename}:{f.f_lineno}:{f.f_lasti}"
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):

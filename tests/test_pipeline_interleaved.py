@@ -121,6 +121,33 @@ def test_interleaved_ragged_microbatch_groups():
     np.testing.assert_allclose(_np(pipe(x)), _np(ref), rtol=2e-4, atol=2e-5)
 
 
+def test_pipeline_blocks_with_buffers():
+    """Read-only per-block buffers (rotary caches etc.) stack over pp."""
+    _init(pp=2)
+
+    class ScaledBlock(nn.Layer):
+        def __init__(self, d, scale):
+            super().__init__()
+            self.fc = nn.Linear(d, d)
+            self.register_buffer(
+                "scale", paddle.to_tensor(np.full((1,), scale, np.float32))
+            )
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x)) * self.scale
+
+    paddle.seed(9)
+    blocks = [ScaledBlock(16, 1.0 + 0.1 * i) for i in range(4)]
+    x = paddle.to_tensor(np.random.RandomState(9).randn(4, 16).astype("float32"))
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+    pipe = SpmdPipeline(blocks, num_stages=2, num_microbatches=2)
+    np.testing.assert_allclose(_np(pipe(x)), _np(ref), rtol=2e-4, atol=2e-5)
+    # buffers are state (saved/loaded), not trainable parameters
+    assert all("scale" not in (p.name or "") for p in pipe.parameters())
+
+
 def test_virtual_stage_divisibility_error():
     _init(pp=4)
     with pytest.raises(ValueError):

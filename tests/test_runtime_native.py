@@ -5,6 +5,7 @@ Mirrors the reference's test strategy for its C++ runtime (gtest targets for
 allocators and the store, §4): exercised here through the ctypes surface so
 the same tests also guard the bindings.
 """
+import os
 import threading
 
 import numpy as np
@@ -172,3 +173,38 @@ def test_host_memory_stats_surface():
         "peak_allocated_bytes",
         "alloc_count",
     }
+
+
+# ---------------------------------------------------------------------------
+# C++ test binary + sanitizer matrix (SURVEY.md §5 "Race detection/
+# sanitizers" — the reference's SANITIZER_TYPE CMake option). The plain
+# binary runs in the default suite; ASAN/TSAN/UBSAN builds are slow-marked.
+# ---------------------------------------------------------------------------
+import shutil
+import subprocess
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
+
+
+def _make(target, timeout=600):
+    return subprocess.run(
+        ["make", "-C", _CSRC, target],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.skipif(shutil.which("make") is None, reason="no make")
+def test_cpp_rt_test_binary():
+    r = _make("test")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RT_TEST PASS" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sanitizer", ["asan", "tsan", "ubsan"])
+def test_cpp_sanitizers(sanitizer):
+    r = _make(sanitizer)
+    if r.returncode != 0 and ("cannot find" in r.stderr or "not found" in r.stderr):
+        pytest.skip(f"toolchain lacks {sanitizer} runtime")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RT_TEST PASS" in r.stdout

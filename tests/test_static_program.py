@@ -1,0 +1,173 @@
+"""Static Program capture + Executor replay (reference: ProgramDesc build
+under enable_static + StandaloneExecutor run — SURVEY.md §2.1 "Legacy
+framework", §3.4). Ops executed inside program_guard are recorded by the
+defop gateway; Executor.run replays them as one jit-compiled program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _build_fc_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        h = static.nn.fc(x, 16, activation="relu", name="fc1")
+        out = static.nn.fc(h, 4, name="fc2")
+    return main, out
+
+
+def test_program_captures_ops():
+    paddle.seed(0)
+    main, out = _build_fc_program()
+    assert main.num_ops() > 0
+
+
+def test_executor_replays_with_feed():
+    paddle.seed(0)
+    main, out = _build_fc_program()
+    exe = static.Executor()
+    x1 = np.random.default_rng(0).standard_normal((4, 8)).astype("float32")
+    (res,) = exe.run(main, feed={"x": x1}, fetch_list=[out])
+    assert res.shape == (4, 4)
+
+    # reference: same weights applied eagerly
+    params = static.nn.static_parameters(main)
+    w1, b1 = params[0].numpy(), params[1].numpy()
+    w2, b2 = params[2].numpy(), params[3].numpy()
+    ref = np.maximum(x1 @ w1 + b1, 0) @ w2 + b2
+    np.testing.assert_allclose(res, ref, rtol=1e-5, atol=1e-6)
+
+    # different batch size => fresh signature, same program
+    x2 = np.random.default_rng(1).standard_normal((7, 8)).astype("float32")
+    (res2,) = exe.run(main, feed={"x": x2}, fetch_list=[out])
+    assert res2.shape == (7, 4)
+    assert len(main._exec_cache) == 2
+
+
+def test_executor_sees_updated_parameters():
+    """Params are passed by live value: mutate one, re-run, output moves."""
+    paddle.seed(1)
+    main, out = _build_fc_program()
+    exe = static.Executor()
+    x = np.ones((2, 8), np.float32)
+    (r1,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    p = static.nn.static_parameters(main)[0]
+    p._rebind(p._value * 2.0)
+    (r2,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert not np.allclose(r1, r2)
+    assert len(main._exec_cache) == 1  # no recompilation for a value change
+
+
+def test_missing_feed_raises():
+    paddle.seed(2)
+    main, out = _build_fc_program()
+    exe = static.Executor()
+    with pytest.raises(KeyError, match="not fed"):
+        exe.run(main, feed={}, fetch_list=[out])
+
+
+def test_capture_does_not_leak_outside_guard():
+    from paddle_tpu.framework import op as op_mod
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 1.0
+    n = main.num_ops()
+    # ops outside the guard must not append
+    _ = paddle.to_tensor(np.zeros((2, 2), np.float32)) * 3.0
+    assert main.num_ops() == n
+    assert op_mod._capture_program is None
+
+
+def test_to_static_inside_guard_is_captured():
+    """A to_static callable inside program_guard runs eagerly so its ops
+    are recorded — replay honors the feed (not a frozen trace constant)."""
+    from paddle_tpu import jit
+
+    fn = jit.to_static(lambda t: t * 3.0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = fn(x)
+        z = y + 1.0
+    exe = static.Executor()
+    fives = np.full((2, 2), 5.0, np.float32)
+    (r,) = exe.run(main, feed={"x": fives}, fetch_list=[z])
+    np.testing.assert_allclose(r, 16.0)
+
+
+def test_fetch_by_name():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        out = x * 4.0
+    out.name = "scaled"
+    exe = static.Executor()
+    (r,) = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                   fetch_list=["scaled"])
+    np.testing.assert_allclose(r, 4.0)
+    with pytest.raises(ValueError, match="does not match"):
+        exe.run(main, feed={"x": np.ones(2, np.float32)},
+                fetch_list=["nope"])
+
+
+def test_externally_computed_tensor_warns():
+    """Tensors computed outside the capture (tape grads, pre-guard math)
+    enter as frozen live values — loudly, not silently."""
+    import warnings
+
+    pre = paddle.to_tensor(np.full((2,), 2.0, np.float32))
+    outside = pre * 5.0  # computed BEFORE the guard: not captured
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        out = x + outside
+    exe = static.Executor()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        (r,) = exe.run(main, feed={"x": np.zeros(2, np.float32)},
+                       fetch_list=[out])
+        assert any("NOT recompute" in str(i.message) for i in w)
+    np.testing.assert_allclose(r, 10.0)
+
+
+def test_amp_cast_reproduced_in_replay():
+    from paddle_tpu import amp
+
+    paddle.seed(4)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        w = paddle.to_tensor(
+            np.random.default_rng(4).standard_normal((8, 8)).astype("float32")
+        )
+        with amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+            out = paddle.matmul(x, w)
+    assert "bfloat16" in str(out._value.dtype)
+    exe = static.Executor()
+    xv = np.random.default_rng(5).standard_normal((4, 8)).astype("float32")
+    (r,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    # replay applied the same cast: output matches the bf16 eager result
+    import jax.numpy as jnp
+
+    eager = jnp.matmul(
+        jnp.asarray(xv, jnp.bfloat16), jnp.asarray(w.numpy(), jnp.bfloat16)
+    )
+    np.testing.assert_allclose(r, np.asarray(eager, np.float32), rtol=1e-2)
+
+
+def test_multiple_fetches_and_intermediate():
+    paddle.seed(3)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        a = x * 2.0
+        b = a + 1.0
+    exe = static.Executor()
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    ra, rb = exe.run(main, feed={"x": xv}, fetch_list=[a, b])
+    np.testing.assert_allclose(ra, xv * 2)
+    np.testing.assert_allclose(rb, xv * 2 + 1)
