@@ -42,6 +42,15 @@ def _expert_axis() -> Optional[str]:
     return None
 
 
+def _aux_loss(probs, e, k):
+    """gshard load-balancing loss: E^2/k * Σ_e density_e · mean-prob_e
+    (shared by the capacity and dropless routing paths)."""
+    density = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32), 0
+    )
+    return jnp.sum(density * jnp.mean(probs, 0)) * (e * e) / max(k, 1)
+
+
 @defop(name="moe_gate_dispatch")
 def _gshard_gating(logits, key, k, capacity, use_aux_noise):
     """Top-k gating with static capacity (gshard/switch).
@@ -72,10 +81,7 @@ def _gshard_gating(logits, key, k, capacity, use_aux_noise):
         fill = fill + onehot.sum(0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)
 
-    # aux load-balancing loss (gshard): E * mean(fraction)·mean(prob)
-    density = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32), 0)
-    density_proxy = jnp.mean(probs, 0)
-    aux = jnp.sum(density * density_proxy) * (e * e) / max(k, 1)
+    aux = _aux_loss(probs, e, k)
 
     denom = sum(gt * m[2] for gt, m in zip(gates, masks))
     denom = jnp.maximum(denom, 1e-9)
@@ -107,6 +113,7 @@ class MoELayer(nn.Layer):
         gate: str = "gshard",
         aux_loss_weight: float = 1e-2,
         activation=None,
+        drop_tokens: bool = True,
     ):
         super().__init__()
         self.d_model = d_model
@@ -116,6 +123,12 @@ class MoELayer(nn.Layer):
         self.capacity_factor = capacity_factor
         self.aux_loss_weight = aux_loss_weight
         self.act = activation or F.gelu
+        # drop_tokens=False → DROPLESS routing over the Pallas grouped-matmul
+        # kernel (megablox-style): no capacity, no dropped tokens; experts
+        # see exactly their routed tokens (ragged groups). Currently runs
+        # with replicated expert weights (the capacity path carries the
+        # EP-sharded all-to-all).
+        self.drop_tokens = drop_tokens
         self.gate = nn.Linear(d_model, num_experts)
         init = I.XavierNormal()
         self.w_in = self.create_parameter(
@@ -127,7 +140,10 @@ class MoELayer(nn.Layer):
         )
         self.b_out = self.create_parameter([num_experts, 1, d_model], is_bias=True)
         ax = _expert_axis()
-        if ax is not None and num_experts % _mesh.mesh_axis_size(ax) == 0:
+        if (drop_tokens and ax is not None
+                and num_experts % _mesh.mesh_axis_size(ax) == 0):
+            # EP sharding only for the capacity path; the dropless grouped-
+            # matmul kernel runs with replicated expert weights
             for p in (self.w_in, self.b_in, self.w_out, self.b_out):
                 p.dist_spec = P(ax)
                 p.is_distributed = True
@@ -136,11 +152,18 @@ class MoELayer(nn.Layer):
     def forward(self, x):
         b, t, h = x.shape
         g = b * t
+        flat = x.reshape([g, h])
+        logits = self.gate(flat)
+        if not self.drop_tokens:
+            out, aux = _moe_apply_dropless(
+                flat, logits, self.w_in, self.b_in, self.w_out, self.b_out,
+                self.act, self.top_k,
+            )
+            self.last_aux_loss = aux * self.aux_loss_weight
+            return out.reshape([b, t, h])
         capacity = max(
             self.top_k, int(math.ceil(self.top_k * self.capacity_factor * g / self.num_experts))
         )
-        flat = x.reshape([g, h])
-        logits = self.gate(flat)
         from ..framework import rng as _rng
 
         key = _rng.next_key() if self.training else None
@@ -171,6 +194,50 @@ def _moe_apply(flat, combine, dispatch, w_in, b_in, w_out, b_out, act):
         expert_out = _mesh.sharding_constraint(expert_out, P(ax))
     # combine back to tokens
     return jnp.einsum("gec,ech->gh", combine.astype(flat.dtype), expert_out)
+
+
+@defop(name="moe_apply_dropless")
+def _moe_apply_dropless(flat, logits, w_in, b_in, w_out, b_out, act, top_k):
+    """Dropless MoE FFN over the Pallas grouped-matmul kernel.
+
+    Token copies are sorted by routed expert; the two expert GEMMs run as
+    ragged grouped matmuls with data-dependent group sizes (no capacity, no
+    dropped tokens — the reference needs `global_scatter` + per-expert GEMM
+    loops for this; megablox-style kernels are the TPU-native equivalent).
+    Returns (out [G, H], aux_loss).
+    """
+    from ..ops.pallas.grouped_matmul import grouped_matmul
+
+    g, h = flat.shape
+    e = w_in.shape[0]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)  # [G, k]
+    gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    aux = _aux_loss(probs, e, top_k)
+
+    gk = g * top_k
+    expert_ids = topi.reshape(-1)  # [gk]
+    order = jnp.argsort(expert_ids)  # stable: ties keep token order
+    sizes = jnp.bincount(expert_ids, length=e)  # dynamic group sizes
+    row_gid = expert_ids[order]
+    xs = flat[order // top_k].astype(flat.dtype)  # [gk, H] sorted copies
+
+    block_m = 128 if gk >= 128 else max(8, 1 << (gk - 1).bit_length())
+    pad = (-gk) % block_m
+    xs_p = jnp.pad(xs, ((0, pad), (0, 0)))
+
+    h1 = grouped_matmul(xs_p, w_in, sizes, block_m=block_m)[:gk]
+    h1 = h1 + b_in[row_gid, 0]
+    a = raw(act(h1)).astype(flat.dtype)
+    a_p = jnp.pad(a, ((0, pad), (0, 0)))
+    y = grouped_matmul(a_p, w_out, sizes, block_m=block_m)[:gk]
+    y = y + b_out[row_gid, 0]
+
+    inv = jnp.argsort(order)  # unsort copies back to (token, slot) order
+    y_tok = y[inv].reshape(g, top_k, h)
+    out = jnp.sum(gates[..., None].astype(flat.dtype) * y_tok, axis=1)
+    return out, aux
 
 
 # ------------------------------------------------- global_scatter / gather --
