@@ -107,3 +107,33 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
     from .autograd import grad as _grad
 
     return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph, allow_unused)
+
+# ---- default dtype + execution-mode toggles (paddle.* parity) -------------
+from .framework.dtypes import (  # noqa: E402,F401
+    get_default_dtype,
+    set_default_dtype,
+)
+
+
+def enable_static():
+    """Enter static-graph mode: ops record into the default main Program
+    (capture at the defop gateway — see paddle_tpu.static.Program)."""
+    from . import static as _static
+    from .framework import op as _op
+
+    _op.set_capture_program(_static.default_main_program())
+
+
+def disable_static():
+    from .framework import op as _op
+
+    _op.set_capture_program(None)
+
+
+def in_dynamic_mode():
+    from .framework import op as _op
+
+    return _op._capture_program is None
+
+
+

@@ -93,3 +93,29 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
 
 
 QueueDataset = None  # PS-mode datasets: deliberate non-goal (SURVEY.md §2.3 PS)
+
+from . import launch  # noqa: E402,F401  (paddle.distributed.launch module)
+
+
+def split(x, size, operation, axis=0, num_partitions=None, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity: build a tensor-parallel embedding or
+    linear whose weight is partitioned over the mp axis (reference:
+    python/paddle/distributed/collective.py::split). Under SPMD the
+    partitioning is a sharding annotation on the parallel layer."""
+    from .fleet import meta_parallel as mp_layers
+
+    if operation == "embedding":
+        layer = mp_layers.VocabParallelEmbedding(size[0], size[1])
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = mp_layers.RowParallelLinear(
+                size[0], size[1], input_is_parallel=False
+            )
+        else:
+            layer = mp_layers.ColumnParallelLinear(
+                size[0], size[1], gather_output=gather_out
+            )
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

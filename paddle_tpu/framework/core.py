@@ -433,9 +433,24 @@ class Tensor:
 
     # -- value rebinding (in-place family) -------------------------------
     def _rebind(self, value, node=None):
-        self._value = value
-        if node is not None:
+        if node is not None and node is not self._node:
+            if any(t is self for t in getattr(node, "inputs", ())):
+                # in-place op on a tensor that feeds its own producing node
+                # (y.reshape_() where y is non-leaf): snapshot the pre-state
+                # under the OLD uid so backward sees old-value -> node -> new
+                # instead of a self-cycle
+                old = Tensor(self._value, stop_gradient=self.stop_gradient)
+                old._node = self._node
+                old._uid, self._uid = self._uid, old._uid
+                node.inputs = tuple(
+                    old if t is self else t for t in node.inputs
+                )
+            # retarget the (single) output uid to THIS tensor so backward's
+            # uid chain stays intact across the rebind
+            if getattr(node, "out_uids", None) is not None and                     len(node.out_uids) == 1:
+                node.out_uids = (self._uid,)
             self._node = node
+        self._value = value
         return self
 
     def set_value(self, value):

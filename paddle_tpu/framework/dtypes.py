@@ -85,3 +85,19 @@ def default_float_dtype():
     from . import flags
 
     return convert_dtype(flags.get_flags("FLAGS_default_float_dtype"))
+
+
+# ---- default floating dtype (paddle.get/set_default_dtype) ----------------
+_default_float = ["float32"]
+
+
+def get_default_dtype() -> str:
+    return _default_float[0]
+
+
+def set_default_dtype(d) -> None:
+    dt = convert_dtype(d)
+    name = str(np.dtype(dt)) if not isinstance(d, str) else d
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise ValueError(f"default dtype must be a float type, got {name}")
+    _default_float[0] = name

@@ -1,17 +1,21 @@
 """paddle.nn parity surface."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
-from .layer import Layer, LayerList, ParamAttr, Parameter, ParameterList, Sequential  # noqa: F401
+from .layer import (  # noqa: F401
+    Layer, LayerDict, LayerList, ParamAttr, Parameter, ParameterList,
+    Sequential,
+)
 from .layers.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, SELU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
-    LeakyReLU, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, RReLU, Sigmoid,
-    Silu, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh, Tanhshrink,
-    ThresholdedReLU,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+    RReLU, SiLU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign,
+    Swish, Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layers.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
-    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle,
-    PixelUnshuffle, ReflectionPad2D, ReplicationPad2D, Unflatten, Upsample,
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+    Pad2D, Pad3D, PairwiseDistance, PixelShuffle, PixelUnshuffle,
+    ReflectionPad2D, ReplicationPad2D, Unflatten, Unfold, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
 )
 from .layers.conv import (  # noqa: F401
@@ -19,8 +23,10 @@ from .layers.conv import (  # noqa: F401
 )
 from .layers.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss, CTCLoss,
-    HingeEmbeddingLoss, HuberLoss, KLDivLoss, L1Loss, MarginRankingLoss,
-    MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss,
+    GaussianNLLLoss, HingeEmbeddingLoss, HuberLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss, NLLLoss,
+    PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layers.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
@@ -29,11 +35,11 @@ from .layers.norm import (  # noqa: F401
 )
 from .layers.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
-    AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
-    MaxPool3D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool2D,
 )
 from .layers.rnn import (  # noqa: F401
-    GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,
+    GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN, SimpleRNNCell,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,

@@ -184,3 +184,8 @@ def maxout(x, groups, axis=1, name=None):
     c = shape[axis]
     shape[axis : axis + 1] = [c // groups, groups]
     return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+@defop(name="log_sigmoid")
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)

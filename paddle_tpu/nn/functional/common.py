@@ -352,3 +352,90 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         sampled = np.sort(np.concatenate([pos, extra]))
     remapped = np.searchsorted(sampled, lab)
     return Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled))
+
+
+@defop
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+@defop
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups).swapaxes(3, 4).reshape(n, h, w, c)
+
+
+@defop
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (reference: phi temporal_shift kernel)."""
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    pre = jnp.pad(v[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    post = jnp.pad(v[:, :-1, fold:2 * fold], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    keep = v[:, :, 2 * fold:]
+    out = jnp.concatenate([pre, post, keep], axis=2).reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@defop
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D or 3-D affine sampling grid (reference: affine_grid op; feeds
+    grid_sample). out_shape: [N,C,H,W] -> [N,H,W,2] or [N,C,D,H,W] ->
+    [N,D,H,W,3]."""
+
+    def axis(nv):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, nv)
+        return (jnp.arange(nv) + 0.5) * 2.0 / nv - 1.0
+
+    dims = [int(s) for s in out_shape]
+    if len(dims) == 4:
+        _, _, h, w = dims
+        gy, gx = jnp.meshgrid(axis(h), axis(w), indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,nak->nhwa", base, jnp.asarray(theta))
+    if len(dims) == 5:
+        _, _, d, h, w = dims
+        gz, gy, gx = jnp.meshgrid(axis(d), axis(h), axis(w), indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+        return jnp.einsum("dhwk,nak->ndhwa", base, jnp.asarray(theta))
+    raise ValueError(f"out_shape must be rank 4 or 5, got {dims}")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of unfold: accumulate sliding-window columns back into the
+    image (reference: fold op). Implemented as the VJP of unfold, which is
+    exactly col2im."""
+    import jax as _jax
+
+    from .conv import unfold as _unfold
+    from ...framework.op import raw as _raw
+
+    xv = jnp.asarray(_raw(x))
+    n, ckk, L = xv.shape
+    if isinstance(kernel_sizes, int):
+        kh = kw = kernel_sizes
+    else:
+        kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = (output_sizes if not isinstance(output_sizes, int)
+              else (output_sizes, output_sizes))
+
+    def f(img):
+        return _raw(_unfold(img, kernel_sizes, strides, paddings, dilations))
+
+    img0 = jnp.zeros((n, c, oh, ow), xv.dtype)
+    _, vjp = _jax.vjp(f, img0)
+    (out,) = vjp(xv)
+    return Tensor(out)

@@ -32,6 +32,8 @@ __all__ = [
     "adaptive_avg_pool3d",
     "adaptive_max_pool1d",
     "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+    "max_unpool2d",
     "unfold",
 ]
 
@@ -233,7 +235,54 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
 
 @defop
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+    out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+    if not return_mask:
+        return out
+    # flat h*w argmax per pooled cell (the unpool indices the reference's
+    # max_pool2d_with_index kernel produces): compare each input position's
+    # value against its window's max via an unfold of values and positions
+    if ceil_mode or data_format != "NCHW" or isinstance(padding, str):
+        raise NotImplementedError(
+            "max_pool2d(return_mask=True) supports NCHW, numeric padding, "
+            "ceil_mode=False (the index/unpool path)"
+        )
+    n, c, h, w = x.shape
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride or kernel_size, 2)
+    cols = _unfold_nchw(x, k, s, padding)  # [N, C, kh*kw, L]
+    pos = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    pos = jnp.broadcast_to(pos, (n, 1, h, w))
+    pcols = _unfold_nchw(pos, k, s, padding)  # [N, 1, kh*kw, L]
+    arg = jnp.argmax(cols, axis=2)  # [N, C, L]
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(pcols, cols.shape), arg[:, :, None, :], axis=2
+    )[:, :, 0, :]
+    oh, ow = out.shape[2], out.shape[3]
+    return out, idx.reshape(n, c, oh, ow).astype(jnp.int32)
+
+
+def _unfold_nchw(x, k, s, padding):
+    """[N, C, H, W] -> [N, C, kh*kw, L] sliding windows (helper for the
+    pooling argmax; padded positions carry -inf so they never win)."""
+    p = _tuple(padding, 2) if not isinstance(padding, int) else (padding, padding)
+    xp = jnp.pad(
+        x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+        constant_values=-jnp.inf,
+    )
+    n, c, h, w = xp.shape
+    oh = (h - k[0]) // s[0] + 1
+    ow = (w - k[1]) // s[1] + 1
+    windows = []
+    for di in range(k[0]):
+        for dj in range(k[1]):
+            windows.append(
+                jax.lax.slice(
+                    xp, (0, 0, di, dj),
+                    (n, c, di + (oh - 1) * s[0] + 1, dj + (ow - 1) * s[1] + 1),
+                    (1, 1, s[0], s[1]),
+                )
+            )
+    return jnp.stack(windows, axis=2).reshape(n, c, k[0] * k[1], oh * ow)
 
 
 @defop
@@ -326,3 +375,29 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     )
     # patches: [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, oh*ow]
     return jnp.reshape(patches, (n, patches.shape[1], -1))
+
+
+@defop
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max")
+
+
+@defop
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Scatter pooled values back to their argmax positions (reference:
+    unpool op). `indices` are flat h*w positions as produced by
+    max_pool2d(return_mask=True)."""
+    n, c, ph, pw = x.shape
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride or kernel_size, 2)
+    if output_size is None:
+        oh = (ph - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else padding[0])
+        ow = (pw - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else padding[1])
+    else:
+        oh, ow = output_size[-2:]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return out.reshape(n, c, oh, ow)

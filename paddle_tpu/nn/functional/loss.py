@@ -287,3 +287,121 @@ def _ctc(log_probs, labels, input_lengths, label_lengths, blank, reduction):
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
     return _ctc(log_probs, labels, input_lengths, label_lengths, blank=int(blank), reduction=reduction)
+
+
+@defop
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """-(label*log(input+eps) + (1-label)*log(1-input+eps)) (paddle log_loss)."""
+    return -(label * jnp.log(input + epsilon)
+             + (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+@defop
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y| / (|X|+|Y|) over the class-prob dim (segmentation)."""
+    lab = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1],
+                         dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@defop
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    return loss
+
+
+@defop
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # guard label<=1 BEFORE the log: jnp.where alone still propagates
+        # NaN through the untaken branch's gradient at label == 0
+        safe = jnp.where(label > 1, label, 2.0)
+        stirling = safe * jnp.log(safe) - safe + 0.5 * jnp.log(
+            2 * jnp.pi * safe
+        )
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, input.dtype))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    from ..functional import pairwise_distance as _pd
+
+    d = distance_function or (lambda a, b: _pd(a, b))
+    dp = d(input, positive)
+    dn = d(input, negative)
+    if swap:
+        import paddle_tpu as _p
+
+        dn = _p.minimum(dn, d(positive, negative))
+    import paddle_tpu as _p
+
+    loss = _p.clip(dp - dn + margin, min=0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss

@@ -75,3 +75,5 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+LogSigmoid = _simple("log_sigmoid")
+SiLU = Silu  # paddle exposes both spellings

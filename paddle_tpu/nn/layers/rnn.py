@@ -294,3 +294,7 @@ class RNN(Layer):
             outs = outs[::-1]
         out = stack(outs, axis=t_axis)
         return out, states
+
+
+# Base alias for cell classes (paddle exposes RNNCellBase for subclassing)
+RNNCellBase = Layer

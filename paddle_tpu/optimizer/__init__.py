@@ -456,3 +456,109 @@ class Adadelta(Optimizer):
         update = -jnp.sqrt((st["avg_squared_update"] + self._epsilon) / (asg + self._epsilon)) * g
         asu = self._rho * st["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
         return p + lr * update, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with backtracking (Armijo) line search.
+
+    Reference: ``python/paddle/optimizer/lbfgs.py``. Unlike the first-order
+    optimizers above, each `step(closure)` re-evaluates the loss: pass a
+    closure that recomputes loss (and grads via backward), the standard
+    paddle/torch LBFGS contract. The two-loop recursion runs on host over
+    device arrays — dimensions involved are (history, params), not tokens,
+    so there is nothing for the MXU here.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.max_iter = max_iter
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flat(self, vals):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([jnp.reshape(v, (-1,)) for v in vals])
+
+    def _unflat(self, flat):
+        import jax.numpy as jnp
+
+        out, off = [], 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            out.append(jnp.reshape(flat[off:off + n], p.shape))
+            off += n
+        return out
+
+    def _direction(self, g):
+        import jax.numpy as jnp
+
+        q = g
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        import jax.numpy as jnp
+
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss = closure()
+        flat = self._flat([raw(p) for p in self._parameter_list])
+        grads = [
+            raw(p.grad) if p.grad is not None else jnp.zeros(p.shape)
+            for p in self._parameter_list
+        ]
+        g = self._flat(grads)
+        if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+            return loss
+        if self._prev_flat is not None:
+            s = flat - self._prev_flat
+            y = g - self._prev_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        d = self._direction(g)
+        lr = self.get_lr()
+        f0 = float(raw(loss))
+        gtd = float(jnp.vdot(g, d))
+        t = lr
+        new_flat = flat
+        for trial in range(10):  # backtracking Armijo
+            new_flat = flat + t * d
+            for p, v in zip(self._parameter_list, self._unflat(new_flat)):
+                p._rebind(v)
+            self.clear_grad()
+            f1 = float(raw(closure()))
+            if f1 <= f0 + 1e-4 * t * gtd:
+                break
+            if trial < 9:
+                t *= 0.5
+        # record the point the parameters are ACTUALLY at — a mismatched
+        # _prev_flat would corrupt the next (s, y) curvature pair
+        self._prev_flat = new_flat
+        self._prev_grad = self._flat([
+            raw(p.grad) if p.grad is not None else jnp.zeros(p.shape)
+            for p in self._parameter_list
+        ])
+        return loss

@@ -290,3 +290,28 @@ class ReduceOnPlateau(LRScheduler):
             self.last_lr = max(self.last_lr * self.factor, self.min_lr)
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_{t} = lr_{t-1} * lr_lambda(t) (reference: lr.MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        self._prod_epoch = 0
+        self._prod = 1.0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        # incremental running product (O(1) per step); recompute from
+        # scratch only when the epoch jumps (set_state_dict / replay)
+        if self.last_epoch < self._prod_epoch:
+            self._prod_epoch, self._prod = 0, 1.0
+        while self._prod_epoch < self.last_epoch:
+            self._prod_epoch += 1
+            self._prod *= self.lr_lambda(self._prod_epoch)
+        return self.base_lr * self._prod
+
+    def state_dict(self):
+        d = super().state_dict()
+        d.pop("lr_lambda", None)
+        return d

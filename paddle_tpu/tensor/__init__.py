@@ -17,6 +17,7 @@ from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
+from .manipulation import paddle_slice as slice  # noqa: F401,A001
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 
@@ -195,8 +196,36 @@ def _patch():
     def normal_(self, mean=0.0, std=1.0, name=None):
         return random.normal_(self, mean, std)
 
+    def _inplace_unary(fn):
+        def method(self):
+            return self._rebind(fn(self._value))
+        return method
+
+    for nm, fn in {
+        "exp_": jnp.exp, "floor_": jnp.floor, "ceil_": jnp.ceil,
+        "tanh_": jnp.tanh, "sqrt_": jnp.sqrt,
+        "rsqrt_": lambda v: 1.0 / jnp.sqrt(v),
+        "reciprocal_": lambda v: 1.0 / v, "round_": jnp.round,
+    }.items():
+        meth = _inplace_unary(fn)
+        meth.__name__ = nm
+        setattr(T, nm, meth)
+
+    def remainder_(self, y):
+        return self._rebind(jnp.mod(self._value, raw(y)))
+
+    def flatten_(self, start_axis=0, stop_axis=-1):
+        out = mp.flatten(self, start_axis, stop_axis)
+        return self._rebind(out._value, out._node)
+
+    T.dim = lambda self: self.ndim
+    T.rank = lambda self: self.ndim
+    T.ndimension = lambda self: self.ndim
+    T.element_size = lambda self: self._value.dtype.itemsize
+    T.value = lambda self: self
+
     for f in (zero_, fill_, add_, subtract_, multiply_, divide_, scale_, clip_,
-              exponential_, uniform_, normal_):
+              exponential_, uniform_, normal_, remainder_, flatten_):
         setattr(T, f.__name__, f)
 
     # device/dtype movement

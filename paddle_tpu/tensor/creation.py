@@ -39,12 +39,16 @@ def _shape_list(shape):
     return [int(raw(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape]
 
 
-def zeros(shape, dtype="float32", name=None):
-    return Tensor(jnp.zeros(_shape_list(shape), _dtypes.convert_dtype(dtype) or jnp.float32))
+def _float_default():
+    return _dtypes.convert_dtype(_dtypes.get_default_dtype())
 
 
-def ones(shape, dtype="float32", name=None):
-    return Tensor(jnp.ones(_shape_list(shape), _dtypes.convert_dtype(dtype) or jnp.float32))
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dtypes.convert_dtype(dtype) or _float_default()))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dtypes.convert_dtype(dtype) or _float_default()))
 
 
 def full(shape, fill_value, dtype=None, name=None):
@@ -52,7 +56,7 @@ def full(shape, fill_value, dtype=None, name=None):
     return Tensor(jnp.full(_shape_list(shape), fill_value, _dtypes.convert_dtype(dtype)))
 
 
-def empty(shape, dtype="float32", name=None):
+def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype, name)
 
 
@@ -203,3 +207,10 @@ def _vander(x, n, increasing):
 
 def vander(x, n=None, increasing=False, name=None):
     return _vander(x, n=n, increasing=bool(increasing))
+
+
+def shape(input):
+    """paddle.shape: the shape as an int32 tensor (static under trace)."""
+    from ..framework.op import raw as _raw
+
+    return Tensor(jnp.asarray(jnp.shape(_raw(input)), jnp.int32))

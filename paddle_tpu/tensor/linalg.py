@@ -285,3 +285,8 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     if q is None:
         q = min(6, raw(x).shape[-2], raw(x).shape[-1])
     return pca_lowrank_helper(x, q=int(q))
+
+
+def mm(input, mat2, name=None):
+    """Alias of matmul (paddle keeps both)."""
+    return matmul(input, mat2)

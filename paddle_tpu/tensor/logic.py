@@ -88,6 +88,14 @@ def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
 
 @defop
 def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    x = jnp.asarray(x)
+    if not is_arithmetic and jnp.issubdtype(x.dtype, jnp.signedinteger):
+        # logical shift: zero-fill from the left (reference semantics);
+        # keep BOTH operands unsigned so promotion cannot reintroduce sign
+        udt = jnp.dtype(f"uint{x.dtype.itemsize * 8}")
+        u = x.view(udt)
+        yu = jnp.asarray(y).astype(udt)
+        return jnp.right_shift(u, yu).view(x.dtype)
     return jnp.right_shift(x, y)
 
 

@@ -8,6 +8,8 @@ dy2static marks such ops as unsupported-in-static.
 """
 from __future__ import annotations
 
+import builtins
+
 from typing import List, Sequence, Union
 
 import jax
@@ -741,3 +743,40 @@ def tensordot(x, y, axes=2, name=None):
     if isinstance(axes, (list, tuple)):
         axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
     return _tensordot(x, y, axes=axes)
+
+
+def cat(x, axis=0, name=None):
+    """Alias of concat (torch-style name kept by paddle)."""
+    return concat(x, axis=axis, name=name)
+
+
+def permute(x, *perm, name=None):
+    """torch-style transpose alias."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return transpose(x, list(perm))
+
+
+@defop(name="slice_op")
+def paddle_slice(input, axes, starts, ends, name=None):
+    """paddle.slice: slice `input` along `axes` with [starts, ends).
+
+    (Named paddle_slice inside this module so the Python builtin stays
+    usable; exported as `paddle.slice` from the package root.)"""
+    x = jnp.asarray(input)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        s = int(s) if s >= 0 else int(s) + dim
+        e = int(e) if e >= 0 else int(e) + dim
+        idx[ax] = builtins.slice(max(s, 0), min(e, dim))
+    return x[tuple(idx)]
+
+
+def vsplit(x, num_or_indices, name=None):
+    return [Tensor(v) for v in jnp.split(
+        jnp.asarray(raw(x)),
+        num_or_indices if isinstance(num_or_indices, int)
+        else list(num_or_indices),
+        axis=0,
+    )]

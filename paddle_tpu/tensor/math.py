@@ -675,3 +675,107 @@ def renorm(x, p, axis, max_norm, name=None):
 def frexp(x, name=None):
     m, e = jnp.frexp(x)
     return m, e
+
+
+@defop
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y)."""
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@defop
+def ldexp(x, y, name=None):
+    return x * jnp.exp2(y.astype(jnp.float32)).astype(x.dtype if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.float32)
+
+
+@defop
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@defop
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+@defop
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+@defop
+def isposinf(x, name=None):
+    return jnp.isposinf(x)
+
+
+@defop
+def isneginf(x, name=None):
+    return jnp.isneginf(x)
+
+
+@defop
+def isreal(x, name=None):
+    return jnp.isreal(x)
+
+
+@defop
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, jnp.sign otherwise."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+@defop
+def positive(x, name=None):
+    return +jnp.asarray(x)
+
+
+@defop
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = jnp.asarray(y)
+    n = y.shape[axis]
+    y0 = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        d = jax.lax.slice_in_dim(x, 1, n, axis=axis) - jax.lax.slice_in_dim(x, 0, n - 1, axis=axis)
+    else:
+        d = dx if dx is not None else 1.0
+    return jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (host-computed index
+    set; eager-only like the reference's op)."""
+    import itertools as _it
+
+    from ..framework.op import raw as _raw
+
+    v = jnp.asarray(_raw(x))
+    n = v.shape[0]
+    gen = _it.combinations_with_replacement(range(n), r) if with_replacement \
+        else _it.combinations(range(n), r)
+    idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+    return Tensor(v[idx])
+
+
+@defop
+def polar(abs, angle, name=None):
+    return abs * jnp.exp(1j * angle.astype(jnp.float32))
+
+
+@defop
+def as_complex(x, name=None):
+    """[..., 2] float -> [...] complex."""
+    x = jnp.asarray(x)
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@defop
+def as_real(x, name=None):
+    """[...] complex -> [..., 2] float."""
+    x = jnp.asarray(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
