@@ -122,7 +122,7 @@ def _select_backend(max_tries=3, backoff=60.0):
     return None, None, f"{last_err}; cpu fallback also failed: {err}"
 
 
-def _emit(value, vs_baseline, extra):
+def _line(value, vs_baseline, extra):
     line = {
         "metric": "ernie3.0-base finetune tokens/sec/chip (O2 bf16, seq128)",
         "value": value,
@@ -130,7 +130,11 @@ def _emit(value, vs_baseline, extra):
         "vs_baseline": vs_baseline,
     }
     line.update(extra)
-    print(json.dumps(line))
+    return line
+
+
+def _emit(value, vs_baseline, extra):
+    print(json.dumps(_line(value, vs_baseline, extra)))
 
 
 def _flash_attention_timing(batch=4, seq=2048, heads=16, dim=64, iters=5):
@@ -185,20 +189,57 @@ def _flash_attention_timing(batch=4, seq=2048, heads=16, dim=64, iters=5):
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _measure_child(platform, backend_err):
+    try:
+        _measure(platform, backend_err)
+    except Exception as e:  # OOM, compile failure, backend flap, ...
+        _emit(0.0, 0.0, {"error": f"{type(e).__name__}: {e}"[:500]})
+
+
 def main():
     env, platform, backend_err = _select_backend()
     if env is None:
         _emit(0.0, 0.0, {"error": backend_err})
         return
-    os.environ.clear()
-    os.environ.update(env)
-    try:
-        _measure(platform, backend_err)
-    except Exception as e:  # OOM, compile failure, ... — still emit JSON
-        _emit(0.0, 0.0, {"error": f"{type(e).__name__}: {e}"[:500]})
+    # The tunnel backend can flap between the probe and the real init, and
+    # jax CACHES a failed backend init for the life of the process — so each
+    # measurement attempt runs in a FRESH subprocess; transient UNAVAILABLE
+    # gets retried with backoff.
+    last_line = None
+    for attempt in range(3):
+        child_env = dict(env)
+        child_env["BENCH_CHILD"] = f"{platform}|{backend_err or ''}"
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=child_env, capture_output=True, text=True, timeout=2400,
+            )
+        except subprocess.TimeoutExpired:
+            last_line = json.dumps(_line(0.0, 0.0, {
+                "error": "measurement subprocess timed out (2400s)"}))
+            continue
+        out = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        sys.stderr.write(p.stderr[-2000:])
+        if out:
+            last_line = out[-1]
+            if '"error"' not in last_line or "UNAVAILABLE" not in last_line:
+                print(last_line)
+                return
+        else:
+            last_line = json.dumps(_line(0.0, 0.0, {
+                "error": f"child produced no JSON (rc={p.returncode}): "
+                         f"{(p.stderr or '')[-200:]}"}))
+        if attempt < 2:
+            time.sleep(90)
+    print(last_line)
 
 
 def _measure(platform, backend_err):
+    global BATCH, STEPS, WARMUP
+    if platform == "cpu":
+        # CPU fallback exists only so the driver gets a parseable line with
+        # an "error" field — shrink so it completes in minutes, not hours
+        BATCH, STEPS, WARMUP = min(BATCH, 8), min(STEPS, 2), 1
 
     import jax
 
@@ -302,4 +343,9 @@ def _measure(platform, backend_err):
 
 
 if __name__ == "__main__":
-    main()
+    child = os.environ.pop("BENCH_CHILD", None)
+    if child is not None:
+        plat, err = child.split("|", 1)
+        _measure_child(plat, err or None)
+    else:
+        main()

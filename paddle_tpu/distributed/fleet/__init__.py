@@ -108,6 +108,66 @@ def is_first_worker() -> bool:
     return worker_index() == 0
 
 
+def init_server(*model_paths, **kwargs):
+    """PS-mode parity: on TPU there are no server processes — tables are
+    mesh-sharded (see paddle_tpu.distributed.ps). Accepted as a no-op so
+    PS-mode scripts run under the collective runtime."""
+
+
+def run_server():
+    """PS-mode parity no-op (no server loop to run; see distributed.ps)."""
+
+
+def init_worker(scopes=None):
+    """PS-mode parity: workers need no table-RPC setup under SPMD."""
+
+
+def stop_worker():
+    """PS-mode parity no-op."""
+
+
+def is_server() -> bool:
+    return False
+
+
+def is_worker() -> bool:
+    return True
+
+
+def save_persistables(executor=None, dirname=None, main_program=None, mode=0,
+                      model=None):
+    """PS-mode checkpoint parity: persist every parameter (the whole model
+    IS the 'table' under SPMD). Rides the sharding-aware orbax saver
+    (distributed.checkpoint.save_state_dict), so mesh-sharded tables write
+    shard-by-shard per host instead of materializing on one process.
+
+    Sources, in priority order: `model` (a Layer) -> the static Program's
+    static.nn parameters. Raises when there is nothing to save — a silent
+    empty checkpoint is worse than an error."""
+    import os as _os
+
+    if dirname is None:
+        raise ValueError("save_persistables requires dirname")
+    from ...static import default_main_program
+    from ...static.nn import static_parameters
+
+    if model is not None:
+        named = list(model.named_parameters())
+    else:
+        prog = main_program or default_main_program()
+        named = [(f"p{i}", p) for i, p in enumerate(static_parameters(prog))]
+    if not named:
+        raise ValueError(
+            "save_persistables found no parameters: pass model=<Layer> for "
+            "dygraph scripts, or build the program with static.nn layers"
+        )
+    state = {n: p._value for n, p in named}
+    from ..checkpoint import save_state_dict
+
+    save_state_dict(state, _os.path.join(dirname, "persistables"))
+    return list(state)
+
+
 def barrier_worker():
     from ..collective import barrier
 
@@ -318,7 +378,16 @@ class DistTrainStep(TrainStep):
 # imported last: meta_parallel's sharding module needs HybridParallelOptimizer
 from . import meta_parallel  # noqa: F401,E402
 
+from .. import ps  # noqa: E402,F401  (paddle.distributed.ps equivalent)
+
 __all__ = [
+    "init_server",
+    "run_server",
+    "init_worker",
+    "stop_worker",
+    "is_server",
+    "is_worker",
+    "save_persistables",
     "init",
     "DistributedStrategy",
     "distributed_model",

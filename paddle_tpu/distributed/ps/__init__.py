@@ -1,0 +1,177 @@
+"""Parameter-server capability, TPU-reshaped.
+
+Reference (SURVEY.md §1 "Parameter-server stack", §2.3 "Parameter server"):
+`paddle/fluid/distributed/ps/` — dense/sparse tables on dedicated server
+processes over brpc, async/geo-SGD, heter-PS with HBM/SSD caches, driven by
+`fleet.init(role)` PS mode (`python/paddle/distributed/ps/the_one_ps.py`).
+
+What PS-mode actually buys the reference is ONE capability: embedding
+tables too large for a single accelerator, updated sparsely by many
+workers. The TPU-native equivalent is not a server process — it is a
+MESH-SHARDED table: rows are partitioned over the device mesh
+(`ShardedEmbeddingTable`), lookups become GSPMD-inserted collectives over
+ICI, and updates are the same SPMD optimizer step every other parameter
+takes (sparse-gradient row updates arrive as dense-with-zeros grads that
+XLA keeps sharded). There are no servers to start, so the PS role-control
+API (`is_first_worker`, `init_server`, `run_server`, `init_worker`,
+`stop_worker`, barriers) is provided as working no-ops/logical equivalents
+so PS-mode training scripts run unchanged under the collective runtime.
+
+Deliberately absent (documented non-goals, not gaps on TPU): brpc
+transport, async/geo-SGD staleness modes, SSD cache tiers — XLA's
+synchronous SPMD replaces the async PS consistency model entirely.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...framework.core import Tensor
+from ...framework.op import defop, raw
+from ...nn import Layer
+from ...nn import initializer as I
+from ...nn.layer import Parameter
+from .. import mesh as _mesh
+
+__all__ = [
+    "ShardedEmbeddingTable",
+    "sparse_embedding",
+    "RoleMakerBase",
+    "table_shard_info",
+]
+
+
+def _table_axis() -> Optional[str]:
+    """Mesh axis carrying table rows: widest of sharding/mp/dp."""
+    m = _mesh.get_global_mesh()
+    if m is None:
+        return None
+    best, width = None, 1
+    for name in ("sharding", "mp", "dp"):
+        if m.shape.get(name, 1) > width:
+            best, width = name, m.shape[name]
+    return best
+
+
+class ShardedEmbeddingTable(Layer):
+    """A vocab-row-sharded embedding table — the PS "distributed table".
+
+    Rows live partitioned over the table mesh axis (each device holds
+    vocab/N rows); a lookup is a sharded gather for which GSPMD inserts the
+    exact comm the reference routes through its PS RPC (but over ICI, inside
+    the compiled step). Works as a drop-in Embedding for rec-sys-scale
+    vocabularies.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx=None, weight_attr=None, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [self.num_embeddings, self.embedding_dim],
+            attr=weight_attr,
+            dtype=dtype,
+            default_initializer=I.XavierNormal(),
+        )
+        ax = _table_axis()
+        if ax is not None and self.num_embeddings % _mesh.mesh_axis_size(ax) == 0:
+            self.weight.dist_spec = P(ax)
+            self.weight.is_distributed = True
+            self.weight._rebind(
+                _mesh.sharding_constraint(raw(self.weight), P(ax))
+            )
+
+    def forward(self, ids):
+        return _sharded_lookup(
+            ids, self.weight, padding_idx=self.padding_idx
+        )
+
+    def shard_info(self):
+        return table_shard_info(self.weight)
+
+
+@defop(name="sharded_embedding_lookup")
+def _sharded_lookup(ids, table, padding_idx=None):
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        out = out * (ids != padding_idx)[..., None].astype(out.dtype)
+    return out
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32", name=None):
+    """`paddle.static.nn.sparse_embedding` parity — the PS-mode lookup op.
+
+    Builds (once per call site, like static.nn layers) a ShardedEmbeddingTable
+    and applies it. `entry` (frequency-gated rows) is accepted and ignored:
+    row admission policies exist to bound PS server memory, which row
+    sharding already bounds deterministically.
+    """
+    from ...static.nn import _auto, _get
+
+    key = _auto("sparse_embedding", name)
+    table = _get(
+        key, lambda: ShardedEmbeddingTable(size[0], size[1], padding_idx,
+                                           weight_attr=param_attr,
+                                           dtype=dtype)
+    )
+    return table(input)
+
+
+def table_shard_info(weight) -> dict:
+    """Placement report for a sharded table (PS `print_table_stats` role)."""
+    v = raw(weight)
+    sharding = getattr(v, "sharding", None)
+    n_shards = 1
+    ax = None
+    spec = getattr(sharding, "spec", None)
+    if spec:
+        m = _mesh.get_global_mesh()
+        names = [s for s in jax.tree_util.tree_leaves(list(spec)) if s]
+        ax = names[0] if names else None
+        if m is not None and ax in m.shape:
+            n_shards = m.shape[ax]
+    return {
+        "global_rows": int(v.shape[0]),
+        "dim": int(v.shape[1]),
+        "num_shards": n_shards,
+        "rows_per_shard": int(v.shape[0]) // max(n_shards, 1),
+        "axis": ax,
+        "bytes_per_shard": int(v.size * v.dtype.itemsize) // max(n_shards, 1),
+    }
+
+
+class RoleMakerBase:
+    """PS role protocol, collective-runtime semantics: every process is a
+    worker; there are no servers (tables are mesh-sharded)."""
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False
+
+    def is_first_worker(self) -> bool:
+        from .. import get_rank
+
+        return get_rank() == 0
+
+    def worker_num(self) -> int:
+        from .. import get_world_size
+
+        return get_world_size()
+
+    def server_num(self) -> int:
+        return 0
+
+    def worker_index(self) -> int:
+        from .. import get_rank
+
+        return get_rank()

@@ -117,6 +117,21 @@ def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None, d
     return layer(input)
 
 
+def sparse_embedding(input, size, padding_idx=None, is_test=False, entry=None,
+                     param_attr=None, dtype="float32", name=None):
+    """PS-mode distributed-table lookup (reference:
+    python/paddle/static/nn/common.py::sparse_embedding over the PS
+    DistributedLookupTable). TPU-native: a mesh-row-sharded table — see
+    paddle_tpu.distributed.ps.ShardedEmbeddingTable."""
+    from ..distributed.ps import sparse_embedding as impl
+
+    # resolve the call-site key HERE: impl's own _auto would see this
+    # wrapper frame, collapsing all unnamed call sites to one table
+    key = _auto("sparse_embedding", name)
+    return impl(input, size, padding_idx=padding_idx, is_test=is_test,
+                entry=entry, param_attr=param_attr, dtype=dtype, name=key)
+
+
 def static_parameters(program=None):
     """All parameters created by static.nn calls on `program` (default main)."""
     prog = program or default_main_program()
@@ -297,6 +312,6 @@ def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
 
 __all__ = [
     "fc", "conv2d", "conv2d_transpose", "batch_norm", "layer_norm",
-    "embedding", "static_parameters",
+    "embedding", "sparse_embedding", "static_parameters",
     "cond", "case", "switch_case", "while_loop",
 ]
