@@ -112,3 +112,98 @@ def test_hybrid_dp_mp_pp_loss_parity(baseline):
         baseline,
         "dp2.mp2.pp2",
     )
+
+
+# ---- sep (context parallel / ring attention) ------------------------------
+
+def _run_sep_model(degrees):
+    """Tiny causal-attention LM whose attention runs through
+    context_parallel_attention (ring attention over the sep axis; dense
+    fallback at sep=1 — identical math, different schedule)."""
+    from paddle_tpu import nn
+    from paddle_tpu.framework.op import defop
+    from paddle_tpu.nn.functional.ring_attention import (
+        context_parallel_attention,
+    )
+
+    @defop(name="cp_attn_test")
+    def cp_attn(q, k, v):
+        return context_parallel_attention(q, k, v, causal=True)
+
+    class TinyLM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(VOCAB, 32)
+            self.qkv = nn.Linear(32, 96)
+            self.out = nn.Linear(32, VOCAB)
+
+        def forward(self, ids, labels=None):
+            h = self.emb(ids)
+            q, k, v = paddle.split(self.qkv(h), 3, axis=-1)
+            r = lambda t: t.reshape((t.shape[0], t.shape[1], 2, 16))
+            a = cp_attn(r(q), r(k), r(v))
+            logits = self.out(a.reshape((h.shape[0], h.shape[1], 32)))
+            loss = paddle.nn.functional.cross_entropy(
+                logits.reshape((-1, VOCAB)), labels.reshape((-1,))
+            )
+            return loss
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(degrees)
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(77)
+    model = TinyLM()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=model.parameters()
+    )
+    fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+    step = fleet.DistTrainStep(model, lambda m, ids, lbl: m(ids, labels=lbl), opt)
+    return [float(step(ids, ids)) for ids in _data()]
+
+
+def test_sep2_loss_parity():
+    base = _run_sep_model({})
+    _assert_parity(_run_sep_model({"sep_degree": 2}), base, "sep2")
+
+
+# ---- ep (expert parallel / MoE capacity path) -----------------------------
+
+def _run_moe(degrees):
+    from paddle_tpu import incubate, nn
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(degrees)
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(55)
+    moe = incubate.MoELayer(d_model=32, d_hidden=64, num_experts=8, top_k=2)
+    head = nn.Linear(32, VOCAB)
+    emb = nn.Embedding(VOCAB, 32)
+
+    class Wrap(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb, self.moe, self.head = emb, moe, head
+
+        def forward(self, ids, labels=None):
+            logits = self.head(self.moe(self.emb(ids)))
+            ce = paddle.nn.functional.cross_entropy(
+                logits.reshape((-1, VOCAB)), labels.reshape((-1,))
+            )
+            return ce + self.moe.last_aux_loss
+
+    model = Wrap()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=model.parameters()
+    )
+    fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+    step = fleet.DistTrainStep(model, lambda m, ids, lbl: m(ids, labels=lbl), opt)
+    return [float(step(ids, ids)) for ids in _data()]
+
+
+def test_ep_sharding8_loss_parity():
+    """MoE with the expert dim sharded over 8 devices matches 1-device."""
+    base = _run_moe({})
+    # expert axis rides 'sharding'
+    _assert_parity(_run_moe({"sharding_degree": 8}), base, "ep.sharding8")
