@@ -186,3 +186,83 @@ __all__ = [
     "FusedMultiHeadAttention", "FusedFeedForward",
     "FusedTransformerEncoderLayer", "FusedLinear",
 ]
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None, name=None):
+    """Functional fused MHA (reference: incubate.nn.functional.
+    fused_multi_head_attention). XLA fuses the chain; the functional form
+    exists for script parity. qkv_weight: [3, H, D/H, D] paddle layout.
+
+    Everything flows through framework ops (F.linear / Tensor methods) so
+    the eager tape records the whole chain — raw jnp math here would
+    silently detach gradients (see the fused-layer comment above).
+    """
+    from ..nn import functional as F
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv (incremental decoding) is not supported by the fused "
+            "functional; use nn.MultiHeadAttention with its cache API"
+        )
+    three, nh, hd, d = qkv_weight.shape
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [d], weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    w = qkv_weight.reshape([3 * nh * hd, d]).t()  # [d, 3*nh*hd], tape op
+    b_ = qkv_bias.reshape([3 * nh * hd]) if qkv_bias is not None else None
+    qkv = F.linear(h, w, b_)
+    from .. import tensor as _pt
+
+    q, k, v = _pt.split(qkv, 3, axis=-1)
+    b, t = h.shape[0], h.shape[1]
+    r = lambda a: a.reshape([b, t, nh, hd])
+    o = F.scaled_dot_product_attention(
+        r(q), r(k), r(v), attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate, training=training,
+    )
+    out = F.linear(o.reshape([b, t, nh * hd]), linear_weight, linear_bias)
+    if dropout_rate:
+        out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + x
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [d], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """Functional fused FFN (reference: incubate.nn.functional.fused_feedforward)."""
+    from ..nn import functional as F
+
+    d = x.shape[-1]
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [d], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate:
+        h = F.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        h = F.dropout(h, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = h + x
+    if not pre_layer_norm:
+        h = F.layer_norm(h, [d], weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return h

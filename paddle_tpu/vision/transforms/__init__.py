@@ -235,11 +235,32 @@ class BrightnessTransform(BaseTransform):
         return np.clip(a * f, 0, 255).astype(np.uint8)
 
 
+def _adjust_saturation(a, factor):
+    gray = (a[..., :1] * 0.299 + a[..., 1:2] * 0.587 + a[..., 2:3] * 0.114)
+    return gray + (a - gray) * factor
+
+
+def _adjust_hue(a, shift):
+    """Hue rotation by `shift` in [-0.5, 0.5] turns, via the YIQ rotation
+    matrix (the standard cheap hue adjust; exact per-pixel HSV round-trips
+    are not needed for augmentation)."""
+    theta = 2.0 * np.pi * shift
+    cos, sin = np.cos(theta), np.sin(theta)
+    t_yiq = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.322],
+                      [0.211, -0.523, 0.312]], np.float32)
+    rot = np.array([[1, 0, 0], [0, cos, -sin], [0, sin, cos]], np.float32)
+    t_rgb = np.linalg.inv(t_yiq) @ rot @ t_yiq
+    return a @ t_rgb.T
+
+
 class ColorJitter(BaseTransform):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
         super().__init__(keys)
         self.brightness = brightness
         self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
 
     def _apply_image(self, img):
         a = _to_np(img).astype(np.float32)
@@ -248,7 +269,85 @@ class ColorJitter(BaseTransform):
         if self.contrast:
             mean = a.mean()
             a = (a - mean) * (1 + _pyrandom.uniform(-self.contrast, self.contrast)) + mean
+        if self.saturation:
+            a = _adjust_saturation(
+                a, 1 + _pyrandom.uniform(-self.saturation, self.saturation)
+            )
+        if self.hue:
+            a = _adjust_hue(a, _pyrandom.uniform(-self.hue, self.hue))
         return np.clip(a, 0, 255).astype(np.uint8)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        mean = a.mean()
+        f = 1 + _pyrandom.uniform(-self.value, self.value)
+        return np.clip((a - mean) * f + mean, 0, 255).astype(np.uint8)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        f = 1 + _pyrandom.uniform(-self.value, self.value)
+        return np.clip(_adjust_saturation(a, f), 0, 255).astype(np.uint8)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        a = _to_np(img).astype(np.float32)
+        return np.clip(
+            _adjust_hue(a, _pyrandom.uniform(-self.value, self.value)), 0, 255
+        ).astype(np.uint8)
+
+
+class RandomErasing(BaseTransform):
+    """Randomly occlude a rectangle (reference: transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        a = _to_np(img).copy()
+        if _pyrandom.random() >= self.prob:
+            return a
+        # canonical use is AFTER ToTensor: CHW float in [0, 1]; also accept
+        # raw HWC uint8
+        chw = a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3)
+        h, w = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        is_float = np.issubdtype(a.dtype, np.floating)
+        for _ in range(10):
+            area = h * w * _pyrandom.uniform(*self.scale)
+            ratio = _pyrandom.uniform(*self.ratio)
+            eh = int(round(np.sqrt(area * ratio)))
+            ew = int(round(np.sqrt(area / ratio)))
+            if eh < h and ew < w:
+                top = _pyrandom.randint(0, h - eh)
+                left = _pyrandom.randint(0, w - ew)
+                region = (np.s_[:, top:top + eh, left:left + ew] if chw
+                          else np.s_[top:top + eh, left:left + ew])
+                if self.value == "random":
+                    shape = a[region].shape
+                    a[region] = (np.random.uniform(0, 1, shape) if is_float
+                                 else np.random.randint(0, 256, shape))
+                else:
+                    a[region] = self.value
+                break
+        return a
 
 
 class RandomRotation(BaseTransform):

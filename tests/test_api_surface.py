@@ -233,3 +233,70 @@ def test_temporal_shift_shapes():
     )
     out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
     assert out.shape == [4, 8, 5, 5]
+
+
+def test_color_transforms_and_random_erasing():
+    from paddle_tpu.vision import transforms as T
+
+    img = np.random.default_rng(0).integers(0, 255, (32, 32, 3)).astype(np.uint8)
+    for cls, args in [
+        (T.ContrastTransform, (0.4,)), (T.SaturationTransform, (0.4,)),
+        (T.HueTransform, (0.2,)),
+    ]:
+        out = cls(*args)(img)
+        assert out.shape == (32, 32, 3) and out.dtype == np.uint8
+    out = T.RandomErasing(prob=1.0, value=0)(img)
+    assert out.shape == (32, 32, 3)
+    assert (out == 0).any()  # some rectangle was erased
+    out = T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img)
+    assert out.shape == (32, 32, 3)
+    # YIQ hue rotation sanity: +0.25 then -0.25 turns round-trips; and the
+    # quarter-turn itself is NOT the identity
+    from paddle_tpu.vision.transforms import _adjust_hue
+
+    a = img.astype(np.float32)
+    np.testing.assert_allclose(
+        _adjust_hue(_adjust_hue(a, 0.25), -0.25), a, atol=1e-2)
+    assert np.abs(_adjust_hue(a, 0.25) - a).max() > 1.0
+    # CHW float RandomErasing (post-ToTensor layout) erases a region too
+    chw = np.random.default_rng(1).random((3, 32, 32)).astype(np.float32)
+    out = T.RandomErasing(prob=1.0, value=0.0)(chw)
+    assert out.shape == (3, 32, 32) and (out == 0).any()
+
+
+def test_incubate_fused_functionals():
+    from paddle_tpu.incubate import nn as inn
+
+    d, nh, hd = 16, 2, 8
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((2, 6, d)).astype("float32"))
+    qkv_w = paddle.to_tensor(
+        (rng.standard_normal((3, nh, hd, d)) * 0.1).astype("float32"))
+    lin_w = paddle.to_tensor(
+        (rng.standard_normal((d, d)) * 0.1).astype("float32"))
+    out = inn.fused_multi_head_attention(
+        x, qkv_w, lin_w, pre_layer_norm=True,
+        pre_ln_scale=paddle.ones([d]), pre_ln_bias=paddle.zeros([d]),
+        dropout_rate=0.0, attn_dropout_rate=0.0,
+    )
+    assert out.shape == [2, 6, d]
+    assert np.isfinite(out.numpy()).all()
+    # gradients flow to the fused weights (the functional must stay on the
+    # tape — raw jnp math here silently detaches)
+    qkv_w.stop_gradient = False
+    lin_w.stop_gradient = False
+    out_g = inn.fused_multi_head_attention(
+        x, qkv_w, lin_w, pre_layer_norm=True,
+        pre_ln_scale=paddle.ones([d]), pre_ln_bias=paddle.zeros([d]),
+        dropout_rate=0.0, attn_dropout_rate=0.0,
+    )
+    (out_g ** 2).mean().backward()
+    assert qkv_w.grad is not None and float(np.abs(qkv_w.grad.numpy()).max()) > 0
+    assert lin_w.grad is not None
+    w1 = paddle.to_tensor((rng.standard_normal((d, 32)) * 0.1).astype("float32"))
+    w2 = paddle.to_tensor((rng.standard_normal((32, d)) * 0.1).astype("float32"))
+    out2 = inn.fused_feedforward(
+        x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+        ln2_scale=paddle.ones([d]), ln2_bias=paddle.zeros([d]),
+    )
+    assert out2.shape == [2, 6, d]
