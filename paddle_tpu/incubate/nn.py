@@ -192,7 +192,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
                                pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
                                ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
                                linear_bias=None, cache_kv=None, attn_mask=None,
-                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
                                ln_epsilon=1e-5, training=True, mode="upscale_in_train",
                                ring_id=-1, add_residual=True, num_heads=None, name=None):
     """Functional fused MHA (reference: incubate.nn.functional.

@@ -20,3 +20,9 @@ from .ernie import (  # noqa: F401
     ErnieForTokenClassification,
     ErnieModel,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaDecoderLayer,
+    LlamaForCausalLM,
+    LlamaModel,
+)

@@ -1,0 +1,265 @@
+"""Llama family — modern decoder-only LM: RoPE + RMSNorm + SwiGLU + GQA.
+
+Reference capability: the Paddle ecosystem's Llama lives in PaddleNLP
+(`LlamaModel`/`LlamaForCausalLM` built from the same fleet mpu layers as
+GPT, with fused rope and GQA via its flash-attention integration). Core
+Paddle provides the building blocks (mpu layers, flash_attn kernels).
+
+TPU-native design mirrors paddle_tpu's GPT: mpu layer classes as sharding
+annotations, bf16-friendly [B, T, H, D] attention layout. Grouped-query
+attention runs through the Pallas flash kernel's native GQA path
+(ops/pallas/flash_attention.py — kv heads selected in the BlockSpec index
+map, no head replication in HBM); rotary embeddings are applied on the
+fly from a per-block cos/sin cache (a read-only buffer, so the decoder
+stacks under SpmdPipeline including its buffers).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ... import nn
+from ...distributed import mesh as _mesh
+from ...distributed.fleet.layers.mpu import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    mark_activation,
+)
+from ...distributed.fleet.utils import recompute as _recompute
+from ...framework.core import Tensor
+from ...framework.op import defop, raw
+from ...nn import functional as F
+from ...nn import initializer as I
+
+
+class LlamaConfig:
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        hidden_size: int = 768,
+        intermediate_size: Optional[int] = None,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        num_key_value_heads: Optional[int] = None,
+        max_position_embeddings: int = 2048,
+        rms_norm_eps: float = 1e-6,
+        rope_theta: float = 10000.0,
+        initializer_range: float = 0.02,
+        tie_word_embeddings: bool = False,
+        use_flash_attention: bool = True,
+        use_recompute: bool = False,
+        sequence_parallel: bool = False,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        # Llama SwiGLU sizing: 8/3 * h rounded up to a multiple of 256
+        self.intermediate_size = intermediate_size or (
+            (int(8 * hidden_size / 3) + 255) // 256 * 256
+        )
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        assert num_attention_heads % self.num_key_value_heads == 0
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.initializer_range = initializer_range
+        self.tie_word_embeddings = tie_word_embeddings
+        self.use_flash_attention = use_flash_attention
+        self.use_recompute = use_recompute
+        self.sequence_parallel = sequence_parallel
+
+
+def _rope_cache(max_t: int, dim: int, theta: float):
+    inv = 1.0 / (theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    t = np.arange(max_t, dtype=np.float64)
+    freqs = np.outer(t, inv)  # [T, dim/2]
+    return (np.cos(freqs).astype(np.float32),
+            np.sin(freqs).astype(np.float32))
+
+
+@defop(name="apply_rope")
+def _apply_rope(x, cos, sin, name=None):
+    """x: [B, T, H, D]; cos/sin: [Tmax, D/2] → rotate pairs (interleaved
+    halves, the Llama convention)."""
+    import jax.numpy as jnp
+
+    t = x.shape[1]
+    d2 = x.shape[-1] // 2
+    c = cos[:t][None, :, None, :]  # [1, T, 1, D/2]
+    s = sin[:t][None, :, None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(
+        x.dtype
+    )
+
+
+@defop(name="gqa_flash_attention")
+def _gqa_attention(q, k, v, causal=True):
+    """[B, T, H, D] x [B, T, Hkv, D] — Pallas flash kernel, native GQA."""
+    from ...ops.pallas.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=causal)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        kv_h = self.num_kv_heads * self.head_dim
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, kv_h, has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, kv_h, has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
+        cos, sin = _rope_cache(
+            config.max_position_embeddings, self.head_dim, config.rope_theta
+        )
+        import jax.numpy as jnp
+
+        self.register_buffer("rope_cos", Tensor(jnp.asarray(cos)))
+        self.register_buffer("rope_sin", Tensor(jnp.asarray(sin)))
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x):
+        b, t, h = x.shape
+        q = self.q_proj(x).reshape([b, t, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, t, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, t, self.num_kv_heads, self.head_dim])
+        q = _apply_rope(q, self.rope_cos, self.rope_sin)
+        k = _apply_rope(k, self.rope_cos, self.rope_sin)
+        if self.use_flash:
+            o = _gqa_attention(q, k, v, causal=True)
+        else:
+            from ... import tensor as pt
+
+            group = self.num_heads // self.num_kv_heads
+            o = F.scaled_dot_product_attention(
+                q,
+                pt.repeat_interleave(k, group, axis=2),
+                pt.repeat_interleave(v, group, axis=2),
+                is_causal=True,
+                training=self.training,
+            )
+        return self.o_proj(o.reshape([b, t, h]))
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    """Pre-RMSNorm block — structurally uniform → SpmdPipeline-stackable
+    (its rope caches stack as read-only buffers)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps
+        )
+        self.mlp = LlamaMLP(config)
+        self._use_recompute = config.use_recompute
+        self._sequence_parallel = config.sequence_parallel
+
+    def _block(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if self._sequence_parallel:
+            x = mark_activation(x, seq_mp=True)
+        return x
+
+    def forward(self, x):
+        if self._use_recompute:
+            return _recompute(self._block, x)
+        return self._block(x)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=I.Normal(std=config.initializer_range)),
+        )
+        blocks = [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        pp = _mesh.mesh_axis_size("pp")
+        if pp > 1 and config.num_hidden_layers % pp == 0:
+            from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+                SpmdPipeline,
+            )
+
+            self.layers = SpmdPipeline(
+                blocks, num_stages=pp, recompute_block=config.use_recompute
+            )
+        else:
+            if pp > 1:
+                import warnings
+
+                warnings.warn(
+                    f"num_hidden_layers={config.num_hidden_layers} not "
+                    f"divisible by pp_degree={pp}: Llama decoder runs "
+                    "WITHOUT pipeline partitioning"
+                )
+            self.layers = nn.LayerList(blocks)
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        if isinstance(self.layers, nn.LayerList):
+            for blk in self.layers:
+                x = blk(x)
+        else:
+            x = self.layers(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.llama = LlamaModel(config)
+        self.config = config
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False,
+            )
+        self.criterion = ParallelCrossEntropy(ignore_index=-100)
+
+    def _logits(self, hidden):
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens.weight
+            logits = F.linear(hidden, w.t())
+            return mark_activation(logits, last_mp=True)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, loss_mask=None):
+        hidden = self.llama(input_ids)
+        logits = self._logits(hidden)
+        if labels is not None:
+            loss = self.criterion(logits, labels)
+            if loss_mask is not None:
+                lm = loss_mask.reshape(loss.shape)
+                return (loss * lm).sum() / lm.sum().clip(min=1.0)
+            # average over VALID tokens: ignore_index positions contribute
+            # zero loss and must not deflate the mean (HF Llama semantics)
+            valid = (labels.reshape(loss.shape) != -100).astype(loss.dtype)
+            return loss.sum() / valid.sum().clip(min=1.0)
+        return logits

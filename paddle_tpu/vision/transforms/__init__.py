@@ -271,7 +271,8 @@ class ColorJitter(BaseTransform):
             a = (a - mean) * (1 + _pyrandom.uniform(-self.contrast, self.contrast)) + mean
         if self.saturation:
             a = _adjust_saturation(
-                a, 1 + _pyrandom.uniform(-self.saturation, self.saturation)
+                a, _pyrandom.uniform(max(0.0, 1 - self.saturation),
+                                     1 + self.saturation)
             )
         if self.hue:
             a = _adjust_hue(a, _pyrandom.uniform(-self.hue, self.hue))
@@ -286,7 +287,7 @@ class ContrastTransform(BaseTransform):
     def _apply_image(self, img):
         a = _to_np(img).astype(np.float32)
         mean = a.mean()
-        f = 1 + _pyrandom.uniform(-self.value, self.value)
+        f = _pyrandom.uniform(max(0.0, 1 - self.value), 1 + self.value)
         return np.clip((a - mean) * f + mean, 0, 255).astype(np.uint8)
 
 
@@ -297,7 +298,7 @@ class SaturationTransform(BaseTransform):
 
     def _apply_image(self, img):
         a = _to_np(img).astype(np.float32)
-        f = 1 + _pyrandom.uniform(-self.value, self.value)
+        f = _pyrandom.uniform(max(0.0, 1 - self.value), 1 + self.value)
         return np.clip(_adjust_saturation(a, f), 0, 255).astype(np.uint8)
 
 
@@ -322,9 +323,10 @@ class RandomErasing(BaseTransform):
         self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
 
     def _apply_image(self, img):
+        was_tensor = hasattr(img, "_value")
         a = _to_np(img).copy()
         if _pyrandom.random() >= self.prob:
-            return a
+            return self._rewrap(a, was_tensor)
         # canonical use is AFTER ToTensor: CHW float in [0, 1]; also accept
         # raw HWC uint8
         chw = a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3)
@@ -344,9 +346,22 @@ class RandomErasing(BaseTransform):
                     shape = a[region].shape
                     a[region] = (np.random.uniform(0, 1, shape) if is_float
                                  else np.random.randint(0, 256, shape))
+                elif isinstance(self.value, (list, tuple)):
+                    fill = np.asarray(self.value, a.dtype)
+                    a[region] = (fill[:, None, None] if chw
+                                 else fill[None, None, :])
                 else:
                     a[region] = self.value
                 break
+        return self._rewrap(a, was_tensor)
+
+    @staticmethod
+    def _rewrap(a, was_tensor):
+        if was_tensor:
+            from ...framework.core import Tensor
+            import jax.numpy as jnp
+
+            return Tensor(jnp.asarray(a))
         return a
 
 
