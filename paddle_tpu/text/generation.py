@@ -1,0 +1,154 @@
+"""Decoding utilities for the causal LM families (GPT / Llama).
+
+Reference capability: PaddleNLP's `GenerationMixin` (greedy/sampling/beam
+over models with cache). TPU-native v1: an eager decode loop that re-runs
+the compiled forward on the growing sequence — each length hits the jit
+cache once, so a generation sweep compiles O(max_len) programs the first
+time and replays them afterwards. A fixed-shape variant
+(`generate_padded`) keeps ONE compiled program by right-padding to
+max_length and masking, which is the TPU-friendly shape discipline for
+serving loops.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from ..framework.op import raw
+
+
+def _check_length(model, needed: int):
+    """Out-of-range position embeddings clamp SILENTLY under XLA gather —
+    raise up front instead of returning corrupted tokens."""
+    cfg = getattr(model, "config", None)
+    limit = getattr(cfg, "max_position_embeddings", None)
+    if limit is not None and needed > limit:
+        raise ValueError(
+            f"generation needs {needed} positions but the model supports "
+            f"max_position_embeddings={limit}"
+        )
+
+
+def _sample_next(logits_row, top_k, top_p, temperature, rng):
+    """numpy sampling over one [V] logits row (host-side: decoding control
+    flow is data-dependent by nature)."""
+    x = np.asarray(logits_row, np.float64)
+    if temperature is not None and temperature <= 0.0:
+        return int(x.argmax())  # temperature -> 0 degenerates to greedy
+    if temperature != 1.0:
+        x = x / temperature
+    if top_k and top_k > 0:
+        k = min(int(top_k), len(x))  # clamp like the reference
+        kth = np.partition(x, -k)[-k]
+        x = np.where(x < kth, -np.inf, x)
+    p = np.exp(x - x.max())
+    p = p / p.sum()
+    if top_p and top_p < 1.0:
+        order = np.argsort(-p)
+        csum = np.cumsum(p[order])
+        # keep the smallest prefix whose cumulative prob REACHES top_p
+        # (standard nucleus semantics: include the crossing token)
+        cut = np.concatenate([[True], csum[:-1] < top_p])
+        keep = order[cut]
+        mask = np.zeros_like(p, bool)
+        mask[keep] = True
+        p = np.where(mask, p, 0.0)
+        p = p / p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+@no_grad()
+def generate(
+    model,
+    input_ids,
+    max_new_tokens: int = 32,
+    do_sample: bool = False,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    temperature: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: Optional[int] = None,
+    seed: Optional[int] = None,
+):
+    """Decode continuations for a batch of prompts.
+
+    Args:
+      model: a causal LM returning [B, T, V] logits when called without
+        labels (GPTForCausalLM / LlamaForCausalLM or compatible).
+      input_ids: [B, T0] prompt tokens (Tensor or array).
+      do_sample: False = greedy; True = top-k / nucleus sampling.
+    Returns [B, T0 + n] token ids (numpy), n <= max_new_tokens (stops early
+    when every sequence has emitted eos).
+    """
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        ids = np.asarray(raw(input_ids))
+        rng = np.random.default_rng(seed)
+        b = ids.shape[0]
+        done = np.zeros(b, bool)
+        filler = pad_token_id if pad_token_id is not None else eos_token_id
+        _check_length(model, ids.shape[1] + max_new_tokens)
+        for _ in range(max_new_tokens):
+            logits = model(Tensor(ids))
+            last = np.asarray(raw(logits))[:, -1, :]  # [B, V]
+            if do_sample:
+                nxt = np.array(
+                    [_sample_next(last[i], top_k, top_p, temperature, rng)
+                     for i in range(b)]
+                )
+            else:
+                nxt = last.argmax(-1)
+            if eos_token_id is not None:
+                nxt = np.where(done, filler, nxt)
+                done |= nxt == eos_token_id
+            ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
+            if eos_token_id is not None and done.all():
+                break
+        return ids
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()
+
+
+@no_grad()
+def generate_padded(
+    model,
+    input_ids,
+    max_length: int,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+):
+    """Greedy decode with ONE fixed shape: the sequence is right-padded to
+    `max_length` so every step re-runs the same compiled program (the
+    TPU serving discipline — no per-length recompilation)."""
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        ids = np.asarray(raw(input_ids))
+        b, t0 = ids.shape
+        assert t0 < max_length, "prompt already at max_length"
+        _check_length(model, max_length)
+        buf = np.full((b, max_length), pad_token_id, ids.dtype)
+        buf[:, :t0] = ids
+        done = np.zeros(b, bool)
+        cur = t0
+        while cur < max_length:
+            logits = model(Tensor(buf))  # fixed [B, max_length, V]
+            last = np.asarray(raw(logits))[:, cur - 1, :]
+            nxt = last.argmax(-1).astype(ids.dtype)
+            if eos_token_id is not None:
+                nxt = np.where(done, pad_token_id, nxt)
+                done |= nxt == eos_token_id
+            buf[:, cur] = nxt
+            cur += 1
+            if eos_token_id is not None and done.all():
+                break
+        return buf[:, :cur]
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()
