@@ -266,3 +266,61 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         h = F.layer_norm(h, [d], weight=ln2_scale, bias=ln2_bias,
                          epsilon=ln2_epsilon)
     return h
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    """incubate.nn.functional.fused_rms_norm parity — rides F.rms_norm
+    (XLA fuses the reduce+scale chain)."""
+    from ..nn import functional as F
+
+    nd = len(x.shape)
+    axis = begin_norm_axis % nd if begin_norm_axis >= 0 else begin_norm_axis % nd
+    if axis != nd - 1:
+        raise NotImplementedError(
+            "fused_rms_norm: only last-axis normalization is supported "
+            f"(begin_norm_axis={begin_norm_axis} on rank-{nd} input)"
+        )
+    out = F.rms_norm(x, norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    name=None):
+    """incubate.nn.functional.fused_rotary_position_embedding parity.
+
+    q/k/v: [B, T, H, D]; sin/cos: [1, T, 1, D], [T, D] duplicated, or
+    [T, D/2] half-dim caches. Rotates every provided input (the reference
+    rotates v too). position_ids and non-neox pairing are not implemented
+    and raise rather than silently mis-rotating.
+    """
+    from ..text.models.llama import _apply_rope, _rope_cache
+    from ..framework.op import raw
+    import jax.numpy as jnp
+
+    if position_ids is not None:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: position_ids offsets are not "
+            "supported; slice the sin/cos caches instead"
+        )
+    if not use_neox_rotary_style:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: interleaved (non-neox) pairing "
+            "is not supported"
+        )
+    d = q.shape[-1]
+    if cos is None or sin is None:
+        c_np, s_np = _rope_cache(q.shape[1], d, 10000.0)
+        cos_h, sin_h = jnp.asarray(c_np), jnp.asarray(s_np)
+    else:
+        cos_v, sin_v = jnp.asarray(raw(cos)), jnp.asarray(raw(sin))
+        cos_v = cos_v.reshape(-1, cos_v.shape[-1])  # [T, D] or [T, D/2]
+        sin_v = sin_v.reshape(-1, sin_v.shape[-1])
+        # accept full-dim duplicated caches ([T, D]) or half-dim ([T, D/2])
+        cos_h = cos_v[:, : d // 2] if cos_v.shape[-1] == d else cos_v
+        sin_h = sin_v[:, : d // 2] if sin_v.shape[-1] == d else sin_v
+    rot = lambda t: _apply_rope(t, cos_h, sin_h) if t is not None else None
+    return rot(q), rot(k), rot(v)

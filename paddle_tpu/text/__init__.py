@@ -10,4 +10,4 @@ from .models import *  # noqa: F401,F403
 from .datasets import Imdb, UCIHousing  # noqa: F401
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 from . import generation  # noqa: F401
-from .generation import generate, generate_padded  # noqa: F401
+from .generation import beam_search, generate, generate_padded  # noqa: F401

@@ -35,7 +35,9 @@ def _sample_next(logits_row, top_k, top_p, temperature, rng):
     """numpy sampling over one [V] logits row (host-side: decoding control
     flow is data-dependent by nature)."""
     x = np.asarray(logits_row, np.float64)
-    if temperature is not None and temperature <= 0.0:
+    if temperature is None:
+        temperature = 1.0
+    if temperature <= 0.0:
         return int(x.argmax())  # temperature -> 0 degenerates to greedy
     if temperature != 1.0:
         x = x / temperature
@@ -131,7 +133,10 @@ def generate_padded(
     try:
         ids = np.asarray(raw(input_ids))
         b, t0 = ids.shape
-        assert t0 < max_length, "prompt already at max_length"
+        if t0 >= max_length:
+            raise ValueError(
+                f"prompt length {t0} already >= max_length {max_length}"
+            )
         _check_length(model, max_length)
         buf = np.full((b, max_length), pad_token_id, ids.dtype)
         buf[:, :t0] = ids
@@ -149,6 +154,76 @@ def generate_padded(
             if eos_token_id is not None and done.all():
                 break
         return buf[:, :cur]
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()
+
+
+@no_grad()
+def beam_search(
+    model,
+    input_ids,
+    max_new_tokens: int = 32,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+):
+    """Beam-search decode (PaddleNLP GenerationMixin beam semantics).
+
+    Host-side beam bookkeeping over the jit-cached forward; scores are
+    sum of log-probs, length-normalized by len**length_penalty at finish.
+    Returns [B, T0 + n] best sequences.
+    """
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        ids0 = np.asarray(raw(input_ids))
+        b, t0 = ids0.shape
+        _check_length(model, t0 + max_new_tokens)
+        results = []
+        for row in range(b):  # per-prompt beams (batch sizes here are small)
+            beams = [(0.0, ids0[row])]  # (logprob_sum, tokens)
+            finished = []
+            for _ in range(max_new_tokens):
+                batch = np.stack([t for _, t in beams])
+                logits = model(Tensor(batch))
+                last = np.asarray(raw(logits))[:, -1, :].astype(np.float64)
+                logp = last - (
+                    np.log(np.exp(last - last.max(-1, keepdims=True)).sum(-1, keepdims=True))
+                    + last.max(-1, keepdims=True)
+                )
+                cand = []
+                for bi, (score, toks) in enumerate(beams):
+                    top = np.argsort(-logp[bi])[: num_beams]
+                    for tok in top:
+                        cand.append(
+                            (score + float(logp[bi][tok]),
+                             np.concatenate([toks, [tok]]).astype(toks.dtype))
+                        )
+                cand.sort(key=lambda x: -x[0])
+                beams = []
+                for score, toks in cand:
+                    if eos_token_id is not None and toks[-1] == eos_token_id:
+                        norm = score / (len(toks) - t0) ** length_penalty
+                        finished.append((norm, toks))
+                    else:
+                        beams.append((score, toks))
+                    if len(beams) == num_beams:
+                        break
+                if not beams:
+                    break
+            for score, toks in beams:  # unfinished beams compete too
+                norm = score / max(len(toks) - t0, 1) ** length_penalty
+                finished.append((norm, toks))
+            finished.sort(key=lambda x: -x[0])
+            results.append(finished[0][1])
+        width = max(len(r) for r in results)
+        pad = eos_token_id if eos_token_id is not None else 0
+        out = np.full((b, width), pad, ids0.dtype)
+        for i, r in enumerate(results):
+            out[i, : len(r)] = r
+        return out
     finally:
         if was_training and hasattr(model, "train"):
             model.train()

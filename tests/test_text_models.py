@@ -262,3 +262,62 @@ def test_generation_greedy_and_sampling():
     # fixed-shape variant agrees with greedy on the generated tokens
     outp = generate_padded(model, prompt, max_length=11)
     np.testing.assert_array_equal(outp, out)
+
+
+def test_beam_search_beats_or_ties_greedy_logprob():
+    from paddle_tpu.text import beam_search, generate
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(8)
+    cfg = GPTConfig(
+        vocab_size=32, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    model = GPTForCausalLM(cfg)
+    prompt = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+    n = 5
+
+    def seq_logprob(tokens):
+        import jax
+
+        logits = model(paddle.to_tensor(tokens[None, :-1]))
+        lp = np.asarray(jax.nn.log_softmax(
+            np.asarray(logits._value), axis=-1))
+        return sum(lp[0, 2 + i, tokens[3 + i]] for i in range(n))
+
+    g = generate(model, prompt, max_new_tokens=n)[0]
+    b = beam_search(model, prompt, max_new_tokens=n, num_beams=4)[0]
+    assert seq_logprob(b) >= seq_logprob(g) - 1e-6
+
+
+def test_incubate_rms_and_rope_functionals():
+    from paddle_tpu.incubate import nn as inn
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(
+        np.random.default_rng(9).standard_normal((2, 8, 16)).astype("float32"))
+    w = paddle.ones([16])
+    np.testing.assert_allclose(
+        inn.fused_rms_norm(x, w).numpy(), F.rms_norm(x, w).numpy(), rtol=1e-6)
+
+    from paddle_tpu.text.models.llama import _apply_rope, _rope_cache
+    import jax.numpy as jnp
+
+    q = paddle.to_tensor(
+        np.random.default_rng(10).standard_normal((1, 8, 2, 8)).astype("float32"))
+    k = paddle.to_tensor(
+        np.random.default_rng(11).standard_normal((1, 8, 2, 8)).astype("float32"))
+    v = paddle.to_tensor(
+        np.random.default_rng(12).standard_normal((1, 8, 2, 8)).astype("float32"))
+    qr, kr, vr = inn.fused_rotary_position_embedding(q, k, v)
+    c, s = _rope_cache(8, 8, 10000.0)
+    ref_q = _apply_rope(q, jnp.asarray(c), jnp.asarray(s))
+    ref_v = _apply_rope(v, jnp.asarray(c), jnp.asarray(s))
+    np.testing.assert_allclose(qr.numpy(), ref_q.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(vr.numpy(), ref_v.numpy(), rtol=1e-5)
+    # the documented 4-D cache layout works too
+    c4 = paddle.to_tensor(np.asarray(c)[None, :, None, :])
+    s4 = paddle.to_tensor(np.asarray(s)[None, :, None, :])
+    qr2, _, _ = inn.fused_rotary_position_embedding(q, cos=c4, sin=s4)
+    np.testing.assert_allclose(qr2.numpy(), ref_q.numpy(), rtol=1e-5)
