@@ -61,6 +61,16 @@ def _sample_next(logits_row, top_k, top_p, temperature, rng):
     return int(rng.choice(len(p), p=p))
 
 
+def _next_tokens(last, do_sample, top_k, top_p, temperature, rng):
+    """[B, V] logits -> [B] next token ids (shared by every decode loop)."""
+    if do_sample:
+        return np.array([
+            _sample_next(last[i], top_k, top_p, temperature, rng)
+            for i in range(last.shape[0])
+        ])
+    return last.argmax(-1)
+
+
 @no_grad()
 def generate(
     model,
@@ -97,13 +107,7 @@ def generate(
         for _ in range(max_new_tokens):
             logits = model(Tensor(ids))
             last = np.asarray(raw(logits))[:, -1, :]  # [B, V]
-            if do_sample:
-                nxt = np.array(
-                    [_sample_next(last[i], top_k, top_p, temperature, rng)
-                     for i in range(b)]
-                )
-            else:
-                nxt = last.argmax(-1)
+            nxt = _next_tokens(last, do_sample, top_k, top_p, temperature, rng)
             if eos_token_id is not None:
                 nxt = np.where(done, filler, nxt)
                 done |= nxt == eos_token_id

@@ -263,3 +263,175 @@ class LlamaForCausalLM(nn.Layer):
             valid = (labels.reshape(loss.shape) != -100).astype(loss.dtype)
             return loss.sum() / valid.sum().clip(min=1.0)
         return logits
+
+
+# ---------------------------------------------------------------------------
+# KV-cache incremental decoding (reference: PaddleNLP Llama `use_cache` path
+# over fused attention with cache_kv). TPU shape discipline: caches are
+# PREALLOCATED [B, Tmax, Hkv, D] buffers updated in place by position, so a
+# jitted decode step has one fixed signature for the whole generation.
+# ---------------------------------------------------------------------------
+
+@defop(name="rope_at")
+def _apply_rope_at(x, cos, sin, pos):
+    """Rotate a single-step [B, 1, H, D] tensor at absolute position `pos`."""
+    import jax
+    import jax.numpy as jnp
+
+    d2 = x.shape[-1] // 2
+    c = jax.lax.dynamic_slice_in_dim(cos, pos, 1, 0)[None, :, None, :]
+    s = jax.lax.dynamic_slice_in_dim(sin, pos, 1, 0)[None, :, None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+@defop(name="cache_write")
+def _cache_write(cache, kv, pos):
+    """cache [B, Tmax, Hkv, D] <- kv [B, T, Hkv, D] at [pos : pos+T]."""
+    import jax
+
+    return jax.lax.dynamic_update_slice_in_dim(cache, kv.astype(cache.dtype), pos, 1)
+
+
+@defop(name="decode_attention")
+def _decode_attention(q, ck, cv, pos):
+    """One-step attention against the cache: q [B, 1, H, D] over
+    ck/cv [B, Tmax, Hkv, D], positions > pos masked out."""
+    import jax
+    import jax.numpy as jnp
+
+    b, _, hq, d = q.shape
+    tmax, hkv = ck.shape[1], ck.shape[2]
+    group = hq // hkv
+    k = jnp.repeat(ck, group, axis=2)
+    v = jnp.repeat(cv, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    mask = jnp.arange(tmax)[None, None, None, :] <= pos
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _attn_prefill(attn: "LlamaAttention", x, cache):
+    b, t, h = x.shape
+    q = attn.q_proj(x).reshape([b, t, attn.num_heads, attn.head_dim])
+    k = attn.k_proj(x).reshape([b, t, attn.num_kv_heads, attn.head_dim])
+    v = attn.v_proj(x).reshape([b, t, attn.num_kv_heads, attn.head_dim])
+    q = _apply_rope(q, attn.rope_cos, attn.rope_sin)
+    k = _apply_rope(k, attn.rope_cos, attn.rope_sin)
+    cache["k"] = _cache_write(cache["k"], k, 0)
+    cache["v"] = _cache_write(cache["v"], v, 0)
+    if attn.use_flash:
+        o = _gqa_attention(q, k, v, causal=True)
+    else:
+        from ... import tensor as pt
+
+        group = attn.num_heads // attn.num_kv_heads
+        o = F.scaled_dot_product_attention(
+            q, pt.repeat_interleave(k, group, axis=2),
+            pt.repeat_interleave(v, group, axis=2), is_causal=True,
+            training=False,
+        )
+    return attn.o_proj(o.reshape([b, t, h]))
+
+
+def _attn_decode(attn: "LlamaAttention", x, cache, pos: int):
+    b, t, h = x.shape  # t == 1
+    q = attn.q_proj(x).reshape([b, t, attn.num_heads, attn.head_dim])
+    k = attn.k_proj(x).reshape([b, t, attn.num_kv_heads, attn.head_dim])
+    v = attn.v_proj(x).reshape([b, t, attn.num_kv_heads, attn.head_dim])
+    q = _apply_rope_at(q, attn.rope_cos, attn.rope_sin, pos=pos)
+    k = _apply_rope_at(k, attn.rope_cos, attn.rope_sin, pos=pos)
+    cache["k"] = _cache_write(cache["k"], k, pos)
+    cache["v"] = _cache_write(cache["v"], v, pos)
+    o = _decode_attention(q, cache["k"], cache["v"], pos=pos)
+    return attn.o_proj(o.reshape([b, t, h]))
+
+
+def _layer_step(layer: "LlamaDecoderLayer", x, cache, pos: Optional[int]):
+    h = layer.input_layernorm(x)
+    if pos is None:
+        a = _attn_prefill(layer.self_attn, h, cache)
+    else:
+        a = _attn_decode(layer.self_attn, h, cache, pos)
+    x = x + a
+    return x + layer.mlp(layer.post_attention_layernorm(x))
+
+
+def _llama_cached_forward(self, input_ids, caches, pos: Optional[int]):
+    if not isinstance(self.layers, nn.LayerList):
+        raise NotImplementedError(
+            "KV-cache decoding requires the non-pipelined decoder "
+            "(pp_degree=1); pipelined serving uses generate_padded"
+        )
+    x = self.embed_tokens(input_ids)
+    for blk, cache in zip(self.layers, caches):
+        x = _layer_step(blk, x, cache, pos)
+    return self.norm(x)
+
+
+def _llama_init_cache(self, batch_size: int, max_length: int):
+    """Preallocated per-layer KV caches (fp32; one fixed decode shape)."""
+    import jax.numpy as jnp
+
+    c = self.config
+    hkv, d = c.num_key_value_heads, c.hidden_size // c.num_attention_heads
+    return [
+        {"k": Tensor(jnp.zeros((batch_size, max_length, hkv, d), jnp.float32)),
+         "v": Tensor(jnp.zeros((batch_size, max_length, hkv, d), jnp.float32))}
+        for _ in range(c.num_hidden_layers)
+    ]
+
+
+def _llama_generate(self, input_ids, max_new_tokens: int = 32,
+                    do_sample: bool = False, top_k: int = 0, top_p: float = 1.0,
+                    temperature: float = 1.0, eos_token_id=None,
+                    pad_token_id=None, seed=None):
+    """KV-cached generation: one prefill over the prompt, then one-token
+    decode steps against the preallocated caches (each step attends over
+    the cache instead of re-running the whole prefix)."""
+    from ...framework.core import no_grad
+    from ..generation import _check_length, _next_tokens
+
+    with no_grad():
+        was_training = self.training
+        self.eval()
+        try:
+            ids = np.asarray(raw(input_ids))
+            b, t0 = ids.shape
+            max_len = t0 + max_new_tokens
+            _check_length(self, max_len)
+            rng = np.random.default_rng(seed)
+            caches = _llama_init_cache(self.llama, b, max_len)
+            hidden = _llama_cached_forward(
+                self.llama, Tensor(ids), caches, pos=None
+            )
+            done = np.zeros(b, bool)
+            filler = pad_token_id if pad_token_id is not None else eos_token_id
+            for step in range(max_new_tokens):
+                # project ONLY the last position (hidden[:, -1:] slices away
+                # the prompt before the [hidden, vocab] matmul)
+                last = np.asarray(raw(self._logits(hidden[:, -1:])))[:, -1, :]
+                nxt = _next_tokens(last, do_sample, top_k, top_p, temperature, rng)
+                if eos_token_id is not None:
+                    nxt = np.where(done, filler, nxt)
+                    done |= nxt == eos_token_id
+                ids = np.concatenate(
+                    [ids, nxt[:, None].astype(ids.dtype)], axis=1
+                )
+                if (eos_token_id is not None and done.all()) \
+                        or step == max_new_tokens - 1:
+                    break
+                hidden = _llama_cached_forward(
+                    self.llama, Tensor(ids[:, -1:]), caches, pos=t0 + step
+                )
+            return ids
+        finally:
+            if was_training:
+                self.train()
+
+
+LlamaForCausalLM.generate = _llama_generate
