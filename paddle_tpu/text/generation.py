@@ -231,3 +231,59 @@ def beam_search(
     finally:
         if was_training and hasattr(model, "train"):
             model.train()
+
+
+def alloc_kv_caches(num_layers: int, batch_size: int, max_length: int,
+                    num_kv_heads: int, head_dim: int):
+    """Per-layer zero KV caches [B, Tmax, Hkv, D] fp32 (shared by every
+    cached model: one place owns layout/dtype)."""
+    import jax.numpy as jnp
+
+    return [
+        {"k": Tensor(jnp.zeros(
+            (batch_size, max_length, num_kv_heads, head_dim), jnp.float32)),
+         "v": Tensor(jnp.zeros(
+            (batch_size, max_length, num_kv_heads, head_dim), jnp.float32))}
+        for _ in range(num_layers)
+    ]
+
+
+@no_grad()
+def run_cached_generation(model, cached_forward, init_cache, logits_fn,
+                          input_ids, max_new_tokens=32, do_sample=False,
+                          top_k=0, top_p=1.0, temperature=1.0,
+                          eos_token_id=None, pad_token_id=None, seed=None):
+    """Shared prefill + one-token-decode loop for KV-cached models.
+
+    cached_forward(ids_tensor, caches, pos_or_None) -> hidden;
+    init_cache(batch, max_len) -> caches; logits_fn(hidden) -> [B, t, V].
+    """
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        ids = np.asarray(raw(input_ids))
+        b, t0 = ids.shape
+        max_len = t0 + max_new_tokens
+        _check_length(model, max_len)
+        rng = np.random.default_rng(seed)
+        caches = init_cache(b, max_len)
+        hidden = cached_forward(Tensor(ids), caches, None)  # prefill
+        done = np.zeros(b, bool)
+        filler = pad_token_id if pad_token_id is not None else eos_token_id
+        for step in range(max_new_tokens):
+            # project ONLY the final position to vocab
+            last = np.asarray(raw(logits_fn(hidden[:, -1:])))[:, -1, :]
+            nxt = _next_tokens(last, do_sample, top_k, top_p, temperature, rng)
+            if eos_token_id is not None:
+                nxt = np.where(done, filler, nxt)
+                done |= nxt == eos_token_id
+            ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
+            if (eos_token_id is not None and done.all()) \
+                    or step == max_new_tokens - 1:
+                break
+            hidden = cached_forward(Tensor(ids[:, -1:]), caches, t0 + step)
+        return ids
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()

@@ -247,3 +247,81 @@ class GPTForCausalLM(nn.Layer):
 
 GPTLMHeadModel = GPTForCausalLM
 GPTForPretraining = GPTForCausalLM
+
+
+# ---------------------------------------------------------------------------
+# KV-cache incremental decoding (mirrors llama.py; shares the cache-write and
+# cache-attention defops and the text.generation loop). llama never imports
+# gpt, so this import is cycle-free.
+# ---------------------------------------------------------------------------
+from .llama import _cache_write, _decode_attention  # noqa: E402
+
+def _gpt_qkv(attn: "GPTAttention", x):
+    """The SAME projection+split GPTAttention.forward performs (one place)."""
+    b, t, _ = x.shape
+    qkv = attn.qkv_proj(x).reshape([b, t, 3, attn.num_heads, attn.head_dim])
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _gpt_attn_cached(attn: "GPTAttention", x, cache, pos):
+    """Prefill (pos None) or one-step decode (pos int) against the cache."""
+    b, t, h = x.shape
+    q, k, v = _gpt_qkv(attn, x)
+    cache["k"] = _cache_write(cache["k"], k, 0 if pos is None else pos)
+    cache["v"] = _cache_write(cache["v"], v, 0 if pos is None else pos)
+    if pos is None:
+        o = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, training=False
+        )
+    else:
+        o = _decode_attention(q, cache["k"], cache["v"], pos=pos)
+    return attn.out_proj(o.reshape([b, t, h]))
+
+
+def _gpt_cached_forward(model: "GPTModel", input_ids, caches, pos):
+    from ... import tensor as pt
+
+    if not isinstance(model.decoder, nn.LayerList):
+        raise NotImplementedError(
+            "KV-cache decoding requires the non-pipelined decoder "
+            "(pp_degree=1); pipelined serving uses generate_padded"
+        )
+    if pos is None:
+        x = model.embeddings(input_ids)
+    else:
+        position_ids = pt.arange(pos, pos + 1, dtype="int64")
+        x = model.embeddings(input_ids, position_ids)
+    for blk, cache in zip(model.decoder, caches):
+        x = x + _gpt_attn_cached(blk.attn, blk.ln_1(x), cache, pos)
+        x = x + blk.mlp(blk.ln_2(x))
+    return model.final_layernorm(x)
+
+
+def _gpt_init_cache(model: "GPTModel", batch_size: int, max_length: int):
+    from ..generation import alloc_kv_caches
+
+    c = model.config
+    return alloc_kv_caches(
+        c.num_hidden_layers, batch_size, max_length, c.num_attention_heads,
+        c.hidden_size // c.num_attention_heads,
+    )
+
+
+def _gpt_generate(self, input_ids, max_new_tokens: int = 32,
+                  do_sample: bool = False, top_k: int = 0, top_p: float = 1.0,
+                  temperature: float = 1.0, eos_token_id=None,
+                  pad_token_id=None, seed=None):
+    from ..generation import run_cached_generation
+
+    return run_cached_generation(
+        self,
+        lambda ids, caches, pos: _gpt_cached_forward(self.gpt, ids, caches, pos),
+        lambda b, n: _gpt_init_cache(self.gpt, b, n),
+        self._logits,
+        input_ids, max_new_tokens=max_new_tokens, do_sample=do_sample,
+        top_k=top_k, top_p=top_p, temperature=temperature,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed,
+    )
+
+
+GPTForCausalLM.generate = _gpt_generate

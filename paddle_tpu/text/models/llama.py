@@ -374,16 +374,13 @@ def _llama_cached_forward(self, input_ids, caches, pos: Optional[int]):
 
 
 def _llama_init_cache(self, batch_size: int, max_length: int):
-    """Preallocated per-layer KV caches (fp32; one fixed decode shape)."""
-    import jax.numpy as jnp
+    from ..generation import alloc_kv_caches
 
     c = self.config
-    hkv, d = c.num_key_value_heads, c.hidden_size // c.num_attention_heads
-    return [
-        {"k": Tensor(jnp.zeros((batch_size, max_length, hkv, d), jnp.float32)),
-         "v": Tensor(jnp.zeros((batch_size, max_length, hkv, d), jnp.float32))}
-        for _ in range(c.num_hidden_layers)
-    ]
+    return alloc_kv_caches(
+        c.num_hidden_layers, batch_size, max_length, c.num_key_value_heads,
+        c.hidden_size // c.num_attention_heads,
+    )
 
 
 def _llama_generate(self, input_ids, max_new_tokens: int = 32,
@@ -391,47 +388,19 @@ def _llama_generate(self, input_ids, max_new_tokens: int = 32,
                     temperature: float = 1.0, eos_token_id=None,
                     pad_token_id=None, seed=None):
     """KV-cached generation: one prefill over the prompt, then one-token
-    decode steps against the preallocated caches (each step attends over
-    the cache instead of re-running the whole prefix)."""
-    from ...framework.core import no_grad
-    from ..generation import _check_length, _next_tokens
+    decode steps against the preallocated caches (see
+    text.generation.run_cached_generation for the shared loop)."""
+    from ..generation import run_cached_generation
 
-    with no_grad():
-        was_training = self.training
-        self.eval()
-        try:
-            ids = np.asarray(raw(input_ids))
-            b, t0 = ids.shape
-            max_len = t0 + max_new_tokens
-            _check_length(self, max_len)
-            rng = np.random.default_rng(seed)
-            caches = _llama_init_cache(self.llama, b, max_len)
-            hidden = _llama_cached_forward(
-                self.llama, Tensor(ids), caches, pos=None
-            )
-            done = np.zeros(b, bool)
-            filler = pad_token_id if pad_token_id is not None else eos_token_id
-            for step in range(max_new_tokens):
-                # project ONLY the last position (hidden[:, -1:] slices away
-                # the prompt before the [hidden, vocab] matmul)
-                last = np.asarray(raw(self._logits(hidden[:, -1:])))[:, -1, :]
-                nxt = _next_tokens(last, do_sample, top_k, top_p, temperature, rng)
-                if eos_token_id is not None:
-                    nxt = np.where(done, filler, nxt)
-                    done |= nxt == eos_token_id
-                ids = np.concatenate(
-                    [ids, nxt[:, None].astype(ids.dtype)], axis=1
-                )
-                if (eos_token_id is not None and done.all()) \
-                        or step == max_new_tokens - 1:
-                    break
-                hidden = _llama_cached_forward(
-                    self.llama, Tensor(ids[:, -1:]), caches, pos=t0 + step
-                )
-            return ids
-        finally:
-            if was_training:
-                self.train()
+    return run_cached_generation(
+        self,
+        lambda ids, caches, pos: _llama_cached_forward(self.llama, ids, caches, pos),
+        lambda b, n: _llama_init_cache(self.llama, b, n),
+        self._logits,
+        input_ids, max_new_tokens=max_new_tokens, do_sample=do_sample,
+        top_k=top_k, top_p=top_p, temperature=temperature,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed,
+    )
 
 
 LlamaForCausalLM.generate = _llama_generate

@@ -346,3 +346,23 @@ def test_llama_kv_cache_generate_matches_full_recompute():
     s1 = model.generate(prompt, max_new_tokens=5, do_sample=True, top_k=8, seed=3)
     s2 = model.generate(prompt, max_new_tokens=5, do_sample=True, top_k=8, seed=3)
     np.testing.assert_array_equal(s1, s2)
+
+
+def test_gpt_kv_cache_generate_matches_full_recompute():
+    from paddle_tpu.text import generate
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(15)
+    cfg = GPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    model = GPTForCausalLM(cfg)
+    prompt = paddle.to_tensor(
+        np.random.default_rng(16).integers(0, 96, (2, 5)).astype(np.int32)
+    )
+    np.testing.assert_array_equal(
+        generate(model, prompt, max_new_tokens=7),
+        model.generate(prompt, max_new_tokens=7),
+    )
