@@ -137,3 +137,24 @@ def in_dynamic_mode():
 
 
 
+
+# ---- remaining top-level namespaces (paddle.* parity) ---------------------
+from . import utils  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
+from .optimizer import L1Decay, L2Decay  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity: delayed parameter initialization. Parameter
+    creation here is cheap host-side numpy/jax init, so the guard is a
+    transparent context (initialization simply happens at construction)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

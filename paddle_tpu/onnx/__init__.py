@@ -1,0 +1,22 @@
+"""paddle.onnx parity surface.
+
+The reference exports via paddle2onnx. This environment has no onnx
+runtime; the TPU-native serialized artifact is StableHLO via
+``paddle_tpu.jit.save`` (consumed by paddle_tpu.inference.Predictor), so
+``export`` raises with that guidance unless the optional onnx stack is
+importable.
+"""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "onnx is not available in this image; use paddle_tpu.jit.save "
+            "(StableHLO artifact + paddle_tpu.inference.Predictor) for "
+            "serialized serving"
+        )
+    raise NotImplementedError(
+        "onnx export is not implemented; use paddle_tpu.jit.save"
+    )

@@ -1,0 +1,4 @@
+"""paddle.regularizer parity: weight-decay regularizers (importable module
+so both `paddle.regularizer.L2Decay` and
+`from paddle_tpu.regularizer import L2Decay` work)."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401

@@ -19,6 +19,20 @@ from ..framework.core import Tensor, no_grad
 from ..framework.op import raw
 
 
+def _without_grad(fn):
+    """Decorator creating a FRESH no_grad context per call: the shared
+    ContextDecorator instance stores its saved state on itself, which is
+    not reentrant across nested/concurrent generate calls."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with no_grad():
+            return fn(*a, **k)
+
+    return wrapper
+
+
 def _check_length(model, needed: int):
     """Out-of-range position embeddings clamp SILENTLY under XLA gather —
     raise up front instead of returning corrupted tokens."""
@@ -71,7 +85,7 @@ def _next_tokens(last, do_sample, top_k, top_p, temperature, rng):
     return last.argmax(-1)
 
 
-@no_grad()
+@_without_grad
 def generate(
     model,
     input_ids,
@@ -120,7 +134,7 @@ def generate(
             model.train()
 
 
-@no_grad()
+@_without_grad
 def generate_padded(
     model,
     input_ids,
@@ -163,7 +177,7 @@ def generate_padded(
             model.train()
 
 
-@no_grad()
+@_without_grad
 def beam_search(
     model,
     input_ids,
@@ -248,7 +262,7 @@ def alloc_kv_caches(num_layers: int, batch_size: int, max_length: int,
     ]
 
 
-@no_grad()
+@_without_grad
 def run_cached_generation(model, cached_forward, init_cache, logits_fn,
                           input_ids, max_new_tokens=32, do_sample=False,
                           top_k=0, top_p=1.0, temperature=1.0,

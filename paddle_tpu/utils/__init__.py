@@ -1,0 +1,90 @@
+"""paddle.utils parity: import helpers, checks, unique names, deprecation.
+
+Reference: ``python/paddle/utils/`` (download/lazy-import/env checks).
+Network-dependent pieces (download, hub) are gated for this offline
+environment and raise with guidance.
+"""
+from __future__ import annotations
+
+import importlib
+import warnings
+
+_name_counters = {}
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Import a module, raising a readable error when absent
+    (reference: paddle.utils.try_import)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+            f"(offline image: only baked-in packages are available)"
+        ) from e
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the backend computes."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = (a @ a).numpy()
+    assert out[0, 0] == 2.0
+    import jax
+
+    print(
+        f"paddle_tpu is installed successfully! backend="
+        f"{jax.default_backend()}, devices={len(jax.devices())}"
+    )
+
+
+def unique_name(prefix: str = "var") -> str:
+    """Monotonic unique names (reference: paddle.utils.unique_name.generate)."""
+    n = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class _UniqueNameNS:
+    generate = staticmethod(unique_name)
+
+    @staticmethod
+    def guard(new_generator=None):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+unique_name_ns = _UniqueNameNS()
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "", level=1):
+    """Decorator emitting a DeprecationWarning on first call."""
+
+    def deco(fn):
+        warned = []
+
+        def wrapper(*a, **k):
+            if not warned:
+                warned.append(1)
+                warnings.warn(
+                    f"{fn.__name__} is deprecated since {since}: {reason}"
+                    + (f"; use {update_to}" if update_to else ""),
+                    DeprecationWarning,
+                )
+            return fn(*a, **k)
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return deco
+
+
+def download(url, path=None, md5sum=None):
+    raise RuntimeError(
+        "paddle_tpu.utils.download: this environment has no network egress; "
+        "place files locally and load them directly"
+    )

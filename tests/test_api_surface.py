@@ -36,7 +36,8 @@ set_grad_enabled sgn shape sign signbit sin sinh slice sort split sqrt square
 squeeze stack standard_normal std subtract sum summary t take take_along_axis
 tan tanh tensordot tile to_tensor topk trace transpose tril triu trunc unbind
 unique unique_consecutive unsqueeze unstack var vsplit where zeros
-zeros_like""".split()
+zeros_like Model callbacks utils onnx version regularizer DataParallel
+LazyGuard""".split()
 
 NN = """Linear Conv1D Conv2D Conv3D Conv1DTranspose Conv2DTranspose
 Conv3DTranspose BatchNorm1D BatchNorm2D BatchNorm3D SyncBatchNorm LayerNorm
