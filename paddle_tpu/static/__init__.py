@@ -325,11 +325,63 @@ def set_program_state(program, state):
 # params files consumed by AnalysisPredictor; here jit.save → .pdmodel
 # StableHLO + .pdiparams consumed by paddle_tpu.inference.Predictor).
 def save(program, model_path, protocol=4):
-    raise NotImplementedError("use paddle_tpu.save / paddle_tpu.jit.save")
+    """Persist a static Program's parameter/buffer state (reference:
+    paddle.static.save → <path>.pdparams). The op list itself is NOT
+    serialized (it holds jax callables); a load re-binds values into a
+    program rebuilt by re-running the user's build code — the same
+    contract as the reference's save/load of persistables."""
+    layers = getattr(program, "_static_nn_layers", {})
+    if not layers:
+        raise ValueError(
+            "static.save found no parameters on this Program (build it "
+            "with static.nn layers first)"
+        )
+    # keys are (stable layer key, param index): reordering same-shaped
+    # layers in the build code becomes a loud key mismatch, not a silent
+    # weight swap
+    state = {}
+    for lkey, layer in layers.items():
+        for i, p in enumerate(layer.parameters()):
+            state[f"{lkey}::{i}"] = np.asarray(raw(p))
+    np.savez(model_path + ".pdparams.npz", **state)
+    return list(state)
 
 
 def load(program, model_path, executor=None, var_list=None):
-    raise NotImplementedError("use paddle_tpu.load / paddle_tpu.jit.load")
+    """Re-bind saved values into `program`'s parameters by stable key."""
+    import jax.numpy as jnp
+
+    if var_list is not None:
+        raise NotImplementedError(
+            "static.load(var_list=...) subset loading is not supported; "
+            "load the full program state"
+        )
+    layers = getattr(program, "_static_nn_layers", {})
+    want = {}
+    for lkey, layer in layers.items():
+        for i, p in enumerate(layer.parameters()):
+            want[f"{lkey}::{i}"] = p
+    with np.load(model_path + ".pdparams.npz") as data:
+        if set(data.files) != set(want):
+            missing = sorted(set(want) - set(data.files))[:3]
+            extra = sorted(set(data.files) - set(want))[:3]
+            raise ValueError(
+                "checkpoint/program parameter keys differ — rebuild the "
+                f"same program first (missing {missing}, extra {extra})"
+            )
+        for key, p in want.items():
+            v = data[key]
+            if tuple(v.shape) != tuple(p.shape):
+                raise ValueError(
+                    f"{key} shape mismatch: checkpoint {v.shape} vs "
+                    f"program {tuple(p.shape)}"
+                )
+            if str(v.dtype) != str(np.dtype(str(raw(p).dtype))):
+                raise ValueError(
+                    f"{key} dtype mismatch: checkpoint {v.dtype} vs "
+                    f"program {raw(p).dtype}"
+                )
+            p._rebind(jnp.asarray(v))
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, *, model=None, input_spec=None, **kwargs):

@@ -171,3 +171,22 @@ def test_multiple_fetches_and_intermediate():
     ra, rb = exe.run(main, feed={"x": xv}, fetch_list=[a, b])
     np.testing.assert_allclose(ra, xv * 2)
     np.testing.assert_allclose(rb, xv * 2 + 1)
+
+
+def test_static_save_load_roundtrip(tmp_path):
+    paddle.seed(6)
+    main, out = _build_fc_program()
+    exe = static.Executor()
+    x = np.random.default_rng(7).standard_normal((3, 8)).astype("float32")
+    (before,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+
+    path = str(tmp_path / "ckpt")
+    static.save(main, path)
+    # perturb every parameter, then restore
+    for p in static.nn.static_parameters(main):
+        p._rebind(p._value * 0.0)
+    (zeroed,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert not np.allclose(zeroed, before)
+    static.load(main, path)
+    (after,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(after, before, rtol=1e-6)
