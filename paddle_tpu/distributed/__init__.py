@@ -94,6 +94,7 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
 
 QueueDataset = None  # PS-mode datasets: deliberate non-goal (SURVEY.md §2.3 PS)
 
+from .collective import P2POp, batch_isend_irecv  # noqa: E402,F401
 from . import launch  # noqa: E402,F401  (paddle.distributed.launch module)
 
 

@@ -306,3 +306,72 @@ class stream:
     reduce = staticmethod(reduce)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+class P2POp:
+    """paddle.distributed.P2POp parity: a deferred point-to-point op for
+    batch_isend_irecv (reference: communication/batch_isend_irecv.py)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (send, recv, isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.send/recv")
+        if not isinstance(tensor, Tensor):
+            raise TypeError(
+                "P2POp tensor must be a paddle Tensor (recv rebinds it "
+                "in place; a raw array cannot receive)"
+            )
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps as fused collective permutes.
+
+    Single-controller SPMD semantics: the program is traced ONCE for all
+    ranks, so per-rank divergent P2P declarations cannot exist. Each
+    declared op is therefore interpreted as a UNIFORM RELATIVE SHIFT —
+    `P2POp(send, t, peer)` means "every rank r sends t to
+    (r + (peer - my_rank)) % n" — which is exactly the symmetric
+    ring/neighbor pattern the reference's pipeline codes use
+    batch_isend_irecv for. Each send becomes one `lax.ppermute`; each recv
+    must match a send with the complementary shift and has its tensor
+    rebound to that permute's output. Recv-only batches (no payload
+    visible to the trace) and unmatched recvs raise. Must run inside a
+    shard_map-traced region, like send/recv. Returns [] (synchronous).
+    """
+    sends = [p for p in p2p_op_list if p.op in (send, isend)]
+    recvs = [p for p in p2p_op_list if p.op in (recv, irecv)]
+    if not sends and not recvs:
+        return []
+    if not sends:
+        raise ValueError(
+            "batch_isend_irecv under SPMD needs at least one send in the "
+            "batch: a traced program has no rank-divergent branches, so a "
+            "recv-only batch has no payload to transmit"
+        )
+    g = _resolve_group(sends[0].group)
+    n = g.nranks
+    me = max(g.rank, 0)
+    out_by_shift = {}
+    for p in sends:
+        shift = (g.get_group_rank(p.peer) if p.peer in g.ranks else p.peer)
+        shift = (shift - me) % n
+        if shift in out_by_shift:
+            raise ValueError(
+                f"two sends with the same relative shift {shift}; their "
+                "payloads would collide in one permutation"
+            )
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        out_by_shift[shift] = ppermute(p.tensor, perm, group=g)
+    for p in recvs:
+        src = (g.get_group_rank(p.peer) if p.peer in g.ranks else p.peer)
+        shift = (me - src) % n
+        if shift not in out_by_shift:
+            raise ValueError(
+                f"recv from relative offset {shift} has no matching send "
+                f"in the batch (sends cover shifts {sorted(out_by_shift)})"
+            )
+        p.tensor._rebind(raw(out_by_shift[shift]))
+    return []

@@ -87,7 +87,7 @@ OneCycleLR CyclicLR MultiplicativeDecay""".split()
 DIST = """init_parallel_env get_rank get_world_size all_reduce all_gather
 broadcast reduce scatter reduce_scatter alltoall send recv barrier new_group
 get_group spawn launch ParallelEnv fleet ReduceOp shard_tensor reshard Shard
-Replicate ProcessMesh DataParallel split""".split()
+Replicate ProcessMesh DataParallel split P2POp batch_isend_irecv""".split()
 
 
 @pytest.mark.parametrize("ns,names", [

@@ -297,3 +297,48 @@ def test_data_parallel_wrapper():
     assert y.shape == [8, 4]
     with dp_m.no_sync():
         pass
+
+
+def test_batch_isend_irecv_ring():
+    """P2P batches are uniform relative shifts under SPMD: the classic
+    neighbor ring exchanges correctly, multi-shift batches keep payloads
+    separate, recv-only batches raise."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.framework.core import Tensor
+
+    _init()
+    g = dist.get_group()
+    ax = g.axis_names[0]
+
+    def body(x):
+        fwd = Tensor(jnp.zeros_like(x))
+        bwd = Tensor(jnp.zeros_like(x))
+        dist.batch_isend_irecv([
+            dist.P2POp(dist.isend, Tensor(x), 1, group=g),        # shift +1
+            dist.P2POp(dist.isend, Tensor(x * 10.0), 7, group=g), # shift -1
+            dist.P2POp(dist.irecv, fwd, 7, group=g),              # from -1
+            dist.P2POp(dist.irecv, bwd, 1, group=g),              # from +1
+        ])
+        return fwd._value, bwd._value
+
+    f = jax.jit(jax.shard_map(
+        body, mesh=g.mesh, in_specs=P(ax), out_specs=(P(ax), P(ax)),
+        check_vma=False,
+    ))
+    fwd, bwd = f(jnp.arange(8.0))
+    assert np.asarray(fwd).tolist() == [7.0, 0, 1, 2, 3, 4, 5, 6]
+    assert np.asarray(bwd).tolist() == [10.0, 20, 30, 40, 50, 60, 70, 0.0]
+
+    with pytest.raises(ValueError, match="at least one send"):
+        def recv_only(x):
+            dist.batch_isend_irecv(
+                [dist.P2POp(dist.irecv, Tensor(x), 1, group=g)]
+            )
+            return x
+        jax.jit(jax.shard_map(
+            recv_only, mesh=g.mesh, in_specs=P(ax), out_specs=P(ax),
+            check_vma=False,
+        ))(jnp.arange(8.0))
