@@ -180,3 +180,13 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+
+
+def is_float16_supported(device=None):
+    """fp16 computes everywhere under XLA; on TPU bf16 is the native fast
+    path (see is_bfloat16_supported)."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
