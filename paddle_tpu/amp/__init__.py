@@ -20,7 +20,10 @@ from ..framework import dtypes as _dtypes
 from ..framework.core import Tensor, no_grad
 from ..framework.op import AMP_BLACK, AMP_WHITE, amp_state, raw
 
-__all__ = ["auto_cast", "autocast", "amp_guard", "decorate", "GradScaler"]
+__all__ = ["auto_cast", "autocast", "amp_guard", "decorate", "GradScaler",
+    "is_float16_supported",
+    "is_bfloat16_supported",
+]
 
 
 @contextlib.contextmanager

@@ -27,6 +27,7 @@ the schedule lives *inside one compiled program*:
 from __future__ import annotations
 
 import functools
+import warnings
 import re
 from typing import Callable, List, Optional, Sequence
 
@@ -65,8 +66,46 @@ class SharedLayerDesc(LayerDesc):
         self.shared_weight_attr = shared_weight_attr
 
 
+def _cfg_sig(layer: Layer):
+    """Primitive/callable config fingerprint: Dropout(p=0.1) vs Dropout(
+    p=0.5), or wrappers holding different forward functions, must not fold
+    together (conservative: differing benign attrs merely prevent folding,
+    which is always safe)."""
+    out = []
+    for k, v in sorted(vars(layer).items()):
+        if k == "training":
+            continue  # runtime mode flag, not identity
+        if isinstance(v, (bool, int, float, str, type(None))):
+            out.append((k, v))
+        elif isinstance(v, (tuple, list)) and all(
+            isinstance(i, (bool, int, float, str, type(None))) for i in v
+        ):
+            out.append((k, tuple(v)))
+        elif isinstance(v, dict) and all(
+            isinstance(i, (bool, int, float, str, type(None)))
+            for i in v.values()
+        ):
+            out.append((k, tuple(sorted(v.items()))))
+        elif callable(v) and not isinstance(v, (Layer, Tensor)):
+            out.append((k, getattr(v, "__qualname__", type(v).__name__)))
+    return tuple(out)
+
+
+def _type_sig(layer: Layer):
+    """Recursive structural identity: type chain + per-layer config
+    fingerprint. Sequential(Linear, ReLU) must NOT match
+    Sequential(Linear, Tanh), and same-typed blocks with different config
+    (dropout rate, wrapped forward fn) must not fold either — folding runs
+    every block through the template's forward."""
+    return (
+        type(layer).__name__,
+        _cfg_sig(layer),
+        tuple(_type_sig(l) for l in layer._sub_layers.values() if l is not None),
+    )
+
+
 def _param_sig(layer: Layer):
-    return tuple(
+    return (_type_sig(layer),) + tuple(
         (n, tuple(raw(p).shape), str(raw(p).dtype)) for n, p in layer.named_parameters()
     ) + tuple(
         (n, tuple(raw(b).shape), str(raw(b).dtype)) for n, b in layer.named_buffers()
@@ -233,8 +272,6 @@ def _choose_microbatches(batch: int, requested: int, warn: bool = True) -> int:
     while batch % m != 0:
         m -= 1
     if warn and m != requested:
-        import warnings
-
         warnings.warn(
             f"num_microbatches={requested} does not divide batch={batch}; "
             f"using {m} micro-batches instead (pipeline bubble grows — pad "
@@ -437,39 +474,46 @@ class PipelineLayer(Layer):
                     j += 1
             runs.append((i, j))
             i = j + 1
-        best = max(runs, key=lambda r: r[1] - r[0])
-        lo, hi = best
-        n_run = hi - lo + 1
+        # fold EVERY homogeneous run long enough to stage-shard into its own
+        # SpmdPipeline — heterogeneous pipelines (e.g. a conv stem run + a
+        # transformer body run) get each body partitioned; non-foldable
+        # layers between runs execute replicated (cheap under SPMD)
         self._segments: List[Layer] = []
-        n_virtual = max(num_virtual_pipeline_stages or 1, 1)
-        n_chunks = self.num_stages * n_virtual
-        if n_virtual > 1 and (n_run < n_chunks or n_run % n_chunks != 0) and n_run % self.num_stages == 0:
-            # virtual stages don't divide the run — fall back to V=1 rather
-            # than silently disabling pipelining altogether
-            import warnings
-
-            warnings.warn(
-                f"num_virtual_pipeline_stages={n_virtual} does not divide the "
-                f"{n_run}-block run over {self.num_stages} stages; falling "
-                "back to non-interleaved pipeline"
-            )
-            n_virtual = 1
-            n_chunks = self.num_stages
-        if self.num_stages > 1 and n_run >= n_chunks and n_run % n_chunks == 0:
-            for l in built[:lo]:
-                self._segments.append(l)
-            self._segments.append(
-                SpmdPipeline(
-                    built[lo : hi + 1],
-                    num_stages=self.num_stages,
-                    recompute_block=recompute_interval > 0,
-                    num_virtual_stages=n_virtual,
+        n_virtual_req = max(num_virtual_pipeline_stages or 1, 1)
+        folded_any = False
+        for lo, hi in runs:
+            n_run = hi - lo + 1
+            n_virtual = n_virtual_req
+            n_chunks = self.num_stages * n_virtual
+            if n_virtual > 1 and (n_run < n_chunks or n_run % n_chunks != 0)                     and n_run % self.num_stages == 0:
+                # virtual stages don't divide this run — fall back to V=1
+                # rather than silently disabling pipelining altogether
+                warnings.warn(
+                    f"num_virtual_pipeline_stages={n_virtual} does not "
+                    f"divide the {n_run}-block run over "
+                    f"{self.num_stages} stages; falling back to "
+                    "non-interleaved pipeline for this run"
                 )
+                n_virtual = 1
+                n_chunks = self.num_stages
+            if self.num_stages > 1 and n_run >= n_chunks                     and n_run % n_chunks == 0:
+                self._segments.append(
+                    SpmdPipeline(
+                        built[lo : hi + 1],
+                        num_stages=self.num_stages,
+                        recompute_block=recompute_interval > 0,
+                        num_virtual_stages=n_virtual,
+                    )
+                )
+                folded_any = True
+            else:
+                self._segments.extend(built[lo : hi + 1])
+        if self.num_stages > 1 and not folded_any:
+            warnings.warn(
+                f"no homogeneous layer run divides {self.num_stages} "
+                "pipeline stages; the model runs WITHOUT pipeline "
+                "partitioning"
             )
-            for l in built[hi + 1 :]:
-                self._segments.append(l)
-        else:
-            self._segments = built
         for i, l in enumerate(self._segments):
             self.add_sublayer(f"seg_{i}", l)
 

@@ -167,3 +167,65 @@ def test_fallback_scan_unpermutes_interleaved_order():
     pipe = SpmdPipeline(blocks, num_stages=4, num_microbatches=1, num_virtual_stages=2)
     assert pipe._layer_order == [0, 4, 1, 5, 2, 6, 3, 7]
     np.testing.assert_allclose(_np(pipe(x)), _np(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_heterogeneous_pipeline_folds_every_run():
+    """A conv-stem-like run AND a transformer-body-like run each fold into
+    their own SpmdPipeline (not just the longest run)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineLayer,
+    )
+
+    _init(pp=2)
+    paddle.seed(11)
+
+    stem = [nn.Sequential(nn.Linear(8, 8), nn.ReLU()) for _ in range(2)]
+    body = [nn.Sequential(nn.Linear(8, 8), nn.Tanh()) for _ in range(4)]
+    head = nn.Linear(8, 3)
+    pl = PipelineLayer(
+        layers=stem + body + [head], num_stages=2,
+        loss_fn=lambda o, y: paddle.nn.functional.mse_loss(o, y),
+    )
+    kinds = [type(s).__name__ for s in pl._segments]
+    assert kinds.count("SpmdPipeline") == 2, kinds
+
+    # parity with plain sequential execution
+    x = paddle.to_tensor(np.random.RandomState(11).randn(4, 8).astype("float32"))
+    ref = x
+    for l in stem + body:
+        ref = l(ref)
+    ref = head(ref)
+    np.testing.assert_allclose(
+        np.asarray(pl(x)._value), np.asarray(ref._value), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_pipeline_warns_when_nothing_folds():
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineLayer,
+    )
+
+    _init(pp=4)
+    paddle.seed(12)
+    # 3 blocks cannot divide 4 stages
+    blocks = [nn.Sequential(nn.Linear(4, 4), nn.Tanh()) for _ in range(3)]
+    with pytest.warns(UserWarning, match="WITHOUT pipeline"):
+        PipelineLayer(layers=blocks, num_stages=4)
+
+
+def test_config_differences_prevent_folding():
+    """Same-typed blocks with different CONFIG (dropout rate) must not fold
+    into one SpmdPipeline — folding would run every block through the
+    template's forward."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineLayer, _param_sig,
+    )
+
+    _init(pp=2)
+    paddle.seed(13)
+    a = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.1))
+    b = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+    assert _param_sig(a) != _param_sig(b)
+    pl = PipelineLayer(layers=[a, b], num_stages=2)
+    kinds = [type(s).__name__ for s in pl._segments]
+    assert "SpmdPipeline" not in kinds  # two 1-block runs, nothing folds
