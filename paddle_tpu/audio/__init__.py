@@ -3,19 +3,10 @@
 Reference: ``python/paddle/audio/`` (features: Spectrogram/MelSpectrogram/
 LogMelSpectrogram/MFCC layers; functional: mel scale + window + dct
 helpers; backends for file IO). Feature compute rides paddle_tpu.signal.stft
-(one fused frame→window→rfft XLA program); file-IO backends are gated (no
-soundfile in this image).
+(one fused frame→window→rfft XLA program); file IO is the pure-numpy WAV
+codec in ``backends`` (mirrors upstream's dependency-free wave_backend,
+plus float32/24-bit support it lacks).
 """
-from . import functional  # noqa: F401
+from . import backends, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401
-
-
-def load(*args, **kwargs):
-    raise NotImplementedError(
-        "paddle_tpu.audio.load: no audio IO backend in this build; decode "
-        "with soundfile/scipy.io.wavfile and pass arrays to the feature layers"
-    )
-
-
-def save(*args, **kwargs):
-    raise NotImplementedError("paddle_tpu.audio.save: no audio IO backend in this build")

@@ -1,0 +1,224 @@
+"""paddle.audio.backends parity — pure-numpy WAV codec.
+
+Reference: ``python/paddle/audio/backends/`` (wave_backend.py is upstream's
+no-dependency default backend: ``load``/``save``/``info`` over the stdlib
+``wave`` module, PCM WAV only; soundfile is an optional richer backend).
+This build ships the same capability with a self-contained RIFF/WAVE codec
+(stdlib ``wave`` cannot do float32 or 24-bit; this can): PCM_U8 / PCM_16 /
+PCM_24 / PCM_32 / IEEE-float32, mono or multichannel, read and write, with
+``normalize`` and ``channels_first`` matching the reference semantics.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = [
+    "list_available_backends",
+    "get_current_backend",
+    "set_backend",
+    "load",
+    "save",
+    "info",
+    "AudioInfo",
+]
+
+_BACKEND = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name: str):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable in this build; "
+            f"available: {list_available_backends()}"
+        )
+
+
+class AudioInfo:
+    """Mirror of the reference backend's info record."""
+
+    def __init__(self, sample_rate, num_frames, num_channels, bits_per_sample,
+                 encoding):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (
+            f"AudioInfo(sample_rate={self.sample_rate}, "
+            f"num_frames={self.num_frames}, num_channels={self.num_channels}, "
+            f"bits_per_sample={self.bits_per_sample}, encoding={self.encoding!r})"
+        )
+
+
+_ENCODINGS = {
+    # encoding -> (format_tag, bits, numpy dtype)
+    "PCM_U8": (1, 8, np.uint8),
+    "PCM_16": (1, 16, np.dtype("<i2")),
+    "PCM_24": (1, 24, None),  # packed 3-byte little-endian, no numpy dtype
+    "PCM_32": (1, 32, np.dtype("<i4")),
+    "PCM_F32": (3, 32, np.dtype("<f4")),
+}
+_ENC_BY_FMT = {(tag, bits): enc for enc, (tag, bits, _) in _ENCODINGS.items()}
+
+
+def _parse_riff(data: bytes):
+    if len(data) < 12 or data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+        raise ValueError("not a RIFF/WAVE file")
+    pos, fmt, frames = 12, None, None
+    while pos + 8 <= len(data):
+        cid, size = data[pos:pos + 4], struct.unpack_from("<I", data, pos + 4)[0]
+        body = data[pos + 8:pos + 8 + size]
+        if cid == b"fmt ":
+            tag, nch, rate, _br, block, bits = struct.unpack_from("<HHIIHH", body)
+            if tag == 0xFFFE and size >= 40:  # WAVE_FORMAT_EXTENSIBLE
+                tag = struct.unpack_from("<H", body, 24)[0]
+            fmt = (tag, nch, rate, block, bits)
+        elif cid == b"data":
+            frames = body
+        pos += 8 + size + (size & 1)  # chunks are word-aligned
+    if fmt is None or frames is None:
+        raise ValueError("WAV missing fmt/data chunk")
+    return fmt, frames
+
+
+def _decode(fmt, raw):
+    tag, nch, rate, _block, bits = fmt
+    enc = _ENC_BY_FMT.get((tag, bits))
+    if enc == "PCM_24":
+        b = np.frombuffer(raw, np.uint8)[: (len(raw) // 3) * 3].reshape(-1, 3)
+        # sign-extend 3-byte little-endian into int32
+        arr = (
+            b[:, 0].astype(np.int32)
+            | (b[:, 1].astype(np.int32) << 8)
+            | (b[:, 2].astype(np.int8).astype(np.int32) << 16)
+        )
+    elif enc is not None:
+        arr = np.frombuffer(raw, _ENCODINGS[enc][2])
+    else:
+        raise NotImplementedError(f"WAV format tag={tag} bits={bits} unsupported")
+    n = (arr.size // nch) * nch
+    return arr[:n].reshape(-1, nch), rate, enc, bits
+
+
+def _normalize(arr, enc):
+    if enc == "PCM_F32":
+        return arr.astype(np.float32)
+    if enc == "PCM_U8":
+        return (arr.astype(np.float32) - 128.0) / 128.0
+    scale = float(2 ** {"PCM_16": 15, "PCM_24": 23, "PCM_32": 31}[enc])
+    return arr.astype(np.float32) / scale
+
+
+def info(filepath) -> AudioInfo:
+    # streaming header walk: O(chunk headers), never reads sample data
+    with open(filepath, "rb") as f:
+        head = f.read(12)
+        if len(head) < 12 or head[:4] != b"RIFF" or head[8:12] != b"WAVE":
+            raise ValueError("not a RIFF/WAVE file")
+        fmt = data_size = None
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                break
+            cid, size = hdr[:4], struct.unpack("<I", hdr[4:])[0]
+            if cid == b"fmt ":
+                body = f.read(size + (size & 1))
+                tag, nch, rate, _br, block, bits = struct.unpack_from("<HHIIHH", body)
+                if tag == 0xFFFE and size >= 40:
+                    tag = struct.unpack_from("<H", body, 24)[0]
+                fmt = (tag, nch, rate, block, bits)
+            else:
+                if cid == b"data":
+                    data_size = size
+                f.seek(size + (size & 1), 1)
+    if fmt is None or data_size is None:
+        raise ValueError("WAV missing fmt/data chunk")
+    tag, nch, rate, _block, bits = fmt
+    enc = _ENC_BY_FMT.get((tag, bits))
+    if enc is None:
+        raise NotImplementedError(f"WAV format tag={tag} bits={bits} unsupported")
+    return AudioInfo(rate, data_size // (nch * bits // 8), nch, bits, enc)
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns ``(waveform, sample_rate)``; waveform is float32 in [-1, 1]
+    when ``normalize`` (always float32 for float files, matching the
+    reference wave_backend), else the integer PCM values."""
+    with open(filepath, "rb") as f:
+        fmt, raw = _parse_riff(f.read())
+    frames, rate, enc, _bits = _decode(fmt, raw)
+    end = None if num_frames < 0 else frame_offset + num_frames
+    frames = frames[frame_offset:end]
+    out = _normalize(frames, enc) if (normalize or enc == "PCM_F32") else frames
+    if channels_first:
+        out = np.ascontiguousarray(out.T)
+    from ..framework.core import Tensor
+
+    return Tensor(out), rate
+
+
+def save(filepath, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample=None):
+    """Write ``src`` (Tensor/ndarray, float in [-1,1] or integer PCM) as WAV."""
+    arr = np.asarray(getattr(src, "numpy", lambda: src)())
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if arr.ndim != 2:
+        raise ValueError(f"expected 1-D or 2-D waveform, got shape {arr.shape}")
+    if channels_first:
+        arr = arr.T  # -> (frames, channels)
+    if bits_per_sample is not None and encoding != "PCM_F32":
+        by_bits = {8: "PCM_U8", 16: "PCM_16", 24: "PCM_24", 32: "PCM_32"}
+        encoding = by_bits.get(int(bits_per_sample), encoding)
+    if encoding not in _ENCODINGS:
+        raise NotImplementedError(f"encoding {encoding!r}; use {list(_ENCODINGS)}")
+    tag, bits, dtype = _ENCODINGS[encoding]
+
+    if np.issubdtype(arr.dtype, np.floating):
+        x = np.clip(arr.astype(np.float64), -1.0, 1.0)
+        if encoding == "PCM_F32":
+            data = x.astype("<f4").tobytes()
+        elif encoding == "PCM_U8":
+            data = (np.round(x * 128.0) + 128.0).clip(0, 255).astype(np.uint8).tobytes()
+        else:
+            hi = float(2 ** (bits - 1) - 1)
+            q = np.round(x * (2 ** (bits - 1))).clip(-(2 ** (bits - 1)), hi)
+            if encoding == "PCM_24":
+                q = q.astype(np.int32)
+                b = np.empty(q.shape + (3,), np.uint8)
+                b[..., 0], b[..., 1], b[..., 2] = q & 0xFF, (q >> 8) & 0xFF, (q >> 16) & 0xFF
+                data = b.tobytes()
+            else:
+                data = q.astype(dtype).tobytes()
+    else:
+        if encoding == "PCM_24":
+            q = arr.astype(np.int32)
+            b = np.empty(q.shape + (3,), np.uint8)
+            b[..., 0], b[..., 1], b[..., 2] = q & 0xFF, (q >> 8) & 0xFF, (q >> 16) & 0xFF
+            data = b.tobytes()
+        else:
+            data = arr.astype(dtype).tobytes()
+
+    nch = arr.shape[1]
+    block = nch * bits // 8
+    hdr = struct.pack(
+        "<4sI4s4sIHHIIHH4sI",
+        b"RIFF", 36 + len(data), b"WAVE", b"fmt ", 16,
+        tag, nch, int(sample_rate), int(sample_rate) * block, block, bits,
+        b"data", len(data),
+    )
+    with open(filepath, "wb") as f:
+        f.write(hdr + data)

@@ -1,22 +1,18 @@
 """paddle.onnx parity surface.
 
-The reference exports via paddle2onnx. This environment has no onnx
-runtime; the TPU-native serialized artifact is StableHLO via
-``paddle_tpu.jit.save`` (consumed by paddle_tpu.inference.Predictor), so
-``export`` raises with that guidance unless the optional onnx stack is
-importable.
+Reference: ``python/paddle/onnx/export.py`` delegates to the external
+paddle2onnx package. That toolchain (and any ONNX exporter for StableHLO)
+does not exist in this image, so ``export`` is a documented non-goal: it
+always raises, pointing at the TPU-native serialized artifact instead
+(StableHLO via ``paddle_tpu.jit.save``, served by
+``paddle_tpu.inference.Predictor``). See PARITY.md.
 """
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise RuntimeError(
-            "onnx is not available in this image; use paddle_tpu.jit.save "
-            "(StableHLO artifact + paddle_tpu.inference.Predictor) for "
-            "serialized serving"
-        )
     raise NotImplementedError(
-        "onnx export is not implemented; use paddle_tpu.jit.save"
+        "paddle_tpu.onnx.export is a documented non-goal in this build "
+        "(no paddle2onnx / StableHLO->ONNX toolchain in the image). Use "
+        "paddle_tpu.jit.save for a StableHLO artifact and "
+        "paddle_tpu.inference.Predictor to serve it."
     )

@@ -109,29 +109,288 @@ class Imdb(Dataset):
 
 
 class Conll05st(Dataset):
-    """SRL dataset surface; local archive only (no synthetic semantics)."""
+    """SRL dataset (CoNLL-2005 column format). Samples mirror the reference's
+    tuple: (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_id, mark,
+    label_ids). Local column files (``word<TAB>...<TAB>predicate<TAB>label``
+    per token, blank line between sentences) or mode='synthetic'."""
 
-    def __init__(self, data_file: Optional[str] = None, **kwargs):
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 word_dict: Optional[dict] = None,
+                 label_dict: Optional[dict] = None,
+                 download: bool = False, **kwargs):
         super().__init__()
-        raise RuntimeError(_NO_NET.format(name="Conll05st"))
+        # expose the vocabularies so train/test splits can share ids
+        # (reference ships fixed dict files; pass word_dict/label_dict from
+        # the train split when constructing the test split)
+        self.word_dict = {} if word_dict is None else word_dict
+        self.label_dict = {} if label_dict is None else label_dict
+        # only grow vocabularies we own; a supplied dict (from the train
+        # split) stays frozen so test construction can't shift train ids
+        grow = word_dict is None
+        if data_file:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(f"Conll05st data_file: {data_file}")
+            sents = self._parse_columns(
+                data_file, self.word_dict, self.label_dict, grow)
+        elif mode == "synthetic":
+            rs = np.random.RandomState(7 if mode == "train" else 8)
+            sents = []
+            for _ in range(200 if mode == "train" else 50):
+                n = rs.randint(5, 30)
+                words = rs.randint(0, 5000, n).astype("int64")
+                pred = int(rs.randint(0, n))
+                labels = rs.randint(0, 67, n).astype("int64")
+                sents.append((words, pred, labels))
+        else:
+            raise RuntimeError(_NO_NET.format(name="Conll05st"))
+        self.samples = [self._featurize(w, p, l) for w, p, l in sents]
+
+    @staticmethod
+    def _parse_columns(path, vocab, labvoc, grow=True):
+        def wid(w, voc):
+            if grow:
+                return voc.setdefault(w, len(voc))
+            return voc.get(w, voc.get("<unk>", 0))
+
+        opener = gzip.open if path.endswith(".gz") else open
+        sents, words, preds, labels = [], [], [], []
+        with opener(path, "rt") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if words:
+                        pred = preds.index(True) if True in preds else 0
+                        sents.append((np.asarray(words, "int64"), pred,
+                                      np.asarray(labels, "int64")))
+                    words, preds, labels = [], [], []
+                    continue
+                cols = line.split()
+                w, lab = cols[0].lower(), cols[-1]
+                words.append(wid(w, vocab))
+                preds.append(len(cols) > 2 and cols[-2] != "-")
+                labels.append(wid(lab, labvoc))
+        if words:
+            pred = preds.index(True) if True in preds else 0
+            sents.append((np.asarray(words, "int64"), pred,
+                          np.asarray(labels, "int64")))
+        return sents
+
+    @staticmethod
+    def _featurize(words, pred, labels):
+        n = len(words)
+        pad = lambda i: words[min(max(i, 0), n - 1)]
+        ctx = [np.asarray([pad(i + d) for i in range(n)], "int64")
+               for d in (-2, -1, 0, 1, 2)]
+        mark = np.zeros(n, "int64")
+        mark[pred] = 1
+        pred_ids = np.full(n, words[pred], "int64")
+        return (words, *ctx, pred_ids, mark, labels)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
 
 
 class Movielens(Dataset):
-    def __init__(self, data_file: Optional[str] = None, mode="train", **kwargs):
+    """MovieLens-1M rating prediction. Samples mirror the reference:
+    (user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+    rating). Parses a local ml-1m archive (zip/tar/directory with
+    ``ratings.dat``/``users.dat``/``movies.dat``, ``::``-separated) or
+    generates a synthetic set with the same field spaces."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0,
+                 download: bool = False, **kwargs):
         super().__init__()
-        raise RuntimeError(_NO_NET.format(name="Movielens"))
+        if data_file:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(f"Movielens data_file: {data_file}")
+            users, movies, ratings = self._read_archive(data_file)
+        elif mode == "synthetic":
+            rs = np.random.RandomState(11)
+            users = {u: (u % 2, u % len(self.AGES), u % 21)
+                     for u in range(1, 301)}
+            movies = {m: ([m % 18, (m * 7) % 18],
+                          list(rs.randint(0, 5000, 1 + m % 8)))
+                      for m in range(1, 201)}
+            ratings = [(int(rs.randint(1, 301)), int(rs.randint(1, 201)),
+                        float(rs.randint(1, 6))) for _ in range(4000)]
+        else:
+            raise RuntimeError(_NO_NET.format(name="Movielens"))
+        rs = np.random.RandomState(rand_seed)
+        keep_test = rs.rand(len(ratings)) < test_ratio
+        self.samples = []
+        for (u, m, r), is_test in zip(ratings, keep_test):
+            if (mode == "test") != is_test and mode != "synthetic":
+                continue
+            if u not in users or m not in movies:
+                continue
+            g, a, j = users[u]
+            cats, title = movies[m]
+            self.samples.append((
+                np.asarray([u], "int64"), np.asarray([g], "int64"),
+                np.asarray([a], "int64"), np.asarray([j], "int64"),
+                np.asarray([m], "int64"), np.asarray(cats, "int64"),
+                np.asarray(title, "int64"), np.asarray([r], "float32"),
+            ))
+
+    @classmethod
+    def _read_archive(cls, path):
+        def read_members(get):
+            users, movies, ratings = {}, {}, []
+            cat_voc, title_voc = {}, {}
+            for line in get("users.dat"):
+                uid, gender, age, job = line.split("::")[:4]
+                users[int(uid)] = (
+                    0 if gender == "M" else 1,
+                    cls.AGES.index(int(age)) if int(age) in cls.AGES else 0,
+                    int(job),
+                )
+            for line in get("movies.dat"):
+                mid, title, cats = line.split("::")[:3]
+                cat_ids = [cat_voc.setdefault(c, len(cat_voc))
+                           for c in cats.strip().split("|")]
+                title_ids = [title_voc.setdefault(w, len(title_voc))
+                             for w in title.lower().split()]
+                movies[int(mid)] = (cat_ids, title_ids)
+            for line in get("ratings.dat"):
+                uid, mid, r = line.split("::")[:3]
+                ratings.append((int(uid), int(mid), float(r)))
+            return users, movies, ratings
+
+        def decode(b):
+            return b.decode("latin-1").strip()
+
+        if os.path.isdir(path):
+            def get(name):
+                with open(os.path.join(path, name), encoding="latin-1") as f:
+                    return [l.strip() for l in f if l.strip()]
+
+            return read_members(get)
+        if path.endswith(".zip"):
+            import zipfile
+
+            with zipfile.ZipFile(path) as zf:
+                names = {os.path.basename(n): n for n in zf.namelist()}
+                return read_members(lambda name: [
+                    decode(l) for l in zf.read(names[name]).splitlines()
+                    if l.strip()])
+        with tarfile.open(path) as tf:
+            names = {os.path.basename(m.name): m for m in tf.getmembers()}
+            return read_members(lambda name: [
+                decode(l) for l in tf.extractfile(names[name]).read().splitlines()
+                if l.strip()])
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
 
 
-class WMT14(Dataset):
-    def __init__(self, data_file: Optional[str] = None, **kwargs):
+class _WMTBase(Dataset):
+    """Shared machinery for the WMT parallel-corpus surfaces: local
+    tab-separated ``source<TAB>target`` text (optionally .gz / inside a tar),
+    or synthetic paired token sequences. Samples are
+    (src_ids, trg_ids, trg_ids_next) like the reference.
+
+    ``mode`` selects the member whose basename contains it when data_file is
+    a tar of splits; a plain text/gz file IS one split, so mode is ignored
+    there — point each split's Dataset at its own file. Pass the train
+    split's ``src_dict``/``trg_dict`` into the test split so ids agree."""
+
+    NAME = "WMT"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = 3000, src_dict: Optional[dict] = None,
+                 trg_dict: Optional[dict] = None,
+                 download: bool = False, **kwargs):
         super().__init__()
-        raise RuntimeError(_NO_NET.format(name="WMT14"))
+        self.dict_size = dict_size
+        base = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        self.src_dict = dict(base) if src_dict is None else src_dict
+        self.trg_dict = dict(base) if trg_dict is None else trg_dict
+        # a supplied dict stays frozen (unseen words -> <unk>) so the test
+        # split can't grow or shift the train split's vocabulary
+        self._grow = src_dict is None
+        if data_file:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(f"{self.NAME} data_file: {data_file}")
+            pairs = self._parse(data_file, mode)
+            if not pairs:
+                raise ValueError(
+                    f"{self.NAME}: no '{mode}' pairs found in {data_file} "
+                    "(tar members are matched by basename substring; text "
+                    "files need source<TAB>target lines)")
+        elif mode == "synthetic":
+            rs = np.random.RandomState(3 if mode == "train" else 4)
+            pairs = []
+            for _ in range(500 if mode == "train" else 100):
+                n = rs.randint(4, 30)
+                src = rs.randint(3, dict_size, n).astype("int64")
+                trg = np.asarray(
+                    [(t * 13 + 7) % dict_size for t in src][: max(3, n - 2)],
+                    "int64",
+                )
+                pairs.append((src, trg))
+        else:
+            raise RuntimeError(_NO_NET.format(name=self.NAME))
+        self.samples = []
+        for src, trg in pairs:
+            trg_in = np.concatenate([[0], trg]).astype("int64")   # <s> = 0
+            trg_next = np.concatenate([trg, [1]]).astype("int64")  # <e> = 1
+            self.samples.append((src, trg_in, trg_next))
+
+    def _parse(self, path, mode):
+        vocab_s, vocab_t = self.src_dict, self.trg_dict
+
+        def to_ids(words, vocab):
+            out = []
+            for w in words:
+                if self._grow and w not in vocab and len(vocab) < self.dict_size:
+                    vocab[w] = len(vocab)
+                out.append(vocab.get(w, 2))
+            return np.asarray(out, "int64")
+
+        def lines_of(fileobj):
+            for raw_line in fileobj:
+                line = raw_line.decode("utf-8", "ignore") if isinstance(raw_line, bytes) else raw_line
+                if "\t" in line:
+                    s, t = line.rstrip("\n").split("\t", 1)
+                    if s.strip() and t.strip():
+                        yield s.strip().lower().split(), t.strip().lower().split()
+
+        pairs = []
+        if tarfile.is_tarfile(path):
+            with tarfile.open(path) as tf:
+                for m in tf.getmembers():
+                    if m.isfile() and mode in os.path.basename(m.name):
+                        for s, t in lines_of(tf.extractfile(m)):
+                            pairs.append((to_ids(s, vocab_s), to_ids(t, vocab_t)))
+        else:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt", encoding="utf-8", errors="ignore") as f:
+                for s, t in lines_of(f):
+                    pairs.append((to_ids(s, vocab_s), to_ids(t, vocab_t)))
+        return pairs
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
 
 
-class WMT16(Dataset):
-    def __init__(self, data_file: Optional[str] = None, **kwargs):
-        super().__init__()
-        raise RuntimeError(_NO_NET.format(name="WMT16"))
+class WMT14(_WMTBase):
+    NAME = "WMT14"
+
+
+class WMT16(_WMTBase):
+    NAME = "WMT16"
 
 
 __all__ = ["UCIHousing", "Imdb", "Conll05st", "Movielens", "WMT14", "WMT16"]

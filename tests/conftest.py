@@ -35,6 +35,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: XLA-compile-heavy tests skipped by default "
         "(run with --runslow)")
+    config.addinivalue_line(
+        "markers", "fast: quick smoke subset (`pytest -m fast`)")
 
 
 def pytest_collection_modifyitems(config, items):

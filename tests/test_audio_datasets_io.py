@@ -1,0 +1,133 @@
+"""Round-3 surface closures: WAV codec, text dataset parsers, onnx non-goal.
+
+Reference test models: ``test/legacy_test/test_audio_backend.py`` (load/save
+roundtrip across encodings), ``python/paddle/text/datasets/`` dataset tests
+(sample tuple shapes), SURVEY.md §4 op-vs-numpy pattern.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+from paddle_tpu.text.datasets import WMT14, Conll05st, Movielens
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize("encoding,tol", [
+    ("PCM_U8", 1 / 100.0),
+    ("PCM_16", 1e-4),
+    ("PCM_24", 1e-6),
+    ("PCM_32", 1e-8),
+    ("PCM_F32", 1e-7),
+])
+def test_wav_roundtrip(tmp_path, encoding, tol):
+    rs = np.random.RandomState(0)
+    wav = np.clip(rs.randn(2, 4000).astype("float32") * 0.3, -1, 1)
+    path = str(tmp_path / f"x_{encoding}.wav")
+    audio.save(path, wav, 16000, channels_first=True, encoding=encoding)
+    out, sr = audio.load(path, channels_first=True)
+    assert sr == 16000
+    got = out.numpy()
+    assert got.shape == wav.shape
+    np.testing.assert_allclose(got, wav, atol=tol)
+    meta = audio.info(path)
+    assert meta.num_channels == 2 and meta.num_frames == 4000
+    assert meta.encoding == encoding
+
+
+@pytest.mark.fast
+def test_wav_slicing_and_mono(tmp_path):
+    t = np.arange(8000, dtype="float32") / 8000.0
+    wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype("float32")
+    path = str(tmp_path / "mono.wav")
+    audio.save(path, wav, 8000, encoding="PCM_16")
+    full, _ = audio.load(path)
+    assert full.numpy().shape == (1, 8000)
+    part, _ = audio.load(path, frame_offset=1000, num_frames=500)
+    np.testing.assert_allclose(
+        part.numpy()[0], full.numpy()[0, 1000:1500], atol=1e-7)
+    # unnormalized load returns integer PCM values
+    raw, _ = audio.load(path, normalize=False)
+    assert raw.numpy().dtype == np.int16
+
+
+@pytest.mark.fast
+def test_wav_feeds_feature_layers(tmp_path):
+    rs = np.random.RandomState(1)
+    path = str(tmp_path / "f.wav")
+    audio.save(path, rs.randn(1600).astype("float32") * 0.1, 16000)
+    wav, sr = audio.load(path)
+    spec = audio.MelSpectrogram(sr=sr, n_fft=256, n_mels=32)(paddle.to_tensor(wav.numpy()))
+    assert spec.shape[1] == 32 and np.isfinite(spec.numpy()).all()
+
+
+@pytest.mark.fast
+def test_movielens_synthetic_and_archive(tmp_path):
+    ds = Movielens(mode="synthetic")
+    assert len(ds) > 100
+    u, g, a, j, m, cats, title, r = ds[0]
+    assert u.dtype == np.int64 and r.dtype == np.float32
+    assert cats.ndim == 1 and title.ndim == 1
+
+    # ml-1m directory layout with ::-separated files
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text(
+        "1::M::25::10::48067\n2::F::35::3::55117\n")
+    (d / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+        "2::Jumanji (1995)::Adventure|Children's|Fantasy\n")
+    (d / "ratings.dat").write_text(
+        "1::1::5::978300760\n1::2::3::978302109\n2::1::4::978301968\n")
+    ds = Movielens(data_file=str(d), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    u, g, a, j, m, cats, title, r = ds[0]
+    assert int(u[0]) == 1 and int(g[0]) == 0 and float(r[0]) == 5.0
+    assert len(cats) == 3 and len(title) == 3  # "toy story (1995)"
+
+
+@pytest.mark.fast
+def test_conll05_and_wmt_synthetic():
+    srl = Conll05st(mode="synthetic")
+    sample = srl[0]
+    assert len(sample) == 9
+    n = len(sample[0])
+    assert all(len(f) == n for f in sample[:8])
+    assert sample[7].sum() == 1  # exactly one predicate mark
+
+    wmt = WMT14(mode="synthetic")
+    src, trg_in, trg_next = wmt[0]
+    assert trg_in[0] == 0 and trg_next[-1] == 1
+    np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+
+
+@pytest.mark.fast
+def test_wmt_local_tsv(tmp_path):
+    p = tmp_path / "wmt.train.tsv"
+    p.write_text("the cat sat\tle chat assis\nhello world\tbonjour monde\n")
+    ds = WMT14(data_file=str(p), mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_next = ds[0]
+    assert len(src) == 3 and len(trg_in) == 4
+
+
+@pytest.mark.fast
+def test_conll05_column_file(tmp_path):
+    p = tmp_path / "srl.txt"
+    p.write_text(
+        "the\t-\tB-A0\ncat\t-\tI-A0\nsat\tsit\tB-V\n\n"
+        "dogs\t-\tB-A0\nbark\tbark\tB-V\n\n")
+    ds = Conll05st(data_file=str(p))
+    assert len(ds) == 2
+    words, *_ctx, pred_ids, mark, labels = ds[0]
+    assert len(words) == 3 and mark[2] == 1
+
+
+@pytest.mark.fast
+def test_onnx_export_is_honest_nongoal():
+    from paddle_tpu import onnx
+
+    with pytest.raises(NotImplementedError, match="non-goal"):
+        onnx.export(None, "/tmp/x.onnx")
