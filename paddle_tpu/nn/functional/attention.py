@@ -16,6 +16,47 @@ import jax.numpy as jnp
 from ...framework.op import defop, raw
 
 _USE_PALLAS = True
+_PALLAS_PROBE: dict = {}  # backend name -> bool (Mosaic compile probe result)
+
+
+def _pallas_backend_ok() -> bool:
+    """One-time probe: does the Pallas flash kernel actually COMPILE on this
+    backend? (Mosaic failures surface at XLA-compile time, after tracing, so
+    the per-call try/except in `_sdpa` cannot catch them.) On failure the
+    public attention API silently serves the XLA-native reference path —
+    the runtime fallback the reference gets from its flashattn-or-math
+    dispatch (python/paddle/nn/functional/flash_attention.py).
+
+    CPU/GPU backends return False outright: there the kernel would run in
+    Pallas interpret mode, which is orders of magnitude slower than the
+    fused XLA softmax-attention. Set PADDLE_TPU_PALLAS_INTERPRET=1 to force
+    the routed kernel in interpret mode (kernel-routing tests).
+    """
+    import os
+
+    backend = jax.default_backend()
+    if os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1":
+        return True
+    if backend != "tpu":
+        return False
+    got = _PALLAS_PROBE.get(backend)
+    if got is None:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention as _fa
+
+            x = jnp.zeros((1, 128, 1, 64), jnp.bfloat16)
+            jax.jit(lambda a: _fa(a, a, a, causal=True))(x).block_until_ready()
+            got = True
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"Pallas flash-attention kernel failed to compile on "
+                f"backend {backend!r} ({type(e).__name__}: {e}); attention "
+                "falls back to the XLA-native path", stacklevel=2)
+            got = False
+        _PALLAS_PROBE[backend] = got
+    return got
 
 
 def _sdpa_reference(q, k, v, mask, dropout_p, causal, scale, key=None):
@@ -51,7 +92,7 @@ def _sdpa(q, k, v, mask, key, dropout_p, causal, scale, use_pallas):
         mask = jax.lax.stop_gradient(mask)
     pallas_ok = use_pallas and dropout_p == 0.0 and (
         mask is None or getattr(mask, "ndim", 0) == 4
-    )
+    ) and _pallas_backend_ok()
     if pallas_ok:
         try:
             from ...ops.pallas.flash_attention import flash_attention as _fa

@@ -217,14 +217,26 @@ def test_flash_attention_long_seq_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
 
 
-def test_sdpa_routes_to_flash_kernel():
-    """The public functional uses the Pallas kernel when mask/dropout allow."""
-    import paddle_tpu.nn.functional as F
+def test_sdpa_routes_to_flash_kernel(monkeypatch):
+    """The public functional uses the Pallas kernel when mask/dropout allow.
 
+    On non-TPU backends the route is gated off (interpret mode is too slow
+    for real use); PADDLE_TPU_PALLAS_INTERPRET=1 forces it so this test
+    exercises the actual kernel dispatch on the CPU mesh."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.ops.pallas import flash_attention as fa_mod
+
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    calls = []
+    real = fa_mod.flash_attention
+    monkeypatch.setattr(
+        fa_mod, "flash_attention",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
     q, k, v = _rand(1, 32, 2, 16)
     out = F.scaled_dot_product_attention(
         paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), is_causal=True
     )
+    assert calls, "Pallas kernel was not invoked by the sdpa route"
     ref = _sdpa_reference(q, k, v, None, 0.0, True, None)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
