@@ -111,7 +111,15 @@ def _candidates():
     e.pop("PALLAS_AXON_POOL_IPS", None)
     e["JAX_PLATFORMS"] = ""
     out.append(("auto-nopool", e, 180))
-    return out
+    # duplicate env configs waste whole probe timeouts (e.g. with
+    # PALLAS_AXON_POOL_IPS unset the nopool variants equal the pool ones)
+    seen, uniq = set(), []
+    for name, env, timeout in out:
+        key = tuple(sorted(env.items()))
+        if key not in seen:
+            seen.add(key)
+            uniq.append((name, env, timeout))
+    return uniq
 
 
 def _select_backend(max_tries=3, backoff=60.0):

@@ -72,10 +72,12 @@ class TracedLayer:
 
     def __init__(self, fn: Callable, layers: Optional[Sequence[Layer]] = None, full_graph=True):
         self._fn = fn
+        self._orig_fn = fn
         self._layers = list(layers) if layers is not None else _collect_layers(fn)
         self._cache = {}
         self._last_out_tree = None
         self._eager_fallback = False
+        self._tried_dy2static = False
         functools.update_wrapper(self, fn, updated=[])
 
     def _state_tensors(self):
@@ -104,21 +106,47 @@ class TracedLayer:
             return self._fn(*args, **kwargs)
         if self._eager_fallback:
             return self._fn(*args, **kwargs)
+        from .dy2static import Dy2StaticError
+
         try:
             return self._traced_call(*args, **kwargs)
-        except TraceHostSyncError:
-            # dy2static guard semantics (SURVEY.md §7 hard-part #1): a host
-            # sync (`.numpy()`, `if tensor:`) inside the function cannot be
-            # captured — run eagerly from now on instead of failing, exactly
-            # like the reference's dy2static falls back to dygraph.
+        except (TraceHostSyncError, Dy2StaticError):
+            # dy2static (SURVEY.md §7 hard-part #1): the trace hit a host
+            # sync (`if tensor:`, `while tensor:`, `.numpy()`). First try
+            # the AST conversion (Python control flow -> lax.cond/
+            # while_loop, mirroring the reference's program_translator);
+            # only if the CONVERTED function still host-syncs (e.g. a
+            # genuine `.numpy()` call) — or a LATER retrace of the
+            # converted fn hits a structural Dy2StaticError — fall back to
+            # eager like the reference's dygraph fallback.
+            if not self._tried_dy2static:
+                self._tried_dy2static = True
+                from .dy2static import convert_to_static
+
+                converted = convert_to_static(self._orig_fn)
+                if converted is not None:
+                    # drop executables compiled against the original fn
+                    self._fn = converted
+                    self._cache.clear()
+                    try:
+                        return self._traced_call(*args, **kwargs)
+                    except (TraceHostSyncError, Dy2StaticError):
+                        self._fn = self._orig_fn
+                        self._cache.clear()
+            else:
+                # a later-signature retrace failed: revert to the original
+                # for the eager fallback below
+                self._fn = self._orig_fn
+                self._cache.clear()
             import warnings
 
             warnings.warn(
                 f"to_static({getattr(self._fn, '__name__', self._fn)!r}): a "
                 "host sync point (.numpy()/float()/`if tensor:`) was hit "
-                "during tracing; falling back to EAGER execution for this "
-                "callable. Use paddle_tpu.static.nn.cond/while_loop/"
-                "switch_case to keep data-dependent control flow compiled.",
+                "during tracing and dy2static conversion could not compile "
+                "it; falling back to EAGER execution for this callable. Use "
+                "paddle_tpu.static.nn.cond/while_loop/switch_case to keep "
+                "data-dependent control flow compiled.",
                 stacklevel=2,
             )
             self._eager_fallback = True

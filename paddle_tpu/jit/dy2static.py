@@ -1,0 +1,843 @@
+"""Dy2static: compile Python control flow on traced tensors.
+
+Reference: ``python/paddle/jit/dy2static/`` — program_translator.py plus
+~20 AST transformers rewrite ``if``/``while``/``for`` (and bool ops) into
+static-graph control-flow ops, with runtime ``convert_*`` helpers that
+dispatch on whether the condition is a tensor (SURVEY.md §2.2 "Dy2Static",
+§7 hard-part #1).
+
+TPU-native design: the same two-layer shape, retargeted at lax. An AST
+pass rewrites the source of a ``to_static`` function so that
+
+- ``if t:`` / ``elif`` → ``convert_if(...)`` → ``lax.cond`` when the
+  predicate is traced, plain Python otherwise;
+- ``while t:`` → ``convert_while(...)`` → ``lax.while_loop``;
+- ``for i in range(t):`` → the while form with an explicit counter;
+- ``a and b`` / ``or`` / ``not`` / ``a if c else b`` → short-circuit-
+  preserving helpers that lower to ``logical_and``/``lax.cond`` on tensors;
+- ``return`` inside a converted branch is folded into the conversion
+  (the branch helper's return value IS the function return).
+
+The conversion is attempted lazily, the first time tracing a function hits
+a host-sync point (``TraceHostSyncError``); anything the transformer cannot
+prove safe (break/continue crossing a converted boundary, attribute stores
+inside branches, yield/global/nonlocal, returns inside loops that must
+lower to lax) keeps the ORIGINAL statement, so the behavior degrades to
+the existing guard: trace again, and if the untouched statement still
+host-syncs, fall back to eager with a warning — exactly the reference's
+dygraph fallback, but now a last resort instead of the only answer.
+
+Known limits (documented, reference has analogues): closure variables and
+module globals are snapshotted at conversion time; functions CALLED from a
+converted function are not themselves converted (paddle's convert_call
+recursion is a non-goal here); loop-carried variables must exist before a
+lax-lowered loop.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+from typing import Callable, Optional
+
+__all__ = [
+    "convert_to_static", "Dy2StaticUnsupported", "Dy2StaticError",
+    "UNDEFINED",
+]
+
+_JST = "__paddle_jst__"
+
+
+class Dy2StaticUnsupported(Exception):
+    """Raised (internally) when a function cannot be AST-converted; the
+    caller falls back to the eager guard path."""
+
+
+class Dy2StaticError(RuntimeError):
+    """A converted program is structurally invalid for lax lowering (e.g. a
+    variable defined in only one branch of a tensor ``if``)."""
+
+
+class _UndefinedType:
+    """Placeholder for a name not yet bound when entering converted control
+    flow. Any use raises, naming the likely cause."""
+
+    _err = (
+        "a variable used here may be undefined on some path through "
+        "converted (dy2static) control flow — define it before the "
+        "if/while, or keep the branch in pure Python"
+    )
+
+    def __repr__(self):
+        return "<paddle_tpu dy2static UNDEFINED>"
+
+    def _raise(self, *a, **k):
+        raise Dy2StaticError(self._err)
+
+    __bool__ = __call__ = __iter__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __mul__ = __truediv__ = _raise
+    __eq__ = __ne__ = __lt__ = __gt__ = __le__ = __ge__ = _raise
+
+    def __getattr__(self, name):
+        raise Dy2StaticError(self._err)
+
+
+UNDEFINED = _UndefinedType()
+
+
+# --------------------------------------------------------------------- #
+# runtime dispatch helpers (the generated code calls these via __paddle_jst__)
+# --------------------------------------------------------------------- #
+
+def _raw(x):
+    from ..framework.core import Tensor
+    from ..framework.op import raw
+
+    return raw(x) if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    from ..framework.core import is_tracer_value
+
+    try:
+        return is_tracer_value(_raw(x))
+    except Exception:
+        return False
+
+
+def truthy(x) -> bool:
+    import jax
+    import numpy as np
+
+    v = _raw(x)
+    if isinstance(v, (jax.Array, np.ndarray)):
+        return bool(np.asarray(v).reshape(()))
+    return bool(v)
+
+
+def inits(*thunks):
+    """Current values of carried names; UNDEFINED for not-yet-bound ones."""
+    out = []
+    for t in thunks:
+        try:
+            out.append(t())
+        except NameError:
+            out.append(UNDEFINED)
+    return tuple(out)
+
+
+def _check_defined(init, what):
+    if any(v is UNDEFINED for v in init):
+        raise Dy2StaticError(
+            f"{what}: a carried variable is undefined before the converted "
+            "control flow; lax lowering needs every loop/branch variable "
+            "bound (with its final shape/dtype) beforehand")
+
+
+def _branch_args(init):
+    """Fresh per-branch Tensor wrappers: both lax.cond branches trace over
+    the same init objects, and a Tensor mutated in-place while tracing
+    branch A must not leak its rebound value into branch B's trace."""
+    from ..framework.core import Tensor
+
+    return tuple(Tensor(v._value) if isinstance(v, Tensor) else v for v in init)
+
+
+def convert_if(pred, t_fn, f_fn, init):
+    """Statement-form if: branch helpers take and return the carried tuple."""
+    p = _raw(pred)
+    if not _is_traced(p):
+        return tuple((t_fn if truthy(p) else f_fn)(*init))
+    # UNDEFINED entries are fine when both branches bind them (or neither
+    # reads them); lax.cond's structure check catches the one-sided case
+    from ..static.nn import cond as st_cond
+
+    try:
+        out = st_cond(pred, lambda: tuple(t_fn(*_branch_args(init))),
+                      lambda: tuple(f_fn(*_branch_args(init))))
+    except TypeError as e:
+        raise Dy2StaticError(
+            "tensor `if`: both branches must produce every carried variable "
+            f"with matching shape/dtype ({e})") from e
+    return tuple(out)
+
+
+def convert_if_ret(pred, t_fn, f_fn, init):
+    """Return-form if: the taken branch's return value IS the function
+    return value."""
+    p = _raw(pred)
+    if not _is_traced(p):
+        return (t_fn if truthy(p) else f_fn)(*init)
+    from ..static.nn import cond as st_cond
+
+    try:
+        return st_cond(pred, lambda: t_fn(*_branch_args(init)),
+                       lambda: f_fn(*_branch_args(init)))
+    except TypeError as e:
+        raise Dy2StaticError(
+            "tensor `if`: both return paths must produce matching "
+            f"structure/shape/dtype ({e})") from e
+
+
+def convert_while(test_fn, body_fn, init):
+    vars_ = tuple(init)
+    traced_state = any(_is_traced(v) for v in vars_ if v is not UNDEFINED)
+    if not traced_state:
+        c = test_fn(*vars_)
+        if not _is_traced(c):
+            while truthy(c):
+                vars_ = tuple(body_fn(*vars_))
+                c = test_fn(*vars_)
+            return vars_
+    _check_defined(vars_, "while")
+    from ..static.nn import while_loop as st_while
+
+    out = st_while(test_fn, lambda *vs: tuple(body_fn(*vs)), list(vars_))
+    return tuple(out)
+
+
+def range_cond(i, stop, step):
+    """Continuation test for a converted ``for ... in range(...)``; honors
+    the step sign on both the Python and tensor paths."""
+    ri, rs, rp = _raw(i), _raw(stop), _raw(step)
+    if any(_is_traced(v) for v in (ri, rs, rp)):
+        import jax.numpy as jnp
+
+        ri = jnp.asarray(ri)
+        return ((rp > 0) & (ri < rs)) | ((rp < 0) & (ri > rs))
+    return ri < rs if rp > 0 else ri > rs
+
+
+def _bool_chain(jnp_op, short_circuit_on, first, rest):
+    """Shared and_/or_ machinery: Python short-circuit semantics until a
+    traced value appears, then an elementwise logical fold (bool dtype) of
+    the remaining operands — the reference's convert_logical_* contract."""
+    val = first
+    for idx, thunk in enumerate(rest):
+        if _is_traced(val):
+            import jax.numpy as jnp
+
+            out = jnp.asarray(_raw(val)).astype(bool)
+            for t in rest[idx:]:
+                out = jnp_op(out, jnp.asarray(_raw(t())).astype(bool))
+            return out
+        if truthy(val) is short_circuit_on:
+            return val
+        val = thunk()
+    return val
+
+
+def and_(first, *rest):
+    import jax.numpy as jnp
+
+    return _bool_chain(jnp.logical_and, False, first, rest)
+
+
+def or_(first, *rest):
+    import jax.numpy as jnp
+
+    return _bool_chain(jnp.logical_or, True, first, rest)
+
+
+def not_(x):
+    if _is_traced(x):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(jnp.asarray(_raw(x)).astype(bool))
+    return not truthy(x)
+
+
+def ifexp(pred, t_thunk, f_thunk):
+    if not _is_traced(pred):
+        return t_thunk() if truthy(pred) else f_thunk()
+    from ..static.nn import cond as st_cond
+
+    return st_cond(pred, t_thunk, f_thunk)
+
+
+# --------------------------------------------------------------------- #
+# static analysis
+# --------------------------------------------------------------------- #
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+class _Facts(ast.NodeVisitor):
+    """Names assigned / hazards inside a statement region (nested function
+    scopes excluded — their bindings are their own)."""
+
+    def __init__(self):
+        self.assigned = set()
+        self.attr_store = False
+        self.hazard = False  # yield/await/global/nonlocal/del
+        self.returns = 0
+        self.raises = 0  # lax traces BOTH branches: a raise would fire always
+        self.breaks_unbound = 0  # break/continue not bound to an inner loop
+        self._loop_depth = 0
+
+    # -- scope boundaries --
+    def visit_FunctionDef(self, node):
+        self.assigned.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.assigned.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- bindings --
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.assigned.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        elif isinstance(t, ast.Subscript):
+            # x[i] = v rebinds x's value on the tape — treat as assigning x
+            if isinstance(t.value, ast.Name):
+                self.assigned.add(t.value.id)
+            else:
+                self.attr_store = True
+        elif isinstance(t, ast.Attribute):
+            self.attr_store = True
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+            self.visit(node.value)
+
+    def visit_NamedExpr(self, node):
+        self._target(node.target)
+        self.visit(node.value)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.visit(node.iter)
+        self._loop_depth += 1
+        for s in node.body + node.orelse:
+            self.visit(s)
+        self._loop_depth -= 1
+
+    def visit_While(self, node):
+        self.visit(node.test)
+        self._loop_depth += 1
+        for s in node.body + node.orelse:
+            self.visit(s)
+        self._loop_depth -= 1
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._target(node.optional_vars)
+        self.visit(node.context_expr)
+
+    def visit_ExceptHandler(self, node):
+        if node.name:
+            self.assigned.add(node.name)
+        for s in node.body:
+            self.visit(s)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.assigned.add((a.asname or a.name).split(".")[0])
+
+    visit_ImportFrom = visit_Import
+
+    # -- hazards --
+    def visit_Return(self, node):
+        self.returns += 1
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.breaks_unbound += 1
+
+    visit_Continue = visit_Break
+
+    def visit_Raise(self, node):
+        self.raises += 1
+
+    def visit_Assert(self, node):
+        self.raises += 1
+
+    def visit_Global(self, node):
+        self.hazard = True
+
+    visit_Nonlocal = visit_Global
+
+    def visit_Yield(self, node):
+        self.hazard = True
+
+    visit_YieldFrom = visit_Await = visit_Yield
+
+    def visit_Delete(self, node):
+        self.hazard = True
+
+
+def _facts(stmts) -> _Facts:
+    f = _Facts()
+    for s in stmts if isinstance(stmts, list) else [stmts]:
+        f.visit(s)
+    return f
+
+
+def _loaded_names(node) -> set:
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out
+
+
+class _ExprRewriter(ast.NodeTransformer):
+    """``and``/``or``/``not``/ternary → runtime dispatch helpers (preserving
+    Python short-circuiting via thunks). Stops at nested function scopes."""
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = visit_FunctionDef
+
+    @staticmethod
+    def _thunk(expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=expr)
+
+    def _call(self, name, args):
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                               attr=name, ctx=ast.Load()),
+            args=args, keywords=[])
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        head, rest = node.values[0], node.values[1:]
+        name = "and_" if isinstance(node.op, ast.And) else "or_"
+        return self._call(name, [head] + [self._thunk(v) for v in rest])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return self._call("not_", [node.operand])
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        return self._call(
+            "ifexp", [node.test, self._thunk(node.body), self._thunk(node.orelse)])
+
+
+# --------------------------------------------------------------------- #
+# the converter
+# --------------------------------------------------------------------- #
+
+def _jst_call(name: str, arg_src: str) -> str:
+    return f"{_JST}.{name}({arg_src})"
+
+
+def _parse_stmt(src: str) -> ast.stmt:
+    return ast.parse(src).body[0]
+
+
+class _FunctionConverter:
+    def __init__(self, fndef: ast.FunctionDef):
+        self.fndef = fndef
+        self.counter = 0
+        # function-wide positional name facts for while-carry pruning
+        params = {a.arg for a in (
+            fndef.args.posonlyargs + fndef.args.args + fndef.args.kwonlyargs)}
+        if fndef.args.vararg:
+            params.add(fndef.args.vararg.arg)
+        if fndef.args.kwarg:
+            params.add(fndef.args.kwarg.arg)
+        self.params = params
+        self.assign_lines: dict = {}
+        self.load_lines: dict = {}
+        self._index_positions(fndef)
+
+    def _index_positions(self, fndef):
+        for n in ast.walk(fndef):
+            if isinstance(n, ast.Name) and hasattr(n, "lineno"):
+                book = (self.assign_lines
+                        if isinstance(n.ctx, (ast.Store, ast.Del))
+                        else self.load_lines)
+                book.setdefault(n.id, []).append(n.lineno)
+
+    def run(self) -> ast.FunctionDef:
+        top = _facts(self.fndef.body)
+        if top.hazard:
+            raise Dy2StaticUnsupported("yield/global/nonlocal/del in function")
+        self.fndef.body = self._block(self.fndef.body, fn_tail=True)
+        return self.fndef
+
+    # -- naming --
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"_pd_{kind}_{self.counter}"
+
+    # -- emission --
+    def _helper(self, name, carried, body):
+        # template-parse the def so version-specific AST fields
+        # (py3.12 type_params etc.) come out right
+        tmpl = _parse_stmt(f"def {name}({', '.join(carried)}):\n    pass")
+        tmpl.body = body
+        return tmpl
+
+    def _carried_return(self, carried):
+        return _parse_stmt(
+            "return (" + "".join(f"{c}, " for c in carried) + ")")
+
+    def _inits_src(self, carried):
+        lams = ", ".join(f"lambda: {c}" for c in carried)
+        return f"{_JST}.inits({lams})"
+
+    def _assign_call(self, carried, call_src, test_expr):
+        """``(a, b,) = __paddle_jst__.convert_*(<test>, ...)`` with the real
+        test AST spliced over the __PDTEST__ placeholder."""
+        st = _parse_stmt(call_src)
+        if test_expr is not None:
+            for n in ast.walk(st):
+                for field, val in ast.iter_fields(n):
+                    if isinstance(val, ast.Name) and val.id == "__PDTEST__":
+                        setattr(n, field, test_expr)
+                    elif isinstance(val, list):
+                        for i, v in enumerate(val):
+                            if isinstance(v, ast.Name) and v.id == "__PDTEST__":
+                                val[i] = test_expr
+        return st
+
+    # -- block processing --
+    def _block(self, stmts, fn_tail):
+        """Process a statement block. fn_tail=True means falling off the end
+        of this block ends the FUNCTION (so return-bearing ifs may be folded
+        into convert_if_ret); inside loop/with/try bodies it is False and
+        return-bearing ifs stay Python."""
+        out = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.If):
+                facts = _facts([st])
+                if facts.returns and fn_tail and self._if_convertible(st):
+                    out.extend(self._fold_ret_if(st, stmts[i + 1:]))
+                    return out
+                out.extend(self._convert_stmt(st, fn_tail))
+            else:
+                out.extend(self._convert_stmt(st, fn_tail))
+        return out
+
+    def _ret_block(self, stmts, cont):
+        """Block for a return-form helper: always ends in Return. ``cont``
+        is the continuation (statements that run if this block falls
+        through)."""
+        out = []
+        stmts = list(stmts)
+        i = 0
+        while True:
+            if i >= len(stmts):
+                if cont:
+                    stmts, cont, i = list(cont), [], 0
+                    continue
+                out.append(ast.Return(value=None))
+                return out
+            st = stmts[i]
+            if isinstance(st, ast.Return):
+                out.append(self._expr_pass(st))
+                return out
+            if isinstance(st, ast.If) and _facts([st]).returns \
+                    and self._if_convertible(st):
+                out.extend(self._fold_ret_if(st, stmts[i + 1:] + cont))
+                return out
+            out.extend(self._convert_stmt(st, fn_tail=True))
+            i += 1
+
+    def _expr_pass(self, st):
+        return ast.fix_missing_locations(_ExprRewriter().visit(st))
+
+    # -- if --
+    def _if_convertible(self, st: ast.If) -> bool:
+        f = _facts(st.body + st.orelse)
+        return not (f.hazard or f.attr_store or f.breaks_unbound or f.raises)
+
+    def _convert_stmt(self, st, fn_tail):
+        """Convert one statement (returns a list of replacement stmts)."""
+        if isinstance(st, ast.If):
+            facts = _facts([st])
+            if facts.returns or not self._if_convertible(st):
+                # stays Python; still convert nested blocks
+                st.test = self._expr_value(st.test)
+                st.body = self._block(st.body, fn_tail=False)
+                st.orelse = self._block(st.orelse, fn_tail=False)
+                return [ast.fix_missing_locations(st)]
+            return self._convert_plain_if(st, fn_tail)
+        if isinstance(st, ast.While):
+            return self._convert_while(st, fn_tail)
+        if isinstance(st, ast.For):
+            return self._convert_for(st, fn_tail)
+        if isinstance(st, (ast.With, ast.Try)):
+            for field in ("body", "orelse", "finalbody"):
+                blk = getattr(st, field, None)
+                if blk:
+                    setattr(st, field, self._block(blk, fn_tail=False))
+            if isinstance(st, ast.Try):
+                for h in st.handlers:
+                    h.body = self._block(h.body, fn_tail=False)
+            return [ast.fix_missing_locations(self._expr_pass(st))]
+        return [self._expr_pass(st)]
+
+    def _expr_value(self, expr):
+        return ast.fix_missing_locations(_ExprRewriter().visit(expr))
+
+    def _convert_plain_if(self, st, fn_tail):
+        carried = sorted(_facts(st.body + st.orelse).assigned)
+        t_name, f_name = self._fresh("ift"), self._fresh("iff")
+        t_body = self._block(st.body, fn_tail=False) + [self._carried_return(carried)]
+        f_body = self._block(st.orelse, fn_tail=False) + [self._carried_return(carried)]
+        helpers = [self._helper(t_name, carried, t_body),
+                   self._helper(f_name, carried, f_body)]
+        if carried:
+            targets = ", ".join(carried)
+            call = (f"({targets},) = " + _jst_call(
+                "convert_if",
+                f"__PDTEST__, {t_name}, {f_name}, {self._inits_src(carried)}"))
+        else:
+            call = _jst_call(
+                "convert_if", f"__PDTEST__, {t_name}, {f_name}, ()")
+        stmt = self._assign_call(carried, call, self._expr_value(st.test))
+        return [ast.fix_missing_locations(h) for h in helpers] + \
+            [ast.fix_missing_locations(stmt)]
+
+    def _fold_ret_if(self, st, cont):
+        """If with returns, in fn-tail position → return-form conversion."""
+        t_name, f_name = self._fresh("rift"), self._fresh("riff")
+        t_body = self._ret_block(st.body, cont)
+        f_body = self._ret_block(st.orelse, cont)
+        carried = sorted((_facts(st.body + st.orelse).assigned
+                          | _facts(cont).assigned) if cont
+                         else _facts(st.body + st.orelse).assigned)
+        helpers = [self._helper(t_name, carried, t_body),
+                   self._helper(f_name, carried, f_body)]
+        call = "return " + _jst_call(
+            "convert_if_ret",
+            f"__PDTEST__, {t_name}, {f_name}, {self._inits_src(carried)}")
+        stmt = self._assign_call(carried, call, self._expr_value(st.test))
+        return [ast.fix_missing_locations(h) for h in helpers] + \
+            [ast.fix_missing_locations(stmt)]
+
+    # -- while / for --
+    def _carried_for_loop(self, node, body_assigned, test_loads):
+        """Loop-carried names: assigned in the body AND live across
+        iterations (read in the test, bound before the loop, or read after
+        it). Iteration-local temps stay helper-local."""
+        end = getattr(node, "end_lineno", node.lineno)
+        carried = set()
+        for n in body_assigned:
+            if n in test_loads or n in self.params:
+                carried.add(n)
+                continue
+            if any(l < node.lineno for l in self.assign_lines.get(n, [])):
+                carried.add(n)
+                continue
+            if any(l > end for l in self.load_lines.get(n, [])):
+                carried.add(n)
+        return sorted(carried)
+
+    def _loop_convertible(self, node) -> bool:
+        f = _facts(node.body)
+        return not (f.hazard or f.attr_store or f.returns or f.raises
+                    or f.breaks_unbound or node.orelse)
+
+    def _convert_while(self, st, fn_tail):
+        if not self._loop_convertible(st):
+            st.test = self._expr_value(st.test)
+            st.body = self._block(st.body, fn_tail=False)
+            st.orelse = self._block(st.orelse, fn_tail=False)
+            return [ast.fix_missing_locations(st)]
+        body_assigned = _facts(st.body).assigned
+        carried = self._carried_for_loop(st, body_assigned, _loaded_names(st.test))
+        t_name, b_name = self._fresh("wt"), self._fresh("wb")
+        test_fn = self._helper(
+            t_name, carried, [ast.Return(value=self._expr_value(st.test))])
+        body_fn = self._helper(
+            b_name, carried,
+            self._block(st.body, fn_tail=False) + [self._carried_return(carried)])
+        if carried:
+            targets = ", ".join(carried)
+            call = (f"({targets},) = " + _jst_call(
+                "convert_while",
+                f"{t_name}, {b_name}, {self._inits_src(carried)}"))
+        else:
+            call = _jst_call("convert_while", f"{t_name}, {b_name}, ()")
+        stmt = self._assign_call(carried, call, None)
+        return [ast.fix_missing_locations(x) for x in (test_fn, body_fn, stmt)]
+
+    def _convert_for(self, st, fn_tail):
+        # only `for <name> in range(...)` converts; anything else stays
+        # Python (a concrete iterable unrolls under trace, which is the
+        # jax-idiomatic outcome for static trip counts anyway)
+        convertible = (
+            self._loop_convertible(st)
+            and isinstance(st.target, ast.Name)
+            and isinstance(st.iter, ast.Call)
+            and isinstance(st.iter.func, ast.Name)
+            and st.iter.func.id == "range"
+            and not st.iter.keywords
+            and 1 <= len(st.iter.args) <= 3
+        )
+        if not convertible:
+            st.iter = self._expr_value(st.iter)
+            st.body = self._block(st.body, fn_tail=False)
+            st.orelse = self._block(st.orelse, fn_tail=False)
+            return [ast.fix_missing_locations(st)]
+        var = st.target.id
+        a = [self._expr_value(x) for x in st.iter.args]
+        zero = ast.Constant(value=0)
+        one = ast.Constant(value=1)
+        if len(a) == 1:
+            start, stop, step = zero, a[0], one
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], one
+        else:
+            start, stop, step = a
+        # a dedicated counter drives the loop so the user's loop variable
+        # keeps Python's post-loop value (last iterated, NOT the failing
+        # bound). Known divergence: an empty range leaves `var` bound to
+        # start where Python leaves it unbound.
+        i_name = self._fresh("i")
+        stop_name, step_name = self._fresh("stop"), self._fresh("step")
+        pre = [
+            ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())], value=start),
+            ast.Assign(targets=[ast.Name(id=var, ctx=ast.Store())],
+                       value=ast.Name(id=i_name, ctx=ast.Load())),
+            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())], value=stop),
+            ast.Assign(targets=[ast.Name(id=step_name, ctx=ast.Store())], value=step),
+        ]
+        body_assigned = _facts(st.body).assigned | {var, i_name}
+        carried = sorted(set(
+            self._carried_for_loop(st, body_assigned, {i_name})) | {var, i_name})
+        t_name, b_name = self._fresh("ft"), self._fresh("fb")
+        test_fn = self._helper(t_name, carried, [ast.Return(
+            value=_parse_stmt(
+                f"{_JST}.range_cond({i_name}, {stop_name}, {step_name})").value)])
+        set_var = _parse_stmt(f"{var} = {i_name}")
+        inc = _parse_stmt(f"{i_name} = {i_name} + {step_name}")
+        body_fn = self._helper(
+            b_name, carried,
+            [set_var] + self._block(st.body, fn_tail=False)
+            + [inc, self._carried_return(carried)])
+        targets = ", ".join(carried)
+        call = (f"({targets},) = " + _jst_call(
+            "convert_while", f"{t_name}, {b_name}, {self._inits_src(carried)}"))
+        stmt = self._assign_call(carried, call, None)
+        return [ast.fix_missing_locations(x)
+                for x in pre + [test_fn, body_fn, stmt]]
+
+
+# --------------------------------------------------------------------- #
+# source-level plumbing
+# --------------------------------------------------------------------- #
+
+_cache: dict = {}
+
+
+def _transformed_code(func):
+    """Transform func's source once per CODE object; returns the compiled
+    module code and the def's name. Per-function state (closure cells,
+    defaults, globals) is bound by _convert_raw for each function object —
+    two closures over one code object must not share snapshots."""
+    key = func.__code__
+    if key in _cache:
+        return _cache[key]
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+    except (OSError, TypeError) as e:
+        raise Dy2StaticUnsupported(f"source unavailable: {e}") from e
+    try:
+        mod = ast.parse(src)
+    except SyntaxError as e:  # e.g. source slice of a lambda
+        raise Dy2StaticUnsupported(f"unparsable source: {e}") from e
+    if not mod.body or not isinstance(mod.body[0], ast.FunctionDef):
+        raise Dy2StaticUnsupported("not a plain function definition")
+    fndef = mod.body[0]
+    for dec in fndef.decorator_list:
+        dec_src = ast.unparse(dec)
+        if not any(tok in dec_src for tok in ("to_static", "jit", "dygraph_to_static")):
+            raise Dy2StaticUnsupported(f"foreign decorator {dec_src!r}")
+    fndef.decorator_list = []
+
+    fndef = _FunctionConverter(fndef).run()
+
+    freevars = func.__code__.co_freevars
+    if freevars:
+        factory = _parse_stmt(
+            f"def _pd_factory({', '.join(freevars)}):\n"
+            f"    pass\n"
+            f"    return {fndef.name}")
+        factory.body = [fndef, factory.body[-1]]
+        mod = ast.Module(body=[factory], type_ignores=[])
+    else:
+        mod = ast.Module(body=[fndef], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    code = compile(mod, filename=f"<dy2static {func.__qualname__}>", mode="exec")
+    _cache[key] = (code, fndef.name, freevars)
+    return _cache[key]
+
+
+def _convert_raw(func):
+    """Convert a plain (unbound) function; raises Dy2StaticUnsupported."""
+    code, fname, freevars = _transformed_code(func)
+
+    import paddle_tpu.jit.dy2static as _self
+
+    # conversion-time snapshot of THIS function's globals (+ the runtime
+    # helper module); the converted function resolves module globals
+    # through this dict
+    g = dict(func.__globals__)
+    g[_JST] = _self
+    ns: dict = {}
+    exec(code, g, ns)
+    if freevars:
+        cells = [c.cell_contents for c in func.__closure__]
+        converted = ns["_pd_factory"](*cells)
+    else:
+        converted = ns[fname]
+    converted.__defaults__ = func.__defaults__
+    converted.__kwdefaults__ = func.__kwdefaults__
+    converted.__dy2static_original__ = func
+    return converted
+
+
+def convert_to_static(fn) -> Optional[Callable]:
+    """AST-convert ``fn`` (function or bound method). Returns the converted
+    callable, or None when conversion is unsupported (caller falls back to
+    the eager guard)."""
+    try:
+        bound_self = getattr(fn, "__self__", None)
+        raw_fn = fn.__func__ if bound_self is not None else fn
+        if not isinstance(raw_fn, types.FunctionType):
+            return None
+        converted = _convert_raw(raw_fn)
+        if bound_self is not None:
+            return converted.__get__(bound_self)
+        return converted
+    except Dy2StaticUnsupported:
+        return None
+    except (RecursionError, MemoryError):
+        raise
+    except Exception:
+        # conversion is best-effort; any surprise degrades to the guard
+        return None

@@ -1,0 +1,279 @@
+"""Dy2static AST conversion: Python control flow on traced tensors compiles
+to lax.cond/while_loop instead of falling back to eager.
+
+Reference test model: ``test/dygraph_to_static/`` (program_translator tests
+run the same function in dygraph and to_static modes and assert parity;
+transform tests check if/while/for/bool-op conversion). VERDICT r2 #3's
+done-criterion: a data-dependent branchy model runs with NO fallback
+warning and matches eager outputs.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _assert_no_fallback(record):
+    msgs = [str(w.message) for w in record if "EAGER" in str(w.message)]
+    assert not msgs, f"dy2static fell back to eager: {msgs}"
+
+
+def _run_static(fn, *argsets):
+    """to_static(fn), run every argset, assert no fallback warning; returns
+    outputs + the traced callable."""
+    sfn = paddle.jit.to_static(fn)
+    outs = []
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for args in argsets:
+            outs.append(sfn(*args))
+    _assert_no_fallback(rec)
+    return outs, sfn
+
+
+@pytest.mark.fast
+def test_if_on_tensor_compiles_both_branches():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 3
+        return y + 1
+
+    pos = paddle.to_tensor(np.ones((2, 3), "float32"))
+    neg = paddle.to_tensor(-np.ones((2, 3), "float32"))
+    (got_pos, got_neg), sfn = _run_static(f, (pos,), (neg,))
+    np.testing.assert_allclose(got_pos.numpy(), f(pos).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(got_neg.numpy(), f(neg).numpy(), rtol=1e-6)
+    # ONE compiled program serves both branch directions (lax.cond inside)
+    assert sfn.program_cache_size == 1
+
+
+@pytest.mark.fast
+def test_early_return_in_branch():
+    def f(x):
+        if x.mean() > 10:
+            return x / 10
+        z = x + 5
+        return z * 2
+
+    lo = paddle.to_tensor(np.full((4,), 1.0, "float32"))
+    hi = paddle.to_tensor(np.full((4,), 100.0, "float32"))
+    (g_lo, g_hi), sfn = _run_static(f, (lo,), (hi,))
+    np.testing.assert_allclose(g_lo.numpy(), f(lo).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(g_hi.numpy(), f(hi).numpy(), rtol=1e-6)
+    assert sfn.program_cache_size == 1
+
+
+def test_elif_chain():
+    def f(x):
+        s = x.sum()
+        if s > 100:
+            out = x * 1
+        elif s > 0:
+            out = x * 2
+        else:
+            out = x * 3
+        return out
+
+    xs = [paddle.to_tensor(np.full((3,), v, "float32")) for v in (50.0, 1.0, -5.0)]
+    outs, sfn = _run_static(f, *[(x,) for x in xs])
+    for x, got in zip(xs, outs):
+        np.testing.assert_allclose(got.numpy(), f(x).numpy(), rtol=1e-6)
+    assert sfn.program_cache_size == 1
+
+
+@pytest.mark.fast
+def test_while_on_tensor():
+    def f(x):
+        s = x
+        while s.sum() < 100:
+            s = s * 2
+        return s
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    (got,), _ = _run_static(f, (x,))
+    np.testing.assert_allclose(got.numpy(), f(x).numpy(), rtol=1e-6)
+
+
+def test_while_loop_carried_python_counter():
+    def f(x):
+        i = 0
+        s = x
+        while s.max() < 50:
+            s = s * 3
+            i = i + 1
+        return s, i
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    (got,), _ = _run_static(f, (x,))
+    ref = f(x)
+    np.testing.assert_allclose(got[0].numpy(), ref[0].numpy(), rtol=1e-6)
+    assert int(got[1]) == int(ref[1])
+
+
+def test_for_range_tensor_bound():
+    def f(x, n):
+        out = x
+        for _i in range(n):
+            out = out + 2
+        return out
+
+    x = paddle.to_tensor(np.zeros((3,), "float32"))
+    n = paddle.to_tensor(np.asarray(4, "int32"))
+    (got,), _ = _run_static(f, (x, n))
+    np.testing.assert_allclose(got.numpy(), f(x, 4).numpy(), rtol=1e-6)
+
+
+@pytest.mark.fast
+def test_bool_ops_and_ternary():
+    def f(x, flag):
+        big = (x.sum() > 0) and (x.max() > 2)
+        y = x * 5 if big else x * -1
+        if flag and not big:
+            y = y + 100
+        return y
+
+    a = paddle.to_tensor(np.full((3,), 3.0, "float32"))
+    b = paddle.to_tensor(np.full((3,), -1.0, "float32"))
+    outs, _ = _run_static(f, (a, True), (b, True), (b, False))
+    for args, got in zip([(a, True), (b, True), (b, False)], outs):
+        np.testing.assert_allclose(got.numpy(), f(*args).numpy(), rtol=1e-6)
+
+
+def test_branchy_layer_model():
+    """The VERDICT done-criterion: a branchy MODEL under to_static, no
+    fallback, eager parity across inputs taking different paths."""
+
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc_hot = nn.Linear(4, 4)
+            self.fc_cold = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = x
+            # data-dependent routing + a data-dependent refinement loop
+            if h.abs().mean() > 1:
+                h = self.fc_hot(h)
+            else:
+                h = self.fc_cold(h)
+            while h.abs().max() < 3:
+                h = h * 2
+            return h
+
+    paddle.seed(0)
+    m = Gate()
+    m.eval()
+    hot = paddle.to_tensor(np.full((2, 4), 5.0, "float32"))
+    cold = paddle.to_tensor(np.full((2, 4), 0.1, "float32"))
+    ref_hot, ref_cold = m(hot).numpy(), m(cold).numpy()
+
+    paddle.seed(0)
+    sm = Gate()  # fresh params seeded identically for the static copy
+    paddle.jit.to_static(sm)
+    sm.eval()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        got_hot = sm.forward(hot).numpy()
+        got_cold = sm.forward(cold).numpy()
+    _assert_no_fallback(rec)
+    np.testing.assert_allclose(got_hot, ref_hot, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_cold, ref_cold, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.fast
+def test_numpy_sync_still_falls_back():
+    def f(x):
+        v = float(x.sum().numpy())  # genuine host sync, unconvertible
+        return x + v
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    sf = paddle.jit.to_static(f)
+    with pytest.warns(UserWarning, match="EAGER"):
+        got = sf(x)
+    np.testing.assert_allclose(got.numpy(), f(x).numpy(), rtol=1e-6)
+
+
+def test_python_condition_stays_python():
+    def f(x, mode):
+        if mode == "double":  # plain python condition: no conversion needed
+            return x * 2
+        return x / 2
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    outs, _ = _run_static(f, (x, "double"), (x, "half"))
+    np.testing.assert_allclose(outs[0].numpy(), (x * 2).numpy())
+    np.testing.assert_allclose(outs[1].numpy(), (x / 2).numpy())
+
+
+def test_raise_in_branch_not_converted():
+    """lax.cond traces BOTH branches, so a `raise` inside one must keep the
+    whole if in Python (eager fallback) rather than firing unconditionally."""
+
+    def f(x):
+        if x.min() < 0:
+            raise ValueError("negative input")
+        return x * 2
+
+    ok = paddle.to_tensor(np.ones((3,), "float32"))
+    sf = paddle.jit.to_static(f)
+    with pytest.warns(UserWarning, match="EAGER"):
+        got = sf(ok)
+    np.testing.assert_allclose(got.numpy(), (ok * 2).numpy())
+    with pytest.raises(ValueError, match="negative"):
+        sf(paddle.to_tensor(-np.ones((3,), "float32")))
+
+
+def test_for_loop_var_keeps_python_post_value():
+    def f(x):
+        if x.sum() > 1e9:  # tensor cond forces whole-function conversion
+            x = x + 0
+        for i in range(10):
+            x = x + 1
+        return x * i  # python leaves i == 9 after the loop
+
+    x = paddle.to_tensor(np.zeros((2,), "float32"))
+    (got,), _ = _run_static(f, (x,))
+    np.testing.assert_allclose(got.numpy(), np.full((2,), 90.0, "float32"))
+
+
+def test_distinct_closures_not_aliased():
+    """Two closures sharing one code object must keep their own cells."""
+
+    def make(scale):
+        def g(x):
+            if x.sum() > 0:
+                return x * scale
+            return x - scale
+
+        return g
+
+    g2, g5 = make(2.0), make(5.0)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    (got2,), _ = _run_static(g2, (x,))
+    (got5,), _ = _run_static(g5, (x,))
+    np.testing.assert_allclose(got2.numpy(), np.full((2,), 2.0, "float32"))
+    np.testing.assert_allclose(got5.numpy(), np.full((2,), 5.0, "float32"))
+
+
+def test_variable_defined_in_one_branch_raises_clearly():
+    from paddle_tpu.jit.dy2static import Dy2StaticError  # noqa: F401
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        # y undefined on the false path
+        return y  # noqa: F821
+
+    x = paddle.to_tensor(-np.ones((2,), "float32"))
+    sf = paddle.jit.to_static(f)
+    # conversion is attempted, the structural error is detected, and the
+    # guard degrades to eager — where the same bug surfaces as the natural
+    # Python error for the taken path
+    with pytest.warns(UserWarning, match="EAGER"):
+        with pytest.raises(Exception):
+            sf(x)
