@@ -184,16 +184,27 @@ def convert_while(test_fn, body_fn, init):
     vars_ = tuple(init)
     traced_state = any(_is_traced(v) for v in vars_ if v is not UNDEFINED)
     if not traced_state:
+        # Python loop while everything stays concrete. The state (or the
+        # test — e.g. a closure tensor enters the math) can BECOME traced
+        # mid-loop; the iterations already run are plain value updates, so
+        # the lax loop below continues soundly from the current state.
         c = test_fn(*vars_)
-        if not _is_traced(c):
-            while truthy(c):
-                vars_ = tuple(body_fn(*vars_))
-                c = test_fn(*vars_)
-            return vars_
+        while not _is_traced(c):
+            if not truthy(c):
+                return vars_
+            vars_ = tuple(body_fn(*vars_))
+            if any(_is_traced(v) for v in vars_ if v is not UNDEFINED):
+                break
+            c = test_fn(*vars_)
     _check_defined(vars_, "while")
     from ..static.nn import while_loop as st_while
 
-    out = st_while(test_fn, lambda *vs: tuple(body_fn(*vs)), list(vars_))
+    try:
+        out = st_while(test_fn, lambda *vs: tuple(body_fn(*vs)), list(vars_))
+    except TypeError as e:
+        raise Dy2StaticError(
+            "tensor `while`: the loop body must keep every carried "
+            f"variable's shape/dtype fixed across iterations ({e})") from e
     return tuple(out)
 
 
@@ -253,7 +264,12 @@ def ifexp(pred, t_thunk, f_thunk):
         return t_thunk() if truthy(pred) else f_thunk()
     from ..static.nn import cond as st_cond
 
-    return st_cond(pred, t_thunk, f_thunk)
+    try:
+        return st_cond(pred, t_thunk, f_thunk)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "tensor ternary: both arms must produce matching "
+            f"structure/shape/dtype ({e})") from e
 
 
 # --------------------------------------------------------------------- #
@@ -507,7 +523,7 @@ class _FunctionConverter:
         lams = ", ".join(f"lambda: {c}" for c in carried)
         return f"{_JST}.inits({lams})"
 
-    def _assign_call(self, carried, call_src, test_expr):
+    def _assign_call(self, call_src, test_expr):
         """``(a, b,) = __paddle_jst__.convert_*(<test>, ...)`` with the real
         test AST spliced over the __PDTEST__ placeholder."""
         st = _parse_stmt(call_src)
@@ -617,7 +633,7 @@ class _FunctionConverter:
         else:
             call = _jst_call(
                 "convert_if", f"__PDTEST__, {t_name}, {f_name}, ()")
-        stmt = self._assign_call(carried, call, self._expr_value(st.test))
+        stmt = self._assign_call(call, self._expr_value(st.test))
         return [ast.fix_missing_locations(h) for h in helpers] + \
             [ast.fix_missing_locations(stmt)]
 
@@ -634,7 +650,7 @@ class _FunctionConverter:
         call = "return " + _jst_call(
             "convert_if_ret",
             f"__PDTEST__, {t_name}, {f_name}, {self._inits_src(carried)}")
-        stmt = self._assign_call(carried, call, self._expr_value(st.test))
+        stmt = self._assign_call(call, self._expr_value(st.test))
         return [ast.fix_missing_locations(h) for h in helpers] + \
             [ast.fix_missing_locations(stmt)]
 
@@ -682,7 +698,7 @@ class _FunctionConverter:
                 f"{t_name}, {b_name}, {self._inits_src(carried)}"))
         else:
             call = _jst_call("convert_while", f"{t_name}, {b_name}, ()")
-        stmt = self._assign_call(carried, call, None)
+        stmt = self._assign_call(call, None)
         return [ast.fix_missing_locations(x) for x in (test_fn, body_fn, stmt)]
 
     def _convert_for(self, st, fn_tail):
@@ -742,7 +758,7 @@ class _FunctionConverter:
         targets = ", ".join(carried)
         call = (f"({targets},) = " + _jst_call(
             "convert_while", f"{t_name}, {b_name}, {self._inits_src(carried)}"))
-        stmt = self._assign_call(carried, call, None)
+        stmt = self._assign_call(call, None)
         return [ast.fix_missing_locations(x)
                 for x in pre + [test_fn, body_fn, stmt]]
 

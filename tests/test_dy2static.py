@@ -115,6 +115,22 @@ def test_while_loop_carried_python_counter():
     assert int(got[1]) == int(ref[1])
 
 
+def test_while_state_becomes_traced_mid_loop():
+    """Loop state starts as a Python float and becomes a tensor inside the
+    body; the converted loop must carry on (lax continues from the current
+    state) instead of crashing on a tracer truth test."""
+
+    def f(x):
+        s = 0.0
+        while s < 10:
+            s = s + x.sum()
+        return s
+
+    x = paddle.to_tensor(np.full((2,), 3.0, "float32"))
+    (got,), _ = _run_static(f, (x,))
+    np.testing.assert_allclose(float(got), float(f(x)), rtol=1e-6)
+
+
 def test_for_range_tensor_bound():
     def f(x, n):
         out = x
