@@ -1,21 +1,27 @@
 """Launch CLI (python -m paddle_tpu.distributed.launch).
 
-Reference (SURVEY.md §3.5): `paddle.distributed.launch` spawns one process
-per GPU with PADDLE_TRAINER_ID / endpoints env and watches them.
+Reference (SURVEY.md §3.5): `paddle.distributed.launch` spawns worker
+processes with PADDLE_TRAINER_ID / endpoints env, installs a watch loop,
+and (elastic mode, `launch/controllers/`) relaunches failed pods with
+bounded retries; training resumes from the latest checkpoint.
 
-TPU-native design: one process per *host*; devices are discovered by PJRT.
-Single-host: exec the script directly (all local chips visible). Multi-host:
-set the JAX coordination env (coordinator address, process id/count) from
-the same PADDLE_* env names the reference launcher uses, so Paddle-style
-cluster tooling keeps working, then exec the script — rendezvous happens in
-`init_parallel_env` via `jax.distributed.initialize`.
+TPU-native design: one worker process per *host*; devices are discovered
+by PJRT. The launcher negotiates this host's rank (multi-host), sets the
+JAX coordination env from the same PADDLE_* names the reference uses, then
+SPAWNS the script as a child process and watches it: nonzero exit →
+bounded-retry relaunch (``--max_restarts``, PADDLE_RESTART_COUNT exported
+to the worker), rc=0 → clean exit. Fault recovery is checkpoint-resume
+(`fleet.elastic.ElasticManager` in the training script), not rank
+replacement — TPU slices fail as a unit (SURVEY.md §7 "Elastic").
 """
 from __future__ import annotations
 
 import argparse
 import os
-import runpy
+import signal
+import subprocess
 import sys
+import time
 
 
 def build_parser():
@@ -29,6 +35,12 @@ def build_parser():
     p.add_argument("--devices", "--gpus", "--xpus", type=str, default=None, dest="devices")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS", "0")),
+                   help="bounded-retry relaunch count on nonzero worker exit "
+                        "(reference: elastic controllers' restart budget)")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds between relaunches (doubles per retry, capped)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -77,6 +89,59 @@ def negotiate_rank(master: str, nnodes: int, timeout: float = 300.0):
     return rank, store
 
 
+def _supervise(cmd, env, max_restarts: int, backoff: float) -> int:
+    """Spawn the worker, watch it, relaunch on nonzero exit with bounded
+    retries (the reference launch controllers' watch loop, SURVEY.md §3.5
+    steps 3-4). SIGTERM/SIGINT are forwarded to the worker AND latched:
+    an operator kill tears the job down (no relaunch of a deliberately
+    killed worker) instead of orphaning or restarting it."""
+    attempt = 0
+    child = None
+    stop: dict = {}
+
+    def forward(signum, frame):
+        stop["sig"] = signum
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    old_term = signal.signal(signal.SIGTERM, forward)
+    old_int = signal.signal(signal.SIGINT, forward)
+    try:
+        while True:
+            env["PADDLE_RESTART_COUNT"] = str(attempt)
+            child = subprocess.Popen(cmd, env=env)
+            rc = child.wait()
+            if stop:
+                return 128 + stop["sig"]
+            if rc == 0:
+                return 0
+            if attempt >= max_restarts:
+                if max_restarts:
+                    print(
+                        f"[launch] worker exited rc={rc}; restart budget "
+                        f"({max_restarts}) exhausted", file=sys.stderr)
+                # conventional status for signal deaths (e.g. 137 for OOM's
+                # SIGKILL), not python's 256+rc wraparound
+                return 128 - rc if rc < 0 else rc
+            attempt += 1
+            delay = min(backoff * (2 ** (attempt - 1)), 30.0)
+            print(
+                f"[launch] worker exited rc={rc}; relaunching "
+                f"({attempt}/{max_restarts}) in {delay:.1f}s — training "
+                "should resume from the latest checkpoint "
+                "(fleet.elastic.ElasticManager)", file=sys.stderr)
+            # interruptible backoff: a kill during the wait must stop the
+            # job, not be swallowed by PEP-475 sleep resumption
+            deadline = time.monotonic() + delay
+            while not stop and time.monotonic() < deadline:
+                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+            if stop:
+                return 128 + stop["sig"]
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+
+
 def launch(argv=None):
     args = build_parser().parse_args(argv)
     nnodes = int(str(args.nnodes).split(":")[0])
@@ -103,5 +168,15 @@ def launch(argv=None):
             _store.close()
         os.environ["PADDLE_TRAINER_ID"] = str(rank)
         os.environ["JAX_PROCESS_ID"] = str(rank)
-    sys.argv = [args.training_script] + list(args.training_script_args)
-    runpy.run_path(args.training_script, run_name="__main__")
+    cmd = [sys.executable, args.training_script] + list(args.training_script_args)
+    env = os.environ.copy()
+    # the worker is a fresh interpreter: propagate the launcher's import
+    # environment so an uninstalled checkout (imported via cwd/sys.path)
+    # stays importable in the child, as it was under in-process runpy
+    inherited = [p for p in sys.path if p]
+    if env.get("PYTHONPATH"):
+        inherited.append(env["PYTHONPATH"])
+    env["PYTHONPATH"] = os.pathsep.join(inherited)
+    rc = _supervise(cmd, env, args.max_restarts, args.restart_backoff)
+    if rc:
+        sys.exit(rc)
