@@ -110,6 +110,11 @@ def _supervise(cmd, env, max_restarts: int, backoff: float) -> int:
         while True:
             env["PADDLE_RESTART_COUNT"] = str(attempt)
             child = subprocess.Popen(cmd, env=env)
+            if stop:
+                # a kill latched between handler installation / the backoff
+                # check and Popen would otherwise leave this worker running
+                # to completion
+                child.send_signal(stop["sig"])
             rc = child.wait()
             if stop:
                 return 128 + stop["sig"]

@@ -306,11 +306,21 @@ class TrainStep:
         (fleet.DistTrainStep)."""
         return batch_vals
 
-    def cost_analysis(self, *batch):
-        """XLA cost analysis (flops, bytes accessed) of the compiled step for
-        this batch signature. Feeds MFU reporting (bench.py); the reference
-        has no per-program cost introspection — this rides XLA's
-        ``compiled.cost_analysis()`` (same source as hapi.flops)."""
+    def _compiled_for(self, *batch):
+        """Lower+compile the step for this batch signature (cached) and
+        return the XLA Compiled object for introspection."""
+        lowered, key = self._lower_for(*batch, _with_key=True)
+        cache = self.__dict__.setdefault("_introspect_compiled", {})
+        if key not in cache:
+            cache[key] = lowered.compile()
+        return cache[key]
+
+    def _lower_for(self, *batch, _with_key=False):
+        """The jax Lowered object (pre-optimization StableHLO) for this
+        batch signature — program structure BEFORE XLA fusion/CSE.
+        Lowerings and compiles are cached per signature: cost_analysis +
+        memory_analysis + as_text on one step must not trigger repeated
+        multi-second XLA compiles."""
         p_vals = [p._value for p in self._params]
         b_vals = [b._value for b in self._buffers + self._extra_params]
         opt_states = self._opt.functional_states()
@@ -323,15 +333,45 @@ class TrainStep:
             jitted = self._compile()
             self._cache[key] = jitted
         rng_key = _rng.next_key()
-        cost = (
-            jitted.lower(p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
-            .compile()
-            .cost_analysis()
-        )
+        lcache = self.__dict__.setdefault("_introspect_lowered", {})
+        if key not in lcache:
+            lcache[key] = jitted.lower(
+                p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
+        if _with_key:
+            return lcache[key], key
+        return lcache[key]
+
+    def cost_analysis(self, *batch):
+        """XLA cost analysis (flops, bytes accessed) of the compiled step for
+        this batch signature. Feeds MFU reporting (bench.py); the reference
+        has no per-program cost introspection — this rides XLA's
+        ``compiled.cost_analysis()`` (same source as hapi.flops)."""
+        cost = self._compiled_for(*batch).cost_analysis()
         # jax returns either a dict or a one-element list of dicts
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         return dict(cost or {})
+
+    def memory_analysis(self, *batch):
+        """PER-DEVICE memory footprint of the compiled step, from XLA's
+        CompiledMemoryStats: argument/output/temp/code bytes. Under a mesh
+        the compiled program is the per-device SPMD program, so ZeRO
+        sharding and rematerialization wins are directly measurable here
+        (the quantitative counterpart of the reference's GroupSharded
+        memory claims; `paddle.device.cuda.memory_*` report the live PJRT
+        allocator numbers at runtime)."""
+        m = self._compiled_for(*batch).memory_analysis()
+        fields = (
+            "argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes", "alias_size_in_bytes",
+            "generated_code_size_in_bytes",
+        )
+        out = {f: int(getattr(m, f, 0)) for f in fields}
+        out["live_size_in_bytes"] = (
+            out["argument_size_in_bytes"] + out["output_size_in_bytes"]
+            + out["temp_size_in_bytes"] - out["alias_size_in_bytes"]
+        )
+        return out
 
     def _compile(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
