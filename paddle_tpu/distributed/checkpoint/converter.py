@@ -1,0 +1,232 @@
+"""Topology-independent checkpoint form (the reference's checkpoint
+converter capability, ``python/paddle/distributed/auto_parallel/static/
+converter.py``: re-slice checkpoints across parallel configurations).
+
+TPU-native shape of the problem: most placement differences need NO
+conversion at all (sharding is placement over the same global arrays, and
+orbax restores onto the target sharding directly). The one structural
+difference is pipeline parallelism: ``SpmdPipeline`` absorbs its blocks'
+parameters into layer-stacked arrays (``gpt.decoder.attn__qkv_proj__weight``
+with leading layer dim, interleaved stage-major order) where the plain
+model keeps per-layer entries (``gpt.decoder.3.attn.qkv_proj.weight``).
+
+``canonical_state_dict`` therefore explodes stacked entries to the plain
+per-layer names (undoing the interleaved ``_layer_order``) with lazy jax
+slices (no host materialization), and ``apply_canonical`` re-stacks them
+for whatever pipeline layout the LIVE model uses — so a checkpoint saved
+under dp2 x mp2 x pp2 restores under sharding8 (or any other config) and
+vice versa. Optimizer accumulators are keyed by the param's STRUCTURED
+state-dict path (never a run-local auto ``param_N`` name mismatch: the
+index follows the optimizer's own parameter list) and explode/restack
+alongside their params; scalar accumulators (Adam beta powers) replicate
+per layer on save and collapse on load. Missing keys at restore RAISE —
+silently resuming on fresh inits is worse than failing.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ...framework.core import Tensor
+from ...framework.op import raw
+
+OPT = "__opt__."
+EXTRA = "__extra__."
+
+
+def _as_value(v):
+    return raw(v) if isinstance(v, Tensor) else v
+
+
+def _stacked_map(model) -> Dict[str, tuple]:
+    """Exact lookup: state-dict key of a pipeline-stacked param/buffer ->
+    (pipe, canonical template name). Built from each SpmdPipeline's own
+    registration (attr = template name with '.' -> '__', buffers suffixed
+    '_stacked'), not by string-sniffing — a dot-free template name like
+    'weight' maps correctly."""
+    from ..fleet.meta_parallel.pipeline_parallel import SpmdPipeline
+
+    out = {}
+    for path, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, SpmdPipeline):
+            continue
+        pfx = path + "." if path else ""
+        for n, _ in sub._template_holder[0].named_parameters():
+            out[pfx + n.replace(".", "__")] = (path, sub, n)
+        for n, _ in sub._template_holder[0].named_buffers():
+            out[pfx + n.replace(".", "__") + "_stacked"] = (path, sub, n)
+    return out
+
+
+def _param_paths(model, optimizer=None) -> Dict[str, str]:
+    """optimizer-facing param NAME -> state-dict path, via object identity.
+    The ``param_{i}`` fallback indexes the OPTIMIZER's parameter list (that
+    is how Optimizer.state_dict names them), never the model's order."""
+    by_id = {id(v): k for k, v in model.state_dict().items()}
+    plist = (optimizer._parameter_list if optimizer is not None
+             else [p for _, p in model.named_parameters()])
+    out = {}
+    for i, p in enumerate(plist):
+        name = p.name or f"param_{i}"
+        if id(p) in by_id:
+            out[name] = by_id[id(p)]
+    return out
+
+
+def _split_opt_key(key):
+    """'<pname>.<acc>' -> (pname, acc); accumulator suffix has no dots."""
+    pname, _, acc = key.rpartition(".")
+    return pname, acc
+
+
+def _layer_key(path, layer, tmpl):
+    return f"{path}.{layer}.{tmpl}" if path else f"{layer}.{tmpl}"
+
+
+def canonical_state_dict(model, optimizer=None,
+                         extra: Optional[Dict[str, Any]] = None):
+    """Flat topology-independent snapshot of model (+ optimizer) state.
+    Values stay jax arrays (stacked entries become lazy device-side layer
+    slices) so the orbax writer keeps its shard-aware, async-capable path."""
+    stacked_keys = _stacked_map(model)
+    out: Dict[str, Any] = {}
+
+    def explode(canon_prefix, pipe_entry, value, suffix=""):
+        path, pipe, tmpl = pipe_entry
+        v = _as_value(value)
+        is_stacked = getattr(v, "ndim", 0) >= 1 and v.shape[0] == pipe.num_layers
+        for i, layer in enumerate(pipe._layer_order):
+            out[canon_prefix + _layer_key(path, layer, tmpl) + suffix] = (
+                v[i] if is_stacked else v)
+
+    for key, val in model.state_dict().items():
+        if key in stacked_keys:
+            explode("", stacked_keys[key], val)
+        else:
+            out[key] = val
+
+    if optimizer is not None:
+        if hasattr(optimizer, "functional_states"):
+            optimizer.functional_states()  # materialize accumulators
+        name_to_path = _param_paths(model, optimizer)
+        for key, val in optimizer.state_dict().items():
+            if key == "LR_Scheduler":
+                out[OPT + key] = val
+                continue
+            pname, acc = _split_opt_key(key)
+            path_key = name_to_path.get(pname, pname)
+            if path_key in stacked_keys:
+                explode(OPT, stacked_keys[path_key], val, suffix=f".{acc}")
+            else:
+                out[OPT + path_key + f".{acc}"] = val
+
+    for k, v in (extra or {}).items():
+        out[EXTRA + k] = v
+    return out
+
+
+def restore_canonical(path, model, optimizer=None) -> Dict[str, Any]:
+    """Orbax restore of a canonical checkpoint, sharded where possible: the
+    live canonical tree provides shape/dtype/sharding targets, so
+    non-stacked arrays restore straight onto their current placements (no
+    full host materialization); a saved-vs-live tree mismatch raises in
+    orbax rather than resuming silently on fresh inits."""
+    import orbax.checkpoint as ocp
+
+    from . import _checkpointer
+
+    live = canonical_state_dict(model, optimizer)
+
+    def to_target(v):
+        v = _as_value(v)
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return jax.ShapeDtypeStruct(
+                v.shape, v.dtype, sharding=getattr(v, "sharding", None))
+        return v
+
+    target = {k: to_target(v) for k, v in live.items()}
+    with _checkpointer() as ckptr:
+        return ckptr.restore(path, target)
+
+
+def _put_like(new, old_val):
+    """Materialize `new` with the live value's placement (keeps ZeRO/mp
+    shardings across the restore instead of silently replicating). A
+    device_put failure propagates — restoring a param replicated when the
+    live layout says sharded is a silent HBM blowup, not a fallback."""
+    arr = jax.numpy.asarray(new, dtype=getattr(old_val, "dtype", None))
+    sh = getattr(old_val, "sharding", None)
+    if sh is not None and getattr(sh, "mesh", None) is not None:
+        return jax.device_put(arr, sh)
+    return arr
+
+
+def apply_canonical(model, canonical: Dict[str, Any], optimizer=None):
+    """Restore a canonical snapshot into the LIVE model/optimizer layout
+    (re-stacking for whatever pipelines the model uses). Raises KeyError
+    listing anything the checkpoint is missing."""
+    stacked_keys = _stacked_map(model)
+    missing = []
+
+    def assemble(pipe_entry, template_val, prefix="", suffix=""):
+        path, pipe, tmpl = pipe_entry
+        tv = _as_value(template_val)
+        is_stacked = getattr(tv, "ndim", 0) >= 1 and tv.shape[0] == pipe.num_layers
+        pieces = []
+        for layer in pipe._layer_order:
+            k = prefix + _layer_key(path, layer, tmpl) + suffix
+            if k not in canonical:
+                missing.append(k)
+                return None
+            pieces.append(np.asarray(canonical[k]))
+        if not is_stacked:
+            return pieces[0]  # scalar accumulator replicated per layer
+        return np.stack(pieces, axis=0)
+
+    updates = []
+    for key, t in model.state_dict().items():
+        if key in stacked_keys:
+            new = assemble(stacked_keys[key], t)
+        elif key in canonical:
+            new = canonical[key]
+        else:
+            missing.append(key)
+            new = None
+        if new is not None:
+            updates.append((t, new))
+
+    opt_restored = {}
+    if optimizer is not None:
+        if hasattr(optimizer, "functional_states"):
+            optimizer.functional_states()
+        name_to_path = _param_paths(model, optimizer)
+        for key, val in optimizer.state_dict().items():
+            if key == "LR_Scheduler":
+                if OPT + key in canonical:
+                    opt_restored[key] = canonical[OPT + key]
+                continue
+            pname, acc = _split_opt_key(key)
+            path_key = name_to_path.get(pname, pname)
+            if path_key in stacked_keys:
+                new = assemble(stacked_keys[path_key], val,
+                               prefix=OPT, suffix=f".{acc}")
+            else:
+                new = canonical.get(OPT + path_key + f".{acc}")
+                if new is None:
+                    missing.append(OPT + path_key + f".{acc}")
+            if new is not None:
+                opt_restored[key] = Tensor(_put_like(new, _as_value(val)))
+
+    if missing:
+        raise KeyError(
+            "checkpoint is missing entries for the live model/optimizer "
+            f"(stale or pre-canonical format?): {sorted(set(missing))[:8]}"
+            f"{' ...' if len(set(missing)) > 8 else ''}")
+
+    for t, new in updates:
+        t._rebind(_put_like(new, t._value))
+    if optimizer is not None and opt_restored:
+        optimizer.set_state_dict(opt_restored)
+    return model

@@ -17,7 +17,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-from ..checkpoint import load_state_dict, save_state_dict
+from ..checkpoint import save_state_dict
 
 
 class ElasticManager:
@@ -53,17 +53,15 @@ class ElasticManager:
 
     # -- save/restore -------------------------------------------------------
     def _state(self, model, optimizer=None, extra: Optional[Dict[str, Any]] = None):
-        state = dict(model.state_dict())
-        if optimizer is not None:
-            if hasattr(optimizer, "functional_states"):
-                optimizer.functional_states()  # materialize accumulators so
-                # a fresh optimizer's restore target matches the snapshot
-            for k, v in optimizer.state_dict().items():
-                state[f"__opt__.{k}"] = v
-        if extra:
-            for k, v in extra.items():
-                state[f"__extra__.{k}"] = v
-        return state
+        """Snapshot in TOPOLOGY-INDEPENDENT (canonical) form: pipeline-
+        stacked params explode to per-layer entries and optimizer
+        accumulators key by structured param path — so a checkpoint saved
+        under dp x mp x pp restores under sharding-only (or any other
+        hybrid config) and vice versa (the reference's auto-parallel
+        checkpoint converter capability)."""
+        from ...distributed.checkpoint.converter import canonical_state_dict
+
+        return canonical_state_dict(model, optimizer, extra)
 
     def maybe_save(self, step: int, model, optimizer=None, extra=None) -> bool:
         if (step + 1) % self.save_interval != 0:
@@ -92,20 +90,17 @@ class ElasticManager:
             shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{victim}"), ignore_errors=True)
 
     def resume(self, model, optimizer=None) -> int:
-        """Restore latest snapshot (re-sharding onto the live mesh); returns
-        the next step index to run (0 when no checkpoint exists)."""
+        """Restore latest snapshot into the LIVE layout (re-stacking for the
+        model's pipelines, re-placing onto current shardings); returns the
+        next step index to run (0 when no checkpoint exists)."""
+        from ...distributed.checkpoint.converter import (
+            apply_canonical, restore_canonical,
+        )
+
         step = self.latest_step()
         if step is None:
             return 0
         path = os.path.join(self.ckpt_dir, f"step_{step}")
-        state = self._state(model, optimizer)
-        load_state_dict(path, state)
-        # push optimizer entries back
-        if optimizer is not None:
-            opt_state = {
-                k[len("__opt__."):]: v for k, v in state.items() if k.startswith("__opt__.")
-            }
-            if opt_state:
-                optimizer.set_state_dict(opt_state)
-        model.set_state_dict({k: v for k, v in state.items() if not k.startswith("__")})
+        canonical = restore_canonical(path, model, optimizer)
+        apply_canonical(model, canonical, optimizer)
         return step + 1

@@ -1,0 +1,88 @@
+"""Cross-parallel-config checkpoint reshard proof (VERDICT r2 #9).
+
+Reference capability: the auto-parallel checkpoint converter
+(`auto_parallel/static/converter.py`) re-slices checkpoints across
+different parallel configurations. TPU-native: placements live on the
+arrays, so `load_state_dict` restores straight onto the CURRENT mesh —
+proved here by loss-TRAJECTORY continuity: train 5 steps under config A,
+checkpoint, resume under config B, and the steps 5..9 losses must equal an
+uninterrupted single-device run, in BOTH directions
+(dp2 x mp2 x pp2 -> sharding8 and back).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+STEPS, SWITCH, BATCH, SEQ, VOCAB = 10, 5, 8, 16, 64
+
+HYBRID = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+ZERO8 = {"sharding_degree": 8}
+
+
+def _data():
+    rng = np.random.default_rng(42)
+    return [paddle.to_tensor(rng.integers(0, VOCAB, (BATCH, SEQ)).astype(np.int32))
+            for _ in range(STEPS)]
+
+
+def _build(degrees, stage=1):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(degrees)
+    s.sharding_configs.update(stage=stage)
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(1234)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=SEQ, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+    step = fleet.DistTrainStep(model, lambda m, ids, lbl: m(ids, labels=lbl), opt)
+    return model, opt, step
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    _, _, step = _build({})
+    return [float(step(ids, ids)) for ids in _data()]
+
+
+def _switch_run(cfg_a, cfg_b, ckpt_dir, stage_a=1, stage_b=1):
+    data = _data()
+    model, opt, step = _build(cfg_a, stage_a)
+    elastic = ElasticManager(ckpt_dir, save_interval=SWITCH)
+    losses = []
+    for i in range(SWITCH):
+        losses.append(float(step(data[i], data[i])))
+        elastic.maybe_save(i, model, opt)
+
+    # "restart" under a different parallel config: fresh mesh, fresh model,
+    # fresh optimizer; restore re-shards onto the new placements
+    model, opt, step = _build(cfg_b, stage_b)
+    start = elastic.resume(model, opt)
+    assert start == SWITCH
+    for i in range(start, STEPS):
+        losses.append(float(step(data[i], data[i])))
+    return losses
+
+
+def test_hybrid_to_sharding8_continuity(tmp_path, baseline):
+    losses = _switch_run(HYBRID, ZERO8, str(tmp_path / "a"), stage_b=3)
+    np.testing.assert_allclose(
+        losses, baseline, rtol=5e-3, atol=1e-5,
+        err_msg="dp2xmp2xpp2 -> sharding8(stage3) resume diverged")
+    assert losses[-1] < losses[0]
+
+
+def test_sharding8_to_hybrid_continuity(tmp_path, baseline):
+    losses = _switch_run(ZERO8, HYBRID, str(tmp_path / "b"), stage_a=3)
+    np.testing.assert_allclose(
+        losses, baseline, rtol=5e-3, atol=1e-5,
+        err_msg="sharding8(stage3) -> dp2xmp2xpp2 resume diverged")
+    assert losses[-1] < losses[0]
