@@ -379,8 +379,14 @@ def main():
                 return
             # "error" is a MEASUREMENT failure (probe-time backend notes
             # travel as "backend_note" so a measured value that merely saw
-            # a transient probe error is not retried/discarded)
-            ok = "error" not in line or "UNAVAILABLE" not in str(line.get("error"))
+            # a transient probe error is not retried/discarded). Transient
+            # tunnel-backend failures — UNAVAILABLE, INTERNAL read-body
+            # flaps on remote_compile — are retried in a fresh subprocess.
+            err = str(line.get("error"))
+            transient = any(s in err for s in (
+                "UNAVAILABLE", "read body", "response body closed",
+                "DEADLINE_EXCEEDED", "Connection reset", "timed out"))
+            ok = "error" not in line or not transient
             if ok:
                 if line.get("platform") and "cpu" not in str(line["platform"]).lower() \
                         and line.get("value", 0) > 0:

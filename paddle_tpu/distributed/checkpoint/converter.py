@@ -24,7 +24,7 @@ silently resuming on fresh inits is worse than failing.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import numpy as np
@@ -33,7 +33,6 @@ from ...framework.core import Tensor
 from ...framework.op import raw
 
 OPT = "__opt__."
-EXTRA = "__extra__."
 
 
 def _as_value(v):
@@ -85,11 +84,15 @@ def _layer_key(path, layer, tmpl):
     return f"{path}.{layer}.{tmpl}" if path else f"{layer}.{tmpl}"
 
 
-def canonical_state_dict(model, optimizer=None,
-                         extra: Optional[Dict[str, Any]] = None):
+def canonical_state_dict(model, optimizer=None, abstract: bool = False):
     """Flat topology-independent snapshot of model (+ optimizer) state.
     Values stay jax arrays (stacked entries become lazy device-side layer
-    slices) so the orbax writer keeps its shard-aware, async-capable path."""
+    slices) so the orbax writer keeps its shard-aware, async-capable path.
+
+    ``abstract=True`` emits ShapeDtypeStructs for the exploded per-layer
+    entries instead of executing the slices — restore-target construction
+    must not allocate a second full copy of every stacked param on device
+    in the memory-tight resume path."""
     stacked_keys = _stacked_map(model)
     out: Dict[str, Any] = {}
 
@@ -97,9 +100,11 @@ def canonical_state_dict(model, optimizer=None,
         path, pipe, tmpl = pipe_entry
         v = _as_value(value)
         is_stacked = getattr(v, "ndim", 0) >= 1 and v.shape[0] == pipe.num_layers
+        if is_stacked and abstract:
+            slice_t = jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
         for i, layer in enumerate(pipe._layer_order):
             out[canon_prefix + _layer_key(path, layer, tmpl) + suffix] = (
-                v[i] if is_stacked else v)
+                (slice_t if abstract else v[i]) if is_stacked else v)
 
     for key, val in model.state_dict().items():
         if key in stacked_keys:
@@ -122,8 +127,6 @@ def canonical_state_dict(model, optimizer=None,
             else:
                 out[OPT + path_key + f".{acc}"] = val
 
-    for k, v in (extra or {}).items():
-        out[EXTRA + k] = v
     return out
 
 
@@ -132,14 +135,20 @@ def restore_canonical(path, model, optimizer=None) -> Dict[str, Any]:
     live canonical tree provides shape/dtype/sharding targets, so
     non-stacked arrays restore straight onto their current placements (no
     full host materialization); a saved-vs-live tree mismatch raises in
-    orbax rather than resuming silently on fresh inits."""
+    orbax rather than resuming silently on fresh inits. (User payloads that
+    exist only on disk — ElasticManager's ``extra`` — live in a sidecar
+    checkpoint precisely so this target never has to guess their shapes.)
+    """
     import orbax.checkpoint as ocp
 
     from . import _checkpointer
 
-    live = canonical_state_dict(model, optimizer)
+    live = canonical_state_dict(model, optimizer, abstract=True)
 
     def to_target(v):
+        if isinstance(v, jax.ShapeDtypeStruct):
+            return v  # exploded per-layer entry: restored unsharded, then
+            #           restacked onto the live sharding by apply_canonical
         v = _as_value(v)
         if hasattr(v, "shape") and hasattr(v, "dtype"):
             return jax.ShapeDtypeStruct(
@@ -151,14 +160,34 @@ def restore_canonical(path, model, optimizer=None) -> Dict[str, Any]:
         return ckptr.restore(path, target)
 
 
+class _StackPieces:
+    """Deferred layer-restack: materialized only once the LIVE value (and
+    its sharding) is known, so the stack happens on device with the target
+    sharding instead of a full host copy of every stacked param."""
+
+    def __init__(self, pieces):
+        self.pieces = pieces
+
+
 def _put_like(new, old_val):
     """Materialize `new` with the live value's placement (keeps ZeRO/mp
     shardings across the restore instead of silently replicating). A
     device_put failure propagates — restoring a param replicated when the
     live layout says sharded is a silent HBM blowup, not a fallback."""
-    arr = jax.numpy.asarray(new, dtype=getattr(old_val, "dtype", None))
+    dtype = getattr(old_val, "dtype", None)
     sh = getattr(old_val, "sharding", None)
-    if sh is not None and getattr(sh, "mesh", None) is not None:
+    sharded = sh is not None and getattr(sh, "mesh", None) is not None
+    if isinstance(new, _StackPieces):
+        pieces = [jax.numpy.asarray(p, dtype=dtype) for p in new.pieces]
+        if sharded:
+            # compiled stack with the live out-sharding: per-layer restored
+            # shards flow to the stacked placement without a host round-trip
+            return jax.jit(
+                lambda *xs: jax.numpy.stack(xs, axis=0), out_shardings=sh
+            )(*pieces)
+        return jax.numpy.stack(pieces, axis=0)
+    arr = jax.numpy.asarray(new, dtype=dtype)
+    if sharded:
         return jax.device_put(arr, sh)
     return arr
 
@@ -180,10 +209,10 @@ def apply_canonical(model, canonical: Dict[str, Any], optimizer=None):
             if k not in canonical:
                 missing.append(k)
                 return None
-            pieces.append(np.asarray(canonical[k]))
+            pieces.append(_as_value(canonical[k]))
         if not is_stacked:
             return pieces[0]  # scalar accumulator replicated per layer
-        return np.stack(pieces, axis=0)
+        return _StackPieces(pieces)
 
     updates = []
     for key, t in model.state_dict().items():

@@ -15,7 +15,7 @@ membership is the job scheduler's concern (GKE/Borg restart the slice).
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Optional
 
 from ..checkpoint import save_state_dict
 
@@ -52,7 +52,7 @@ class ElasticManager:
         return max(steps) if steps else None
 
     # -- save/restore -------------------------------------------------------
-    def _state(self, model, optimizer=None, extra: Optional[Dict[str, Any]] = None):
+    def _state(self, model, optimizer=None):
         """Snapshot in TOPOLOGY-INDEPENDENT (canonical) form: pipeline-
         stacked params explode to per-layer entries and optimizer
         accumulators key by structured param path — so a checkpoint saved
@@ -61,7 +61,7 @@ class ElasticManager:
         checkpoint converter capability)."""
         from ...distributed.checkpoint.converter import canonical_state_dict
 
-        return canonical_state_dict(model, optimizer, extra)
+        return canonical_state_dict(model, optimizer)
 
     def maybe_save(self, step: int, model, optimizer=None, extra=None) -> bool:
         if (step + 1) % self.save_interval != 0:
@@ -70,6 +70,10 @@ class ElasticManager:
         return True
 
     def save(self, step: int, model, optimizer=None, extra=None):
+        """`extra` (user payload: rng state, epoch counters, ...) goes to a
+        SIDECAR checkpoint next to the canonical one — the canonical tree
+        stays exactly the live model/optimizer structure, so restore targets
+        never have to guess shapes for keys that exist only on disk."""
         path = os.path.join(self.ckpt_dir, f"step_{step}")
         if self._pending is not None:
             try:
@@ -77,9 +81,14 @@ class ElasticManager:
             except Exception:
                 pass
         self._pending = save_state_dict(
-            self._state(model, optimizer, extra), path, async_save=self.async_save
+            self._state(model, optimizer), path, async_save=self.async_save
         )
+        if extra:
+            save_state_dict(dict(extra), self._extra_dir(step))
         self._gc()
+
+    def _extra_dir(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir, f"extra_{step}")
 
     def _gc(self):
         steps = sorted(self._step_dirs())
@@ -88,11 +97,15 @@ class ElasticManager:
             import shutil
 
             shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{victim}"), ignore_errors=True)
+            shutil.rmtree(self._extra_dir(victim), ignore_errors=True)
 
-    def resume(self, model, optimizer=None) -> int:
+    def resume(self, model, optimizer=None, extra_out=None) -> int:
         """Restore latest snapshot into the LIVE layout (re-stacking for the
         model's pipelines, re-placing onto current shardings); returns the
-        next step index to run (0 when no checkpoint exists)."""
+        next step index to run (0 when no checkpoint exists). If the
+        snapshot was saved with ``extra=...``, pass a dict as ``extra_out``
+        to receive that payload back."""
+        from ...distributed.checkpoint import load_state_dict
         from ...distributed.checkpoint.converter import (
             apply_canonical, restore_canonical,
         )
@@ -103,4 +116,6 @@ class ElasticManager:
         path = os.path.join(self.ckpt_dir, f"step_{step}")
         canonical = restore_canonical(path, model, optimizer)
         apply_canonical(model, canonical, optimizer)
+        if extra_out is not None and os.path.isdir(self._extra_dir(step)):
+            extra_out.update(load_state_dict(self._extra_dir(step)))
         return step + 1

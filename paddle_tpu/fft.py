@@ -57,31 +57,36 @@ irfftn = _wrapn(jnp.fft.irfftn)
 
 # Hermitian 2-D transforms via the identity hfftn(x, s) = irfftn(conj(x), s)
 # * prod(s) (numpy/scipy define hfft this way; numpy has no hfft2/hfftn, so
-# these are built from jnp primitives and stay jit-traceable).
+# these are built from jnp primitives and stay jit-traceable). Norm follows
+# the forward-transform convention (like fft): backward = unscaled, ortho =
+# 1/sqrt(N), forward = 1/N, with N = prod of transformed lengths; ihfft2
+# mirrors it (backward = 1/N, ortho = 1/sqrt(N), forward = unscaled).
+def _norm_factor(norm, n, op):
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"{op}: norm must be backward/ortho/forward, got {norm!r}")
+    return {"backward": 1.0, "ortho": float(n) ** 0.5, "forward": float(n)}[norm]
+
+
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    if norm != "backward":
-        raise NotImplementedError("hfft2: only norm='backward' is supported")
     xv = _val(x)
     out = jnp.fft.irfftn(jnp.conj(xv), s=s, axes=axes)
-    scale = 1.0
+    n = 1
     for ax in axes:
-        scale *= out.shape[ax]
-    return Tensor(out * scale)
+        n *= out.shape[ax]
+    return Tensor(out * (n / _norm_factor(norm, n, "hfft2")))
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    if norm != "backward":
-        raise NotImplementedError("ihfft2: only norm='backward' is supported")
     xv = _val(x)
     out = jnp.conj(jnp.fft.rfftn(xv, s=s, axes=axes))
-    scale = 1.0
+    n = 1
     if s is not None:
-        for n in s:
-            scale *= n
+        for m in s:
+            n *= m
     else:
         for ax in axes:
-            scale *= xv.shape[ax]
-    return Tensor(out / scale)
+            n *= xv.shape[ax]
+    return Tensor(out * (_norm_factor(norm, n, "ihfft2") / n))
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -44,8 +44,13 @@ def _pallas_backend_ok() -> bool:
         try:
             from ...ops.pallas.flash_attention import flash_attention as _fa
 
+            # AOT lower+compile, never execute: Mosaic failures surface at
+            # compile time, and (unlike calling the jitted fn) this works
+            # even when the first attention call happens inside an ambient
+            # trace — executing there would return a tracer and poison the
+            # cache with False.
             x = jnp.zeros((1, 128, 1, 64), jnp.bfloat16)
-            jax.jit(lambda a: _fa(a, a, a, causal=True))(x).block_until_ready()
+            jax.jit(lambda a: _fa(a, a, a, causal=True)).lower(x).compile()
             got = True
         except Exception as e:
             import warnings

@@ -37,18 +37,31 @@ def _overlap_add_val(xv, hop_length: int):
 
 @defop(name="frame_op")
 def frame(x, frame_length: int, hop_length: int, axis=-1, name=None):
-    """Split into overlapping frames along the last axis → [..., frame_length, n_frames]."""
-    if axis not in (-1, x.ndim - 1):
-        raise NotImplementedError("frame: axis=-1 only")
-    return _frame_val(x, frame_length, hop_length)
+    """Split into overlapping frames. axis=-1 (default): time is last,
+    → [..., frame_length, n_frames]. axis=0: time is first (the reference's
+    other supported layout), → [n_frames, frame_length, ...]."""
+    # axis==0 must be checked first: on 1-D input it also satisfies the
+    # axis in (-1, ndim-1) test but the layouts are TRANSPOSED — the
+    # reference defines axis=0 as time-first [n_frames, L]
+    if axis == 0:
+        f = _frame_val(jnp.moveaxis(x, 0, -1), frame_length, hop_length)
+        return jnp.moveaxis(f, (-2, -1), (1, 0))  # [F, L, ...]
+    if axis in (-1, x.ndim - 1):
+        return _frame_val(x, frame_length, hop_length)
+    raise ValueError("frame: axis must be 0 or -1 (as in paddle.signal.frame)")
 
 
 @defop(name="overlap_add_op")
 def overlap_add(x, hop_length: int, axis=-1, name=None):
-    """Inverse of frame: [..., frame_length, n_frames] → [..., output_len]."""
-    if axis not in (-1, x.ndim - 1):
-        raise NotImplementedError("overlap_add: axis=-1 only")
-    return _overlap_add_val(x, hop_length)
+    """Inverse of frame. axis=-1: [..., frame_length, n_frames] → [..., T];
+    axis=0: [n_frames, frame_length, ...] → [T, ...]."""
+    if axis == 0:
+        y = _overlap_add_val(jnp.moveaxis(x, (0, 1), (-1, -2)), hop_length)
+        return jnp.moveaxis(y, -1, 0)
+    if axis in (-1, x.ndim - 1):
+        return _overlap_add_val(x, hop_length)
+    raise ValueError(
+        "overlap_add: axis must be 0 or -1 (as in paddle.signal.overlap_add)")
 
 
 def _window_to_nfft(window, n_fft, win_length, dtype):

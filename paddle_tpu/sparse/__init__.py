@@ -250,19 +250,25 @@ class _SparseNN:
             return relu(x)
 
     class Softmax:
-        """Row-wise softmax over the last dim, only at stored positions
-        (paddle.sparse.nn.Softmax semantics on 2D CSR/COO)."""
+        """Softmax over the last dim, only at stored positions, for any rank
+        (paddle.sparse.nn.Softmax semantics; the reference also supports only
+        axis=-1). Leading dims are fused into one segment key so a single
+        segment-max/segment-sum pair handles 2D and ND alike."""
 
         def __init__(self, axis=-1):
             if axis != -1:
-                raise NotImplementedError("sparse softmax: axis=-1 only")
+                raise NotImplementedError(
+                    "sparse softmax: axis=-1 only (matches paddle.sparse)")
 
         def __call__(self, x):
             xb = _as_bcoo(x).sum_duplicates()
-            if len(xb.shape) != 2:
-                raise NotImplementedError("sparse softmax: 2D only")
-            rows = xb.indices[:, 0]
-            nrows = xb.shape[0]
+            lead = xb.shape[:-1]
+            rows = jnp.zeros(xb.indices.shape[0], jnp.int32)
+            stride = 1
+            for d in range(len(lead) - 1, -1, -1):
+                rows = rows + xb.indices[:, d].astype(jnp.int32) * stride
+                stride *= lead[d]
+            nrows = max(stride, 1)
             rowmax = jnp.full(nrows, -jnp.inf, xb.data.dtype).at[rows].max(xb.data)
             e = jnp.exp(xb.data - rowmax[rows])
             denom = jnp.zeros(nrows, xb.data.dtype).at[rows].add(e)

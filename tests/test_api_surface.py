@@ -13,6 +13,8 @@ import paddle_tpu.nn.functional as F
 import paddle_tpu.optimizer as optim
 import paddle_tpu.distributed as dist
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 TOP_LEVEL = """abs acos add addmm all allclose any arange argmax argmin argsort
 as_complex as_real asin assign atan atan2 bernoulli bincount bitwise_and
 bitwise_left_shift bitwise_not bitwise_or bitwise_xor bmm broadcast_shape

@@ -14,6 +14,7 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.nn.functional.attention import _sdpa_reference
 from paddle_tpu.nn.functional.ring_attention import context_parallel_attention
 from paddle_tpu.ops.pallas.flash_attention import flash_attention
+import pytest
 
 
 def _rand(b, t, h, d, seed=0):
@@ -22,6 +23,7 @@ def _rand(b, t, h, d, seed=0):
     return mk(), mk(), mk()
 
 
+@pytest.mark.fast
 def test_flash_attention_matches_reference():
     q, k, v = _rand(2, 100, 2, 32)  # odd length exercises padding/masking
     for causal in (False, True):
@@ -30,6 +32,7 @@ def test_flash_attention_matches_reference():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.fast
 def test_flash_attention_grads():
     q, k, v = _rand(1, 64, 2, 16)
 
@@ -241,6 +244,7 @@ def test_sdpa_routes_to_flash_kernel(monkeypatch):
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.fast
 def test_ring_attention_exactness():
     s = fleet.DistributedStrategy()
     s.hybrid_configs.update(sep_degree=8)

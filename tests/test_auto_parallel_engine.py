@@ -5,6 +5,9 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.distributed import auto_parallel as auto
 from paddle_tpu.distributed import fleet
+import pytest
+
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
 
 
 def _np(t):

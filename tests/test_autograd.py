@@ -9,6 +9,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.autograd import PyLayer, grad as pgrad
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 rng = np.random.RandomState(1)
 
 

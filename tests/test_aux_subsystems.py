@@ -10,6 +10,8 @@ import paddle_tpu.nn as nn
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed import checkpoint as dck
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 @pytest.fixture(autouse=True)
 def _neutral():

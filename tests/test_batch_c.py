@@ -17,6 +17,7 @@ def _np(t):
 # ---------------------------------------------------------------------------
 # functional autodiff
 # ---------------------------------------------------------------------------
+@pytest.mark.fast
 def test_vjp_jvp():
     x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
 
@@ -144,6 +145,7 @@ def test_jacobian_multi_output_and_multi_input():
     )
 
 
+@pytest.mark.fast
 def test_window_matches_scipy():
     import scipy.signal as ss
 

@@ -7,6 +7,8 @@ import paddle_tpu as paddle
 import paddle_tpu.tensor as T
 from paddle_tpu import nn, static, text
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _np(t):
     return np.asarray(t._value)

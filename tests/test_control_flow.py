@@ -17,6 +17,8 @@ import paddle_tpu as paddle
 from paddle_tpu import jit, nn
 from paddle_tpu.static import nn as static_nn
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def test_cond_eager_and_tape():
     x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)

@@ -9,6 +9,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import io
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 class _HeavyDataset(io.Dataset):
     """Python-heavy per-sample transform: pure-Python loop, holds the GIL."""

@@ -16,6 +16,8 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.distributed import fleet
 import paddle_tpu.distributed as dist
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _init(dp=1, mp=1, pp=1, sharding=1, sep=1):
     s = fleet.DistributedStrategy()

@@ -10,6 +10,8 @@ import scipy.stats as st
 import paddle_tpu as paddle
 from paddle_tpu import distribution as D
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _np(t):
     return np.asarray(t._value)

@@ -148,3 +148,32 @@ def test_clean_exit_no_restart(tmp_path):
     assert p.returncode == 0
     assert "relaunching" not in p.stderr
     assert "fine" in p.stdout
+
+
+@pytest.mark.fast
+def test_save_with_extra_payload_roundtrips(tmp_path):
+    """A snapshot saved with extra=... must stay restorable (the extra keys
+    exist only on disk, not in the live tree) and hand the payload back."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    paddle.seed(0)
+    model = nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    mgr = ElasticManager(str(tmp_path / "ckpt"), save_interval=1)
+    rng_state = np.arange(5, dtype=np.uint32)
+    mgr.save(3, model, opt, extra={"rng": rng_state, "epoch": np.int64(2)})
+
+    paddle.seed(1)
+    model2 = nn.Linear(4, 3)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=model2.parameters())
+    extras = {}
+    nxt = ElasticManager(str(tmp_path / "ckpt")).resume(model2, opt2, extra_out=extras)
+    assert nxt == 4
+    np.testing.assert_array_equal(np.asarray(extras["rng"]), rng_state)
+    assert int(extras["epoch"]) == 2
+    np.testing.assert_allclose(
+        np.asarray(model2.weight._value), np.asarray(model.weight._value))

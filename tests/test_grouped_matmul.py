@@ -64,6 +64,7 @@ def test_grouped_matmul_dynamic_sizes_under_jit():
         )
 
 
+@pytest.mark.fast
 def test_grouped_matmul_grads():
     sizes = [50, 30, 48]
     lhs, rhs = _mk(128, 16, 32, 3, seed=2)

@@ -14,6 +14,8 @@ import jax
 
 from paddle_tpu.distributed import mesh as M
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _dev_id(d):
     return d.id

@@ -11,6 +11,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.jit import InputSpec
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 class SmallNet(nn.Layer):
     def __init__(self):

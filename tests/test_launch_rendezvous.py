@@ -32,6 +32,7 @@ def _free_port():
 
 
 @pytest.mark.parametrize("nnodes", [2, 4])
+@pytest.mark.fast
 def test_rank_negotiation_subprocesses(nnodes):
     master = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")

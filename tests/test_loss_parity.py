@@ -80,6 +80,7 @@ def _assert_parity(losses, baseline, axis):
     assert losses[-1] < losses[0], f"{axis}: loss did not decrease"
 
 
+@pytest.mark.fast
 def test_dp2_loss_parity(baseline):
     _assert_parity(_run({"dp_degree": 2}), baseline, "dp2")
 

@@ -14,6 +14,7 @@ def _neutral():
     yield
 
 
+@pytest.mark.fast
 def test_moe_forward_shape_and_aux():
     paddle.seed(0)
     moe = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2)

@@ -13,6 +13,7 @@ def t(a, sg=True):
     return paddle.to_tensor(a, stop_gradient=sg)
 
 
+@pytest.mark.fast
 def test_linear():
     layer = nn.Linear(4, 8)
     x = t(rng.rand(2, 4).astype(np.float32))
@@ -68,6 +69,7 @@ def test_pools():
     )
 
 
+@pytest.mark.fast
 def test_batchnorm_train_eval():
     bn = nn.BatchNorm2D(4)
     x = t(rng.rand(8, 4, 5, 5).astype(np.float32) * 3 + 1)
@@ -84,6 +86,7 @@ def test_batchnorm_train_eval():
     assert out_eval.shape == [8, 4, 5, 5]
 
 
+@pytest.mark.fast
 def test_layernorm():
     ln = nn.LayerNorm(16)
     x = t(rng.rand(4, 16).astype(np.float32))
@@ -100,6 +103,7 @@ def test_groupnorm_instance_rms():
     assert nn.RMSNorm(16)(y).shape == [2, 16]
 
 
+@pytest.mark.fast
 def test_embedding():
     emb = nn.Embedding(10, 6)
     idx = t(np.array([[1, 2], [3, 4]], np.int64))
@@ -119,6 +123,7 @@ def test_dropout_modes():
     np.testing.assert_allclose(d(x).numpy(), 1.0)
 
 
+@pytest.mark.fast
 def test_activations():
     x = rng.randn(4, 5).astype(np.float32)
     np.testing.assert_allclose(F.relu(t(x)).numpy(), np.maximum(x, 0))
@@ -132,6 +137,7 @@ def test_activations():
     assert F.gelu(t(x)).shape == [4, 5]
 
 
+@pytest.mark.fast
 def test_losses():
     logits = rng.randn(8, 5).astype(np.float32)
     labels = rng.randint(0, 5, (8,)).astype(np.int64)
@@ -149,6 +155,7 @@ def test_losses():
     assert np.isfinite(float(bce.numpy()))
 
 
+@pytest.mark.fast
 def test_cross_entropy_ignore_index_and_smoothing():
     logits = rng.randn(6, 4).astype(np.float32)
     labels = np.array([0, 1, -100, 2, -100, 3], np.int64)
@@ -171,6 +178,7 @@ def test_sequential_layerlist():
     assert len(list(ll.parameters())) == 6
 
 
+@pytest.mark.fast
 def test_state_dict_roundtrip():
     net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8, data_format="NC"), nn.Linear(8, 2))
     sd = net.state_dict()
@@ -181,6 +189,7 @@ def test_state_dict_roundtrip():
         np.testing.assert_allclose(p1.numpy(), p2.numpy())
 
 
+@pytest.mark.fast
 def test_multihead_attention():
     mha = nn.MultiHeadAttention(16, 4)
     x = t(rng.rand(2, 5, 16).astype(np.float32))
@@ -206,6 +215,7 @@ def test_lstm_gru():
     assert h.shape == [2, 3, 16]
 
 
+@pytest.mark.fast
 def test_layer_grad_flow():
     net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
     x = t(rng.rand(5, 4).astype(np.float32))
@@ -222,6 +232,7 @@ def test_pad_and_interpolate():
     assert F.interpolate(x, scale_factor=2, mode="bilinear").shape == [1, 2, 8, 8]
 
 
+@pytest.mark.fast
 def test_clip_grad_norm():
     p = nn.Linear(4, 4).weight
     p.grad = paddle.to_tensor(np.full((4, 4), 10.0, np.float32))

@@ -9,6 +9,8 @@ from paddle_tpu import nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.nn.utils import remove_weight_norm, spectral_norm, weight_norm
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def test_weight_norm_forward_matches_plain():
     paddle.seed(0)

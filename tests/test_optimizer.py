@@ -6,6 +6,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.optimizer import SGD, Adam, AdamW, ClipGradByGlobalNorm, Lamb, Momentum, RMSProp, lr
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 rng = np.random.RandomState(0)
 
 

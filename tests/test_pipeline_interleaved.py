@@ -26,6 +26,7 @@ def _blocks(n, d=16, seed=0):
     return [nn.Sequential(nn.Linear(d, d), nn.Tanh()) for _ in range(n)]
 
 
+@pytest.mark.fast
 def test_interleaved_matches_sequential():
     _init(pp=4)
     blocks = _blocks(8)

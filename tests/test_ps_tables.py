@@ -13,6 +13,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.distributed import fleet, ps
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _init(sharding=8):
     s = fleet.DistributedStrategy()

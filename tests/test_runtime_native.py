@@ -183,6 +183,8 @@ def test_host_memory_stats_surface():
 import shutil
 import subprocess
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
 
 

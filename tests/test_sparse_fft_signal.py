@@ -6,6 +6,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import fft, signal, sparse
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _np(t):
     return np.asarray(t._value)
@@ -85,6 +87,30 @@ def test_sparse_unary_and_softmax():
     np.testing.assert_allclose(row_sums, 1.0, rtol=1e-5)
 
 
+def test_sparse_softmax_3d():
+    rs = np.random.RandomState(4)
+    dense = np.zeros((2, 4, 5), "float32")
+    b = rs.randint(0, 2, 10)
+    r = rs.randint(0, 4, 10)
+    c = rs.randint(0, 5, 10)
+    v = rs.randn(10).astype("float32")
+    for bi, ri, ci, vi in zip(b, r, c, v):
+        dense[bi, ri, ci] += vi
+    st = sparse.sparse_coo_tensor(np.stack([b, r, c]), v, dense.shape).coalesce()
+    out = _np(sparse.nn.Softmax()(st).to_dense())
+    mask = _np(st.to_dense()) != 0
+    row_sums = out.sum(-1)[mask.any(-1)]
+    np.testing.assert_allclose(row_sums, 1.0, rtol=1e-5)
+    # stored positions match dense softmax restricted to the sparsity pattern
+    for bi in range(2):
+        for ri in range(4):
+            m = mask[bi, ri]
+            if not m.any():
+                continue
+            e = np.exp(dense[bi, ri][m] - dense[bi, ri][m].max())
+            np.testing.assert_allclose(out[bi, ri][m], e / e.sum(), rtol=1e-5)
+
+
 def test_masked_matmul():
     rs = np.random.RandomState(3)
     x = rs.randn(4, 6).astype("float32")
@@ -136,6 +162,21 @@ def test_hfft2_ihfft2_match_scipy():
     )
 
 
+def test_hfft2_ihfft2_norms_match_scipy():
+    import scipy.fft as sfft
+
+    rs = np.random.RandomState(1)
+    z = (rs.randn(6, 5) + 1j * rs.randn(6, 5)).astype("complex64")
+    xr = rs.randn(6, 8).astype("float32")
+    for norm in ("backward", "ortho", "forward"):
+        np.testing.assert_allclose(
+            _np(fft.hfft2(paddle.to_tensor(z), norm=norm)),
+            sfft.hfft2(z, norm=norm), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            _np(fft.ihfft2(paddle.to_tensor(xr), norm=norm)),
+            sfft.ihfft2(xr, norm=norm), rtol=1e-4, atol=1e-5)
+
+
 # ---------------------------------------------------------------------------
 # signal
 # ---------------------------------------------------------------------------
@@ -145,6 +186,19 @@ def test_frame_overlap_add_roundtrip():
     assert _np(f).shape == (8, 4)
     y = signal.overlap_add(f, 8)
     np.testing.assert_allclose(_np(y), x)
+
+
+def test_frame_overlap_add_axis0():
+    rs = np.random.RandomState(2)
+    x = rs.randn(32, 3).astype("float32")  # time-first, batch trailing
+    f = signal.frame(paddle.to_tensor(x), 8, 4, axis=0)
+    assert _np(f).shape == (7, 8, 3)
+    # frame i along axis 0 == x[i*hop : i*hop+len]
+    np.testing.assert_allclose(_np(f)[2], x[8:16])
+    y = signal.overlap_add(f, 4, axis=0)
+    ref = signal.overlap_add(
+        paddle.to_tensor(np.moveaxis(_np(f), (0, 1), (-1, -2))), 4)
+    np.testing.assert_allclose(_np(y), np.moveaxis(_np(ref), -1, 0), rtol=1e-6)
 
 
 def test_stft_matches_manual_dft():

@@ -8,6 +8,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import static
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def _build_fc_program():
     main = static.Program()

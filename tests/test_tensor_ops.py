@@ -6,6 +6,8 @@ import pytest
 
 import paddle_tpu as paddle
 
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
 
 def t(a, sg=True):
     return paddle.to_tensor(a, stop_gradient=sg)

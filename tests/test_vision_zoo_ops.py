@@ -59,6 +59,7 @@ def _nms_ref(boxes, scores, thr):
     return np.array(keep)
 
 
+@pytest.mark.fast
 def test_nms_matches_reference():
     rs = np.random.RandomState(0)
     xy = rs.rand(40, 2) * 50
@@ -78,6 +79,7 @@ def test_box_iou_and_area():
     np.testing.assert_allclose(_np(ops.box_area(paddle.to_tensor(b))), [4.0, 1.0])
 
 
+@pytest.mark.fast
 def test_roi_align_constant_feature():
     # constant feature map -> every pooled value equals the constant
     x = np.full((1, 3, 16, 16), 2.5, "float32")
