@@ -17,9 +17,13 @@ API (`is_first_worker`, `init_server`, `run_server`, `init_worker`,
 `stop_worker`, barriers) is provided as working no-ops/logical equivalents
 so PS-mode training scripts run unchanged under the collective runtime.
 
-Deliberately absent (documented non-goals, not gaps on TPU): brpc
-transport, async/geo-SGD staleness modes, SSD cache tiers — XLA's
-synchronous SPMD replaces the async PS consistency model entirely.
+The async tiers live in ``ps.geo``: ``GeoSGDCommunicator`` (geo-SGD
+delta exchange over the TCPStore — serverless peer merge) and
+``HostOffloadedTable`` (heter-PS host-RAM tier with device-staged hot
+rows + rowwise AdaGrad). Deliberately absent (documented non-goals, not
+gaps on TPU): brpc transport and the SSD cache tier — XLA's synchronous
+SPMD is the first-choice consistency model; the async tiers exist for
+tables that outgrow the mesh.
 """
 from __future__ import annotations
 
@@ -37,11 +41,22 @@ from ...nn import initializer as I
 from ...nn.layer import Parameter
 from .. import mesh as _mesh
 
+from .geo import (  # noqa: E402  (async PS tiers — see module docstring)
+    GeoSGDCommunicator,
+    HostOffloadedTable,
+    LocalDeltaStore,
+    TCPDeltaStore,
+)
+
 __all__ = [
     "ShardedEmbeddingTable",
     "sparse_embedding",
     "RoleMakerBase",
     "table_shard_info",
+    "GeoSGDCommunicator",
+    "HostOffloadedTable",
+    "LocalDeltaStore",
+    "TCPDeltaStore",
 ]
 
 

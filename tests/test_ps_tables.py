@@ -109,3 +109,105 @@ def test_shard_info_bytes():
     t = ps.ShardedEmbeddingTable(800, 4)
     info = t.shard_info()
     assert info["bytes_per_shard"] == 800 * 4 * 4 // 8
+
+
+# ---------------------------------------------------------------------------
+# async tiers (ps.geo): geo-SGD delta exchange + heter host-offloaded table
+# ---------------------------------------------------------------------------
+def _two_worker_geo(store_factory):
+    vocab, dim = 16, 4
+    base = np.zeros((vocab, dim), "float32")
+    s0, s1 = store_factory()
+    w0 = ps.GeoSGDCommunicator(base.copy(), s0, worker_id=0, num_workers=2,
+                               sync_every=1)
+    w1 = ps.GeoSGDCommunicator(base.copy(), s1, worker_id=1, num_workers=2,
+                               sync_every=1)
+    # w0 trains rows {1,2}; w1 trains rows {2,3} — overlapping on row 2
+    w0.table[1] += 1.0
+    w0.table[2] += 2.0
+    w0.touch([1, 2])
+    w1.table[2] += 10.0
+    w1.table[3] += 20.0
+    w1.touch([2, 3])
+    w0.sync()      # w0 publishes; hasn't seen w1 yet
+    w1.sync()      # w1 publishes and folds w0's delta
+    w0.pull()      # w0 catches up on w1's delta
+    expect = base.copy()
+    expect[1] += 1.0
+    expect[2] += 12.0  # geo merge rule: deltas ADD on overlap
+    expect[3] += 20.0
+    np.testing.assert_allclose(w0.table, expect, rtol=1e-6)
+    np.testing.assert_allclose(w1.table, expect, rtol=1e-6)
+
+
+def test_geo_sgd_local_store_merges_deltas():
+    _two_worker_geo(lambda: (lambda s: (s, s))(ps.LocalDeltaStore()))
+
+
+def test_geo_sgd_over_tcpstore():
+    """Cross-process transport: the delta blobs ride the C++/py TCPStore."""
+    from paddle_tpu.runtime import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    client = TCPStore("127.0.0.1", master.port, is_master=False)
+    try:
+        _two_worker_geo(
+            lambda: (ps.TCPDeltaStore(master), ps.TCPDeltaStore(client)))
+    finally:
+        client.close()
+        master.close()
+
+
+def test_geo_local_drift_not_double_counted():
+    """A worker's unpublished drift must survive a pull exactly once."""
+    s = ps.LocalDeltaStore()
+    w = ps.GeoSGDCommunicator(np.zeros((4, 2), "float32"), s, 0, 1,
+                              sync_every=1)
+    w.table[1] += 5.0
+    w.touch([1])
+    w.sync()
+    w.pull()  # extra pull: our own published delta must not re-apply
+    np.testing.assert_allclose(w.table[1], [5.0, 5.0])
+
+
+def test_host_offloaded_table_trains_and_stages_working_set():
+    import jax
+    import jax.numpy as jnp
+
+    vocab, dim = 500, 8
+    rng = np.random.default_rng(0)
+    target = rng.standard_normal((vocab, dim)).astype("float32")
+    t = ps.HostOffloadedTable(vocab, dim, lr=0.5, seed=1)
+
+    ids = rng.integers(0, vocab, (64,))
+    losses = []
+    for _ in range(30):
+        rows, uniq, inv = t.pull(ids)
+        assert rows.shape[0] == len(np.unique(ids))  # only the working set
+        tgt = jnp.asarray(target[uniq])
+
+        def loss_fn(r):
+            return ((r - tgt) ** 2).mean()
+
+        g = jax.grad(loss_fn)(rows)
+        losses.append(float(loss_fn(rows)))
+        t.push(uniq, np.asarray(g))
+    assert losses[-1] < losses[0] * 0.05
+    # untouched rows never left their init
+    untouched = np.setdiff1d(np.arange(vocab), ids)
+    assert np.all(t._g2[untouched] == 0)
+
+
+def test_host_offloaded_geo_integration():
+    """Two workers training host tables sync through geo push/pull."""
+    store = ps.LocalDeltaStore()
+    init = np.zeros((8, 2), "float32")
+    mk = lambda wid: ps.HostOffloadedTable(
+        8, 2, lr=1.0, initializer=init.copy(),
+        geo=ps.GeoSGDCommunicator(init.copy(), store, wid, 2, sync_every=1))
+    t0, t1 = mk(0), mk(1)
+    t0.push([1], np.array([[1.0, 1.0]]))   # adagrad: step = lr*g/|g| = 1
+    t1.push([2], np.array([[2.0, 2.0]]))
+    t0.geo.pull()
+    np.testing.assert_allclose(t0.table, t1.table, atol=1e-6)
+    assert abs(t0.table[1, 0] + 1.0) < 1e-5 and abs(t0.table[2, 0] + 1.0) < 1e-5
