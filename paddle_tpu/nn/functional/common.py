@@ -439,3 +439,10 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     _, vjp = _jax.vjp(f, img0)
     (out,) = vjp(xv)
     return Tensor(out)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad the spatial dims of a 4-D tensor by [left, right, top,
+    bottom] (paddle.nn.functional.zeropad2d)."""
+    return pad(x, list(padding), mode="constant", value=0.0,
+               data_format=data_format)

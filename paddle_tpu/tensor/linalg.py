@@ -290,3 +290,135 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 def mm(input, mat2, name=None):
     """Alias of matmul (paddle keeps both)."""
     return matmul(input, mat2)
+
+
+@defop
+def svdvals(x, name=None):
+    """Singular values only (paddle.linalg.svdvals)."""
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@defop
+def matrix_exp(x, name=None):
+    """Matrix exponential (paddle.linalg.matrix_exp; upstream lowers to a
+    Padé kernel — XLA gets jax.scipy's squaring-and-scaling expm)."""
+    return jax.scipy.linalg.expm(x)
+
+
+@defop(name="cond_op")
+def _cond_op(x, p):
+    if p in (None, 2, -2):
+        s = jnp.linalg.svd(x, compute_uv=False)
+        smax, smin = s[..., 0], s[..., -1]
+        return smax / smin if p != -2 else smin / smax
+    if p == "fro":
+        nx = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=(-2, -1)))
+        ni = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(jnp.linalg.inv(x))), axis=(-2, -1)))
+        return nx * ni
+    if p == "nuc":
+        nx = jnp.sum(jnp.linalg.svd(x, compute_uv=False), axis=-1)
+        ni = jnp.sum(jnp.linalg.svd(jnp.linalg.inv(x), compute_uv=False), axis=-1)
+        return nx * ni
+    ord_ = {1: 1, -1: -1, np.inf: np.inf, -np.inf: -np.inf}[p]
+    return jnp.linalg.cond(x, p=ord_)
+
+
+def cond(x, p=None, name=None):
+    """Condition number in the norm `p` (paddle.linalg.cond)."""
+    return _cond_op(x, p=p)
+
+
+@defop
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(LU-packed, pivots) -> (P, L, U) with A = P @ L @ U
+    (paddle.linalg.lu_unpack; pivots are the 0-based LAPACK ipiv that
+    `lu()` returns)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    L = U = None
+    if unpack_ludata:
+        L = jnp.tril(x[..., :, :k], -1) + jnp.eye(m, k, dtype=x.dtype)
+        U = jnp.triu(x[..., :k, :])
+    P = None
+    if unpack_pivots:
+        perm = jnp.broadcast_to(jnp.arange(m), x.shape[:-2] + (m,))
+        for i in range(y.shape[-1]):  # replay LAPACK row swaps (static count)
+            pi = y[..., i].astype(jnp.int32)
+            vi = jnp.take_along_axis(perm, jnp.full(perm.shape[:-1] + (1,), i), -1)
+            vp = jnp.take_along_axis(perm, pi[..., None], -1)
+            perm = jnp.put_along_axis(
+                perm, jnp.full(perm.shape[:-1] + (1,), i), vp, -1,
+                inplace=False)
+            perm = jnp.put_along_axis(perm, pi[..., None], vi, -1, inplace=False)
+        P = jax.nn.one_hot(perm, m, dtype=x.dtype)  # [..., m, m]; row j = e_perm[j]
+        P = jnp.swapaxes(P, -1, -2)  # A = P L U  =>  P[:, perm] = I
+    return P, L, U
+
+
+def solve_triangular(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    """paddle.linalg.solve_triangular — same op as triangular_solve."""
+    return triangular_solve(x, y, upper=upper, transpose=transpose,
+                            unitriangular=unitriangular)
+
+
+@defop(name="ormqr_op")
+def _ormqr_op(x, tau, y, left, transpose):
+    m = x.shape[-2]
+    k = tau.shape[-1]
+    idx = jnp.arange(m)
+
+    def reflect_left(vec_i, acc):
+        # H = I - tau_i v v^H applied from the left: acc -= tau_i v (v^H acc)
+        v = jnp.where(idx > vec_i, x[..., :, vec_i],
+                      jnp.where(idx == vec_i, 1.0, 0.0))
+        coef = tau[..., vec_i] * (v @ acc)
+        return acc - v[:, None] * coef[None, :]
+
+    def reflect_right(vec_i, acc):
+        v = jnp.where(idx > vec_i, x[..., :, vec_i],
+                      jnp.where(idx == vec_i, 1.0, 0.0))
+        coef = tau[..., vec_i] * (acc @ v)
+        return acc - coef[:, None] * v[None, :]
+
+    order = range(k)
+    if left:
+        # Q y = H_0 (H_1 (... y));  Q^T y = H_{k-1} (... (H_0 y))
+        for i in (order if transpose else reversed(order)):
+            y = reflect_left(i, y)
+    else:
+        # y Q = ((y H_0) H_1) ...;  y Q^T applies in reverse
+        for i in (reversed(order) if transpose else order):
+            y = reflect_right(i, y)
+    return y
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply by the implicit Q of a QR factorization without forming it
+    (paddle.linalg.ormqr): y <- op(Q) @ y (left) or y @ op(Q)."""
+    return _ormqr_op(x, tau, y, left=bool(left), transpose=bool(transpose))
+
+
+@defop(name="svd_lowrank_op")
+def _svd_lowrank_op(x, rng01, q, niter):
+    y = x @ rng01  # [..., m, q]
+    qm, _ = jnp.linalg.qr(y)
+    for _ in range(niter):  # subspace (power) iteration sharpens spectrum
+        qm, _ = jnp.linalg.qr(jnp.swapaxes(x, -1, -2) @ qm)
+        qm, _ = jnp.linalg.qr(x @ qm)
+    b = jnp.swapaxes(qm, -1, -2) @ x  # [..., q, n]
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return qm @ ub, s, jnp.swapaxes(vt, -1, -2)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (paddle.linalg.svd_lowrank): returns
+    (U [m,q], S [q], V [n,q]) of x (or x - M)."""
+    from ..framework import rng as _rng
+
+    xv = raw(x)
+    if M is not None:
+        xv = xv - raw(M)
+    q = int(min(q, xv.shape[-2], xv.shape[-1]))
+    key = _rng.next_key()
+    g = jax.random.normal(key, xv.shape[:-2] + (xv.shape[-1], q), xv.dtype)
+    return _svd_lowrank_op(Tensor(xv), Tensor(g), q=q, niter=int(niter))

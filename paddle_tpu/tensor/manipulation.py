@@ -780,3 +780,41 @@ def vsplit(x, num_or_indices, name=None):
         else list(num_or_indices),
         axis=0,
     )]
+
+
+@defop
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write `y` onto the (offset) diagonal of the (axis1, axis2) planes
+    (paddle.diagonal_scatter)."""
+    xv = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    m, n = xv.shape[-2], xv.shape[-1]
+    if offset >= 0:
+        rows = jnp.arange(min(m, n - offset))
+        cols = rows + offset
+    else:
+        cols = jnp.arange(min(n, m + offset))
+        rows = cols - offset
+    # y's shape == x.diagonal(offset, axis1, axis2).shape: batch dims first,
+    # diagonal length last — exactly how the advanced index below broadcasts
+    out = xv.at[..., rows, cols].set(y)
+    return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+
+@defop
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write `value` into the strided slice of x (paddle.slice_scatter)."""
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = slice(int(st), int(en), int(sr))
+    return x.at[tuple(idx)].set(value)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors -> [prod(len), n] (paddle.cartesian_prod)."""
+    return _cartesian_prod_op(list(x))
+
+
+@defop(name="cartesian_prod_op")
+def _cartesian_prod_op(xs):
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.ravel() for g in grids], axis=-1)

@@ -779,3 +779,62 @@ def as_real(x, name=None):
     """[...] complex -> [..., 2] float."""
     x = jnp.asarray(x)
     return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# special functions (paddle.i0/i0e/i1/i1e/polygamma/igamma/igammac parity;
+# reference: python/paddle/tensor/math.py — phi Bessel/gamma kernels. XLA
+# lowers the jax.scipy.special implementations to fused elementwise HLO.)
+# --------------------------------------------------------------------------
+@defop
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+@defop
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+@defop
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+@defop
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+@defop
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop
+def igamma(x, a, name=None):
+    """Regularized upper incomplete gamma Q(x, a) (paddle.igamma)."""
+    return jax.scipy.special.gammaincc(x, a)
+
+
+@defop
+def igammac(x, a, name=None):
+    """Regularized lower incomplete gamma P(x, a) (paddle.igammac)."""
+    return jax.scipy.special.gammainc(x, a)
+
+
+@defop(name="histogramdd_op")
+def _histogramdd_op(sample, bins, ranges, density, weights):
+    h, edges = jnp.histogramdd(sample, bins=bins, range=ranges,
+                               density=density, weights=weights)
+    return h, list(edges)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    """N-D histogram (paddle.histogramdd): returns (hist, list of edges)."""
+    w = raw(weights) if weights is not None else None
+    if isinstance(bins, (list, tuple)) and len(bins) and hasattr(bins[0], "ndim"):
+        bins = [raw(b) for b in bins]
+    h, edges = _histogramdd_op(x, bins=bins, ranges=ranges,
+                               density=bool(density), weights=w)
+    return h, edges
