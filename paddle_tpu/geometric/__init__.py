@@ -1,0 +1,190 @@
+"""paddle.geometric parity — graph-learning message passing and segment ops.
+
+Reference: ``python/paddle/geometric/`` (message_passing/send_recv.py,
+math.py segment ops, sampling/neighbors.py — phi graph_send_recv /
+segment_pool CUDA kernels). TPU-native design: message passing IS a
+gather + segment-reduce, which XLA compiles to fused scatter-adds on
+device — ``send_u_recv(x, src, dst)`` lowers to
+``segment_reduce(x[src], dst)`` with no custom kernel needed. With a
+static ``out_size`` everything traces under jit (the TPU-idiomatic form);
+without it the output length is data-dependent (max(dst)+1), which is an
+eager-only path by the same rule as nonzero/unique (manipulation.py).
+
+Neighbor sampling is host-side by design: it is data-layout work
+(CSC walks + RNG) that belongs on CPU feeding the device, exactly like
+the DataLoader's role.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import defop, raw
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "sample_neighbors", "reindex_graph",
+]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None and int(out_size) > 0:
+        return int(out_size)
+    idv = raw(ids)
+    try:
+        return int(jnp.max(idv)) + 1
+    except jax.errors.ConcretizationTypeError:
+        raise ValueError(
+            "geometric ops need a static output length under jit: pass "
+            "out_size= explicitly (the data-dependent max(index)+1 default "
+            "is eager-only, like nonzero/unique)") from None
+
+
+def _segment_reduce(data, ids, pool, n):
+    ids = jnp.asarray(ids)
+    if pool == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=n)
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, data.dtype), ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (data.ndim - 1))
+    if pool == "max":
+        out = jax.ops.segment_max(data, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty segments -> 0 (paddle)
+    if pool == "min":
+        out = jax.ops.segment_min(data, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(f"unknown reduce op {pool!r}")
+
+
+def _make_segment(pool):
+    @defop(name=f"segment_{pool}_op")
+    def seg(data, segment_ids, n):
+        return _segment_reduce(data, segment_ids, pool, n)
+
+    def op(data, segment_ids, name=None):
+        return seg(data, segment_ids, n=_num_segments(segment_ids, None))
+
+    op.__name__ = f"segment_{pool}"
+    op.__doc__ = (
+        f"paddle.geometric.segment_{pool}: {pool}-reduce rows of `data` by "
+        "`segment_ids` (sorted or not). Output length = max(ids)+1 "
+        "(eager; under jit use send_u_recv with out_size).")
+    return op
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+
+@defop(name="send_u_recv_op")
+def _send_u_recv(x, src, dst, pool, n):
+    return _segment_reduce(jnp.take(x, jnp.asarray(src), axis=0),
+                           jnp.asarray(dst), pool, n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather node features along edges and reduce at destinations:
+    out[d] = reduce over edges (s->d) of x[s]. The core message-passing
+    primitive (reference: graph_send_recv)."""
+    n = _num_segments(dst_index, out_size)
+    return _send_u_recv(x, src_index, dst_index, pool=reduce_op, n=n)
+
+
+@defop(name="send_ue_recv_op")
+def _send_ue_recv(x, y, src, dst, msg, pool, n):
+    h = jnp.take(x, jnp.asarray(src), axis=0)
+    e = jnp.asarray(y)
+    if e.ndim < h.ndim:
+        e = e.reshape(e.shape + (1,) * (h.ndim - e.ndim))
+    m = {"add": h + e, "sub": h - e, "mul": h * e, "div": h / e}[msg]
+    return _segment_reduce(m, jnp.asarray(dst), pool, n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size: Optional[int] = None, name=None):
+    """Combine source-node features with per-edge features, reduce at
+    destinations: out[d] = reduce over (s->d) of msg(x[s], y[edge])."""
+    n = _num_segments(dst_index, out_size)
+    return _send_ue_recv(x, y, src_index, dst_index, msg=message_op,
+                         pool=reduce_op, n=n)
+
+
+@defop(name="send_uv_op")
+def _send_uv(x, y, src, dst, msg):
+    h = jnp.take(x, jnp.asarray(src), axis=0)
+    t = jnp.take(y, jnp.asarray(dst), axis=0)
+    return {"add": h + t, "sub": h - t, "mul": h * t, "div": h / t}[msg]
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages msg(x[src], y[dst]) — no reduction (shape [E, ...])."""
+    return _send_uv(x, y, src_index, dst_index, msg=message_op)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on a CSC graph (reference:
+    geometric/sampling/neighbors.py). Host-side numpy by design — this is
+    data-pipeline work (per-node RNG walks over ragged adjacency), the
+    same CPU-feeds-TPU split as the DataLoader.
+
+    Returns (neighbors, counts) — and edge ids too when return_eids.
+    """
+    rowv = np.asarray(raw(row)).astype(np.int64)
+    cptr = np.asarray(raw(colptr)).astype(np.int64)
+    nodes = np.atleast_1d(np.asarray(raw(input_nodes))).astype(np.int64)
+    ev = np.asarray(raw(eids)).astype(np.int64) if eids is not None else None
+    rng = np.random.default_rng()
+    outs, out_eids, counts = [], [], []
+    for nd in nodes:
+        lo, hi = int(cptr[nd]), int(cptr[nd + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(rowv[sel])
+        if ev is not None:
+            out_eids.append(ev[sel])
+        counts.append(len(sel))
+    neighbors = Tensor(jnp.asarray(np.concatenate(outs) if outs else
+                                   np.zeros((0,), np.int64)))
+    counts_t = Tensor(jnp.asarray(np.asarray(counts, np.int64)))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts_t, Tensor(jnp.asarray(np.concatenate(out_eids)))
+    return neighbors, counts_t
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber a sampled subgraph to contiguous ids (reference:
+    geometric/reindex.py): x (center nodes) keep ids [0, len(x));
+    first-seen neighbor order continues from there. Host-side numpy.
+
+    Returns (reindexed_src, reindexed_dst, out_nodes).
+    """
+    xs = np.asarray(raw(x)).astype(np.int64)
+    nb = np.asarray(raw(neighbors)).astype(np.int64)
+    cnt = np.asarray(raw(count)).astype(np.int64)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    for v in nb:
+        if int(v) not in mapping:
+            mapping[int(v)] = len(mapping)
+    src = np.asarray([mapping[int(v)] for v in nb], np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    out_nodes = np.empty(len(mapping), np.int64)
+    for v, i in mapping.items():
+        out_nodes[i] = v
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))

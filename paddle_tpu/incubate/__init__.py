@@ -8,3 +8,64 @@ from .moe import MoELayer, global_gather, global_scatter  # noqa: F401
 class distributed:  # paddle.incubate.distributed.models.moe path parity
     class models:
         from . import moe
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name for geometric.send_u_recv (reference:
+    python/paddle/incubate/operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def segment_sum(data, segment_ids, name=None):
+    from ..geometric import segment_sum as _s
+
+    return _s(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..geometric import segment_mean as _s
+
+    return _s(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..geometric import segment_max as _s
+
+    return _s(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..geometric import segment_min as _s
+
+    return _s(data, segment_ids)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) over the last axis (reference:
+    incubate/operators/softmax_mask_fuse.py — a hand-written CUDA fusion;
+    XLA fuses the add into the softmax natively, so one defop suffices)."""
+    return _softmax_mask_fuse_op(x, mask)
+
+
+from ..framework.op import defop as _defop  # noqa: E402
+
+
+@_defop(name="softmax_mask_fuse_op")
+def _softmax_mask_fuse_op(x, mask):
+    import jax
+
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a loss for IPU-style identity handling (reference:
+    incubate/autograd): reduce per `reduction` and return it unchanged."""
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x

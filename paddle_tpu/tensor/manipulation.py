@@ -818,3 +818,100 @@ def cartesian_prod(x, name=None):
 def _cartesian_prod_op(xs):
     grids = jnp.meshgrid(*xs, indexing="ij")
     return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+
+def _split_like(x, num_or_indices, axis):
+    return [Tensor(v) for v in jnp.split(
+        jnp.asarray(raw(x)),
+        num_or_indices if isinstance(num_or_indices, int)
+        else list(num_or_indices),
+        axis=axis,
+    )]
+
+
+def hsplit(x, num_or_indices, name=None):
+    xv = raw(x)
+    return _split_like(x, num_or_indices, axis=0 if xv.ndim == 1 else 1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_like(x, num_or_indices, axis=2)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """numpy-style uneven split (paddle.tensor_split): an int that does not
+    divide the axis produces first-longer pieces."""
+    xv = jnp.asarray(raw(x))
+    if isinstance(num_or_indices, int):
+        return [Tensor(v) for v in jnp.array_split(xv, num_or_indices, axis=axis)]
+    return [Tensor(v) for v in jnp.split(xv, list(num_or_indices), axis=axis)]
+
+
+@defop
+def unflatten(x, axis, shape, name=None):
+    """Expand one axis into `shape` (paddle.unflatten; -1 infers)."""
+    shape = list(int(s) for s in shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = x.shape[axis] // known
+    out_shape = list(x.shape)
+    out_shape[axis : axis + 1] = shape
+    return jnp.reshape(x, out_shape)
+
+
+def atleast_1d(*inputs, name=None):
+    out = [Tensor(jnp.atleast_1d(raw(v))) for v in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [Tensor(jnp.atleast_2d(raw(v))) for v in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [Tensor(jnp.atleast_3d(raw(v))) for v in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def column_stack(x, name=None):
+    return _column_stack_op(list(x))
+
+
+@defop(name="column_stack_op")
+def _column_stack_op(xs):
+    return jnp.column_stack(xs)
+
+
+def row_stack(x, name=None):
+    return _row_stack_op(list(x))
+
+
+@defop(name="row_stack_op")
+def _row_stack_op(xs):
+    return jnp.vstack(xs)
+
+
+def block_diag(inputs, name=None):
+    return _block_diag_op(list(inputs))
+
+
+@defop(name="block_diag_op")
+def _block_diag_op(xs):
+    return jax.scipy.linalg.block_diag(*[jnp.atleast_2d(v) for v in xs])
+
+
+@defop
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of `mask` with consecutive elements of `value`
+    (paddle.masked_scatter). jit-safe: a cumulative count over the mask
+    turns the data-dependent packing into a static gather."""
+    m = jnp.broadcast_to(mask, x.shape)
+    src = jnp.ravel(value)
+    # position among True elements, row-major (0 where False, clipped safe)
+    k = jnp.cumsum(jnp.ravel(m)) - 1
+    gathered = jnp.take(src, jnp.clip(k, 0, src.shape[0] - 1), axis=0)
+    return jnp.where(m, jnp.reshape(gathered, x.shape), x)

@@ -838,3 +838,22 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None)
     h, edges = _histogramdd_op(x, bins=bins, ranges=ranges,
                                density=bool(density), weights=w)
     return h, edges
+
+
+@defop
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+def fix(x, name=None):
+    """Alias of trunc (paddle.fix)."""
+    return trunc(x)
+
+
+@defop(name="nanquantile_op")
+def _nanquantile(x, q, axis, keepdim):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _nanquantile(x, raw(q), axis=_axis(axis), keepdim=keepdim)
