@@ -13,7 +13,8 @@ from .layers.activation import (  # noqa: F401
 )
 from .layers.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
-    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+    Dropout2D, Dropout3D, Embedding, FeatureAlphaDropout, Flatten, Fold,
+    Identity, Linear, Pad1D,
     Pad2D, Pad3D, PairwiseDistance, PixelShuffle, PixelUnshuffle,
     ReflectionPad2D, ReplicationPad2D, Unflatten, Unfold, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
@@ -40,6 +41,7 @@ from .layers.pooling import (  # noqa: F401
 )
 from .layers.rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN, SimpleRNNCell,
+    BiRNN,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,

@@ -405,3 +405,23 @@ def triplet_margin_with_distance_loss(input, positive, negative,
     if reduction == "sum":
         return loss.sum()
     return loss
+
+
+@defop(name="npair_loss_op")
+def _npair(anchor, positive, labels, l2_reg):
+    reg = 0.25 * l2_reg * (
+        jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+        + jnp.mean(jnp.sum(jnp.square(positive), axis=1)))
+    sim = anchor @ jnp.swapaxes(positive, 0, 1)  # [N, N]
+    lab = jnp.asarray(labels).reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    soft = same / jnp.sum(same, axis=1, keepdims=True)
+    ce = -jnp.sum(soft * jax.nn.log_softmax(sim, axis=1), axis=1)
+    return jnp.mean(ce) + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (paddle.nn.functional.npair_loss): softmax cross
+    entropy over the anchor-positive similarity matrix with soft
+    same-label targets, plus L2 embedding regularization."""
+    return _npair(anchor, positive, labels, l2_reg=float(l2_reg))

@@ -4,10 +4,12 @@ Reference: ``python/paddle/nn/layer/common.py`` (SURVEY.md §2.2 "nn").
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...framework import dtypes as _dtypes
 from ...framework.core import Tensor
+from ...framework.op import defop, raw
 from .. import functional as F
 from .. import initializer as I
 from ..layer import Layer, Parameter
@@ -264,3 +266,33 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.cfg)
+
+
+class FeatureAlphaDropout(Layer):
+    """Alpha dropout that drops whole channels (paddle.nn.FeatureAlphaDropout):
+    the SELU-preserving noise of AlphaDropout with Dropout2D's per-feature
+    mask granularity."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x if isinstance(x, Tensor) else Tensor(raw(x))
+        from ...framework import rng as _rng
+
+        return _feature_alpha_dropout_op(x, _rng.next_key(), p=float(self.p))
+
+
+@defop(name="feature_alpha_dropout_op")
+def _feature_alpha_dropout_op(x, key, p):
+    # selu fixed-point constants: keep mean/variance under dropout
+    alpha, scale = 1.6732632423543772, 1.0507009873554805
+    aprime = -alpha * scale
+    # channel mask: [N, C, 1, 1, ...] broadcast over spatial dims
+    mshape = x.shape[:2] + (1,) * (x.ndim - 2)
+    keep = jax.random.bernoulli(key, 1.0 - p, mshape)
+    a = 1.0 / ((1 - p) * (1 + p * aprime**2)) ** 0.5
+    b = -a * aprime * p
+    return (jnp.where(keep, x, jnp.asarray(aprime, x.dtype)) * a + b).astype(x.dtype)

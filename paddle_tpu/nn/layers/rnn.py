@@ -298,3 +298,27 @@ class RNN(Layer):
 
 # Base alias for cell classes (paddle exposes RNNCellBase for subclassing)
 RNNCellBase = Layer
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (paddle.nn.BiRNN parity): runs cell_fw
+    forward and cell_bw reverse over time, concatenating outputs on the
+    feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+
+        s_fw = s_bw = None
+        if initial_states is not None:
+            s_fw, s_bw = initial_states
+        out_f, st_f = self._fw(inputs, s_fw, sequence_length)
+        out_b, st_b = self._bw(inputs, s_bw, sequence_length)
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)

@@ -413,3 +413,57 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 
 from . import nn  # noqa: E402,F401  (paddle.static.nn)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-Python op inside a compiled program (paddle.static.py_func
+    parity; reference: python/paddle/static/nn/common.py py_func over the
+    C++ py_func op). TPU-native: ``jax.pure_callback`` — XLA calls back to
+    host Python at execution time, under jit and in captured Programs.
+    ``out`` supplies the static shape/dtype contract (a template Tensor or
+    a list of them); ``backward_func`` (if given) defines the VJP, itself
+    run as a host callback."""
+    import jax
+    import numpy as np
+
+    from ..framework.core import Tensor
+    from ..framework.op import raw
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    multi_in, multi_out = isinstance(x, (list, tuple)), isinstance(out, (list, tuple))
+    shapes = tuple(jax.ShapeDtypeStruct(tuple(raw(o).shape), raw(o).dtype)
+                   for o in outs)
+
+    def host_fwd(*vals):
+        r = func(*[np.asarray(v) for v in vals])
+        rs = r if isinstance(r, (list, tuple)) else [r]
+        return tuple(np.asarray(v, s.dtype).reshape(s.shape)
+                     for v, s in zip(rs, shapes))
+
+    @jax.custom_vjp
+    def call(*vals):
+        return jax.pure_callback(host_fwd, shapes, *vals)
+
+    def fwd(*vals):
+        return call(*vals), vals
+
+    def bwd(res, cts):
+        if backward_func is None:
+            return tuple(jax.numpy.zeros_like(v) for v in res)
+        in_shapes = tuple(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                          for v in res)
+
+        def host_bwd(*args):
+            n = len(res)
+            grads = backward_func(*[np.asarray(a) for a in args])
+            gs = grads if isinstance(grads, (list, tuple)) else [grads]
+            return tuple(np.asarray(g, s.dtype).reshape(s.shape)
+                         for g, s in zip(gs, in_shapes))
+
+        return jax.pure_callback(host_bwd, in_shapes, *res, *cts)
+
+    call.defvjp(fwd, bwd)
+    res = call(*[raw(v) for v in xs])
+    res_t = [Tensor(r) for r in res]
+    return res_t if multi_out else res_t[0]

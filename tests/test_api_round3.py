@@ -1,0 +1,160 @@
+"""Round-3 API additions: split family, stacking helpers, masked_scatter,
+BiRNN/FeatureAlphaDropout, npair_loss, static.py_func (reference:
+python/paddle/tensor/manipulation.py, nn/layer/rnn.py, static py_func)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.nn import functional as F
+
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def test_split_family_matches_numpy():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    for got, want in zip(paddle.hsplit(t, 3), np.hsplit(x, 3)):
+        np.testing.assert_allclose(_np(got), want)
+    for got, want in zip(paddle.dsplit(t, 2), np.dsplit(x, 2)):
+        np.testing.assert_allclose(_np(got), want)
+    v = np.arange(7, dtype="float32")
+    for got, want in zip(paddle.tensor_split(paddle.to_tensor(v), 3),
+                         np.array_split(v, 3)):
+        np.testing.assert_allclose(_np(got), want)
+    # 1-D hsplit splits axis 0 (numpy rule)
+    for got, want in zip(paddle.hsplit(paddle.to_tensor(v[:6]), 2),
+                         np.hsplit(v[:6], 2)):
+        np.testing.assert_allclose(_np(got), want)
+
+
+def test_unflatten_and_atleast():
+    x = np.arange(12, dtype="float32")
+    out = paddle.unflatten(paddle.to_tensor(x), 0, [3, -1])
+    assert _np(out).shape == (3, 4)
+    a, b = paddle.atleast_2d(paddle.to_tensor(np.float32(3.0)),
+                             paddle.to_tensor(x[:2]))
+    assert _np(a).shape == (1, 1) and _np(b).shape == (1, 2)
+    c = paddle.atleast_3d(paddle.to_tensor(x[:4].reshape(2, 2)))
+    assert _np(c).shape == (2, 2, 1)
+
+
+def test_stacking_helpers():
+    a = np.asarray([1.0, 2, 3], "float32")
+    b = np.asarray([4.0, 5, 6], "float32")
+    np.testing.assert_allclose(
+        _np(paddle.column_stack([paddle.to_tensor(a), paddle.to_tensor(b)])),
+        np.column_stack([a, b]))
+    np.testing.assert_allclose(
+        _np(paddle.row_stack([paddle.to_tensor(a), paddle.to_tensor(b)])),
+        np.vstack([a, b]))
+    m1 = np.ones((2, 2), "float32")
+    m2 = 2 * np.ones((1, 3), "float32")
+    got = _np(paddle.block_diag([paddle.to_tensor(m1), paddle.to_tensor(m2)]))
+    import scipy.linalg as sl
+
+    np.testing.assert_allclose(got, sl.block_diag(m1, m2))
+
+
+def test_masked_scatter_matches_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4).astype("float32")
+    mask = rs.rand(3, 4) > 0.5
+    value = rs.randn(20).astype("float32")
+    want = torch.from_numpy(x).masked_scatter(
+        torch.from_numpy(mask), torch.from_numpy(value)).numpy()
+    got = _np(paddle.masked_scatter(
+        paddle.to_tensor(x), paddle.to_tensor(mask), paddle.to_tensor(value)))
+    np.testing.assert_allclose(got, want)
+
+
+def test_sinc_fix_nanquantile():
+    x = np.linspace(-2, 2, 7).astype("float32")
+    np.testing.assert_allclose(_np(paddle.sinc(paddle.to_tensor(x))),
+                               np.sinc(x), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_np(paddle.fix(paddle.to_tensor(x))), np.fix(x))
+    v = np.asarray([1.0, np.nan, 3.0, 4.0], "float32")
+    np.testing.assert_allclose(
+        float(_np(paddle.nanquantile(paddle.to_tensor(v), 0.5))),
+        np.nanquantile(v, 0.5))
+
+
+def test_birnn_concats_directions():
+    paddle.seed(0)
+    cell_fw = nn.GRUCell(3, 5)
+    cell_bw = nn.GRUCell(3, 5)
+    rnn = nn.BiRNN(cell_fw, cell_bw)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 4, 3).astype("float32"))
+    out, (st_f, st_b) = rnn(x)
+    assert _np(out).shape == (2, 4, 10)
+    # forward half equals running the fw cell alone
+    out_f, _ = nn.RNN(cell_fw)(x)
+    np.testing.assert_allclose(_np(out)[..., :5], _np(out_f), rtol=1e-6)
+    # backward half is the reversed run of the bw cell
+    out_b, _ = nn.RNN(cell_bw, is_reverse=True)(x)
+    np.testing.assert_allclose(_np(out)[..., 5:], _np(out_b), rtol=1e-6)
+
+
+def test_feature_alpha_dropout_channel_granularity():
+    paddle.seed(0)
+    layer = nn.FeatureAlphaDropout(p=0.5)
+    x = paddle.to_tensor(np.ones((4, 8, 5, 5), "float32"))
+    out = _np(layer(x))
+    # whole channels share one fate: each [n, c] plane is constant
+    per_chan = out.reshape(4, 8, -1)
+    assert np.allclose(per_chan.std(axis=-1), 0.0, atol=1e-6)
+    dropped = np.isclose(per_chan[..., 0], per_chan[..., 0].min()).mean()
+    assert 0.1 < dropped < 0.9  # both fates occur
+    layer.eval()
+    np.testing.assert_allclose(_np(layer(x)), 1.0)  # identity in eval
+
+
+def test_npair_loss_value():
+    rs = np.random.RandomState(2)
+    anchor = rs.randn(4, 6).astype("float32")
+    positive = rs.randn(4, 6).astype("float32")
+    labels = np.asarray([0, 0, 1, 2], "int64")
+    got = float(_np(F.npair_loss(
+        paddle.to_tensor(anchor), paddle.to_tensor(positive),
+        paddle.to_tensor(labels), l2_reg=0.002)))
+    # manual reference
+    sim = anchor @ positive.T
+    same = (labels[:, None] == labels[None, :]).astype("float32")
+    soft = same / same.sum(1, keepdims=True)
+    logp = sim - np.log(np.exp(sim).sum(1, keepdims=True))
+    ce = -(soft * logp).sum(1).mean()
+    reg = 0.25 * 0.002 * ((anchor**2).sum(1).mean() + (positive**2).sum(1).mean())
+    np.testing.assert_allclose(got, ce + reg, rtol=1e-4)
+
+
+def test_py_func_forward_and_backward():
+    import jax
+
+    from paddle_tpu.framework.op import raw
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], "float32"))
+    template = paddle.to_tensor(np.zeros(3, "float32"))
+
+    out = static.py_func(lambda v: v * 2 + 1, x, template)
+    np.testing.assert_allclose(_np(out), [3.0, 5.0, 7.0])
+
+    # under jit (the captured-Program execution mode)
+    def f(v):
+        t = static.py_func(lambda a: a * a, paddle.to_tensor(v), template)
+        return raw(t).sum()
+
+    assert float(jax.jit(f)(raw(x))) == pytest.approx(14.0)
+
+    # custom backward
+    def g(v):
+        t = static.py_func(lambda a: a * 3.0, paddle.to_tensor(v), template,
+                           backward_func=lambda a, ct: ct * 3.0)
+        return raw(t).sum()
+
+    grad = jax.grad(g)(raw(x))
+    np.testing.assert_allclose(np.asarray(grad), 3.0)
