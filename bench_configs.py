@@ -46,9 +46,11 @@ def _sync(x):
 
 
 def _timed_steps(step_fn, args, warmup, iters):
+    out = None
     for _ in range(warmup):
         out = step_fn(*args)
-    _sync(out)
+    if out is not None:
+        _sync(out)  # fence warmup so the timed loop starts clean
     t0 = time.perf_counter()
     for _ in range(iters):
         out = step_fn(*args)

@@ -365,3 +365,191 @@ __all__ = [
     "nms", "box_iou", "box_area", "roi_align", "roi_pool", "box_coder",
     "yolo_box", "deform_conv2d", "DeformConv2D",
 ]
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive ROI pooling (paddle.vision.ops.psroi_pool):
+    input channels are laid out [out_channels, ph, pw]; bin (i, j) of
+    output channel c average-pools ONLY its dedicated input channel
+    (c, i, j) — the R-FCN trick that moves spatial sensitivity into the
+    channel dim so the per-ROI head is a pure pooling."""
+    xv, bv = _val(x), _val(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    C = xv.shape[1]
+    if C % (ph * pw):
+        raise ValueError(f"psroi_pool: channels {C} must be a multiple of "
+                         f"output_size {ph}x{pw}")
+    cout = C // (ph * pw)
+    bn = _val(boxes_num)
+    img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn, total_repeat_length=bv.shape[0])
+    H, W = xv.shape[-2], xv.shape[-1]
+
+    def one_roi(box, img_i):
+        feat = xv[img_i].reshape(cout, ph, pw, H, W)
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        ys = jnp.arange(H, dtype=xv.dtype) + 0.5
+        xs = jnp.arange(W, dtype=xv.dtype) + 0.5
+        ybin = jnp.floor((ys - y1) / bin_h)
+        xbin = jnp.floor((xs - x1) / bin_w)
+        ymask = (ybin[None, :] == jnp.arange(ph)[:, None]) & (ys > y1) & (ys < y2)
+        xmask = (xbin[None, :] == jnp.arange(pw)[:, None]) & (xs > x1) & (xs < x2)
+        m = (ymask[:, None, :, None] & xmask[None, :, None, :]).astype(xv.dtype)
+        # [ph, pw, H, W] mask; bin (i,j) averages feat[:, i, j] over it
+        s = jnp.einsum("cijhw,ijhw->cij", feat, m)
+        cnt = m.sum(axis=(-2, -1))
+        return s / jnp.maximum(cnt, 1.0)
+
+    return Tensor(jax.vmap(one_roi)(bv, img_idx))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes over a feature map
+    (paddle.vision.ops.prior_box). Returns (boxes [H, W, P, 4] normalized
+    xyxy, variances broadcast to the same shape). Pure arithmetic on
+    static shapes — jits as one fused program."""
+    fv, iv = _val(input), _val(image)
+    fh, fw = fv.shape[-2], fv.shape[-1]
+    ih, iw = iv.shape[-2], iv.shape[-1]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    max_sizes = list(max_sizes or [])
+
+    whs = []  # (w, h) per prior, paddle kernel order
+    for i, ms in enumerate(min_sizes):
+        ms = float(ms)
+        whs.append((ms, ms))  # aspect ratio 1
+        if min_max_aspect_ratios_order and max_sizes:
+            s = (ms * float(max_sizes[i])) ** 0.5
+            whs.append((s, s))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
+        if not min_max_aspect_ratios_order and max_sizes:
+            s = (ms * float(max_sizes[i])) ** 0.5
+            whs.append((s, s))
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h  # [H]
+    w = jnp.asarray([p[0] for p in whs], jnp.float32) * 0.5
+    h = jnp.asarray([p[1] for p in whs], jnp.float32) * 0.5
+    full = (fh, fw, len(whs))
+    boxes = jnp.stack([
+        jnp.broadcast_to((cx[None, :, None] - w) / iw, full),
+        jnp.broadcast_to((cy[:, None, None] - h) / ih, full),
+        jnp.broadcast_to((cx[None, :, None] + w) / iw, full),
+        jnp.broadcast_to((cy[:, None, None] + h) / ih, full),
+    ], axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route each RoI to its FPN pyramid level by scale (paddle.vision.ops.
+    distribute_fpn_proposals): level = clip(floor(refer_level +
+    log2(sqrt(area) / refer_scale))). Variable-length outputs make this a
+    host-boundary op (same rule as nms)."""
+    import numpy as np
+
+    rois = np.asarray(_val(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + off) * (rois[:, 3] - rois[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, restore_parts, nums = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        restore_parts.append(idx)
+        nums.append(Tensor(jnp.asarray(np.asarray([len(idx)], np.int32))))
+    order = np.concatenate(restore_parts) if restore_parts else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    restore_ind = Tensor(jnp.asarray(restore[:, None].astype(np.int32)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, nums
+    return multi_rois, restore_ind
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (paddle.vision.ops.generate_proposals):
+    decode anchor deltas -> clip to image -> drop tiny boxes -> per-image
+    top-k + NMS. Decode/clip is fused jnp; the variable-length top-k/NMS
+    tail is the host boundary (nms rule)."""
+    import numpy as np
+
+    sv = np.asarray(_val(scores))        # [N, A, H, W]
+    dv = np.asarray(_val(bbox_deltas))   # [N, 4A, H, W]
+    iv = np.asarray(_val(img_size))      # [N, 2] (h, w)
+    av = np.asarray(_val(anchors)).reshape(-1, 4)    # [H*W*A, 4]
+    vv = np.asarray(_val(variances)).reshape(-1, 4)
+    N, A = sv.shape[0], sv.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sv[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = dv[n].reshape(A, 4, *dv.shape[2:]).transpose(2, 3, 0, 1).reshape(-1, 4)
+        keep = np.argsort(-s)[: int(pre_nms_top_n)]
+        s_k, d_k, a_k, v_k = s[keep], d[keep], av[keep], vv[keep]
+        # decode_center_size with variances
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + 0.5 * aw
+        acy = a_k[:, 1] + 0.5 * ah
+        cx = v_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = v_k[:, 1] * d_k[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(v_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        h_img, w_img = float(iv[n, 0]), float(iv[n, 1])
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_img - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_img - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        big = (ws >= min_size) & (hs >= min_size)
+        boxes, s_k = boxes[big], s_k[big]
+        if len(boxes):
+            keep_idx = np.asarray(_val(nms(
+                Tensor(jnp.asarray(boxes)), iou_threshold=nms_thresh,
+                scores=Tensor(jnp.asarray(s_k)), top_k=int(post_nms_top_n))))
+            boxes, s_k = boxes[keep_idx], s_k[keep_idx]
+        all_rois.append(boxes)
+        all_scores.append(s_k)
+        nums.append(len(boxes))
+
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, axis=0) if all_rois
+                              else np.zeros((0, 4), np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores)[:, None]
+                                 if all_scores else np.zeros((0, 1), np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores

@@ -191,3 +191,95 @@ def test_deform_conv2d_zero_offset_equals_conv():
     out = _np(ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset), paddle.to_tensor(w)))
     ref = jax.lax.conv_general_dilated(x, w, (1, 1), "VALID")
     np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.fast
+def test_psroi_pool_position_sensitive():
+    # 1 image, C = 2 out-channels * 2*2 bins; constant per input channel
+    ph = pw = 2
+    cout = 2
+    C = cout * ph * pw
+    feat = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        feat[0, c] = c + 1.0
+    boxes = paddle.to_tensor(np.asarray([[0.0, 0.0, 8.0, 8.0]], "float32"))
+    out = ops.psroi_pool(paddle.to_tensor(feat), boxes,
+                         paddle.to_tensor(np.asarray([1], "int32")), 2)
+    o = np.asarray(out._value)
+    assert o.shape == (1, cout, ph, pw)
+    # bin (i,j) of out channel c reads input channel c*ph*pw + i*pw + j
+    for c in range(cout):
+        for i in range(ph):
+            for j in range(pw):
+                np.testing.assert_allclose(o[0, c, i, j], c * ph * pw + i * pw + j + 1.0)
+
+
+@pytest.mark.fast
+def test_prior_box_geometry():
+    feat = paddle.to_tensor(np.zeros((1, 3, 4, 4), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32"))
+    boxes, var = ops.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                               aspect_ratios=[2.0], flip=True, clip=True)
+    b = np.asarray(boxes._value)
+    # priors: ar1(min) + ar2 + ar0.5 + sqrt(min*max) = 4
+    assert b.shape == (4, 4, 4, 4)
+    assert np.all(b >= 0.0) and np.all(b <= 1.0)
+    # the ar=1 prior at cell (0,0): center (4,4), size 8 -> [0, 0, 8, 8]/32
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 0.25, 0.25], atol=1e-6)
+    # width/height ratio of the ar=2 prior is 2 (pre-clip cells away from border)
+    bb = b[2, 2, 1]
+    w, h = (bb[2] - bb[0]) * 32, (bb[3] - bb[1]) * 32
+    np.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+    assert np.asarray(var._value).shape == b.shape
+
+
+@pytest.mark.fast
+def test_distribute_fpn_proposals_routing_and_restore():
+    rois = np.asarray([
+        [0, 0, 16, 16],     # sqrt(area)=16 -> low level
+        [0, 0, 224, 224],   # refer scale -> refer level
+        [0, 0, 500, 500],   # big -> high level
+        [0, 0, 20, 20],
+    ], "float32")
+    multi, restore, nums = ops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.asarray([4], "int32")))
+    assert len(multi) == 4  # levels 2..5
+    counts = [int(np.asarray(n._value)[0]) for n in nums]
+    assert sum(counts) == 4
+    # gather(concat(multi_rois), restore_ind) recovers the original order
+    cat = np.concatenate(
+        [np.asarray(m._value) for m in multi if len(np.asarray(m._value))])
+    r = np.asarray(restore._value).ravel()
+    np.testing.assert_allclose(cat[r], rois)
+
+
+@pytest.mark.fast
+def test_generate_proposals_shapes_and_validity():
+    rs = np.random.RandomState(0)
+    H = W = 4
+    A = 3
+    scores = rs.rand(1, A, H, W).astype("float32")
+    deltas = (rs.randn(1, 4 * A, H, W) * 0.1).astype("float32")
+    img_size = np.asarray([[32.0, 32.0]], "float32")
+    # simple anchor grid [H, W, A, 4]
+    anchors = np.zeros((H, W, A, 4), "float32")
+    for i in range(H):
+        for j in range(W):
+            for a, sz in enumerate((8, 12, 16)):
+                cx, cy = j * 8 + 4, i * 8 + 4
+                anchors[i, j, a] = [cx - sz / 2, cy - sz / 2, cx + sz / 2, cy + sz / 2]
+    variances = np.ones((H, W, A, 4), "float32")
+    rois, rscores, num = ops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img_size), paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), pre_nms_top_n=20, post_nms_top_n=5,
+        nms_thresh=0.7, return_rois_num=True)
+    rv = np.asarray(rois._value)
+    assert rv.shape[0] == int(np.asarray(num._value)[0]) <= 5
+    assert rv.shape[1] == 4 and np.asarray(rscores._value).shape == (rv.shape[0], 1)
+    # proposals clipped to the image
+    assert np.all(rv >= 0) and np.all(rv[:, 0::2] <= 32) and np.all(rv[:, 1::2] <= 32)
+    # scores sorted descending per image (NMS keeps score order)
+    sc = np.asarray(rscores._value).ravel()
+    assert np.all(np.diff(sc) <= 1e-6)
