@@ -369,11 +369,13 @@ class RandomRotation(BaseTransform):
     def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
         super().__init__(keys)
         self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+        self.interpolation, self.expand = interpolation, expand
+        self.center, self.fill = center, fill
 
     def _apply_image(self, img):
-        a = _to_np(img)
-        k = _pyrandom.randint(0, 3)
-        return np.rot90(a, k).copy()  # coarse rotation (90° steps)
+        angle = _pyrandom.uniform(*self.degrees)
+        return rotate(_to_np(img), angle, self.interpolation, self.expand,
+                      self.center, self.fill)
 
 
 class Grayscale(BaseTransform):
@@ -419,3 +421,217 @@ def crop(img, top, left, height, width):
 
 def pad(img, padding, fill=0, padding_mode="constant"):
     return Pad(padding, fill, padding_mode)(img)
+
+
+# --------------------------------------------------------------------------
+# geometric warps (host-side numpy: augmentation is data-pipeline work).
+# Reference: python/paddle/vision/transforms/functional_cv2.py affine/rotate/
+# perspective — here one inverse-mapped bilinear sampler serves all three.
+# --------------------------------------------------------------------------
+def _inverse_warp(a, minv, out_hw, interpolation="bilinear", fill=0):
+    """Sample input HWC array `a` at inverse-mapped output coords; `minv`
+    is 3x3 mapping OUTPUT (x, y, 1) -> INPUT (x', y', w')."""
+    h, w = a.shape[0], a.shape[1]
+    oh, ow = out_hw
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones], 0).reshape(3, -1)  # [3, oh*ow]
+    src = minv @ pts
+    sx = src[0] / np.where(np.abs(src[2]) > 1e-8, src[2], 1e-8)
+    sy = src[1] / np.where(np.abs(src[2]) > 1e-8, src[2], 1e-8)
+    a3 = a[..., None] if a.ndim == 2 else a
+    af = a3.astype(np.float32)
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        inside = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out = np.full((oh * ow, a3.shape[-1]), float(fill), np.float32)
+        out[inside] = af[yi[inside], xi[inside]]
+    else:
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        fx, fy = sx - x0, sy - y0
+        out = np.zeros((oh * ow, a3.shape[-1]), np.float32)
+        wsum = np.zeros((oh * ow, 1), np.float32)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi, yi = x0 + dx, y0 + dy
+                wgt = (fx if dx else 1 - fx) * (fy if dy else 1 - fy)
+                ok = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                out[ok] += wgt[ok, None] * af[yi[ok], xi[ok]]
+                wsum[ok] += wgt[ok, None]
+        out = np.where(wsum > 1e-6, out / np.maximum(wsum, 1e-6),
+                       float(fill))
+    out = out.reshape(oh, ow, a3.shape[-1])
+    if a.ndim == 2:
+        out = out[..., 0]
+    return out.astype(a.dtype) if np.issubdtype(a.dtype, np.integer) else out
+
+
+def _affine_matrix(center, angle, translate, scale, shear):
+    cx, cy = center
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    # torchvision/paddle convention: M = T(center) R(angle) Shear Scale T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1]], np.float32) * scale
+    m[2, 2] = 1.0
+    t_pre = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float32)
+    t_post = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                       [0, 0, 1]], np.float32)
+    return t_post @ m @ t_pre
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    """Rotate counter-clockwise by `angle` degrees (paddle functional.rotate)."""
+    a = _to_np(img)
+    h, w = a.shape[0], a.shape[1]
+    ctr = center if center is not None else ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(ctr, -float(angle), (0, 0), 1.0, (0.0, 0.0))
+    out_hw = (h, w)
+    if expand:
+        corners = np.array([[0, 0, 1], [w - 1, 0, 1], [0, h - 1, 1],
+                            [w - 1, h - 1, 1]], np.float32).T
+        mapped = np.linalg.inv(m) @ corners
+        xs, ys = mapped[0], mapped[1]
+        ow = int(np.ceil(xs.max() - xs.min() + 1))
+        oh = int(np.ceil(ys.max() - ys.min() + 1))
+        shift = np.array([[1, 0, xs.min()], [0, 1, ys.min()], [0, 0, 1]],
+                         np.float32)
+        m = m @ shift
+        out_hw = (oh, ow)
+    return _inverse_warp(a, m, out_hw, interpolation, fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (paddle functional.affine): rotation + translation +
+    isotropic scale + shear about `center`."""
+    a = _to_np(img)
+    h, w = a.shape[0], a.shape[1]
+    if isinstance(shear, numbers.Number):
+        shear = (float(shear), 0.0)
+    ctr = center if center is not None else ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(ctr, -float(angle), tuple(translate), float(scale),
+                       tuple(float(s) for s in shear))
+    return _inverse_warp(a, np.linalg.inv(m), (h, w), interpolation, fill)
+
+
+def _homography(src_pts, dst_pts):
+    """3x3 H with H @ [sx, sy, 1] ~ [dx, dy, 1] from 4 point pairs (DLT)."""
+    A = []
+    for (sx, sy), (dx, dy) in zip(src_pts, dst_pts):
+        A.append([sx, sy, 1, 0, 0, 0, -dx * sx, -dx * sy, -dx])
+        A.append([0, 0, 0, sx, sy, 1, -dy * sx, -dy * sy, -dy])
+    _, _, vt = np.linalg.svd(np.asarray(A, np.float64))
+    return vt[-1].reshape(3, 3).astype(np.float32)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Projective warp mapping `startpoints` -> `endpoints` (4 corners each,
+    [x, y]); paddle functional.perspective."""
+    a = _to_np(img)
+    h, w = a.shape[0], a.shape[1]
+    minv = _homography(endpoints, startpoints)  # output -> input
+    return _inverse_warp(a, minv, (h, w), interpolation, fill)
+
+
+def adjust_brightness(img, brightness_factor):
+    a = _to_np(img).astype(np.float32)
+    hi = 255 if not np.issubdtype(_to_np(img).dtype, np.floating) else 1.0
+    out = np.clip(a * float(brightness_factor), 0, hi)
+    return out.astype(_to_np(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    a = _to_np(img).astype(np.float32)
+    hi = 255 if not np.issubdtype(_to_np(img).dtype, np.floating) else 1.0
+    gray_mean = (a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114).mean()
+    out = np.clip(gray_mean + (a - gray_mean) * float(contrast_factor), 0, hi)
+    return out.astype(_to_np(img).dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    a = _to_np(img).astype(np.float32)
+    hi = 255 if not np.issubdtype(_to_np(img).dtype, np.floating) else 1.0
+    out = np.clip(_adjust_saturation(a, float(saturation_factor)), 0, hi)
+    return out.astype(_to_np(img).dtype)
+
+
+def adjust_hue(img, hue_factor):
+    a = _to_np(img).astype(np.float32)
+    hi = 255 if not np.issubdtype(_to_np(img).dtype, np.floating) else 1.0
+    out = np.clip(_adjust_hue(a, float(hue_factor)), 0, hi)
+    return out.astype(_to_np(img).dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the rectangle [i:i+h, j:j+w] with value(s) `v` (functional
+    counterpart of RandomErasing; works on HWC arrays and CHW tensors)."""
+    was_tensor = hasattr(img, "_value")
+    a = _to_np(img)
+    a = a if inplace and not was_tensor else a.copy()
+    chw = a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3)
+    region = np.s_[:, i:i + h, j:j + w] if chw else np.s_[i:i + h, j:j + w]
+    a[region] = np.asarray(v, a.dtype) if not np.isscalar(v) else v
+    return RandomErasing._rewrap(a, was_tensor)
+
+
+class RandomAffine(BaseTransform):
+    """Random affine augmentation (paddle.vision.transforms.RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, numbers.Number) else degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear = ((-shear, shear)
+                      if isinstance(shear, numbers.Number) else shear)
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        h, w = a.shape[0], a.shape[1]
+        angle = _pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = _pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        scale = (_pyrandom.uniform(*self.scale_rng)
+                 if self.scale_rng is not None else 1.0)
+        shear = (0.0, 0.0)
+        if self.shear is not None:
+            shear = (_pyrandom.uniform(self.shear[0], self.shear[1]), 0.0)
+        return affine(a, angle, (tx, ty), scale, shear,
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Random projective distortion (paddle RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.d = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        a = _to_np(img)
+        if _pyrandom.random() >= self.prob:
+            return a
+        h, w = a.shape[0], a.shape[1]
+        dx, dy = self.d * w / 2, self.d * h / 2
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(x + _pyrandom.uniform(0, dx) * (1 if x == 0 else -1),
+                y + _pyrandom.uniform(0, dy) * (1 if y == 0 else -1))
+               for x, y in start]
+        return perspective(a, start, end, self.interpolation, self.fill)

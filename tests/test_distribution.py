@@ -171,3 +171,129 @@ def test_independent_sums_event_dims():
     lp = _np(ind.log_prob(v))
     assert lp.shape == (3,)
     np.testing.assert_allclose(lp, _np(base.log_prob(v)).sum(-1), rtol=1e-6)
+
+
+def test_chi2():
+    import scipy.stats as st
+
+    d = D.Chi2(paddle.to_tensor(np.asarray(3.0, "float32")))
+    x = np.asarray([0.5, 2.0, 5.0], "float32")
+    np.testing.assert_allclose(
+        _np(d.log_prob(paddle.to_tensor(x))), st.chi2.logpdf(x, 3.0),
+        rtol=1e-4, atol=1e-5)
+    assert float(_np(d.mean)) == pytest.approx(3.0)
+    assert float(_np(d.variance)) == pytest.approx(6.0)
+    paddle.seed(0)
+    s = _np(d.sample((4000,)))
+    assert s.mean() == pytest.approx(3.0, rel=0.1)
+
+
+def test_multivariate_normal_logprob_and_sampling():
+    import scipy.stats as st
+
+    mu = np.asarray([1.0, -2.0], "float32")
+    cov = np.asarray([[2.0, 0.6], [0.6, 1.0]], "float32")
+    d = D.MultivariateNormal(paddle.to_tensor(mu),
+                                covariance_matrix=paddle.to_tensor(cov))
+    x = np.asarray([[0.0, 0.0], [1.0, -2.0], [2.0, 1.0]], "float32")
+    np.testing.assert_allclose(
+        _np(d.log_prob(paddle.to_tensor(x))),
+        st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-4, atol=1e-5)
+    assert float(_np(d.entropy())) == pytest.approx(
+        st.multivariate_normal(mu, cov).entropy(), rel=1e-4)
+    paddle.seed(1)
+    s = _np(d.rsample((6000,)))
+    np.testing.assert_allclose(s.mean(0), mu, atol=0.1)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+    # precision/scale_tril parameterizations agree
+    d2 = D.MultivariateNormal(paddle.to_tensor(mu),
+                                 precision_matrix=paddle.to_tensor(
+                                     np.linalg.inv(cov).astype("float32")))
+    np.testing.assert_allclose(
+        _np(d2.log_prob(paddle.to_tensor(x))),
+        _np(d.log_prob(paddle.to_tensor(x))), rtol=1e-3, atol=1e-4)
+
+
+def test_von_mises():
+    import scipy.stats as st
+
+    d = D.VonMises(paddle.to_tensor(np.asarray(0.5, "float32")),
+                      paddle.to_tensor(np.asarray(2.0, "float32")))
+    x = np.asarray([-1.0, 0.5, 2.0], "float32")
+    np.testing.assert_allclose(
+        _np(d.log_prob(paddle.to_tensor(x))),
+        st.vonmises.logpdf(x, 2.0, loc=0.5), rtol=1e-4, atol=1e-5)
+    assert float(_np(d.entropy())) == pytest.approx(
+        st.vonmises.entropy(2.0), rel=1e-4)
+    paddle.seed(2)
+    s = _np(d.sample((5000,)))
+    assert np.all(np.abs(s) <= np.pi + 1e-5)
+    # circular mean near loc
+    ang = np.arctan2(np.sin(s - 0.5).mean(), np.cos(s - 0.5).mean())
+    assert abs(ang) < 0.08
+
+
+def test_continuous_bernoulli():
+    d = D.ContinuousBernoulli(paddle.to_tensor(np.asarray(0.3, "float32")))
+    # density integrates to ~1
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype("float32")
+    pdf = np.exp(_np(d.log_prob(paddle.to_tensor(xs))))
+    assert np.trapezoid(pdf, xs) == pytest.approx(1.0, abs=1e-3)
+    # mean matches the closed form and the sampler
+    m = float(_np(d.mean))
+    paddle.seed(3)
+    s = _np(d.rsample((8000,)))
+    assert s.mean() == pytest.approx(m, abs=0.02)
+    assert np.all((s >= 0) & (s <= 1))
+    # at p ~ 0.5 the Taylor branch applies and stays finite/continuous
+    dh = D.ContinuousBernoulli(paddle.to_tensor(np.asarray(0.5, "float32")))
+    assert np.isfinite(float(_np(dh.log_prob(paddle.to_tensor(
+        np.asarray(0.4, "float32"))))))
+    assert float(_np(dh.mean)) == pytest.approx(0.5, abs=1e-4)
+
+
+def test_lkj_cholesky():
+    paddle.seed(4)
+    d = D.LKJCholesky(3, paddle.to_tensor(np.asarray(1.5, "float32")))
+    L = _np(d.sample((200,)))
+    assert L.shape == (200, 3, 3)
+    # valid Cholesky factors of correlation matrices: unit row norms,
+    # lower-triangular, positive diagonal
+    np.testing.assert_allclose((L**2).sum(-1), 1.0, atol=1e-5)
+    assert np.all(np.triu(L, 1) == 0)
+    assert np.all(np.diagonal(L, axis1=-2, axis2=-1) > 0)
+    lp = _np(d.log_prob(paddle.to_tensor(L[0])))
+    assert np.isfinite(lp)
+    # eta=1, d=2: correlation r = L[1,0] is uniform on (-1,1) => log_prob
+    # of the factor has the |dr/dL| density ~ const*1 -> check symmetry
+    d2 = D.LKJCholesky(2, paddle.to_tensor(np.asarray(1.0, "float32")))
+    La = np.asarray([[1.0, 0], [0.6, 0.8]], "float32")
+    Lb = np.asarray([[1.0, 0], [-0.6, 0.8]], "float32")
+    np.testing.assert_allclose(_np(d2.log_prob(paddle.to_tensor(La))),
+                               _np(d2.log_prob(paddle.to_tensor(Lb))), rtol=1e-5)
+
+
+def test_exponential_family_entropy_bregman():
+    class _NormalEF(D.ExponentialFamily):
+        def __init__(self, loc, scale):
+            self.loc, self.scale = loc, scale
+            super().__init__(np.shape(loc))
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / self.scale**2, -0.5 / self.scale**2)
+
+        def _log_normalizer(self, n1, n2):
+            return -(n1**2) / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+        @property
+        def _mean_carrier_measure(self):
+            return -0.5 * np.log(2 * np.pi)  # E[log h], h = 1/sqrt(2 pi)
+
+    import jax.numpy as jnp
+
+    ef = _NormalEF(np.float32(1.3), np.float32(0.7))
+    want = float(_np(D.Normal(paddle.to_tensor(np.float32(1.3)),
+                                 paddle.to_tensor(np.float32(0.7))).entropy()))
+    got = float(_np(ef.entropy()))
+    assert got == pytest.approx(want, rel=1e-4)

@@ -1,0 +1,86 @@
+"""Geometric warp + functional color transforms (reference:
+python/paddle/vision/transforms/functional_cv2.py — here one inverse-mapped
+bilinear sampler serves rotate/affine/perspective)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+
+
+def _bar_img():
+    img = np.zeros((20, 20, 3), np.uint8)
+    img[5:15, 8:12] = 200  # vertical bar
+    return img
+
+
+def test_rotate_90_turns_bar_horizontal():
+    img = _bar_img()
+    r = T.rotate(img, 90, interpolation="bilinear")
+    assert r.shape == img.shape
+    col = r[:, :, 0]
+    assert (col.max(axis=0) > 100).sum() > (col.max(axis=1) > 100).sum()
+
+
+def test_rotate_full_circle_is_identity():
+    img = _bar_img().astype(np.float32)
+    np.testing.assert_allclose(
+        T.rotate(img, 360, interpolation="bilinear"), img, atol=2)
+
+
+def test_rotate_expand_grows_canvas():
+    img = _bar_img()
+    r = T.rotate(img, 45, expand=True)
+    assert r.shape[0] > img.shape[0] and r.shape[1] > img.shape[1]
+
+
+def test_affine_identity_translate_scale():
+    img = _bar_img().astype(np.float32)
+    np.testing.assert_allclose(
+        T.affine(img, 0, (0, 0), 1.0, (0, 0), interpolation="bilinear"),
+        img, atol=1e-3)
+    at = T.affine(img, 0, (3, 0), 1.0, (0, 0), interpolation="nearest")
+    assert at[:, 11:15, 0].max() > 100 and at[10, 8, 0] < 100
+    # scale 2 about center: bar gets wider
+    sc = T.affine(img, 0, (0, 0), 2.0, (0, 0), interpolation="bilinear")
+    assert (sc[10, :, 0] > 100).sum() > (img[10, :, 0] > 100).sum()
+
+
+def test_perspective_identity_and_distortion():
+    img = _bar_img().astype(np.float32)
+    pts = [(0, 0), (19, 0), (19, 19), (0, 19)]
+    np.testing.assert_allclose(
+        T.perspective(img, pts, pts, interpolation="bilinear"), img, atol=1e-2)
+    end = [(2, 2), (17, 0), (19, 19), (0, 17)]
+    warped = T.perspective(img, pts, end, interpolation="bilinear")
+    assert warped.shape == img.shape and not np.allclose(warped, img)
+
+
+def test_functional_color_ops():
+    img = _bar_img()
+    assert T.adjust_brightness(img, 0.5).max() == 100
+    c = T.adjust_contrast(img, 0.0)  # zero contrast -> constant gray mean
+    assert np.ptp(c.astype(np.float32)) < 1.0
+    h = T.adjust_hue(img, 0.25)
+    assert h.shape == img.shape
+    s = T.adjust_saturation(img, 0.0)  # desaturated -> channels equal
+    assert np.allclose(s[..., 0], s[..., 1], atol=1) and np.allclose(
+        s[..., 1], s[..., 2], atol=1)
+    g = T.to_grayscale(img)
+    assert g.shape[-1] == 1
+
+
+def test_erase_functional():
+    img = _bar_img()
+    e = T.erase(img, 2, 3, 4, 5, 7)
+    assert (e[2:6, 3:8] == 7).all() and e[0, 0, 0] == 0
+    assert img[2, 3, 0] == 0  # not inplace by default
+
+
+def test_random_warp_classes_run():
+    img = _bar_img()
+    assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                          shear=5)(img).shape == img.shape
+    assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+    assert T.RandomRotation(30)(img).shape == img.shape
