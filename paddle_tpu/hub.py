@@ -1,0 +1,73 @@
+"""paddle.hub parity — model-hub entrypoint discovery and loading.
+
+Reference: ``python/paddle/hapi/hub.py`` (list/help/load over a repo's
+``hubconf.py``, sources github/gitee/local). This build is offline by
+design: ``source='local'`` is fully supported (the common production path
+— a checked-out model repo on disk); the network sources raise with a
+clear offline note instead of pretending to download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(os.path.abspath(repo_dir), HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"hub: no {HUB_CONF} in {repo_dir!r} (a hub repo exposes its "
+            "entrypoints there)")
+    name = f"_paddle_tpu_hubconf_{abs(hash(path))}"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, os.path.dirname(path))
+    try:
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"unknown source {source!r}: expected github/gitee/local")
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access; this build is "
+            "offline — clone the repo and use source='local' with its path")
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """The entrypoint's docstring."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"hub: no entrypoint {model!r} in {repo_dir!r}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call the entrypoint and return its model."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"hub: no entrypoint {model!r} in {repo_dir!r}")
+    return getattr(mod, model)(**kwargs)

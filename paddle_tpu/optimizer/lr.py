@@ -315,3 +315,23 @@ class MultiplicativeDecay(LRScheduler):
         d = super().state_dict()
         d.pop("lr_lambda", None)
         return d
+
+
+class LinearLR(LRScheduler):
+    """Linear ramp of the LR multiplier from start_factor to end_factor over
+    total_steps (paddle.optimizer.lr.LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        f = self.start_factor + (self.end_factor - self.start_factor) * (
+            t / self.total_steps)
+        return self.base_lr * f

@@ -222,10 +222,12 @@ def _patch():
     T.rank = lambda self: self.ndim
     T.ndimension = lambda self: self.ndim
     T.element_size = lambda self: self._value.dtype.itemsize
+    T.nbytes = property(lambda self: self._value.dtype.itemsize * self.size)
     T.value = lambda self: self
 
     for f in (zero_, fill_, add_, subtract_, multiply_, divide_, scale_, clip_,
-              exponential_, uniform_, normal_, remainder_, flatten_):
+              exponential_, uniform_, normal_, remainder_, flatten_,
+              bernoulli_, log_normal_):
         setattr(T, f.__name__, f)
 
     # device/dtype movement

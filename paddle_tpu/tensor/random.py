@@ -158,3 +158,47 @@ def exponential_(x, lam=1.0, name=None):
 def shuffle_(x, name=None):
     key = _rng.next_key()
     return x._rebind(jax.random.permutation(key, raw(x), axis=0))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place Bernoulli fill (paddle.Tensor.bernoulli_)."""
+    key = _rng.next_key()
+    out = jax.random.bernoulli(key, p, tuple(raw(x).shape))
+    return x._rebind(out.astype(raw(x).dtype))
+
+
+@defop(name="log_normal_op")
+def _log_normal(key, shape, mean, std, dtype):
+    return jnp.exp(mean + std * jax.random.normal(key, shape, dtype))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Samples with ln X ~ N(mean, std) (paddle.log_normal)."""
+    return _log_normal(_rng.next_key(), shape=_shape(shape or [1]),
+                       mean=float(mean), std=float(std), dtype=jnp.float32)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    out = _log_normal(_rng.next_key(), shape=tuple(raw(x).shape),
+                      mean=float(mean), std=float(std), dtype=raw(x).dtype)
+    return x._rebind(raw(out))
+
+
+@defop(name="standard_gamma_op")
+def _standard_gamma(x, key):
+    return jax.random.gamma(key, x)
+
+
+def standard_gamma(x, name=None):
+    """Gamma(alpha=x, rate=1) samples, elementwise (paddle.standard_gamma)."""
+    return _standard_gamma(x, _rng.next_key())
+
+
+@defop(name="binomial_op")
+def _binomial(count, prob, key):
+    return jax.random.binomial(key, count, prob).astype(jnp.int64)
+
+
+def binomial(count, prob, name=None):
+    """Binomial(count, prob) samples, elementwise-broadcast (paddle.binomial)."""
+    return _binomial(count, prob, _rng.next_key())

@@ -158,3 +158,60 @@ def test_py_func_forward_and_backward():
 
     grad = jax.grad(g)(raw(x))
     np.testing.assert_allclose(np.asarray(grad), 3.0)
+
+
+def test_random_ops_round3():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.zeros((1000,), "float32"))
+    x.bernoulli_(0.3)
+    assert 0.2 < float(_np(x).mean()) < 0.4 and set(np.unique(_np(x))) <= {0.0, 1.0}
+
+    paddle.seed(1)
+    s = _np(paddle.log_normal(mean=0.0, std=0.5, shape=[4000]))
+    assert np.all(s > 0)
+    assert np.log(s).mean() == pytest.approx(0.0, abs=0.05)
+
+    paddle.seed(2)
+    g = _np(paddle.standard_gamma(paddle.to_tensor(
+        np.full((3000,), 2.0, "float32"))))
+    assert g.mean() == pytest.approx(2.0, rel=0.1)  # E[Gamma(2,1)] = 2
+
+    paddle.seed(3)
+    b = _np(paddle.binomial(paddle.to_tensor(np.full((3000,), 10.0, "float32")),
+                            paddle.to_tensor(np.full((3000,), 0.3, "float32"))))
+    assert b.mean() == pytest.approx(3.0, rel=0.1)
+    assert b.min() >= 0 and b.max() <= 10
+
+    t = paddle.to_tensor(np.zeros((2, 3), "float32"))
+    assert t.nbytes == 24
+
+
+def test_linear_lr_schedule():
+    sched = paddle.optimizer.lr.LinearLR(
+        learning_rate=0.1, total_steps=4, start_factor=0.5, end_factor=1.0)
+    lrs = []
+    for _ in range(6):
+        lrs.append(sched.get_lr())
+        sched.step()
+    # ramps linearly then clamps at end_factor
+    assert lrs[0] == pytest.approx(0.1 * 0.5)
+    assert lrs[4] == pytest.approx(0.1 * 1.0)
+    assert lrs[5] == pytest.approx(0.1 * 1.0)
+    np.testing.assert_allclose(np.diff(lrs[:5]), np.diff(lrs[:5])[0], rtol=1e-6)
+
+
+def test_hub_local_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_linear(out=2):\n"
+        "    '''A tiny linear model.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(3, out)\n"
+    )
+    from paddle_tpu import hub
+
+    assert hub.list(str(tmp_path), source="local") == ["tiny_linear"]
+    assert "tiny" in hub.help(str(tmp_path), "tiny_linear", source="local")
+    m = hub.load(str(tmp_path), "tiny_linear", source="local", out=4)
+    assert m(paddle.to_tensor(np.ones((1, 3), "float32"))).shape == [1, 4]
+    with pytest.raises(RuntimeError, match="offline"):
+        hub.load("owner/repo", "x", source="github")
