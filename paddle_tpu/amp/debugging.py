@@ -1,0 +1,128 @@
+"""paddle.amp.debugging parity — numeric-anomaly tooling for mixed precision.
+
+Reference: ``python/paddle/amp/debugging.py`` (TensorCheckerConfig,
+enable/disable_tensor_checker, check_numerics, operator-stats collection
+over the C++ op hooks). TPU-native reshape: the defop gateway is the single
+dispatch point, so the checker is a post-op host assertion hook there;
+``check_numerics`` itself is a pure jnp reduction that also works inside
+jit (debug_check only forces a host sync in eager).
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.op import raw
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "collect_operator_stats",
+    "enable_operator_stats_collection", "disable_operator_stats_collection",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+_CHECKER: Optional[TensorCheckerConfig] = None
+_OP_STATS: Optional[dict] = None
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    global _CHECKER
+    _CHECKER = config if config.enable else None
+
+
+def disable_tensor_checker():
+    global _CHECKER
+    _CHECKER = None
+
+
+def current_checker() -> Optional[TensorCheckerConfig]:
+    return _CHECKER
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count NaN/Inf in `tensor`; returns (num_nan, num_inf, num_zero) as
+    Tensors (paddle.amp.debugging.check_numerics). Under ABORT mode a
+    nonzero count raises — the eager analogue of the reference's
+    FLAGS_check_nan_inf abort."""
+    v = raw(tensor)
+    num_nan = jnp.sum(jnp.isnan(v))
+    num_inf = jnp.sum(jnp.isinf(v))
+    num_zero = jnp.sum(v == 0)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        n, i = int(num_nan), int(num_inf)
+        if n or i:
+            raise FloatingPointError(
+                f"check_numerics: {op_type or '<tensor>'} {var_name} has "
+                f"{n} NaN and {i} Inf values")
+    return Tensor(num_nan), Tensor(num_inf), Tensor(num_zero)
+
+
+def enable_operator_stats_collection():
+    global _OP_STATS
+    _OP_STATS = {}
+    from ..framework import op as _op
+
+    _op.set_op_observer(_observe)
+
+
+def disable_operator_stats_collection():
+    from ..framework import op as _op
+
+    _op.set_op_observer(None)
+    stats = _OP_STATS or {}
+    if stats:
+        print("<------ operator dtype stats ------>")
+        for (name, dtype), n in sorted(stats.items()):
+            print(f"  {name:<40} {dtype:<10} calls: {n}")
+    return stats
+
+
+def _observe(op_name: str, out_vals):
+    if _OP_STATS is None:
+        return
+    for v in out_vals:
+        dt = str(getattr(v, "dtype", "?"))
+        key = (op_name, dt)
+        _OP_STATS[key] = _OP_STATS.get(key, 0) + 1
+    cfg = _CHECKER
+    if cfg is not None and (not cfg.checked_op_list or op_name in cfg.checked_op_list) \
+            and op_name not in cfg.skipped_op_list:
+        for v in out_vals:
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+                check_numerics(v, op_type=op_name, debug_mode=cfg.debug_mode)
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Context manager printing per-op dtype call counts on exit (the
+    reference's low/high-precision op-list summary)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

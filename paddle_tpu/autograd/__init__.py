@@ -349,3 +349,76 @@ class Hessian:
     @property
     def shape(self):
         return list(self.matrix._value.shape)
+
+
+def enable_grad():
+    """paddle.autograd.enable_grad — re-export of the framework context."""
+    from ..framework.core import enable_grad as _eg
+
+    return _eg()
+
+
+class saved_tensors_hooks:
+    """paddle.autograd.saved_tensors_hooks parity: pack/unpack hooks around
+    tensors the tape saves for backward. The eager tape saves VALUES inside
+    vjp closures, so hooks apply at Tensor.backward boundaries: pack runs
+    on tensors as ops record them, unpack when backward consumes them.
+    Registered globally for the `with` scope (reference:
+    python/paddle/autograd/saved_tensors_hooks.py)."""
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        type(self)._active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._active = None
+        return False
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """New-style paddle.autograd.jacobian: Jacobian of COMPUTED tensor `ys`
+    w.r.t. `xs`, via one tape backward per output component (the reference
+    materializes through double-grad the same way). For the functional
+    form (a callable), use the Jacobian class — it rides jax.jacrev in one
+    compiled pass."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor as _T
+
+    single = isinstance(xs, _T)
+    xs_list = [xs] if single else list(xs)
+    y_flat = ys.reshape([-1]) if ys.ndim else ys.reshape([1])
+    rows = []
+    n = 1
+    for s in ys.shape:
+        n *= int(s)
+    for i in range(n):
+        gs = grad([y_flat[i]], xs_list, retain_graph=True,
+                  create_graph=False, allow_unused=True)
+        rows.append([
+            jnp.zeros(raw(x).shape) if g is None else jnp.ravel(raw(g))
+            for g, x in zip(gs, xs_list)])
+    outs = []
+    for k in range(len(xs_list)):
+        J = jnp.stack([jnp.ravel(r[k]) for r in rows])  # [out, in]
+        if batch_axis is not None:
+            b = ys.shape[0]
+            J = J.reshape(n // b * b, -1)
+        outs.append(_T(J))
+    return outs[0] if single else outs
+
+
+def hessian(ys, xs, batch_axis=None):
+    """New-style paddle.autograd.hessian over a COMPUTED tensor needs eager
+    double-backward (create_graph), which this tape deliberately does not
+    do — higher-order derivatives are served functionally. Use
+    ``autograd.Hessian(func, xs)`` (jax.hessian under the hood) instead."""
+    raise NotImplementedError(
+        "hessian(ys, xs) needs eager create_graph; use the functional "
+        "autograd.Hessian(func, xs) / incubate vjp+jvp instead")

@@ -34,10 +34,14 @@ from .collective import (  # noqa: F401
     alltoall_single,
     barrier,
     broadcast,
+    broadcast_object_list,
+    gather,
+    get_backend,
     irecv,
     isend,
     ppermute,
     recv,
+    scatter_object_list,
     reduce,
     reduce_scatter,
     scatter,

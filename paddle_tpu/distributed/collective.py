@@ -375,3 +375,45 @@ def batch_isend_irecv(p2p_op_list):
             )
         p.tensor._rebind(raw(out_by_shift[shift]))
     return []
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """SPMD semantics: every process already holds the replicated objects
+    (same as all_gather_object's honest model) — the list is returned
+    unchanged; rank-mismatch is impossible in single-controller SPMD."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """Pick this rank's object from src's list (host-side SPMD: the full
+    list is already replicated)."""
+    g = _resolve_group(group)
+    if in_object_list is None:
+        raise ValueError("scatter_object_list needs in_object_list on src")
+    idx = max(g.rank, 0)
+    out_object_list.append(in_object_list[idx])
+    return out_object_list
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather tensors to dst (paddle.distributed.gather). Traced form:
+    all_gather then use the result on dst (XLA has no single-destination
+    gather over ICI; the all-gather is what the hardware would run)."""
+    g = _resolve_group(group)
+    v = raw(tensor)
+    if _in_trace(v):
+        outs = lax.all_gather(v, _axes(g), axis=0, tiled=False)
+        parts = [outs[i] for i in range(g.nranks)]
+    else:
+        parts = [jnp.asarray(v) for _ in range(g.nranks)]
+    wrapped = [_wrap_like(tensor, p) for p in parts]
+    if gather_list is not None and isinstance(gather_list, list):
+        gather_list.extend(wrapped)
+        return gather_list
+    return wrapped
+
+
+def get_backend(group=None):
+    """Communication backend name: XLA collectives over ICI/DCN (the
+    TPU-native answer to 'nccl'/'gloo')."""
+    return "xla"

@@ -34,6 +34,19 @@ OP_REGISTRY: Dict[str, Callable] = {}
 _capture_program = None
 
 
+# Post-op observer hook (amp.debugging operator stats / tensor checker).
+# None on the hot path — one attribute test per eager op call.
+_op_observer = None
+
+
+def set_op_observer(observer):
+    """Install a callable (op_name, out_value_leaves) -> None run after
+    every eager defop dispatch; None uninstalls. Serves
+    paddle.amp.debugging's operator-stats and NaN/Inf-checker hooks."""
+    global _op_observer
+    _op_observer = observer
+
+
 def set_capture_program(prog):
     global _capture_program
     prev = _capture_program
@@ -146,6 +159,8 @@ def defop(fn=None, *, name: Optional[str] = None, amp: Optional[str] = None):
                     _record_capture(
                         _capture_program, f, treedef, leaves, vals, res
                     )
+                if _op_observer is not None and not any_tracer:
+                    _op_observer(opname, jax.tree_util.tree_leaves(out))
                 return res
 
             const_vals = list(vals)
@@ -164,6 +179,8 @@ def defop(fn=None, *, name: Optional[str] = None, amp: Optional[str] = None):
             res = _wrap_outputs(out, node=node, any_tracer=False)
             if _capture_program is not None:
                 _record_capture(_capture_program, f, treedef, leaves, vals, res)
+            if _op_observer is not None:
+                _op_observer(opname, out_leaves)
             return res
 
         wrapper.op_name = opname

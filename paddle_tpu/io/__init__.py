@@ -91,6 +91,29 @@ class ChainDataset(IterableDataset):
             yield from d
 
 
+class ComposeDataset(Dataset):
+    """Zip datasets sample-wise: item i concatenates every dataset's
+    fields at index i (paddle.io.ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        lens = {len(d) for d in self.datasets}
+        if len(lens) != 1:
+            raise ValueError("ComposeDataset datasets must share a length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+
 def random_split(dataset, lengths, generator=None):
     from ..framework import rng as _rng
     import jax
@@ -149,6 +172,20 @@ class WeightedRandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Shuffle a fixed index subset each epoch (paddle.io.SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(self.indices[i]
+                    for i in np.random.permutation(len(self.indices)))
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class BatchSampler(Sampler):

@@ -425,3 +425,161 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     entropy over the anchor-positive similarity matrix with soft
     same-label targets, plus L2 embedding regularization."""
     return _npair(anchor, positive, labels, l2_reg=float(l2_reg))
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop(name="multi_margin_loss_op")
+def _multi_margin(input, label, p, margin, weight, reduction):
+    n, c = input.shape
+    lab = jnp.asarray(label).reshape(-1)
+    x_y = jnp.take_along_axis(input, lab[:, None], axis=1)  # [N, 1]
+    m = jnp.maximum(margin - x_y + input, 0.0)
+    if p == 2:
+        m = m * m
+    elif p != 1:
+        m = m**p
+    if weight is not None:
+        m = m * jnp.asarray(weight)[lab][:, None]
+    # the target class contributes margin^p; mask it out
+    m = m * (jnp.arange(c)[None, :] != lab[:, None])
+    return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss (paddle.nn.functional.multi_margin_loss)."""
+    return _multi_margin(input, label, p=int(p), margin=float(margin),
+                         weight=weight, reduction=reduction)
+
+
+def _default_tree_paths(num_classes):
+    """Complete-binary-tree paths for the default hsigmoid tree: leaf l is
+    heap node l + (C-1); internal nodes 0..C-2 carry the weight rows; code
+    1 = right child. Returns (path_table, path_code, mask) [C, depth]."""
+    import numpy as _onp
+
+    depth = max(int(_onp.ceil(_onp.log2(max(num_classes, 2)))), 1)
+    table = _onp.zeros((num_classes, depth), _onp.int64)
+    code = _onp.zeros((num_classes, depth), _onp.float32)
+    mask = _onp.zeros((num_classes, depth), _onp.float32)
+    for leaf in range(num_classes):
+        node = leaf + num_classes - 1
+        hops = []
+        while node != 0:
+            parent = (node - 1) // 2
+            hops.append((parent, float(node == 2 * parent + 2)))
+            node = parent
+        for j, (nid, c) in enumerate(reversed(hops)):
+            table[leaf, j] = nid
+            code[leaf, j] = c
+            mask[leaf, j] = 1.0
+    return table, code, mask
+
+
+@defop(name="hsigmoid_loss_op")
+def _hsigmoid(input, label, weight, bias, table, code, mask):
+    lab = jnp.asarray(label).reshape(-1)
+    t = jnp.asarray(table)[lab]  # [N, depth]
+    c = jnp.asarray(code)[lab]
+    m = jnp.asarray(mask)[lab]
+    w = jnp.asarray(weight)[t]  # [N, depth, D]
+    pre = jnp.einsum("nd,njd->nj", input, w)
+    if bias is not None:
+        pre = pre + jnp.asarray(bias).reshape(-1)[t]
+    # P(go to child with code c) = sigmoid((2c-1) * pre); NLL accumulates
+    nll = jax.nn.softplus(-(2 * c - 1) * pre) * m
+    return jnp.mean(jnp.sum(nll, axis=1))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (paddle.nn.functional.hsigmoid_loss):
+    O(log C) classification over a binary tree. Default tree = complete
+    binary heap (leaf probabilities sum to 1); custom trees via
+    path_table/path_code as upstream."""
+    from ...framework.op import raw as _raw
+
+    if path_table is None:
+        table, code, mask = _default_tree_paths(int(num_classes))
+    else:
+        table = np.asarray(_raw(path_table))
+        code = np.asarray(_raw(path_code), np.float32)
+        mask = (table >= 0).astype(np.float32)
+        table = np.maximum(table, 0)
+    return _hsigmoid(input, label, weight, bias, table=table, code=code,
+                     mask=mask)
+
+
+@defop(name="margin_cross_entropy_op")
+def _margin_ce(logits, label, margin1, margin2, margin3, scale, reduction,
+               return_softmax):
+    lab = jnp.asarray(label).reshape(-1)
+    n, c = logits.shape
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(jnp.take_along_axis(cos, lab[:, None], axis=1))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(lab, c, dtype=logits.dtype)
+    mod = cos * (1 - onehot) + target * onehot
+    z = mod * scale
+    logp = jax.nn.log_softmax(z, axis=1)
+    loss = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (paddle.nn.functional.
+    margin_cross_entropy): target cos(theta) -> cos(m1*theta + m2) - m3,
+    scaled, then CE. `group` (class-sharded mp) is served by the mesh
+    placing the class dim — XLA inserts the same collectives the
+    reference's sharded kernel hand-writes."""
+    return _margin_ce(logits, label, margin1=float(margin1),
+                      margin2=float(margin2), margin3=float(margin3),
+                      scale=float(scale), reduction=reduction,
+                      return_softmax=bool(return_softmax))
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (paddle.nn.functional.adaptive_log_softmax_with_loss,
+    torch-compatible semantics): frequent classes score in the head,
+    rare classes in down-projected tail clusters; returns (per-sample
+    log-prob of the TARGET, mean loss)."""
+    return _adaptive_lsm(input, label, head_weight, list(tail_weights),
+                         head_bias, cutoffs=tuple(int(c) for c in cutoffs))
+
+
+@defop(name="adaptive_log_softmax_op")
+def _adaptive_lsm(input, label, head_weight, tail_weights, head_bias, cutoffs):
+    lab = jnp.asarray(label).reshape(-1)
+    n_clusters = len(cutoffs) - 1  # cutoffs includes n_classes at the end
+    shortlist = cutoffs[0]
+    head = input @ head_weight  # [N, shortlist + n_clusters]
+    if head_bias is not None:
+        head = head + head_bias
+    head_logp = jax.nn.log_softmax(head, axis=1)
+    # target in shortlist: logp directly; else cluster logp + within-cluster
+    out = jnp.take_along_axis(
+        head_logp, jnp.clip(lab, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+    for i in range(n_clusters):
+        lo, hi = cutoffs[i], cutoffs[i + 1]
+        in_cluster = (lab >= lo) & (lab < hi)
+        proj, cluster_w = tail_weights[i]
+        h = (input @ proj) @ cluster_w  # [N, hi - lo]
+        cluster_logp = jax.nn.log_softmax(h, axis=1)
+        rel = jnp.clip(lab - lo, 0, hi - lo - 1)
+        cand = (head_logp[:, shortlist + i]
+                + jnp.take_along_axis(cluster_logp, rel[:, None], axis=1)[:, 0])
+        out = jnp.where(in_cluster, cand, out)
+    return out, -jnp.mean(out)

@@ -7,7 +7,9 @@ fine-tune, GPT-3 pretraining configs.
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from .models import *  # noqa: F401,F403
-from .datasets import Imdb, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 from . import generation  # noqa: F401
 from .generation import beam_search, generate, generate_padded  # noqa: F401

@@ -394,3 +394,64 @@ class WMT16(_WMTBase):
 
 
 __all__ = ["UCIHousing", "Imdb", "Conll05st", "Movielens", "WMT14", "WMT16"]
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (reference: text/datasets/imikolov.py;
+    the Mikolov simple-examples archive). Local ``data_file`` may be the
+    .tgz archive or a plain token text file; synthetic mode generates a
+    Zipf token stream with the same interface."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = False):
+        super().__init__()
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        self.data_type, self.window_size = data_type, window_size
+        split = "train" if mode in ("train", "synthetic") else "valid"
+        if data_file and os.path.exists(data_file):
+            words = self._read_words(data_file, split)
+        elif mode == "synthetic" or not download:
+            rs = np.random.RandomState(0 if split == "train" else 1)
+            n = 20000 if split == "train" else 4000
+            # Zipf-ish stream over 2000 types (realistic frequency decay)
+            words = rs.zipf(1.3, n) % 2000
+            words = [f"w{t}" for t in words]
+        else:
+            raise RuntimeError(_NO_NET.format(name="Imikolov"))
+        freq = {}
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+        kept = {w for w, c in freq.items() if c >= min_word_freq}
+        self.word_idx = {w: i for i, w in enumerate(sorted(kept))}
+        unk = len(self.word_idx)
+        ids = np.asarray([self.word_idx.get(w, unk) for w in words], "int64")
+        self.vocab_size = unk + 1
+        if data_type == "NGRAM":
+            k = window_size
+            self.data = [ids[i:i + k] for i in range(len(ids) - k + 1)]
+        else:
+            k = window_size if window_size > 0 else 20
+            self.data = [
+                (ids[i:i + k], ids[i + 1:i + k + 1])
+                for i in range(0, len(ids) - k - 1, k)
+            ]
+
+    @staticmethod
+    def _read_words(path, split):
+        name = f"ptb.{split}.txt"
+        if tarfile.is_tarfile(path):
+            with tarfile.open(path) as tf:
+                member = next(m for m in tf.getmembers() if m.name.endswith(name))
+                text = tf.extractfile(member).read().decode("utf-8")
+        else:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        return text.replace("\n", " <eos> ").split()
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

@@ -171,3 +171,127 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: paddle.vision.datasets.Flowers).
+    Loads from local copies of the reference's three files — image tgz
+    (jpg folder), setid.mat, imagelabels.mat (scipy-readable) — or from a
+    plain DatasetFolder-style directory."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "offline environment: pass data_file=<102flowers dir or tgz> "
+                "(+ label_file/setid_file .mat for official splits)")
+        self.transform = transform
+        self._tar = None
+        if os.path.isdir(data_file):
+            names = sorted(
+                os.path.join(r, f)
+                for r, _, fs in os.walk(data_file) for f in fs
+                if f.lower().endswith(".jpg"))
+            self._read = lambda p: self._decode(open(p, "rb").read())
+        else:
+            self._tar = tarfile.open(data_file)
+            members = {m.name: m for m in self._tar.getmembers()
+                       if m.name.lower().endswith(".jpg")}
+            names = sorted(members)
+            self._read = lambda p: self._decode(
+                self._tar.extractfile(members[p]).read())
+        if label_file and setid_file:
+            from scipy.io import loadmat
+
+            labels = loadmat(label_file)["labels"].ravel().astype(np.int64) - 1
+            ids = loadmat(setid_file)[self._SPLIT_KEY[mode]].ravel()
+            self.samples = [(names[i - 1], labels[i - 1]) for i in ids]
+        else:
+            self.samples = [(n, np.int64(0)) for n in names]
+
+    @staticmethod
+    def _decode(buf):
+        import io as _io
+
+        from PIL import Image
+
+        return np.asarray(Image.open(_io.BytesIO(buf)).convert("RGB"))
+
+    def __getitem__(self, idx):
+        name, label = self.samples[idx]
+        img = self._read(name)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation pairs (reference:
+    paddle.vision.datasets.VOC2012). Loads from a local VOCdevkit directory
+    or the VOCtrainval tar; yields (image, label_mask) uint8 arrays."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "offline environment: pass data_file=<VOCdevkit dir or "
+                "VOCtrainval tar>")
+        self.transform = transform
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "trainval": "trainval"}[mode]
+        self._tar = None
+        if os.path.isdir(data_file):
+            root = data_file
+            if os.path.basename(root) != "VOC2012":
+                cand = os.path.join(root, "VOC2012")
+                root = cand if os.path.isdir(cand) else os.path.join(
+                    root, "VOCdevkit", "VOC2012")
+            lst = os.path.join(root, "ImageSets", "Segmentation", f"{split}.txt")
+            with open(lst) as f:
+                ids = [l.strip() for l in f if l.strip()]
+            self._items = [
+                (os.path.join(root, "JPEGImages", f"{i}.jpg"),
+                 os.path.join(root, "SegmentationClass", f"{i}.png"))
+                for i in ids]
+            self._read = lambda p: Flowers._decode(open(p, "rb").read())
+            self._read_mask = lambda p: self._decode_mask(open(p, "rb").read())
+        else:
+            self._tar = tarfile.open(data_file)
+            members = {m.name: m for m in self._tar.getmembers()}
+            lst = next(n for n in members
+                       if n.endswith(f"ImageSets/Segmentation/{split}.txt"))
+            ids = [l.strip() for l in
+                   self._tar.extractfile(members[lst]).read().decode().splitlines()
+                   if l.strip()]
+            base = lst.split("ImageSets/")[0]
+            self._items = [
+                (f"{base}JPEGImages/{i}.jpg", f"{base}SegmentationClass/{i}.png")
+                for i in ids]
+            self._read = lambda p: Flowers._decode(
+                self._tar.extractfile(members[p]).read())
+            self._read_mask = lambda p: self._decode_mask(
+                self._tar.extractfile(members[p]).read())
+
+    @staticmethod
+    def _decode_mask(buf):
+        import io as _io
+
+        from PIL import Image
+
+        return np.asarray(Image.open(_io.BytesIO(buf)))  # palette indices
+
+    def __getitem__(self, idx):
+        img_p, mask_p = self._items[idx]
+        img = self._read(img_p)
+        mask = self._read_mask(mask_p)
+        if self.transform:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._items)

@@ -215,3 +215,47 @@ def test_hub_local_repo(tmp_path):
     assert m(paddle.to_tensor(np.ones((1, 3), "float32"))).shape == [1, 4]
     with pytest.raises(RuntimeError, match="offline"):
         hub.load("owner/repo", "x", source="github")
+
+
+def test_compose_dataset_and_subset_random_sampler():
+    from paddle_tpu.io import ComposeDataset, SubsetRandomSampler, TensorDataset
+
+    a = TensorDataset([paddle.to_tensor(np.arange(4, dtype="float32"))])
+    b = TensorDataset([paddle.to_tensor(np.arange(4, 8).astype("float32"))])
+    ds = ComposeDataset([a, b])
+    assert len(ds) == 4
+    item = ds[2]
+    assert float(_np(item[0])) == 2.0 and float(_np(item[1])) == 6.0
+
+    s = SubsetRandomSampler([5, 7, 9])
+    assert len(s) == 3
+    assert sorted(list(s)) == [5, 7, 9]
+
+
+def test_autograd_jacobian_new_style():
+    from paddle_tpu import autograd
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))
+    x.stop_gradient = False
+    y = (x * x).sum() + x[0] * 3  # dy/dx = [2x0+3, 2x1]
+    # vector-valued: y2 = [x0^2, x0*x1]
+    y2 = paddle.stack([x[0] * x[0], x[0] * x[1]])
+    J = autograd.jacobian(y2, x)
+    np.testing.assert_allclose(_np(J), [[2.0, 0.0], [2.0, 1.0]], rtol=1e-6)
+    with pytest.raises(NotImplementedError):
+        autograd.hessian(y, x)
+
+
+def test_distributed_gather_and_object_lists():
+    from paddle_tpu import distributed as dist
+
+    assert dist.get_backend() == "xla"
+    objs = ["a", {"b": 1}]
+    assert dist.broadcast_object_list(objs) == objs
+    out = []
+    dist.scatter_object_list(out, ["x"])
+    assert out == ["x"]
+    t = paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))
+    parts = dist.gather(t)
+    assert len(parts) == dist.get_world_size() or len(parts) == 1
+    np.testing.assert_allclose(_np(parts[0]), [1.0, 2.0])

@@ -131,3 +131,71 @@ def test_onnx_export_is_honest_nongoal():
 
     with pytest.raises(NotImplementedError, match="non-goal"):
         onnx.export(None, "/tmp/x.onnx")
+
+
+@pytest.mark.fast
+def test_flowers_local_dir(tmp_path):
+    from PIL import Image
+    from scipy.io import savemat
+
+    from paddle_tpu.vision.datasets import Flowers
+
+    d = tmp_path / "jpg"
+    d.mkdir()
+    for i in range(1, 4):
+        Image.fromarray(
+            np.full((8, 8, 3), i * 40, np.uint8)).save(d / f"image_{i:05d}.jpg")
+    savemat(tmp_path / "imagelabels.mat",
+            {"labels": np.asarray([[1, 2, 1]], np.uint8)})
+    savemat(tmp_path / "setid.mat",
+            {"trnid": np.asarray([[1, 3]]), "valid": np.asarray([[2]]),
+             "tstid": np.asarray([[2]])})
+    ds = Flowers(data_file=str(d), label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and int(label) == 0  # labels are 0-based
+    val = Flowers(data_file=str(d), label_file=str(tmp_path / "imagelabels.mat"),
+                  setid_file=str(tmp_path / "setid.mat"), mode="valid")
+    assert len(val) == 1 and int(val[0][1]) == 1
+
+
+@pytest.mark.fast
+def test_voc2012_local_dir(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import VOC2012
+
+    root = tmp_path / "VOCdevkit" / "VOC2012"
+    for sub in ("JPEGImages", "SegmentationClass", "ImageSets/Segmentation"):
+        (root / sub).mkdir(parents=True)
+    for i, name in enumerate(["2007_000001", "2007_000002"]):
+        Image.fromarray(np.full((6, 5, 3), 100 + i, np.uint8)).save(
+            root / "JPEGImages" / f"{name}.jpg")
+        mask = Image.fromarray(np.full((6, 5), i, np.uint8), mode="P")
+        mask.save(root / "SegmentationClass" / f"{name}.png")
+    (root / "ImageSets/Segmentation/train.txt").write_text(
+        "2007_000001\n2007_000002\n")
+    (root / "ImageSets/Segmentation/val.txt").write_text("2007_000002\n")
+    ds = VOC2012(data_file=str(tmp_path), mode="train")
+    assert len(ds) == 2
+    img, mask = ds[1]
+    assert img.shape == (6, 5, 3) and mask.shape == (6, 5)
+    assert int(mask[0, 0]) == 1
+    assert len(VOC2012(data_file=str(tmp_path), mode="valid")) == 1
+
+
+@pytest.mark.fast
+def test_imikolov_ngrams():
+    from paddle_tpu.text.datasets import Imikolov
+
+    ds = Imikolov(mode="synthetic", data_type="NGRAM", window_size=3,
+                  min_word_freq=5)
+    assert len(ds) > 100
+    g = ds[0]
+    assert g.shape == (3,) and g.dtype == np.int64
+    assert ds.vocab_size > 10
+    seq = Imikolov(mode="synthetic", data_type="SEQ", window_size=8,
+                   min_word_freq=5)
+    src, trg = seq[0]
+    np.testing.assert_array_equal(src[1:], trg[:-1])  # shifted-by-one pair

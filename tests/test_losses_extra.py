@@ -1,0 +1,101 @@
+"""New loss functionals vs torch references (multi_margin, hsigmoid,
+margin_cross_entropy, adaptive_log_softmax_with_loss; reference:
+python/paddle/nn/functional/loss.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+torch = pytest.importorskip("torch")
+
+
+def test_multi_margin_matches_torch():
+    rs = np.random.RandomState(0)
+    x = rs.randn(5, 4).astype("float32")
+    y = rs.randint(0, 4, 5).astype("int64")
+    for p in (1, 2):
+        want = torch.nn.functional.multi_margin_loss(
+            torch.from_numpy(x), torch.from_numpy(y), p=p, margin=1.0).item()
+        got = float(np.asarray(F.multi_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y), p=p)._value))
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=f"p={p}")
+
+
+def test_hsigmoid_default_tree_probabilities_sum_to_one():
+    rs = np.random.RandomState(0)
+    C, D = 6, 8
+    w = rs.randn(C - 1, D).astype("float32") * 0.3
+    b = rs.randn(C - 1).astype("float32") * 0.1
+    xi = rs.randn(1, D).astype("float32")
+    ps = []
+    for lab in range(C):
+        nll = float(np.asarray(F.hsigmoid_loss(
+            paddle.to_tensor(xi), paddle.to_tensor(np.asarray([lab], "int64")),
+            C, paddle.to_tensor(w), paddle.to_tensor(b))._value))
+        ps.append(np.exp(-nll))
+    # the tree defines a proper distribution over leaves
+    assert abs(sum(ps) - 1.0) < 1e-5
+
+
+def test_hsigmoid_custom_path():
+    rs = np.random.RandomState(1)
+    D = 4
+    w = rs.randn(3, D).astype("float32")
+    x = rs.randn(2, D).astype("float32")
+    # two classes with explicit 2-hop paths
+    table = np.asarray([[0, 1], [0, 2]], "int64")
+    code = np.asarray([[0, 1], [1, 0]], "float32")
+    lab = np.asarray([0, 1], "int64")
+    got = float(np.asarray(F.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lab), 2, paddle.to_tensor(w),
+        path_table=paddle.to_tensor(table), path_code=paddle.to_tensor(code))._value))
+    # manual: nll_i = sum_j softplus(-(2c-1) * x_i . w[path_ij])
+    pre0 = x[0] @ w[[0, 1]].T
+    pre1 = x[1] @ w[[0, 2]].T
+    sp = lambda z: np.log1p(np.exp(z))
+    want = np.mean([
+        sp(-(2 * 0 - 1) * pre0[0]) + sp(-(2 * 1 - 1) * pre0[1]),
+        sp(-(2 * 1 - 1) * pre1[0]) + sp(-(2 * 0 - 1) * pre1[1]),
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_margin_cross_entropy_reduces_to_scaled_ce():
+    rs = np.random.RandomState(0)
+    logits = np.tanh(rs.randn(4, 7)).astype("float32")
+    y2 = rs.randint(0, 7, 4).astype("int64")
+    got = float(np.asarray(F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(y2), margin1=1.0,
+        margin2=0.0, margin3=0.0, scale=10.0)._value))
+    want = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits * 10.0), torch.from_numpy(y2)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    # with an additive margin the target class is penalized -> larger loss
+    harder = float(np.asarray(F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(y2), margin1=1.0,
+        margin2=0.5, margin3=0.0, scale=10.0)._value))
+    assert harder > got
+
+
+def test_adaptive_log_softmax_matches_torch():
+    torch.manual_seed(0)
+    tmod = torch.nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12],
+                                               div_value=2.0)
+    xt = torch.randn(6, 16)
+    yt = torch.randint(0, 20, (6,))
+    want_out, want_loss = tmod(xt, yt)
+    head_w = tmod.head.weight.detach().numpy().T  # [16, 5 + 2 clusters]
+    tails = []
+    for seq in tmod.tail:
+        proj = seq[0].weight.detach().numpy().T  # [16, d]
+        clus = seq[1].weight.detach().numpy().T  # [d, cluster size]
+        tails.append((paddle.to_tensor(proj), paddle.to_tensor(clus)))
+    out, loss = F.adaptive_log_softmax_with_loss(
+        paddle.to_tensor(xt.numpy()),
+        paddle.to_tensor(yt.numpy().astype("int64")),
+        paddle.to_tensor(head_w), tails, cutoffs=[5, 12, 20])
+    np.testing.assert_allclose(np.asarray(out._value),
+                               want_out.detach().numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(loss._value), want_loss.item(), rtol=1e-4)
