@@ -1,6 +1,10 @@
 """paddle.nn.functional parity surface."""
 from .activation import *  # noqa: F401,F403
-from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_attention,
+    scaled_dot_product_attention,
+    sparse_attention,
+)
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403

@@ -155,3 +155,43 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     if return_softmax:
         return out, None
     return out, None
+
+
+@defop(name="sparse_attention_op")
+def _sparse_attention(q, k, v, offset, columns, key_padding_mask, attn_mask):
+    # q/k/v: [B, H, T, D] (paddle sparse_attention layout); CSR pattern
+    # [B, H, T+1] / [B, H, nnz] selects which keys each query attends to.
+    b, h, t, d = q.shape
+    nnz = columns.shape[-1]
+    pos = jnp.arange(nnz)
+    # row of each nnz entry: offset is monotone per (b, h)
+    row = jax.vmap(jax.vmap(
+        lambda off: jnp.searchsorted(off, pos, side="right") - 1))(offset)
+    mask = jnp.zeros((b, h, t, t), bool)
+    bi = jnp.arange(b)[:, None, None]
+    hi = jnp.arange(h)[None, :, None]
+    mask = mask.at[bi, hi, row, columns].set(True)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    neg = jnp.asarray(-1e30, logits.dtype)
+    logits = jnp.where(mask, logits, neg)
+    if key_padding_mask is not None:
+        logits = jnp.where(key_padding_mask[:, None, None, :] != 0, logits, neg)
+    if attn_mask is not None:
+        logits = jnp.where(attn_mask[None, None] != 0, logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # rows with an empty pattern produce zeros, not NaN
+    probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """paddle.nn.functional.sparse_attention parity: attention restricted
+    to a per-(batch, head) CSR pattern over keys. Reference: a CUDA
+    block-sparse kernel (sparse_attention op, sm>=70 only); TPU-native
+    lowering is the masked dense form — the MXU wins nothing from
+    unstructured sparsity, and XLA fuses mask+softmax+matmul into the
+    same fused attention it runs for dense."""
+    return _sparse_attention(query, key, value, sparse_csr_offset,
+                             sparse_csr_columns, key_padding_mask, attn_mask)

@@ -99,3 +99,93 @@ def test_adaptive_log_softmax_matches_torch():
     np.testing.assert_allclose(np.asarray(out._value),
                                want_out.detach().numpy(), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(float(loss._value), want_loss.item(), rtol=1e-4)
+
+
+def test_rnnt_loss_matches_bruteforce():
+    """Exact check vs full alignment enumeration (the reference tests
+    warp-transducer the same way at toy sizes)."""
+    import itertools
+
+    import jax
+
+    def brute(logp, labels, T, U, blank):
+        total = -np.inf
+        for emits_at in itertools.combinations(range(T + U), U):
+            t = u = 0
+            lp = 0.0
+            ok = True
+            for step in range(T + U):
+                if step in emits_at:
+                    if u >= U or t >= T:
+                        ok = False
+                        break
+                    lp += logp[t, u, labels[u]]
+                    u += 1
+                else:
+                    if t >= T:
+                        ok = False
+                        break
+                    lp += logp[t, u, blank]
+                    t += 1
+            if ok and u == U and t == T:
+                total = np.logaddexp(total, lp)
+        return -total
+
+    rs = np.random.RandomState(0)
+    B, T, U, V = 2, 4, 3, 5
+    logits = rs.randn(B, T, U + 1, V).astype("float32")
+    labels = rs.randint(1, V, (B, U)).astype("int32")
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    tl = np.asarray([4, 3], "int32")
+    ul = np.asarray([3, 2], "int32")
+    want = np.asarray([brute(logp[b], labels[b], tl[b], ul[b], 0)
+                       for b in range(B)])
+    got = np.asarray(F.rnnt_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(tl), paddle.to_tensor(ul), blank=0,
+        fastemit_lambda=0.0, reduction="none")._value)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    x = paddle.to_tensor(logits)
+    x.stop_gradient = False
+    loss = F.rnnt_loss(x, paddle.to_tensor(labels), paddle.to_tensor(tl),
+                       paddle.to_tensor(ul), blank=0, fastemit_lambda=0.0)
+    loss.backward()
+    g = np.asarray(x.grad._value)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_sparse_attention_matches_masked_dense():
+    rs = np.random.RandomState(1)
+    B, H, T, D = 1, 2, 6, 4
+    q = rs.randn(B, H, T, D).astype("float32")
+    k = rs.randn(B, H, T, D).astype("float32")
+    v = rs.randn(B, H, T, D).astype("float32")
+    # banded pattern: each row attends to itself and its left neighbor
+    offs, cols = [], []
+    for h in range(H):
+        o = [0]
+        c = []
+        for t in range(T):
+            row = [t] if t == 0 else [t - 1, t]
+            c.extend(row)
+            o.append(len(c))
+        offs.append(o)
+        cols.append(c)
+    offset = np.asarray([offs], "int32")   # [B, H, T+1]
+    columns = np.asarray([cols], "int32")  # [B, H, nnz]
+    out = np.asarray(F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offset), paddle.to_tensor(columns))._value)
+    # dense reference with the same mask
+    mask = np.zeros((B, H, T, T), bool)
+    for h in range(H):
+        for t in range(T):
+            for c in cols[h][offs[h][t]:offs[h][t + 1]]:
+                mask[0, h, t, c] = True
+    logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+    logits[~mask] = -1e30
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = p @ v
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
