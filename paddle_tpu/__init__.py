@@ -58,6 +58,7 @@ from . import sparse  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import audio  # noqa: E402,F401

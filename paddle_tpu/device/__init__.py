@@ -209,3 +209,26 @@ def host_memory_stats() -> dict:
     from .. import runtime
 
     return runtime.host_memory_stats()
+
+
+def get_all_device_type():
+    """Device types this build can drive (paddle.device.get_all_device_type)."""
+    import jax
+
+    out = ["cpu"]
+    try:
+        if jax.default_backend() == "tpu":
+            out.append("tpu")
+    except Exception:
+        pass
+    return out
+
+
+def get_available_device():
+    """Device strings currently visible (paddle.device.get_available_device)."""
+    import jax
+
+    try:
+        return [f"{d.platform}:{d.id}" for d in jax.devices()]
+    except Exception:
+        return ["cpu:0"]

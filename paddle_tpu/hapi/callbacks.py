@@ -258,3 +258,34 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "verbose": verbose, "metrics": metrics or [],
     })
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """Drive an optimizer.lr.ReduceOnPlateau scheduler from a monitored
+    metric at epoch end (paddle.callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, mode="auto",
+                 min_delta=1e-4, cooldown=0, min_lr=0.0, verbose=1):
+        super().__init__()
+        self.monitor = monitor
+        self._kw = dict(factor=factor, patience=patience,
+                        threshold=min_delta, cooldown=cooldown, min_lr=min_lr,
+                        mode="min" if mode in ("auto", "min") else "max")
+        self._sched = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        if isinstance(val, (list, tuple)):
+            val = val[0]
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        if self._sched is None:
+            from ..optimizer.lr import ReduceOnPlateau
+
+            lr = opt.get_lr()
+            self._sched = ReduceOnPlateau(learning_rate=lr, **self._kw)
+            opt._learning_rate = self._sched
+        self._sched.step(float(val))

@@ -172,3 +172,23 @@ def calculate_gain(nonlinearity, param=None):
         "selu": 3.0 / 4,
     }
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs
+    (paddle.nn.initializer.Bilinear)."""
+
+    def __call__(self, shape, dtype=_dtypes.float32):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        oc, ic, kh, kw = shape
+        out = np.zeros(shape, np.float32)
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cx = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        ky = 1 - np.abs(np.arange(kh) / fh - cy)
+        kx = 1 - np.abs(np.arange(kw) / fw - cx)
+        kern = ky[:, None] * kx[None, :]
+        for i in range(oc):
+            out[i, i % ic] = kern
+        return jnp.asarray(out, dtype)

@@ -315,3 +315,56 @@ __all__ = [
     "embedding", "sparse_embedding", "static_parameters",
     "cond", "case", "switch_case", "while_loop",
 ]
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    key = _auto("group_norm", name)
+    layer = _get(key, lambda: _nn.GroupNorm(groups, ch, epsilon=epsilon,
+                                            weight_attr=param_attr,
+                                            bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """static.nn.prelu: trainable negative slope ('all' = one scalar,
+    'channel' = per channel, 'element' = per element)."""
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:
+        n = 1
+        for d in x.shape[1:]:
+            n *= d
+    key = _auto("prelu", name)
+    layer = _get(key, lambda: _nn.PReLU(num_parameters=n, weight_attr=param_attr,
+                                        data_format=data_format))
+    return layer(x)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None,
+              **kwargs):
+    """static.nn.data_norm: normalization by RUNNING statistics only (no
+    learned scale/shift coupling across batch like batch_norm; the
+    reference uses it for sparse/CTR features). Served by BatchNorm with
+    affine disabled."""
+    ch = input.shape[1]
+    key = _auto("data_norm", name)
+    layer = _get(key, lambda: _nn.BatchNorm1D(ch, epsilon=epsilon,
+                                              weight_attr=False,
+                                              bias_attr=False))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def sequence_softmax(input, name=None):
+    """Softmax over the last axis (dense-tensor form of the reference's
+    LoD sequence op — LoD tensors don't exist here by design)."""
+    return _nn.functional.softmax(input, axis=-1)

@@ -1,0 +1,18 @@
+"""paddle.sysconfig parity — build include/lib discovery.
+
+Reference: ``python/paddle/sysconfig.py`` (returns the C++ header and
+shared-library directories for downstream native extensions). Here the
+native runtime lives in ``csrc/``."""
+from __future__ import annotations
+
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include():
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "csrc", "build")

@@ -88,3 +88,20 @@ def download(url, path=None, md5sum=None):
         "paddle_tpu.utils.download: this environment has no network egress; "
         "place files locally and load them directly"
     )
+
+
+def require_version(min_version: str, max_version: str = None):
+    """Check the installed framework version against bounds
+    (paddle.utils.require_version)."""
+    from .. import __version__
+
+    def key(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    if key(__version__) < key(min_version):
+        raise Exception(
+            f"version {__version__} < required minimum {min_version}")
+    if max_version is not None and key(__version__) > key(max_version):
+        raise Exception(
+            f"version {__version__} > allowed maximum {max_version}")
+    return True

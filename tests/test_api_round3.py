@@ -259,3 +259,52 @@ def test_distributed_gather_and_object_lists():
     parts = dist.gather(t)
     assert len(parts) == dist.get_world_size() or len(parts) == 1
     np.testing.assert_allclose(_np(parts[0]), [1.0, 2.0])
+
+
+def test_static_nn_extra_and_misc_namespaces():
+    paddle.disable_static()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 8, 8).astype("float32"))
+    g = static.nn.group_norm(x, groups=2)
+    assert _np(g).shape == (2, 4, 8, 8)
+    p = static.nn.prelu(x, mode="channel")
+    assert _np(p).shape == (2, 4, 8, 8)
+    flat = paddle.to_tensor(np.random.RandomState(1).randn(3, 5).astype("float32"))
+    dn = static.nn.data_norm(flat)
+    assert _np(dn).shape == (3, 5)
+    sm = static.nn.sequence_softmax(flat)
+    np.testing.assert_allclose(_np(sm).sum(-1), 1.0, rtol=1e-5)
+
+    assert paddle.sysconfig.get_include().endswith("csrc")
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0.0")
+    assert "cpu" in paddle.device.get_all_device_type()
+    assert paddle.device.get_available_device()
+
+    init = paddle.nn.initializer.Bilinear()
+    w = np.asarray(init([2, 2, 4, 4]))
+    assert w.shape == (2, 2, 4, 4)
+    # bilinear kernel: symmetric, center-peaked
+    np.testing.assert_allclose(w[0, 0], w[0, 0].T, rtol=1e-6)
+    assert w[0, 0, 1, 1] == w[0, 0].max()
+    assert w[0, 1].max() == 0  # channel-matched upsampling only
+
+
+def test_callbacks_reduce_lr_on_plateau():
+    from paddle_tpu import callbacks, nn
+
+    paddle.seed(0)
+    model = paddle.Model(nn.Linear(4, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.network.parameters())
+    model.prepare(opt, nn.MSELoss())
+    cb = callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                     min_delta=0.0)
+    cb.model = model
+    cb.on_epoch_end(0, {"loss": 1.0})
+    lr0 = opt.get_lr()
+    # no improvement for > patience epochs -> LR halves
+    cb.on_epoch_end(1, {"loss": 1.0})
+    cb.on_epoch_end(2, {"loss": 1.0})
+    cb.on_epoch_end(3, {"loss": 1.0})
+    assert opt.get_lr() <= lr0 * 0.5 + 1e-9
