@@ -189,3 +189,9 @@ def maxout(x, groups, axis=1, name=None):
 @defop(name="log_sigmoid")
 def log_sigmoid(x, name=None):
     return jax.nn.log_sigmoid(x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    """In-place ELU (paddle.nn.functional.elu_)."""
+    out = elu(x, alpha)
+    return x._rebind(out._value, out._node)

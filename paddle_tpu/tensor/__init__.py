@@ -230,6 +230,50 @@ def _patch():
               bernoulli_, log_normal_):
         setattr(T, f.__name__, f)
 
+    # generic in-place variants: run the out-of-place op, rebind the value
+    # (tape semantics identical to the reference's inplace ops: the result
+    # participates in autograd as the op's output)
+    def _inplace_of(op_name):
+        def method(self, *a, **k):
+            out = getattr(self, op_name)(*a, **k)
+            return self._rebind(out._value, out._node)
+
+        method.__name__ = op_name + "_"
+        return method
+
+    for base in ("lerp", "erfinv", "put_along_axis", "index_add",
+                 "index_put", "masked_fill", "masked_scatter", "sigmoid",
+                 "tanh", "sqrt", "rsqrt", "ceil", "floor", "round",
+                 "reciprocal", "index_copy"):
+        if hasattr(T, base):
+            setattr(T, base + "_", _inplace_of(base))
+
+    def index_copy(self, index, value, axis=0):
+        """Write rows of `value` at `index` along `axis` (torch-style
+        index_copy, exposed by paddle.Tensor)."""
+        import builtins
+
+        import jax.numpy as _jnp
+
+        idx = [builtins.slice(None)] * self.ndim
+        idx[axis] = _jnp.asarray(raw(index))
+        return Tensor(self._value.at[tuple(idx)].set(raw(value)))
+
+    if not hasattr(T, "index_copy"):
+        T.index_copy = index_copy
+        T.index_copy_ = _inplace_of("index_copy")
+
+    def apply(self, func):
+        """Apply a python callable to the tensor (paddle.Tensor.apply)."""
+        return func(self)
+
+    def apply_(self, func):
+        out = func(self)
+        return self._rebind(out._value if isinstance(out, Tensor) else out)
+
+    T.apply = apply
+    T.apply_ = apply_
+
     # device/dtype movement
     def cpu(self):
         import jax

@@ -915,3 +915,30 @@ def masked_scatter(x, mask, value, name=None):
     k = jnp.cumsum(jnp.ravel(m)) - 1
     gathered = jnp.take(src, jnp.clip(k, 0, src.shape[0] - 1), axis=0)
     return jnp.where(m, jnp.reshape(gathered, x.shape), x)
+
+
+@defop
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal construction (paddle.diag_embed): the last axis of
+    `input` becomes the (offset) diagonal of new (dim1, dim2) planes."""
+    n = input.shape[-1] + builtins.abs(offset)
+    out = jnp.zeros(input.shape[:-1] + (n, n), input.dtype)
+    if offset >= 0:
+        rows = jnp.arange(input.shape[-1])
+        cols = rows + offset
+    else:
+        cols = jnp.arange(input.shape[-1])
+        rows = cols - offset
+    out = out.at[..., rows, cols].set(input)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    return jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+
+
+@defop
+def scatter_nd(index, updates, shape, name=None):
+    """Scatter-ADD updates into zeros of `shape` (paddle.scatter_nd)."""
+    out = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(jnp.asarray(index), -1, 0))
+    return out.at[idx].add(updates)

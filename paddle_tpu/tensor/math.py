@@ -857,3 +857,54 @@ def _nanquantile(x, q, axis, keepdim):
 
 def nanquantile(x, q, axis=None, keepdim=False, name=None):
     return _nanquantile(x, raw(q), axis=_axis(axis), keepdim=keepdim)
+
+
+@defop
+def gammaln(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (paddle.gammainc)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@defop
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (paddle.gammaincc)."""
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@defop
+def multigammaln(x, p, name=None):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@defop
+def logaddexp2(x, y, name=None):
+    return jnp.logaddexp2(x, y)
+
+
+@defop(name="histc_op")
+def _histc(x, bins, min, max):
+    lo, hi = min, max
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return h
+
+
+def histc(input, bins=100, min=0, max=0, name=None):
+    """Histogram counts (paddle.histc; min==max==0 -> data range)."""
+    return _histc(input, bins=int(bins), min=float(min), max=float(max))
+
+
+def msort(x, name=None):
+    """Sort along axis 0 (paddle.msort)."""
+    return _msort_op(x)
+
+
+@defop(name="msort_op")
+def _msort_op(x):
+    return jnp.sort(x, axis=0)

@@ -308,3 +308,48 @@ def test_callbacks_reduce_lr_on_plateau():
     cb.on_epoch_end(2, {"loss": 1.0})
     cb.on_epoch_end(3, {"loss": 1.0})
     assert opt.get_lr() <= lr0 * 0.5 + 1e-9
+
+
+def test_inplace_method_tail_and_scatter_helpers():
+    import scipy.special as sp
+
+    import paddle_tpu.nn.functional as F2
+
+    t = paddle.to_tensor(np.asarray([0.25, 0.5], "float32"))
+    t.erfinv_()
+    np.testing.assert_allclose(_np(t), sp.erfinv([0.25, 0.5]), rtol=1e-4)
+    a = paddle.to_tensor(np.asarray([1.0, -2.0], "float32"))
+    a.sigmoid_()
+    np.testing.assert_allclose(_np(a), 1 / (1 + np.exp([-1.0, 2.0])), rtol=1e-5)
+    b = paddle.to_tensor(np.zeros((3, 2), "float32"))
+    b.index_copy_(paddle.to_tensor(np.asarray([0, 2])),
+                  paddle.to_tensor(np.ones((2, 2), "float32")))
+    np.testing.assert_allclose(_np(b), [[1, 1], [0, 0], [1, 1]])
+    c = paddle.to_tensor(np.asarray([1.0, 4.0], "float32"))
+    assert float(_np(c.apply(lambda v: v.sum()))) == 5.0
+    c.apply_(lambda v: v * 2)
+    np.testing.assert_allclose(_np(c), [2.0, 8.0])
+
+    np.testing.assert_allclose(
+        _np(paddle.diag_embed(paddle.to_tensor(np.asarray([[1.0, 2]], "float32")))),
+        [[[1, 0], [0, 2]]])
+    np.testing.assert_allclose(
+        _np(paddle.diag_embed(paddle.to_tensor(np.asarray([1.0], "float32")),
+                              offset=1)), [[0, 1], [0, 0]])
+    np.testing.assert_allclose(
+        _np(paddle.msort(paddle.to_tensor(np.asarray([[3.0], [1.0]], "float32")))),
+        [[1.0], [3.0]])
+    np.testing.assert_allclose(
+        _np(paddle.histc(paddle.to_tensor(
+            np.asarray([0.1, 0.9, 0.5, 0.5], "float32")), bins=2)), [1, 3])
+    np.testing.assert_allclose(
+        float(_np(paddle.gammaln(paddle.to_tensor(np.asarray(4.0, "float32"))))),
+        np.log(6.0), rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(paddle.scatter_nd(
+            paddle.to_tensor(np.asarray([[0], [1], [0]], "int64")),
+            paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], "float32")), [3])),
+        [4.0, 2.0, 0.0])
+    e = paddle.to_tensor(np.asarray([-1.0, 1.0], "float32"))
+    F2.elu_(e)
+    np.testing.assert_allclose(_np(e), [np.exp(-1) - 1, 1.0], rtol=1e-5)
