@@ -407,3 +407,57 @@ __all__ = [
     "UserDefinedRoleMaker",
     "PaddleCloudRoleMaker",
 ]
+
+
+class UtilBase:
+    """fleet.util parity (reference: fleet/utils/fleet_util.py UtilBase):
+    small cross-worker helpers over the collective runtime + a filesystem
+    handle."""
+
+    def __init__(self):
+        from .utils.fs import LocalFS
+
+        self._fs = LocalFS()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .. import collective as C
+        from ...framework.core import Tensor
+
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
+              "max": C.ReduceOp.MAX}[mode]
+        return C.all_reduce(t, op=op)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import collective as C
+        from ...framework.core import Tensor
+        import numpy as np
+
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        return C.all_gather(None, t)
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (PS data sharding)."""
+        from .. import get_rank, get_world_size
+
+        n, r = get_world_size(), max(get_rank(), 0)
+        per, extra = divmod(len(files), n)
+        start = r * per + min(r, extra)
+        return files[start: start + per + (1 if r < extra else 0)]
+
+    def set_file_system(self, fs):
+        self._fs = fs
+
+    @property
+    def fs(self):
+        return self._fs
+
+
+util = UtilBase()

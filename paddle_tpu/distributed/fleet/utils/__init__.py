@@ -1,2 +1,3 @@
 from .recompute_helper import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401

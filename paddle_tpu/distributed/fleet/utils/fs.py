@@ -1,0 +1,123 @@
+"""fleet.utils filesystem helpers (reference:
+``python/paddle/distributed/fleet/utils/fs.py`` — LocalFS + HDFSClient over
+``hadoop fs`` subprocess calls). LocalFS is fully served by the OS;
+HDFSClient shells out to a ``hadoop`` binary when one exists and raises a
+clear error otherwise (no cluster in this environment)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class LocalFS:
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for n in os.listdir(path):
+            (dirs if os.path.isdir(os.path.join(path, n)) else files).append(n)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def mv(self, src, dst, overwrite=False):
+        if os.path.exists(dst) and not overwrite:
+            raise FileExistsError(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient:
+    """`hadoop fs` wrapper; needs a hadoop binary on PATH."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._bin = (os.path.join(hadoop_home, "bin", "hadoop")
+                     if hadoop_home else shutil.which("hadoop"))
+        self._cfg = []
+        for k, v in (configs or {}).items():
+            self._cfg += ["-D", f"{k}={v}"]
+
+    def _run(self, *args):
+        if not self._bin or not os.path.exists(self._bin):
+            raise ExecuteError(
+                "HDFSClient: no hadoop binary available in this environment "
+                "(offline build); use LocalFS or mount the data locally")
+        p = subprocess.run([self._bin, "fs", *self._cfg, *args],
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise ExecuteError(p.stderr[-500:])
+        return p.stdout
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def need_upload_download(self):
+        return True

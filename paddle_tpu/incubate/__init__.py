@@ -69,3 +69,37 @@ def identity_loss(x, reduction="none"):
     if reduction in ("sum", 0):
         return x.sum()
     return x
+
+
+from .. import autograd  # noqa: E402,F401  (paddle.incubate.autograd parity:
+# jvp/vjp/Jacobian/Hessian live on the main autograd module)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, **kw):
+    """Multi-hop sampling (legacy incubate name): iterate geometric
+    sample_neighbors per hop."""
+    from ..geometric import sample_neighbors
+
+    nodes = input_nodes
+    all_nb, all_cnt = [], []
+    for k in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, nodes, sample_size=int(k))
+        all_nb.append(nb)
+        all_cnt.append(cnt)
+        nodes = nb
+    return all_nb, all_cnt
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1, **kw):
+    from ..geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes, sample_size)
+
+
+def graph_reindex(x, neighbors, count, **kw):
+    from ..geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count)
+
+
+from . import asp  # noqa: E402,F401

@@ -1,0 +1,109 @@
+"""paddle.incubate.asp parity — automatic sparsity (2:4 structured pruning).
+
+Reference: ``python/paddle/incubate/asp/`` (supported-layer registry,
+magnitude-based 1-D/2-D n:m mask calculation, optimizer decoration that
+re-applies masks after every step so pruned weights stay zero through
+training). TPU-native: masks are device arrays; the decorated optimizer
+multiplies masked params after its functional step — XLA fuses the mask
+into the update program. (The reference's Ampere sparse-tensor-core
+speedup has no TPU analogue; ASP here delivers the same MODEL sparsity
+for compression/distillation workflows.)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import raw
+
+_EXCLUDED: set = set()
+_MASKS: Dict[int, object] = {}  # id(param) -> mask jnp array
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    v = np.asarray(raw(x))
+    return float((v != 0).sum() / v.size)
+
+
+def _mask_2on4_1d(flat: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-magnitude entries of every group of 4."""
+    pad = (-len(flat)) % 4
+    v = np.concatenate([flat, np.zeros(pad, flat.dtype)]).reshape(-1, 4)
+    order = np.argsort(-np.abs(v), axis=1)
+    mask = np.zeros_like(v, bool)
+    np.put_along_axis(mask, order[:, :2], True, axis=1)
+    return mask.reshape(-1)[: len(flat)]
+
+
+def create_mask(x, func_name: str = "mask_2d_best", n: int = 2, m: int = 4):
+    """n:m magnitude mask along the input dim (paddle asp semantics)."""
+    v = np.asarray(raw(x))
+    if n != 2 or m != 4:
+        raise NotImplementedError("asp: only 2:4 masks are supported")
+    flat = v.reshape(-1) if v.ndim == 1 else v
+    if v.ndim == 1:
+        return _mask_2on4_1d(flat).reshape(v.shape)
+    rows = v.reshape(-1, v.shape[-1])
+    mask = np.stack([_mask_2on4_1d(r) for r in rows])
+    return mask.reshape(v.shape)
+
+
+def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
+    v = np.asarray(raw(x)).reshape(-1)
+    pad = (-len(v)) % m
+    groups = np.concatenate([v, np.zeros(pad, v.dtype)]).reshape(-1, m)
+    return bool(((groups != 0).sum(axis=1) <= n).all())
+
+
+def _prunable(model):
+    from ..nn import Conv2D, Linear
+
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Conv2D)) and hasattr(sub, "weight"):
+            w = sub.weight
+            if (w.name or name) in _EXCLUDED or name in _EXCLUDED:
+                continue
+            if raw(w).ndim >= 2 and raw(w).shape[-1] % 4 == 0:
+                yield name, w
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_2d_best",
+                with_mask: bool = True):
+    """Apply 2:4 magnitude pruning to every supported layer; masks are
+    remembered so a decorated optimizer keeps the pattern through training."""
+    pruned = {}
+    for name, w in _prunable(model):
+        mask = jnp.asarray(create_mask(w, mask_algo, n, m), raw(w).dtype)
+        w._rebind(raw(w) * mask)
+        if with_mask:
+            _MASKS[id(w)] = mask
+        pruned[name] = float((np.asarray(mask) != 0).mean())
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply the pruning masks after the update
+    (the reference's OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step(*a, **k):
+        out = orig_step(*a, **k)
+        for p in optimizer._parameter_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._rebind(raw(p) * mask)
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

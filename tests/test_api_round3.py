@@ -353,3 +353,83 @@ def test_inplace_method_tail_and_scatter_helpers():
     e = paddle.to_tensor(np.asarray([-1.0, 1.0], "float32"))
     F2.elu_(e)
     np.testing.assert_allclose(_np(e), [np.exp(-1) - 1, 1.0], rtol=1e-5)
+
+
+def test_asp_2to4_pruning_and_decorated_optimizer():
+    from paddle_tpu import incubate
+
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    pruned = incubate.asp.prune_model(model)
+    assert pruned  # the Linear weight qualified
+    w = model.weight
+    assert incubate.asp.check_sparsity(w)
+    assert abs(incubate.asp.calculate_density(w) - 0.5) < 0.01
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    opt = incubate.asp.decorate(opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(16, 4).astype("float32"))
+    for _ in range(3):
+        loss = nn.MSELoss()(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survived training
+    assert incubate.asp.check_sparsity(model.weight)
+    incubate.asp.reset_excluded_layers()
+
+
+def test_fused_ec_moe_and_dropout_add():
+    from paddle_tpu import incubate
+
+    paddle.seed(0)
+    moe = incubate.nn.FusedEcMoe(hidden_size=8, inter_size=16, num_experts=2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 8).astype("float32"))
+    out = moe(x)
+    assert _np(out).shape == (2, 4, 8)
+    assert np.isfinite(_np(out)).all()
+    # gradient flows to the gate (routing is differentiable via scores)
+    loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(_np(moe.gate.grad)).max() > 0
+
+    fda = incubate.nn.FusedDropoutAdd(p=0.0)
+    a = paddle.to_tensor(np.ones((2, 2), "float32"))
+    b = paddle.to_tensor(np.full((2, 2), 3.0, "float32"))
+    np.testing.assert_allclose(_np(fda(a, b)), 4.0)
+
+
+def test_fleet_util_and_fs(tmp_path):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import HDFSClient, LocalFS
+
+    fs = LocalFS()
+    d = tmp_path / "sub"
+    fs.mkdirs(str(d))
+    fs.touch(str(d / "a.txt"))
+    assert fs.is_exist(str(d / "a.txt")) and fs.is_file(str(d / "a.txt"))
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["sub"] and files == []
+    fs.mv(str(d / "a.txt"), str(d / "b.txt"))
+    assert fs.is_exist(str(d / "b.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+
+    u = fleet.UtilBase()
+    from paddle_tpu.distributed import get_rank, get_world_size
+
+    files = [f"f{i}" for i in range(5)]
+    shard = u.get_file_shard(files)
+    n, r = get_world_size(), max(get_rank(), 0)
+    per, extra = divmod(len(files), n)
+    want = files[r * per + min(r, extra):][: per + (1 if r < extra else 0)]
+    assert shard == want  # this rank's contiguous slice of the even split
+
+    import pytest as _pytest
+
+    from paddle_tpu.distributed.fleet.utils.fs import ExecuteError
+
+    client = HDFSClient()
+    with _pytest.raises(ExecuteError, match="offline|hadoop"):
+        client.mkdirs("/tmp/x")
