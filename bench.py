@@ -359,6 +359,10 @@ def main():
     for attempt in range(3):
         child_env = dict(env)
         child_env["BENCH_CHILD"] = f"{platform}|{backend_err or ''}"
+        # persistent compile cache: a retry after a mid-measure tunnel flap
+        # re-uses already-compiled programs instead of paying (and risking)
+        # every remote compile again
+        child_env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache_bench")
         try:
             p = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
