@@ -148,8 +148,12 @@ def _fwd_kernel(
         l = l_scr[:, :1]
         safe = jnp.maximum(l, 1e-30)
         o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
-        lse = jnp.where(l > 0, m_scr[:, :1] + jnp.log(safe), NEG_INF)
-        lse_ref[0] = lse[:, 0]
+        # lse_ref block is [1, block_q, 1]: per-row stats travel with a
+        # trailing singleton dim because Mosaic requires a block's last two
+        # dims to be (divisible by 8, divisible by 128) OR equal to the
+        # array dims — a (1, block_q) row block is rejected on real TPU
+        # (interpret mode does not enforce this)
+        lse_ref[0] = jnp.where(l > 0, m_scr[:, :1] + jnp.log(safe), NEG_INF)
 
 
 def _bh_kv(b, n_heads, n_kv_heads):
@@ -254,13 +258,13 @@ def _fa_forward(q, k, v, bias, causal, scale, n_heads, n_kv_heads,
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq + pad_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq + pad_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq + pad_q, 1), jnp.float32),
         ],
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         scratch_shapes=[
             _scratch((block_q, 128)),
@@ -269,7 +273,7 @@ def _fa_forward(q, k, v, bias, causal, scale, n_heads, n_kv_heads,
         ],
         interpret=interpret,
     )(*args)
-    return (o[:, :tq] if pad_q else o), lse
+    return (o[:, :tq] if pad_q else o), lse[:, :, 0]
 
 
 # ---------------------------------------------------------------- backward
@@ -317,7 +321,7 @@ def _dq_kernel(
                          (block_q, block_k))
         _, ds = _bwd_p_ds(
             q_ref[0], k_ref[0], v_ref[0], do_ref[0].astype(jnp.float32),
-            lse_ref[0][:, None], delta_ref[0][:, None], bias_ref, mask, scale,
+            lse_ref[0], delta_ref[0], bias_ref, mask, scale,
         )
         if dbias_ref is not None:
             dbias_ref[0] = ds.astype(dbias_ref.dtype)
@@ -364,7 +368,7 @@ def _dkv_kernel(
                          (block_q, block_k))
         p, ds = _bwd_p_ds(
             q_ref[0], k_ref[0], v_ref[0], do,
-            lse_ref[0][:, None], delta_ref[0][:, None], bias_ref, mask, scale,
+            lse_ref[0], delta_ref[0], bias_ref, mask, scale,
         )
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -407,6 +411,10 @@ def _fa_backward(q, k, v, bias, o, lse, do, causal, scale, n_heads,
         bias = _pad_bias(bias, pad_q, pad_k)
     if lse.shape[1] != tqp:
         lse = jnp.pad(lse, ((0, 0), (0, tqp - lse.shape[1])))
+    # per-row stats enter the kernels with a trailing singleton dim (see
+    # the forward's lse out_spec for the Mosaic tiling rule)
+    lse = lse[:, :, None]
+    delta = delta[:, :, None]
     nq, nk = tqp // block_q, tkp // block_k
     has_bias = bias is not None
     # dbias needs a per-(batch*q-head) [Tq, Tk] dS tensor in HBM — O(B*H*T^2),
@@ -420,7 +428,7 @@ def _fa_backward(q, k, v, bias, o, lse, do, causal, scale, n_heads,
         bias_b, bias_h, bias_tq, bias_tk,
     )
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     in_specs = [
         q_spec,
         pl.BlockSpec((1, block_k, d), kv_index),
@@ -489,7 +497,7 @@ def _fa_backward(q, k, v, bias, o, lse, do, causal, scale, n_heads,
 
     def row_index2(b, i, j):
         _, jj, _ = q_index2(b, i, j)
-        return (b, jj)
+        return (b, jj, 0)
 
     in_specs2 = [
         pl.BlockSpec((1, block_q, d), q_index2),
@@ -513,8 +521,8 @@ def _fa_backward(q, k, v, bias, o, lse, do, causal, scale, n_heads,
         args2.append(bias)
     in_specs2 += [
         pl.BlockSpec((1, block_q, d), q_index2),
-        pl.BlockSpec((1, block_q), row_index2),
-        pl.BlockSpec((1, block_q), row_index2),
+        pl.BlockSpec((1, block_q, 1), row_index2),
+        pl.BlockSpec((1, block_q, 1), row_index2),
     ]
     args2 += [do, lse, delta]
 
