@@ -70,22 +70,9 @@ def negotiate_rank(master: str, nnodes: int, timeout: float = 300.0):
             f"negotiate_rank: {rank + 1} processes joined a {nnodes}-node job "
             f"at {master} — stale store or wrong --nnodes"
         )
-    # Asymmetric handshake: clients finish with an acknowledged `set` (no
-    # trailing request left in flight), the master finishes with `wait`s for
-    # every client ack — so the master cannot tear the store down (by
-    # exiting) while any client still has an unanswered request. A symmetric
-    # counter barrier is racy here: the master may pass it and exit before a
-    # slow client's final wait reaches the server.
-    if rank == 0:
-        for r in range(1, nnodes):
-            store.wait(f"__launch/arrived/{r}", timeout)
-        store.set("__launch/go", b"1")
-        for r in range(1, nnodes):
-            store.wait(f"__launch/ack/{r}", timeout)
-    else:
-        store.set(f"__launch/arrived/{rank}", b"1")
-        store.wait("__launch/go", timeout)
-        store.set(f"__launch/ack/{rank}", b"1")
+    # master-closes-last rendezvous (see TCPStore.asymmetric_handshake for
+    # why a symmetric counter barrier is racy here)
+    store.asymmetric_handshake("__launch", rank, nnodes, timeout)
     return rank, store
 
 

@@ -243,6 +243,30 @@ class TCPStore:
             return self._py_fallback.delete_key(key)
         return self._lib.pt_store_del(self._h, key.encode()) == 1
 
+    def asymmetric_handshake(
+        self, ns: str, rank: int, world_size: int, timeout: float = 60.0
+    ) -> None:
+        """Rendezvous where the master (rank 0) provably finishes last.
+
+        Clients end with an acknowledged ``set`` (no request left in
+        flight); the master ends waiting for every client ack — so the
+        master, whose exit tears down the store server, cannot close while
+        any client still has an unanswered request. A symmetric counter
+        barrier is racy here (the master may pass it and exit before a
+        slow client's final wait reaches the server). Shared by the launch
+        rank negotiation and ``paddle.distributed.rpc.shutdown``.
+        """
+        if rank == 0:
+            for r in range(1, world_size):
+                self.wait(f"{ns}/arrived/{r}", timeout)
+            self.set(f"{ns}/go", b"1")
+            for r in range(1, world_size):
+                self.wait(f"{ns}/ack/{r}", timeout)
+        else:
+            self.set(f"{ns}/arrived/{rank}", b"1")
+            self.wait(f"{ns}/go", timeout)
+            self.set(f"{ns}/ack/{rank}", b"1")
+
     def barrier(self, name: str, world_size: int, timeout: float = 60.0) -> None:
         """All `world_size` participants rendezvous on `name`.
 

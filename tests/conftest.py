@@ -46,3 +46,15 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+def free_port():
+    """An OS-assigned free TCP port (shared by the multi-process
+    rendezvous/rpc tests; keep retry/SO_REUSEADDR tweaks in one place)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

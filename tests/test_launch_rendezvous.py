@@ -23,12 +23,7 @@ print(f"RANK={rank}")
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from conftest import free_port as _free_port
 
 
 @pytest.mark.parametrize("nnodes", [2, 4])

@@ -1,0 +1,88 @@
+"""paddle.distributed.rpc tests — localhost multi-process, TestDistBase
+style (SURVEY.md §4). Covers sync/async round-trips in both directions,
+worker-info queries, remote-exception propagation, and the shutdown
+barrier (reference: ``python/paddle/distributed/rpc/``)."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import sys
+import paddle_tpu.distributed.rpc as rpc
+
+master, rank, world = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rpc.init_rpc(name=f"worker{rank}", rank=rank, world_size=world,
+             master_endpoint=master)
+
+me = rpc.get_current_worker_info()
+assert me.name == f"worker{rank}" and me.rank == rank
+infos = rpc.get_all_worker_infos()
+assert [w.rank for w in infos] == list(range(world))
+assert rpc.get_worker_info("worker0").rank == 0
+
+# every worker calls every OTHER worker, sync and async
+import operator
+peers = [w.name for w in infos if w.rank != rank]
+for peer in peers:
+    assert rpc.rpc_sync(peer, operator.add, args=(rank, 100)) == rank + 100
+futs = [rpc.rpc_async(p, pow, args=(2, rank + 3)) for p in peers]
+for f in futs:
+    assert f.wait() == 2 ** (rank + 3)
+
+# remote exceptions re-raise at the caller with the original type
+if peers:
+    try:
+        rpc.rpc_sync(peers[0], operator.truediv, args=(1, 0))
+    except ZeroDivisionError:
+        pass
+    else:
+        raise AssertionError("remote ZeroDivisionError did not propagate")
+
+rpc.shutdown()
+print(f"RPC_OK={rank}")
+"""
+
+
+from conftest import free_port as _free_port
+
+
+@pytest.mark.parametrize("world", [2, 3])
+@pytest.mark.fast
+def test_rpc_roundtrip_subprocesses(world):
+    master = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, master, str(rank), str(world)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        )
+        for rank in range(world)
+    ]
+    oks = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err
+            oks += [int(l.split("=")[1]) for l in out.splitlines()
+                    if l.startswith("RPC_OK=")]
+    finally:
+        for p in procs:  # a hung/failed worker must not orphan the rest
+            if p.poll() is None:
+                p.kill()
+    assert sorted(oks) == list(range(world))
+
+
+@pytest.mark.fast
+def test_rpc_requires_init():
+    import paddle_tpu.distributed.rpc as rpc
+
+    with pytest.raises(RuntimeError, match="not initialized"):
+        rpc.rpc_sync("worker0", max, args=(1, 2))
+    with pytest.raises(RuntimeError, match="not initialized"):
+        rpc.get_current_worker_info()
+    rpc.shutdown()  # no-op when never initialized
