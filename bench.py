@@ -4,10 +4,13 @@ This is the BASELINE.json headline metric ("ERNIE-3.0 tokens/sec/chip").
 One compiled train step (fwd + bwd + AdamW) of ERNIE-3.0-base
 (12L / 768h / 12 heads) sequence classification, O2 bf16 (fp32 master
 weights), seq_len=128, on whatever single accelerator is visible (the
-driver runs this on one real TPU chip). Attention runs through the Pallas
-flash kernel (attention-prob dropout 0, the TPU-idiomatic configuration;
-hidden dropout stays 0.1) — reported as "flash_attention" in the JSON,
-with a seq-512 secondary config and a kernel-vs-XLA microbench table.
+driver runs this on one real TPU chip). Attention routing is shape-gated
+(attention-prob dropout 0, the TPU-idiomatic configuration; hidden dropout
+stays 0.1): the Pallas flash kernel serves seq>=1024 where it measures
+faster than fused XLA attention, so the seq-1024 secondary config and the
+kernel microbench exercise it; the seq-128 headline uses XLA attention.
+"flash_attention" in the JSON reports kernel availability, "flash_policy"
+the routing.
 
 Baseline anchor: the north star is ">=0.8x per-chip H100 throughput". No
 reference numbers exist in-repo (BASELINE.json published: {}), so we anchor
@@ -228,10 +231,14 @@ def _time_fn(fn, args, iters):
     return (time.perf_counter() - t0) / iters
 
 
-def _kernel_microbench(seq, batch=4, heads=16, dim=64, iters=5):
+def _kernel_microbench(seq, batch=4, heads=16, dim=64, iters=20):
     """Mosaic flash kernel vs XLA-native attention, same shapes (causal,
     bf16): fwd and fwd+bwd ms, achieved TFLOP/s, and max |diff| exactness.
-    VERDICT r2 #10."""
+    VERDICT r2 #10. Timing repeats the op INSIDE one jit (fori_loop carrying
+    q) — per-call dispatch through the tunnel backend has a ~13ms floor that
+    otherwise swamps the kernel time."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
@@ -243,24 +250,39 @@ def _kernel_microbench(seq, batch=4, heads=16, dim=64, iters=5):
         rng.standard_normal((batch, seq, heads, dim)) * 0.05, jnp.bfloat16)
     q, k, v = mk(), mk(), mk()
 
-    def loss_fa(a, b, c):
-        return flash_attention(a, b, c, causal=True).astype(jnp.float32).sum()
+    fa = lambda a, b, c: flash_attention(a, b, c, causal=True)
+    ref = lambda a, b, c: _sdpa_reference(a, b, c, None, 0.0, True, None)
 
-    def loss_ref(a, b, c):
-        return _sdpa_reference(a, b, c, None, 0.0, True, None).astype(jnp.float32).sum()
+    def fwd_loop(attn, a, b, c):
+        return jax.lax.fori_loop(
+            0, iters, lambda i, x: attn(x, b, c).astype(x.dtype), a)
 
-    fa_f = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))
-    ref_f = jax.jit(lambda a, b, c: _sdpa_reference(a, b, c, None, 0.0, True, None))
-    fa_b = jax.jit(jax.grad(loss_fa, argnums=(0, 1, 2)))
-    ref_b = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
+    def bwd_loop(attn, a, b, c):
+        # differentiate wrt q AND k AND v: grad-wrt-q-only lets XLA
+        # dead-code-eliminate the dk/dv matmuls while the Pallas custom_vjp
+        # always computes all three — an unequal comparison
+        g = jax.grad(
+            lambda x, y, z: attn(x, y, z).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))
 
-    o_fa = np.asarray(fa_f(q, k, v), np.float32)
-    o_ref = np.asarray(ref_f(q, k, v), np.float32)
+        def body(i, qkv):
+            x, y, z = qkv
+            dx, dy, dz = g(x, y, z)
+            return (x - 1e-6 * dx.astype(x.dtype),
+                    y - 1e-6 * dy.astype(y.dtype),
+                    z - 1e-6 * dz.astype(z.dtype))
+
+        return jax.lax.fori_loop(0, iters, body, (a, b, c))[0]
+
+    o_fa = np.asarray(jax.jit(fa)(q, k, v), np.float32)
+    o_ref = np.asarray(jax.jit(ref)(q, k, v), np.float32)
     max_diff = float(np.abs(o_fa - o_ref).max())
 
-    t = {name: _time_fn(fn, (q, k, v), iters)
-         for name, fn in [("pallas_fwd", fa_f), ("xla_fwd", ref_f),
-                          ("pallas_fwdbwd", fa_b), ("xla_fwdbwd", ref_b)]}
+    t = {name: _time_fn(jax.jit(functools.partial(loop, attn)), (q, k, v), 1)
+            / iters
+         for name, attn, loop in [
+             ("pallas_fwd", fa, fwd_loop), ("xla_fwd", ref, fwd_loop),
+             ("pallas_fwdbwd", fa, bwd_loop), ("xla_fwdbwd", ref, bwd_loop)]}
     # causal attention FLOPs: 2 matmuls fwd (QK^T, PV), +5 bwd; x1/2 causal
     f_fwd = 2 * 2 * batch * heads * seq * seq * dim / 2
     f_bwd = (2 + 5) * 2 * batch * heads * seq * seq * dim / 2
@@ -420,18 +442,36 @@ def _measure(platform, backend_err):
         # an "error" field — shrink so it completes in minutes, not hours
         BATCH, STEPS, WARMUP = min(BATCH, 8), min(STEPS, 2), 1
 
+    import gc
+
     import jax
 
     from paddle_tpu.nn.functional import attention as attn_mod
 
+    def _release_device_memory():
+        """Drop dead model/optimizer buffers and compiled executables
+        between phases — round-3 postmortem: three ERNIE models' states
+        accumulating in HBM drove the flash probe and seq512 config into
+        RESOURCE_EXHAUSTED."""
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+
     dev_kind = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
     peak = _peak_flops(str(dev_kind)) if platform != "cpu" else None
+
+    # probe the kernel FIRST, while HBM is empty (an OOM-poisoned probe
+    # would misreport the kernel as unavailable)
+    flash_routed = attn_mod._pallas_backend_ok()
 
     tok_s, step_s, mfu, flops, loss = _measure_config(BATCH, SEQ, STEPS, WARMUP, peak)
     if platform != "cpu" and "BENCH_BATCH" not in os.environ:
         # batch sweep: bigger batches amortize per-step overhead and fill
         # the MXU better; keep whichever sustains the higher throughput
         for b2 in (512,):
+            _release_device_memory()
             try:
                 t2, s2, m2, f2, l2 = _measure_config(b2, SEQ, STEPS, WARMUP, peak)
             except Exception:
@@ -451,21 +491,34 @@ def _measure(platform, backend_err):
         })
         return
 
-    flash_routed = attn_mod._pallas_backend_ok()
+    # seq128 routes XLA attention by design (shape-gated: the Pallas kernel
+    # wins only from seq>=1024 — see nn/functional/attention.py); the kernel
+    # itself is proven by the seq1024 config and the microbench below
+    flash_policy = (
+        "kernel available; routed for seq>=1024 (measured fwd+bwd crossover "
+        "on v5e; headline seq128 uses fused XLA attention, faster there); "
+        "the seq1024 config exercises it"
+        if flash_routed else
+        "Pallas kernel unavailable on this backend (probe failed); all "
+        "attention uses the fused XLA path"
+    )
 
-    seq512 = kernels = None
+    seq_long = kernels = None
     if platform != "cpu":
+        _release_device_memory()
         try:
-            t512, s512, m512, f512, _ = _measure_config(
-                64, 512, max(STEPS // 2, 5), 2, peak)
-            seq512 = {"tokens_per_sec": round(t512, 1),
-                      "step_time_ms": round(s512 * 1e3, 2),
-                      "mfu": round(m512, 4) if m512 else None,
-                      "batch": 64, "seq": 512}
+            tL, sL, mL, fL, _ = _measure_config(
+                32, 1024, max(STEPS // 2, 5), 2, peak)
+            seq_long = {"tokens_per_sec": round(tL, 1),
+                        "step_time_ms": round(sL * 1e3, 2),
+                        "mfu": round(mL, 4) if mL else None,
+                        "batch": 32, "seq": 1024,
+                        "flash_routed": bool(flash_routed)}
         except Exception as e:
-            seq512 = {"error": f"{type(e).__name__}: {e}"[:200]}
+            seq_long = {"error": f"{type(e).__name__}: {e}"[:200]}
         kernels = {}
-        for s in (512, 2048):
+        for s in (1024, 2048):
+            _release_device_memory()
             try:
                 kernels[f"seq{s}"] = _kernel_microbench(s)
             except Exception as e:
@@ -474,6 +527,7 @@ def _measure(platform, backend_err):
     extra = {
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flash_attention": flash_routed,
+        "flash_policy": flash_policy,
         "vs_baseline_mfu_normalized": (
             round(mfu / H100_ANCHOR_MFU, 4) if mfu is not None else None
         ),
@@ -482,7 +536,7 @@ def _measure(platform, backend_err):
         "seq": SEQ,
         "flops_per_step": flops,
         "platform": str(dev_kind),
-        "seq512": seq512,
+        "seq1024": seq_long,
         "flash_kernel_microbench": kernels,
         "note": (
             "480k tok/s baseline needs ~245 TFLOP/s for this model; v5e bf16 "

@@ -9,6 +9,7 @@ else (b) a jnp reference path that XLA still fuses well.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -95,7 +96,17 @@ def _sdpa(q, k, v, mask, key, dropout_p, causal, scale, use_pallas):
         # mask semantics on every path: never differentiated (keeps grads
         # identical between the Pallas route and the reference fallback)
         mask = jax.lax.stop_gradient(mask)
-    pallas_ok = use_pallas and dropout_p == 0.0 and (
+    # Shape gate, measured on v5e (full fwd+bwd wrt q,k,v, causal, d=64,
+    # in-jit repetition): s128 b256 pallas 12.3ms vs XLA 4.8 (0.39x);
+    # s512 b64 10.2 vs 9.2 (0.90x); s1024 b16 7.3 vs 9.4 (1.29x);
+    # s2048 b8 11.8 vs 17.8 (1.51x). Short sequences are per-grid-step
+    # overhead-bound in the kernel while the XLA softmax fuses well; from
+    # ~1k tokens the kernel wins and avoids the O(T^2) HBM logits
+    # round-trip entirely.
+    long_seq = max(q.shape[1], k.shape[1]) >= 1024 or (
+        os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"  # test hook
+    )
+    pallas_ok = use_pallas and long_seq and dropout_p == 0.0 and (
         mask is None or getattr(mask, "ndim", 0) == 4
     ) and _pallas_backend_ok()
     if pallas_ok:

@@ -43,8 +43,13 @@ try:  # pallas TPU backend is optional at import time (CPU test runs)
 except Exception:  # pragma: no cover
     pltpu = None
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on v5e (fwd TF/s at b8/s2048/h16/d64, causal): blocks 128 -> 4.1,
+# 256 -> 6.8, 512 -> 10.2, 1024 -> 12.9 (vs XLA-unfused 8.6, official jax
+# pallas kernel at its defaults 5.8). Per-grid-step overhead dominates small
+# blocks; 1024 keeps the fp32 logits tile at 4MB of VMEM and is clamped to
+# the (padded) sequence length for short inputs.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
