@@ -2,7 +2,9 @@
 (SURVEY.md §2.2 "Incubate")."""
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
 from .moe import MoELayer, global_gather, global_scatter  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 
 class distributed:  # paddle.incubate.distributed.models.moe path parity

@@ -1,0 +1,199 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage.
+
+Reference capability: ``python/paddle/incubate/optimizer/lookahead.py`` and
+``modelaverage.py``. Both are host-side wrappers around the pytree update
+rules — the inner optimizer's compiled step stays a single XLA program; the
+slow-weights / averaging math is pure jnp on the parameter leaves.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..framework.op import raw
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead optimizer (Zhang et al. 2019): wrap any inner optimizer;
+    every ``k`` fast steps, slow weights move ``alpha`` toward the fast
+    weights and the fast weights reset onto them.
+
+    Mirrors the reference wrapper API: ``step`` / ``minimize`` /
+    ``clear_grad`` / ``state_dict`` / ``set_state_dict``.
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner_optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be within [0, 1], got {alpha}")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError(f"k must be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._global_step = 0
+        # slow weights start at phi_0 = the parameters at wrap time (the
+        # paper's initialization; zeros would drag the first sync toward 0)
+        self._slow = {
+            i: jnp.asarray(raw(p), jnp.float32)
+            for i, p in enumerate(self._parameter_list)
+            if p.trainable
+        }
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._global_step += 1
+        if self._global_step % self.k:
+            return
+        for i, p in enumerate(self._parameter_list):
+            if not p.trainable:
+                continue
+            fast = raw(p)
+            slow = self._slow.get(i)
+            if slow is None:  # param became trainable after wrap
+                slow = jnp.asarray(fast, jnp.float32)
+            slow = slow + self.alpha * (fast - slow)
+            self._slow[i] = slow
+            p._rebind(slow.astype(fast.dtype))
+            # master fp32 copies (O2) must follow the rebind or the next
+            # inner step would resurrect the pre-sync fast weights
+            if getattr(self.inner_optimizer, "_use_master_weights", False):
+                if i in self.inner_optimizer._master:
+                    self.inner_optimizer._master[i] = slow.astype(jnp.float32)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        state = self.inner_optimizer.state_dict()
+        state["@lookahead_step"] = self._global_step
+        for i, s in self._slow.items():
+            state[f"@lookahead_slow_{i}"] = s
+        return state
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        self._global_step = int(state.pop("@lookahead_step", 0))
+        self._slow = {
+            int(k.rsplit("_", 1)[1]): jnp.asarray(state.pop(k))
+            for k in [k for k in state if k.startswith("@lookahead_slow_")]
+        }
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage:
+    """Polyak-style parameter averaging over a sliding window.
+
+    Accumulate with ``step()`` after every optimizer step; evaluate under
+    ``with model_average.apply():`` (parameters temporarily rebind to the
+    average) and train on via ``restore()`` semantics — same triple-sum
+    window rotation as the reference (sum_1/sum_2/sum_3 with
+    num_accumulates rolling into old_num_accumulates at the window bound).
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self._parameter_list = list(parameters)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        n = len(self._parameter_list)
+        # two-window accumulation: sum_1 is the open window, sum_3 the last
+        # closed one (the reference's sum_2 staging buffer collapses into
+        # this — the average it yields is identical)
+        self._sum_1 = [None] * n
+        self._sum_3 = [None] * n
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._restore = None
+
+    @no_grad()
+    def step(self):
+        self._num_updates += 1
+        window = max(
+            self.min_average_window,
+            min(self.max_average_window,
+                int(self._num_updates * self.average_window)),
+        )
+        if self._num_accumulates >= window:
+            # rotate: sum_3 absorbs the closed window, sum_1 restarts
+            for i in range(len(self._parameter_list)):
+                self._sum_3[i] = self._sum_1[i]
+                self._sum_1[i] = None
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+        for i, p in enumerate(self._parameter_list):
+            v = raw(p).astype(jnp.float32)
+            self._sum_1[i] = v if self._sum_1[i] is None else self._sum_1[i] + v
+        self._num_accumulates += 1
+
+    def _average(self, i):
+        total = None
+        for s in (self._sum_1[i], self._sum_3[i]):
+            if s is not None:
+                total = s if total is None else total + s
+        count = self._num_accumulates + self._old_num_accumulates
+        if total is None or count == 0:
+            return None
+        return total / count
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Rebind every tracked parameter to its running average.
+
+        ``need_restore=False`` leaves the parameters bound to the average
+        on exit; the saved fast weights are KEPT so a later manual
+        ``restore()`` still works (reference semantics).
+        """
+        if self._restore is not None:
+            raise RuntimeError("ModelAverage.apply() calls cannot nest")
+        saved = []
+        for i, p in enumerate(self._parameter_list):
+            avg = self._average(i)
+            saved.append(raw(p))
+            if avg is not None:
+                p._rebind(avg.astype(saved[-1].dtype))
+        self._restore = saved
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._restore is None:
+            return
+        for p, v in zip(self._parameter_list, self._restore):
+            p._rebind(v)
+        self._restore = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # parity shim: the reference's static-mode ModelAverage.minimize is
+        # a no-op on the loss; accumulation happens via step()
+        self.step()

@@ -277,7 +277,9 @@ class _SparseNN:
             )
 
 
-nn = _SparseNN()
+# real paddle.sparse.nn module (Conv3D/SubmConv3D/BatchNorm/pooling +
+# activations); _SparseNN.Softmax above stays the shared softmax impl
+from . import nn  # noqa: E402,F401
 
 __all__ = [
     "SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor", "to_sparse",

@@ -1,0 +1,313 @@
+"""paddle.sparse.nn — layers and functionals over SparseCooTensor.
+
+Reference capability: ``python/paddle/sparse/nn/`` (Conv3D / SubmConv3D /
+BatchNorm / activations / MaxPool3D, functional conv3d / subm_conv3d /
+max_pool3d), whose GPU path gathers rulebooks and scatters through cuSPARSE
+kernels. TPU-native design: the MXU wants dense tiles, so sparse 3-D convs
+compute on the densified block (XLA conv, which IS the fast path on TPU for
+the occupancy regimes the reference targets) and carry the sparse STRUCTURE
+exactly: a regular conv3d's output sites are the input sites dilated by the
+kernel support (computed by convolving the occupancy indicator with an
+all-ones kernel); a submanifold conv keeps the input sites unchanged.
+Values at structural sites are kept even when numerically zero — same
+contract as the reference's rulebook output.
+
+Input layout [N, D, H, W, C] (channel-last, the reference's only supported
+sparse conv layout); kernel layout [kD, kH, kW, C_in/groups, C_out].
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from .. import nn as dense_nn
+from ..framework.core import Tensor
+from ..framework.op import raw
+from ..nn import functional as F
+from ..nn import initializer as I
+
+from . import SparseTensor, _as_bcoo, relu  # noqa: F401  (re-export relu)
+
+__all__ = [
+    "Conv3D", "SubmConv3D", "BatchNorm", "MaxPool3D",
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "functional",
+]
+
+
+def _coo_from_dense_at(dense, sites_nd, sparse_shape):
+    """COO over explicit structural ``sites_nd`` [nnz, ndim-1] (values may
+    be zero there — structure is semantic, not derived from magnitude)."""
+    vals = dense[tuple(sites_nd.T)]
+    mat = jsparse.BCOO(
+        (vals, jnp.asarray(sites_nd, jnp.int32)), shape=tuple(sparse_shape)
+    )
+    return SparseTensor(mat, "coo")
+
+
+def _sites(x: SparseTensor) -> np.ndarray:
+    """Unique (n, d, h, w) active sites of a [N,D,H,W,C] sparse input.
+
+    Accepts both storage conventions: 4 sparse dims with dense [C] values
+    (the reference layout) and 5 fully-sparse dims (what ``to_sparse``
+    yields) — the channel column is dropped for the site set.
+    """
+    xb = x._mat.sum_duplicates() if x._fmt == "coo" else x._mat.to_bcoo().sum_duplicates()
+    idx = np.asarray(xb.indices)
+    if idx.shape[1] not in (4, 5):
+        raise ValueError(
+            "sparse conv expects a [N, D, H, W, C] SparseCooTensor; got "
+            f"{idx.shape[1]} sparse dims"
+        )
+    return np.unique(idx[:, :4], axis=0)
+
+
+def _triple(v):
+    return (v,) * 3 if isinstance(v, int) else tuple(v)
+
+
+def _structure_indicator(x: SparseTensor, dense_shape):
+    """Float [N,D,H,W,1] with 1.0 at every STORED site of ``x``."""
+    ind = np.zeros(tuple(dense_shape[:4]) + (1,), np.float32)
+    ind[tuple(_sites(x).T)] = 1.0
+    return jnp.asarray(ind)
+
+
+def _conv3d_impl(x, weight, bias, stride, padding, dilation, groups, subm):
+    if not isinstance(x, SparseTensor):
+        raise TypeError("sparse conv3d expects a SparseCooTensor input")
+    w = raw(weight) if hasattr(weight, "_value") or hasattr(weight, "numpy") else jnp.asarray(weight)
+    if w.ndim != 5:
+        raise ValueError("kernel must be [kD, kH, kW, C_in/groups, C_out]")
+    stride, dilation = _triple(stride), _triple(dilation)
+    if subm and (any(s != 1 for s in stride)):
+        raise ValueError("subm_conv3d requires stride 1 (sites must be preserved)")
+
+    dense = x.to_dense()  # Tensor [N, D, H, W, C]
+    # paddle dense conv kernel layout is [C_out, C_in/groups, kD, kH, kW]
+    w_dense = Tensor(jnp.transpose(w, (4, 3, 0, 1, 2)))
+    y = F.conv3d(
+        dense, w_dense, bias=bias, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, data_format="NDHWC",
+    )
+    yv = raw(y)
+
+    if subm:
+        sites = _sites(x)
+    else:
+        # occupancy indicator (1 at STORED sites — structure, not value
+        # magnitude: a structurally-stored exact-zero value still occupies
+        # its site) convolved with an all-ones kernel marks every site the
+        # kernel support can reach — the reference rulebook's structure
+        occ_in = _structure_indicator(x, raw(dense).shape)
+        kD, kH, kW = w.shape[:3]
+        ones_w = Tensor(jnp.ones((1, 1, kD, kH, kW), jnp.float32))
+        occ = F.conv3d(
+            Tensor(occ_in), ones_w, stride=stride, padding=padding,
+            dilation=dilation, data_format="NDHWC",
+        )
+        sites = np.argwhere(np.asarray(raw(occ))[..., 0] > 0)
+    return _coo_from_dense_at(yv, sites, yv.shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", key=None, name=None):
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only (matches paddle)")
+    return _conv3d_impl(x, weight, bias, stride, padding, dilation, groups, False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    if data_format != "NDHWC":
+        raise ValueError("sparse subm_conv3d supports NDHWC only (matches paddle)")
+    return _conv3d_impl(x, weight, bias, stride, padding, dilation, groups, True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only (matches paddle)")
+    if not isinstance(x, SparseTensor):
+        raise TypeError("sparse max_pool3d expects a SparseCooTensor input")
+    dense = raw(x.to_dense())
+    occ_in = _structure_indicator(x, dense.shape)
+    # the reference pools STORED values only: implicit zeros must not win
+    # (an all-negative window pools to its largest stored value, not 0), so
+    # empty positions are masked to -inf before the dense pooling
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, dense.dtype)
+    masked = jnp.where(occ_in > 0, dense, neg)
+    y = F.max_pool3d(
+        Tensor(masked), kernel_size, stride=stride, padding=padding,
+        ceil_mode=ceil_mode, data_format="NDHWC",
+    )
+    yv = raw(y)
+    occ = F.max_pool3d(
+        Tensor(occ_in), kernel_size, stride=stride, padding=padding,
+        ceil_mode=ceil_mode, data_format="NDHWC",
+    )
+    sites = np.argwhere(np.asarray(raw(occ))[..., 0] > 0)
+    return _coo_from_dense_at(yv, sites, yv.shape)
+
+
+class _SparseUnaryLayer(dense_nn.Layer):
+    def forward(self, x: SparseTensor) -> SparseTensor:
+        xb = _as_bcoo(x)
+        return SparseTensor(
+            jsparse.BCOO((self._fn(xb.data), xb.indices), shape=xb.shape), "coo"
+        )
+
+
+class ReLU(_SparseUnaryLayer):
+    _fn = staticmethod(lambda v: jnp.maximum(v, 0))
+
+
+class ReLU6(_SparseUnaryLayer):
+    _fn = staticmethod(lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(_SparseUnaryLayer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = float(negative_slope)
+
+    def forward(self, x):
+        xb = _as_bcoo(x)
+        v = jnp.where(xb.data >= 0, xb.data, self._slope * xb.data)
+        return SparseTensor(jsparse.BCOO((v, xb.indices), shape=xb.shape), "coo")
+
+
+class Softmax(dense_nn.Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        from . import _SparseNN
+
+        self._impl = _SparseNN.Softmax(axis)
+
+    def forward(self, x):
+        return self._impl(x)
+
+
+class Conv3D(dense_nn.Layer):
+    """y = sparse_conv3d(x, W) over [N,D,H,W,C]; kernel stored in the
+    reference layout [kD,kH,kW,C_in/groups,C_out]."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if padding_mode != "zeros":
+            raise NotImplementedError("sparse conv: zeros padding only")
+        kD, kH, kW = _triple(kernel_size)
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        fan_in = in_channels // groups * kD * kH * kW
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (kD, kH, kW, in_channels // groups, out_channels),
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound),
+        )
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound),
+            )
+
+    def forward(self, x):
+        fn = subm_conv3d if self._subm else conv3d
+        return fn(x, self.weight, bias=self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold conv: output sites == input sites (stride 1)."""
+
+    _subm = True
+
+
+class MaxPool3D(dense_nn.Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NDHWC"):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        k, s, p, cm = self._args
+        return max_pool3d(x, k, stride=s, padding=p, ceil_mode=cm)
+
+
+class BatchNorm(dense_nn.Layer):
+    """Channel-wise batch norm over the STORED values only (the reference
+    normalizes nnz values, not the implicit zeros)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._eps = float(momentum), float(epsilon)
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self._mean = jnp.zeros((num_features,), jnp.float32)
+        self._variance = jnp.ones((num_features,), jnp.float32)
+
+    def forward(self, x: SparseTensor) -> SparseTensor:
+        xb = _as_bcoo(x).sum_duplicates()
+        v = xb.data
+        nc = self._mean.shape[0]
+        if v.ndim == 2:
+            # reference layout: 4 sparse site dims, dense [C] values
+            chan = None
+            v32 = v.astype(jnp.float32)
+        elif v.ndim == 1:
+            # fully-sparse storage (to_sparse): channel is the last index
+            # column; per-channel stats via segment reductions
+            chan = xb.indices[:, -1].astype(jnp.int32)
+            v32 = v.astype(jnp.float32)
+        else:
+            raise ValueError("sparse BatchNorm expects [*, C] or scalar values")
+        use_global = (
+            self._use_global_stats
+            if self._use_global_stats is not None
+            else not self.training
+        )
+        if use_global:
+            mean, var = self._mean, self._variance
+        else:
+            if chan is None:
+                mean = v32.mean(0)
+                var = v32.var(0)
+            else:
+                cnt = jnp.zeros(nc, jnp.float32).at[chan].add(1.0)
+                safe = jnp.maximum(cnt, 1.0)
+                mean = jnp.zeros(nc, jnp.float32).at[chan].add(v32) / safe
+                var = jnp.zeros(nc, jnp.float32).at[chan].add(
+                    (v32 - mean[chan]) ** 2) / safe
+            m = self._momentum
+            self._mean = m * self._mean + (1 - m) * mean
+            self._variance = m * self._variance + (1 - m) * var
+        inv = 1.0 / jnp.sqrt(var + self._eps)
+        scale = (raw(self.weight) * inv).astype(v.dtype)
+        shift = raw(self.bias).astype(v.dtype)
+        if chan is None:
+            out = (v - mean.astype(v.dtype)) * scale + shift
+        else:
+            out = (v - mean[chan].astype(v.dtype)) * scale[chan] + shift[chan]
+        return SparseTensor(
+            jsparse.BCOO((out, xb.indices), shape=xb.shape), "coo")
+
+
+class functional:  # paddle.sparse.nn.functional namespace parity
+    conv3d = staticmethod(conv3d)
+    subm_conv3d = staticmethod(subm_conv3d)
+    max_pool3d = staticmethod(max_pool3d)
+    relu = staticmethod(relu)

@@ -1,0 +1,168 @@
+"""paddle.sparse.nn tests (Conv3D / SubmConv3D / BatchNorm / MaxPool3D).
+
+Reference: ``python/paddle/sparse/nn/``. Values are checked against the
+dense conv on the densified input; STRUCTURE is checked independently —
+a regular conv's output sites are the kernel-dilated input sites (kept
+even when the value there is numerically zero), a submanifold conv's
+sites equal the input sites exactly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.nn import functional as F
+
+
+def _random_sparse_input(rng, shape=(2, 5, 6, 7, 3), nnz=12):
+    n, d, h, w, c = shape
+    dense = np.zeros(shape, np.float32)
+    sites = set()
+    while len(sites) < nnz:
+        sites.add((rng.integers(n), rng.integers(d), rng.integers(h),
+                   rng.integers(w)))
+    for s in sites:
+        dense[s] = rng.standard_normal(c)
+    return dense, sorted(sites)
+
+
+def _coo(dense):
+    return sparse.to_sparse(paddle.to_tensor(dense))
+
+
+def _sparse_sites(st):
+    idx = np.asarray(st._mat.sum_duplicates().indices)[:, :4]
+    return sorted(tuple(int(i) for i in row) for row in np.unique(idx, axis=0))
+
+
+@pytest.mark.fast
+def test_subm_conv3d_values_and_structure():
+    rng = np.random.default_rng(0)
+    dense, sites = _random_sparse_input(rng)
+    st = _coo(dense)
+    conv = sparse.nn.SubmConv3D(3, 4, kernel_size=3, padding=1)
+    out = conv(st)
+
+    # structure: exactly the input sites
+    assert _sparse_sites(out) == sites
+
+    # values: dense conv3d at those sites
+    w = np.transpose(conv.weight.numpy(), (4, 3, 0, 1, 2))
+    ref = F.conv3d(
+        paddle.to_tensor(dense), paddle.to_tensor(w),
+        bias=conv.bias, padding=1, data_format="NDHWC").numpy()
+    got = np.asarray(out.to_dense())
+    for s in sites:
+        np.testing.assert_allclose(got[s], ref[s], rtol=1e-4, atol=1e-5)
+    # sites outside the structure stay implicit zeros even though the dense
+    # conv (with bias) is nonzero there
+    mask = np.ones(ref.shape[:4], bool)
+    for s in sites:
+        mask[s] = False
+    assert np.all(got[mask] == 0)
+
+
+@pytest.mark.fast
+def test_conv3d_structure_is_kernel_dilated():
+    rng = np.random.default_rng(1)
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 1, 1] = rng.standard_normal(2)  # single active site
+    st = _coo(dense)
+    out = sparse.nn.functional.conv3d(
+        st, paddle.to_tensor(rng.standard_normal((3, 3, 3, 2, 5)).astype("float32")),
+        padding=1)
+    # one site conv 3x3x3 pad 1 -> full 3x3x3 neighborhood is structural
+    expected = sorted(
+        (0, z, y, x)
+        for z in range(0, 3) for y in range(0, 3) for x in range(0, 3))
+    assert _sparse_sites(out) == expected
+
+
+@pytest.mark.fast
+def test_conv3d_stride_and_values():
+    rng = np.random.default_rng(2)
+    dense, _ = _random_sparse_input(rng, shape=(1, 6, 6, 6, 2), nnz=9)
+    st = _coo(dense)
+    w = rng.standard_normal((2, 2, 2, 2, 3)).astype("float32")
+    out = sparse.nn.functional.conv3d(st, paddle.to_tensor(w), stride=2)
+    ref = F.conv3d(
+        paddle.to_tensor(dense),
+        paddle.to_tensor(np.transpose(w, (4, 3, 0, 1, 2))),
+        stride=2, data_format="NDHWC").numpy()
+    got = np.asarray(out.to_dense())
+    for s in _sparse_sites(out):
+        np.testing.assert_allclose(got[s], ref[s], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.fast
+def test_subm_conv3d_rejects_stride():
+    rng = np.random.default_rng(3)
+    dense, _ = _random_sparse_input(rng)
+    with pytest.raises(ValueError, match="stride 1"):
+        sparse.nn.functional.subm_conv3d(
+            _coo(dense),
+            paddle.to_tensor(np.ones((3, 3, 3, 3, 4), np.float32)), stride=2)
+
+
+@pytest.mark.fast
+def test_sparse_max_pool3d():
+    rng = np.random.default_rng(4)
+    dense, sites = _random_sparse_input(rng, shape=(1, 4, 4, 4, 2), nnz=6)
+    st = _coo(dense)
+    out = sparse.nn.functional.max_pool3d(st, kernel_size=2, stride=2)
+    # reference pools STORED values only: implicit zeros must not win, so
+    # the dense reference masks empty positions to -inf first
+    masked = np.full_like(dense, np.finfo(np.float32).min)
+    for s in sites:
+        masked[s] = dense[s]
+    ref = F.max_pool3d(
+        paddle.to_tensor(masked), 2, stride=2, data_format="NDHWC").numpy()
+    got = np.asarray(out.to_dense())
+    for s in _sparse_sites(out):
+        np.testing.assert_allclose(got[s], ref[s], rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.fast
+def test_sparse_max_pool3d_all_negative_window():
+    dense = np.zeros((1, 2, 2, 2, 1), np.float32)
+    dense[0, 0, 0, 0, 0] = -2.0  # only stored value in the window
+    out = sparse.nn.functional.max_pool3d(_coo(dense), kernel_size=2, stride=2)
+    # the implicit zeros in the window must NOT win the max
+    assert np.asarray(out.to_dense())[0, 0, 0, 0, 0] == pytest.approx(-2.0)
+
+
+@pytest.mark.fast
+def test_sparse_batch_norm_train_and_eval():
+    rng = np.random.default_rng(5)
+    dense, sites = _random_sparse_input(rng, nnz=20)
+    st = _coo(dense)
+    bn = sparse.nn.BatchNorm(3)
+    bn.train()
+    out = bn(st)
+    # stored values normalized per channel (mean ~0, var ~1)
+    vals = np.asarray(out.to_dense())[tuple(np.array(sites).T)]  # [nnz, C]
+    np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(vals.var(0), 1.0, atol=1e-3)
+    assert _sparse_sites(out) == sites
+
+    bn.eval()
+    out2 = bn(st)  # running stats path; finite + same structure
+    assert np.isfinite(np.asarray(out2._mat.data)).all()
+    assert _sparse_sites(out2) == sites
+
+
+@pytest.mark.fast
+def test_sparse_activation_layers():
+    rng = np.random.default_rng(6)
+    dense, sites = _random_sparse_input(rng, nnz=8)
+    st = _coo(dense)
+    r = sparse.nn.ReLU()(st)
+    np.testing.assert_allclose(
+        np.asarray(r.to_dense()), np.maximum(dense, 0), atol=1e-6)
+    l = sparse.nn.LeakyReLU(0.1)(st)
+    np.testing.assert_allclose(
+        np.asarray(l.to_dense()),
+        np.where(dense >= 0, dense, 0.1 * dense), atol=1e-6)
+    r6 = sparse.nn.ReLU6()(st)
+    np.testing.assert_allclose(
+        np.asarray(r6.to_dense()), np.clip(dense, 0, 6), atol=1e-6)
