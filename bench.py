@@ -361,7 +361,61 @@ def _measure_config(batch, seq, steps, warmup, peak):
     return batch * seq / dt, dt, mfu, flops, final_loss
 
 
+def _phase_child(phase):
+    """Secondary phases run in their OWN processes: in-process
+    gc+clear_caches was not enough — after the headline models the tunnel
+    backend kept reporting RESOURCE_EXHAUSTED for every later compile, so
+    isolation (plus the persistent compile cache) is the reliable fix."""
+    import jax
+
+    try:
+        if phase == "seq1024":
+            dev = jax.devices()[0]
+            peak = _peak_flops(str(getattr(dev, "device_kind", dev.platform)))
+            from paddle_tpu.nn.functional import attention as attn_mod
+
+            routed = attn_mod._pallas_backend_ok()
+            t, s, m, f, _ = _measure_config(32, 1024, max(STEPS // 2, 5), 2, peak)
+            print(json.dumps({
+                "tokens_per_sec": round(t, 1),
+                "step_time_ms": round(s * 1e3, 2),
+                "mfu": round(m, 4) if m else None,
+                "batch": 32, "seq": 1024, "flash_routed": bool(routed)}))
+        elif phase.startswith("micro:"):
+            print(json.dumps(_kernel_microbench(int(phase.split(":", 1)[1]))))
+        else:
+            print(json.dumps({"error": f"unknown bench phase {phase!r}"}))
+    except Exception as e:
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}))
+
+
+def _run_phase(env, platform, phase, timeout=1500):
+    child_env = dict(env)
+    child_env["BENCH_CHILD"] = f"{platform}|"
+    child_env["BENCH_PHASE"] = phase
+    child_env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache_bench")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=child_env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"phase {phase} timed out ({timeout}s)"}
+    out = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    if not out:
+        return {"error": f"phase {phase}: no JSON (rc={p.returncode}): "
+                         f"{(p.stderr or '')[-200:]}"}
+    try:
+        return json.loads(out[-1])
+    except ValueError:
+        return {"error": f"phase {phase}: garbled JSON"}
+
+
 def _measure_child(platform, backend_err):
+    phase = os.environ.pop("BENCH_PHASE", None)
+    if phase:
+        _phase_child(phase)
+        return
     try:
         _measure(platform, backend_err)
     except Exception as e:  # OOM, compile failure, backend flap, ...
@@ -416,6 +470,13 @@ def main():
             if ok:
                 if line.get("platform") and "cpu" not in str(line["platform"]).lower() \
                         and line.get("value", 0) > 0:
+                    # secondary phases in fresh processes (HBM/compile-state
+                    # isolation from the headline's models)
+                    line["seq1024"] = _run_phase(env, platform, "seq1024")
+                    line["flash_kernel_microbench"] = {
+                        f"seq{s}": _run_phase(env, platform, f"micro:{s}")
+                        for s in (1024, 2048)
+                    }
                     _persist_last_good(line)
                     print(json.dumps(line))
                 else:
@@ -503,26 +564,10 @@ def _measure(platform, backend_err):
         "attention uses the fused XLA path"
     )
 
+    # seq1024 + kernel microbench phases run in fresh subprocesses driven
+    # by the parent (see _phase_child); placeholders keep the JSON shape
+    # when the parent cannot run them (cpu fallback)
     seq_long = kernels = None
-    if platform != "cpu":
-        _release_device_memory()
-        try:
-            tL, sL, mL, fL, _ = _measure_config(
-                32, 1024, max(STEPS // 2, 5), 2, peak)
-            seq_long = {"tokens_per_sec": round(tL, 1),
-                        "step_time_ms": round(sL * 1e3, 2),
-                        "mfu": round(mL, 4) if mL else None,
-                        "batch": 32, "seq": 1024,
-                        "flash_routed": bool(flash_routed)}
-        except Exception as e:
-            seq_long = {"error": f"{type(e).__name__}: {e}"[:200]}
-        kernels = {}
-        for s in (1024, 2048):
-            _release_device_memory()
-            try:
-                kernels[f"seq{s}"] = _kernel_microbench(s)
-            except Exception as e:
-                kernels[f"seq{s}"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     extra = {
         "mfu": round(mfu, 4) if mfu is not None else None,
