@@ -58,21 +58,25 @@ class LookAhead:
         self._global_step += 1
         if self._global_step % self.k:
             return
+        masters = getattr(self.inner_optimizer, "_master", {})
         for i, p in enumerate(self._parameter_list):
             if not p.trainable:
                 continue
-            fast = raw(p)
+            pv = raw(p)
+            # under O2 the fp32 master is the source of truth — reading the
+            # bf16 parameter would round sub-bf16 progress out of the
+            # master at every sync
+            fast = masters.get(i, pv).astype(jnp.float32)
             slow = self._slow.get(i)
             if slow is None:  # param became trainable after wrap
-                slow = jnp.asarray(fast, jnp.float32)
+                slow = fast
             slow = slow + self.alpha * (fast - slow)
             self._slow[i] = slow
-            p._rebind(slow.astype(fast.dtype))
-            # master fp32 copies (O2) must follow the rebind or the next
-            # inner step would resurrect the pre-sync fast weights
-            if getattr(self.inner_optimizer, "_use_master_weights", False):
-                if i in self.inner_optimizer._master:
-                    self.inner_optimizer._master[i] = slow.astype(jnp.float32)
+            p._rebind(slow.astype(pv.dtype))
+            # the master copy must follow the rebind or the next inner step
+            # would resurrect the pre-sync fast weights
+            if i in masters:
+                masters[i] = slow
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

@@ -242,8 +242,10 @@ class Optimizer:
 
 
 class SGD(Optimizer):
-    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._use_master_weights = bool(multi_precision)
 
     def _rule(self, p, g, st, lr):
         return p - lr * g, st
@@ -251,10 +253,11 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
-                 weight_decay=None, grad_clip=None, name=None):
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._use_master_weights = bool(multi_precision)
 
     def _init_state(self, p):
         return {"velocity": jnp.zeros_like(raw(p))}
@@ -439,6 +442,55 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return p - lr * trust * r, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Lars(Optimizer):
+    """LARS momentum (You et al. 2017): layerwise trust-ratio scaling for
+    large-batch training. Reference:
+    ``paddle/fluid/optimizer.py::LarsMomentumOptimizer`` /
+    ``lars_momentum_op`` (enabled by DistributedStrategy's lars flag).
+
+    local_lr = lr * lars_coeff * ||p|| / (||g|| + lars_weight_decay*||p||)
+    v        = momentum * v + local_lr * (g + lars_weight_decay * p)
+    p       -= v
+    Parameters matched by ``exclude_from_weight_decay`` (substring on the
+    param name, as upstream) run with lars_weight_decay = 0 but KEEP the
+    trust-ratio local lr (upstream zeroes only the decay term).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = float(momentum)
+        self._coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._epsilon = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._use_master_weights = bool(multi_precision)
+
+    def _init_state(self, p):
+        st = {"velocity": jnp.zeros_like(raw(p))}
+        if any(s in (p.name or "") for s in self._exclude):
+            # the exclusion marker must be STATIC under jit (a bool leaf
+            # would become a traced array and `if excluded:` would raise
+            # TracerBoolConversionError in jit.TrainStep) — encode it as
+            # pytree STRUCTURE: an empty-tuple entry carries no leaves but
+            # survives the functional state round-trip
+            st["excluded"] = ()
+        return st
+
+    def _rule(self, p, g, st, lr):
+        wd = 0.0 if "excluded" in st else self._lars_wd
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        denom = g_norm + wd * p_norm + self._epsilon
+        local_lr = jnp.where(
+            (p_norm > 0) & (denom > 0),
+            lr * self._coeff * p_norm / denom, lr)
+        v = self._momentum * st["velocity"] + local_lr * (g + wd * p)
+        return p - v, dict(st, velocity=v)
 
 
 class Adadelta(Optimizer):

@@ -81,7 +81,7 @@ gaussian_nll_loss square_error_cost softmax_with_cross_entropy unfold fold
 flash_attention scaled_dot_product_attention sequence_mask temporal_shift
 class_center_sample""".split()
 
-OPTIM = "SGD Momentum Adam AdamW Adamax Adagrad Adadelta RMSProp Lamb LBFGS".split()
+OPTIM = "SGD Momentum Adam AdamW Adamax Adagrad Adadelta RMSProp Lamb Lars LBFGS".split()
 LR = """LRScheduler NoamDecay ExponentialDecay NaturalExpDecay
 InverseTimeDecay PolynomialDecay LinearWarmup PiecewiseDecay
 CosineAnnealingDecay MultiStepDecay StepDecay LambdaDecay ReduceOnPlateau

@@ -142,3 +142,66 @@ def test_grad_scaler_fp16_flow():
     scaler.step(opt)
     scaler.update()
     np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 2.0, rtol=1e-5)
+
+
+@pytest.mark.fast
+def test_lars_trust_ratio_and_exclusion():
+    """Lars (reference LarsMomentumOptimizer): layerwise trust-ratio update
+    checked against a numpy replay; excluded params zero the decay only."""
+    import numpy as np
+
+    paddle.seed(0)
+    layer = nn.Linear(6, 4)
+    layer.bias.name = "b_0"  # exclusion matches on the param NAME substring
+    lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+    opt = paddle.optimizer.Lars(
+        learning_rate=lr, momentum=mu, lars_coeff=coeff,
+        lars_weight_decay=wd, parameters=layer.parameters(),
+        exclude_from_weight_decay=["b_"])
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((5, 6)).astype("float32"))
+
+    ws = [p.numpy().copy() for p in layer.parameters()]
+    vs = [np.zeros_like(w) for w in ws]
+    excl = [any(s in (p.name or "") for s in ["b_"]) for p in layer.parameters()]
+
+    for _ in range(4):
+        loss = (layer(x) ** 2).mean()
+        loss.backward()
+        gs = [p.grad.numpy().copy() for p in layer.parameters()]
+        opt.step()
+        opt.clear_grad()
+        for i, (w, v, g) in enumerate(zip(ws, vs, gs)):
+            # exclusion zeroes ONLY the weight decay (upstream semantics);
+            # the trust-ratio local lr applies to every param
+            wd_i = 0.0 if excl[i] else wd
+            p_n, g_n = np.linalg.norm(w), np.linalg.norm(g)
+            denom = g_n + wd_i * p_n
+            local = lr * coeff * p_n / denom if (p_n > 0 and denom > 0) else lr
+            v = mu * v + local * (g + wd_i * w)
+            ws[i], vs[i] = w - v, v
+        for p, w in zip(layer.parameters(), ws):
+            np.testing.assert_allclose(p.numpy(), w, rtol=1e-5, atol=1e-6)
+    assert any(excl), "bias param should match the exclude list"
+
+
+@pytest.mark.fast
+def test_lars_works_under_compiled_trainstep():
+    """The exclusion marker is pytree STRUCTURE, so Lars must survive the
+    compiled jit.TrainStep path (a bool state leaf would become a traced
+    array and crash on `if excluded`)."""
+    import numpy as np
+
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    layer = nn.Linear(6, 4)
+    layer.bias.name = "b_0"
+    opt = paddle.optimizer.Lars(
+        learning_rate=0.05, parameters=layer.parameters(),
+        exclude_from_weight_decay=["b_"])
+    step = TrainStep(layer, lambda m, x: (m(x) ** 2).mean(), opt)
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((5, 6)).astype("float32"))
+    losses = [float(step(x)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
