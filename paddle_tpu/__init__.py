@@ -69,6 +69,7 @@ from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import runtime  # noqa: E402,F401
 from . import static  # noqa: E402,F401
+from . import text  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 
 from .device import get_device, is_compiled_with_cuda, is_compiled_with_tpu, set_device  # noqa: E402,F401
@@ -161,3 +162,20 @@ class LazyGuard:
 
     def __exit__(self, *exc):
         return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch parity (legacy reader decorator,
+    ``python/paddle/reader/decorator.py``): turn a sample reader into a
+    batched reader yielding lists of ``batch_size`` samples."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

@@ -10,7 +10,8 @@ import scipy.stats as st
 import paddle_tpu as paddle
 from paddle_tpu import distribution as D
 
-pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+# fast tier: all but the two heaviest checks (sampling_moments and
+# lkj_cholesky together cost ~10s of compile on this 1-core box)
 
 
 def _np(t):
@@ -40,6 +41,7 @@ X = np.linspace(0.1, 0.9, 5).astype("float32")
         (lambda: D.StudentT(4.0, 0.1, 1.2), st.t(4.0, 0.1, 1.2)),
     ],
 )
+@pytest.mark.fast
 def test_continuous_logpdf_matches_scipy(dist, ref):
     d = dist()
     np.testing.assert_allclose(
@@ -58,12 +60,14 @@ def test_continuous_logpdf_matches_scipy(dist, ref):
         (lambda: D.Beta(2.0, 3.0), st.beta(2.0, 3.0)),
     ],
 )
+@pytest.mark.fast
 def test_entropy_matches_scipy(dist, ref):
     np.testing.assert_allclose(
         float(_np(dist().entropy())), ref.entropy(), rtol=1e-4, atol=1e-5
     )
 
 
+@pytest.mark.fast
 def test_discrete_logpmf():
     k = np.array([0.0, 1.0, 3.0], dtype="float32")
     np.testing.assert_allclose(
@@ -80,6 +84,7 @@ def test_discrete_logpmf():
     )
 
 
+@pytest.mark.fast
 def test_bernoulli_and_categorical():
     b = D.Bernoulli(probs=0.3)
     np.testing.assert_allclose(float(_np(b.log_prob(paddle.to_tensor(1.0)))), math.log(0.3), rtol=1e-5)
@@ -93,6 +98,7 @@ def test_bernoulli_and_categorical():
     assert abs((s == 2).mean() - 0.5) < 0.05
 
 
+@pytest.mark.fast
 def test_multinomial_logpmf_and_sample():
     m = D.Multinomial(10, paddle.to_tensor(np.array([0.2, 0.3, 0.5], "float32")))
     v = np.array([2.0, 3.0, 5.0], "float32")
@@ -116,6 +122,7 @@ def test_sampling_moments():
     np.testing.assert_allclose(sd.mean(0), [1 / 6, 2 / 6, 3 / 6], atol=0.02)
 
 
+@pytest.mark.fast
 def test_rsample_reparam_gradient():
     # gradient of E[x] wrt mu through rsample ≈ 1
     import jax
@@ -131,6 +138,7 @@ def test_rsample_reparam_gradient():
     np.testing.assert_allclose(float(g), 1.0, atol=1e-5)
 
 
+@pytest.mark.fast
 def test_kl_registry():
     p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
     expected = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
@@ -144,6 +152,7 @@ def test_kl_registry():
         D.kl_divergence(D.Normal(0, 1), D.Beta(1.0, 1.0))
 
 
+@pytest.mark.fast
 def test_transforms_and_transformed_distribution():
     t = D.ExpTransform()
     x = paddle.to_tensor(np.array([0.5, 1.0], "float32"))
@@ -164,6 +173,7 @@ def test_transforms_and_transformed_distribution():
     )
 
 
+@pytest.mark.fast
 def test_independent_sums_event_dims():
     base = D.Normal(np.zeros((3, 4), "float32"), np.ones((3, 4), "float32"))
     ind = D.Independent(base, 1)
@@ -173,6 +183,7 @@ def test_independent_sums_event_dims():
     np.testing.assert_allclose(lp, _np(base.log_prob(v)).sum(-1), rtol=1e-6)
 
 
+@pytest.mark.fast
 def test_chi2():
     import scipy.stats as st
 
@@ -188,6 +199,7 @@ def test_chi2():
     assert s.mean() == pytest.approx(3.0, rel=0.1)
 
 
+@pytest.mark.fast
 def test_multivariate_normal_logprob_and_sampling():
     import scipy.stats as st
 
@@ -214,6 +226,7 @@ def test_multivariate_normal_logprob_and_sampling():
         _np(d.log_prob(paddle.to_tensor(x))), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.fast
 def test_von_mises():
     import scipy.stats as st
 
@@ -233,6 +246,7 @@ def test_von_mises():
     assert abs(ang) < 0.08
 
 
+@pytest.mark.fast
 def test_continuous_bernoulli():
     d = D.ContinuousBernoulli(paddle.to_tensor(np.asarray(0.3, "float32")))
     # density integrates to ~1
@@ -273,6 +287,7 @@ def test_lkj_cholesky():
                                _np(d2.log_prob(paddle.to_tensor(Lb))), rtol=1e-5)
 
 
+@pytest.mark.fast
 def test_exponential_family_entropy_bregman():
     class _NormalEF(D.ExponentialFamily):
         def __init__(self, loc, scale):

@@ -63,7 +63,6 @@ SCRIPT = textwrap.dedent("""
 """)
 
 
-@pytest.mark.fast
 def test_kill_midtraining_resumes_from_checkpoint(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(SCRIPT)
@@ -89,7 +88,6 @@ def test_kill_midtraining_resumes_from_checkpoint(tmp_path):
     assert done["final_loss"] < 1.0
 
 
-@pytest.mark.fast
 def test_restart_budget_exhausted_propagates_rc(tmp_path):
     script = tmp_path / "always_die.py"
     script.write_text("import os\nos._exit(9)\n")
@@ -104,7 +102,6 @@ def test_restart_budget_exhausted_propagates_rc(tmp_path):
     assert "budget (1) exhausted" in p.stderr
 
 
-@pytest.mark.fast
 def test_operator_kill_stops_job_without_relaunch(tmp_path):
     """SIGTERM to the LAUNCHER must tear the job down (no relaunch of a
     deliberately killed worker) and exit 128+signum."""

@@ -26,8 +26,8 @@ print(f"RANK={rank}")
 from conftest import free_port as _free_port
 
 
-@pytest.mark.parametrize("nnodes", [2, 4])
-@pytest.mark.fast
+@pytest.mark.parametrize(
+    "nnodes", [pytest.param(2, marks=pytest.mark.fast), 4])
 def test_rank_negotiation_subprocesses(nnodes):
     master = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")

@@ -7,10 +7,12 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.nn import functional as F
 
-pytestmark = pytest.mark.fast  # whole-module smoke: cheap on 1 core
+# fast tier: every test except the rnnt exactness check (its
+# associative-scan compile alone costs ~15s on this 1-core box)
 torch = pytest.importorskip("torch")
 
 
+@pytest.mark.fast
 def test_multi_margin_matches_torch():
     rs = np.random.RandomState(0)
     x = rs.randn(5, 4).astype("float32")
@@ -23,6 +25,7 @@ def test_multi_margin_matches_torch():
         np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=f"p={p}")
 
 
+@pytest.mark.fast
 def test_hsigmoid_default_tree_probabilities_sum_to_one():
     rs = np.random.RandomState(0)
     C, D = 6, 8
@@ -39,6 +42,7 @@ def test_hsigmoid_default_tree_probabilities_sum_to_one():
     assert abs(sum(ps) - 1.0) < 1e-5
 
 
+@pytest.mark.fast
 def test_hsigmoid_custom_path():
     rs = np.random.RandomState(1)
     D = 4
@@ -62,6 +66,7 @@ def test_hsigmoid_custom_path():
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+@pytest.mark.fast
 def test_margin_cross_entropy_reduces_to_scaled_ce():
     rs = np.random.RandomState(0)
     logits = np.tanh(rs.randn(4, 7)).astype("float32")
@@ -79,6 +84,7 @@ def test_margin_cross_entropy_reduces_to_scaled_ce():
     assert harder > got
 
 
+@pytest.mark.fast
 def test_adaptive_log_softmax_matches_torch():
     torch.manual_seed(0)
     tmod = torch.nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12],
@@ -155,6 +161,7 @@ def test_rnnt_loss_matches_bruteforce():
     assert np.isfinite(g).all() and np.abs(g).max() > 0
 
 
+@pytest.mark.fast
 def test_sparse_attention_matches_masked_dense():
     rs = np.random.RandomState(1)
     B, H, T, D = 1, 2, 6, 4

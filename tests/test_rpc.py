@@ -51,8 +51,8 @@ print(f"RPC_OK={rank}")
 from conftest import free_port as _free_port
 
 
-@pytest.mark.parametrize("world", [2, 3])
-@pytest.mark.fast
+@pytest.mark.parametrize(
+    "world", [pytest.param(2, marks=pytest.mark.fast), 3])
 def test_rpc_roundtrip_subprocesses(world):
     master = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")

@@ -78,7 +78,6 @@ def test_conv3d_structure_is_kernel_dilated():
     assert _sparse_sites(out) == expected
 
 
-@pytest.mark.fast
 def test_conv3d_stride_and_values():
     rng = np.random.default_rng(2)
     dense, _ = _random_sparse_input(rng, shape=(1, 6, 6, 6, 2), nnz=9)

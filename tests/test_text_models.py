@@ -105,7 +105,6 @@ def test_gpt_mp_parity_with_single_device():
     np.testing.assert_allclose(ref, out, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.fast
 def test_bert_mlm_and_classification():
     paddle.seed(0)
     cfg = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
