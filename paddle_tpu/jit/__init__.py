@@ -277,29 +277,36 @@ class TrainStep:
         ]
 
     def __call__(self, *batch):
+        batch_vals = self._place_batch(
+            [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch])
+        key = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
+        loss_val = self._dispatch(key, self._compile, batch_vals)
+        return Tensor(loss_val)
+
+    def _dispatch(self, key, build, batch_vals):
+        """Shared plumbing for the single-step and multi-step paths: state
+        extraction, cache get-or-compile, rng draw, and the write-back of
+        params/buffers/optimizer states. Returns the jitted fn's first
+        output (loss scalar or per-step losses)."""
         params = self._params
         buffers = self._buffers + self._extra_params
         p_vals = [p._value for p in params]
         b_vals = [b._value for b in buffers]
         opt_states = self._opt.functional_states()
-        batch_vals = [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
-        batch_vals = self._place_batch(batch_vals)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        key = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
         jitted = self._cache.get(key)
         if jitted is None:
-            jitted = self._compile()
+            jitted = build()
             self._cache[key] = jitted
         rng_key = _rng.next_key()
-        loss_val, new_p, new_b, new_st = jitted(p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
+        out, new_p, new_b, new_st = jitted(
+            p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
         for p, v in zip(params, new_p):
             p._value = v
         for b, v in zip(buffers, new_b):
             b._value = v
         self._opt.load_functional_states(new_st)
-        if isinstance(self._opt._learning_rate, type(None)):
-            pass
-        return Tensor(loss_val)
+        return out
 
     def _place_batch(self, batch_vals):
         """Hook: distributed subclasses place the batch on the data mesh axes
@@ -373,7 +380,74 @@ class TrainStep:
         )
         return out
 
+    # -- compiled multi-step loops (scan over steps) ------------------------
+    def repeat(self, n, *batch):
+        """Run ``n`` optimizer steps on the SAME batch inside ONE compiled
+        program (lax.scan carrying params/buffers/opt-states); returns the
+        per-step losses as a length-``n`` Tensor.
+
+        This is the TPU-idiomatic training-loop shape (MaxText-style
+        scan-over-steps): per-step host dispatch disappears — through the
+        axon tunnel backend that is ~13ms/step, ~5% of an ERNIE-base step.
+        The learning rate is held constant within the compiled window;
+        step LR schedulers between windows. Per-step dropout keys are
+        folded from one base key (jax.random.fold_in on the step index).
+        """
+        return self._run_multi(int(n), None, batch)
+
+    def run_steps(self, *stacked_batch):
+        """Like ``repeat`` but every batch argument carries a leading
+        [n_steps, ...] axis: step i consumes slice i (scan over the data).
+        Returns the per-step losses."""
+        n = int(raw(stacked_batch[0]).shape[0])
+        return self._run_multi(n, True, stacked_batch)
+
+    def _run_multi(self, n, stacked, batch):
+        batch_vals = [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        if stacked:
+            short = [i for i, v in enumerate(batch_vals) if v.shape[0] != n]
+            if short:
+                raise ValueError(
+                    f"run_steps: batch args {short} have leading axis "
+                    f"{[batch_vals[i].shape[0] for i in short]} != {n} "
+                    "(every arg must stack one slice per step — JAX's "
+                    "clamping gather would otherwise silently repeat the "
+                    "last slice)"
+                )
+            # placement of each per-step slice happens inside the scan body
+        else:
+            batch_vals = self._place_batch(batch_vals)
+        key = ("multi", bool(stacked), n,
+               tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals))
+        losses = self._dispatch(
+            key, lambda: self._jit(self._build_multi(n, bool(stacked))),
+            batch_vals)
+        return Tensor(losses)
+
+    def _build_multi(self, n, stacked):
+        step = self._build_step()
+        place = self._place_batch
+
+        def multi(p_vals, b_vals, opt_states, batch_vals, lr, rng_key):
+            def body(carry, i):
+                p, b, st = carry
+                bv = [v[i] for v in batch_vals] if stacked else batch_vals
+                if stacked:
+                    bv = place(bv)
+                loss, p2, b2, st2 = step(
+                    p, b, st, bv, lr, jax.random.fold_in(rng_key, i))
+                return (p2, b2, st2), loss
+
+            (p, b, st), losses = jax.lax.scan(
+                body, (p_vals, b_vals, opt_states), jnp.arange(n))
+            return losses, p, b, st
+
+        return multi
+
     def _compile(self):
+        return self._jit(self._build_step())
+
+    def _build_step(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
         params, buffers = self._params, self._buffers + self._extra_params
         trainable = [p.trainable for p in params]
@@ -407,7 +481,7 @@ class TrainStep:
             new_p, new_st = opt.functional_step(p_vals, grads, opt_states, lr)
             return loss_val, new_p, new_b, new_st
 
-        return self._jit(step)
+        return step
 
     def _jit(self, step):
         donate = (0, 2) if self._donate else ()

@@ -234,3 +234,62 @@ def test_fused_transformer_encoder_layer():
     after = [_np(p) for p in layer.parameters()]
     for b, a in zip(before, after):
         assert np.abs(a - b).max() > 0, "a parameter received no gradient"
+
+
+@pytest.mark.fast
+def test_trainstep_repeat_matches_sequential():
+    """repeat(n) — one compiled scan-over-steps program — must produce the
+    exact per-step loss trajectory of n sequential step() calls (dropout 0,
+    so the RNG keying difference is immaterial)."""
+    import numpy as np
+
+    from paddle_tpu.jit import TrainStep
+
+    def build():
+        paddle.seed(7)
+        m = paddle.nn.Sequential(
+            paddle.nn.Linear(6, 8), paddle.nn.Tanh(), paddle.nn.Linear(8, 2))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        return TrainStep(m, lambda mm, x, y: ((mm(x) - y) ** 2).mean(), opt)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((10, 6)).astype("float32"))
+    y = paddle.to_tensor(rng.standard_normal((10, 2)).astype("float32"))
+
+    s1 = build()
+    seq_losses = [float(s1(x, y)) for _ in range(4)]
+    s2 = build()
+    rep_losses = np.asarray(s2.repeat(4, x, y)._value)
+    np.testing.assert_allclose(rep_losses, seq_losses, rtol=1e-5, atol=1e-6)
+    # final weights identical too
+    for p1, p2 in zip(s1._params, s2._params):
+        np.testing.assert_allclose(
+            np.asarray(p1._value), np.asarray(p2._value), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.fast
+def test_trainstep_run_steps_scans_data():
+    """run_steps consumes a leading [n_steps] axis per batch arg; the loss
+    trajectory equals sequential calls on the slices."""
+    import numpy as np
+
+    from paddle_tpu.jit import TrainStep
+
+    def build():
+        paddle.seed(3)
+        m = paddle.nn.Linear(5, 3)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        return TrainStep(m, lambda mm, x, y: ((mm(x) - y) ** 2).mean(), opt)
+
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((3, 8, 5)).astype("float32")
+    ys = rng.standard_normal((3, 8, 3)).astype("float32")
+
+    s1 = build()
+    seq = [float(s1(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i])))
+           for i in range(3)]
+    s2 = build()
+    got = np.asarray(s2.run_steps(paddle.to_tensor(xs), paddle.to_tensor(ys))._value)
+    np.testing.assert_allclose(got, seq, rtol=1e-5, atol=1e-6)
