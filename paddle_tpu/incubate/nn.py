@@ -287,6 +287,92 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     return out
 
 
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     residual_alpha=1.0, begin_norm_axis=1, bias=None,
+                     residual=None, quant_scale=-1, name=None, **_quant_kw):
+    """incubate.nn.functional.fused_layer_norm parity: LN(x + bias +
+    residual_alpha * residual). Quantized outputs (quant_scale > 0) are
+    not supported. Returns (out, residual_out) when a residual is given
+    (reference contract), else out."""
+    from ..nn import functional as F
+
+    if quant_scale > 0:
+        raise NotImplementedError("fused_layer_norm: quantized output path")
+    import numpy as _np
+
+    from ..tensor import manipulation as M
+
+    h = x
+    if bias is not None:
+        h = h + bias
+    if residual is not None:
+        h = h + residual_alpha * residual
+    nd = len(h.shape)
+    axis = begin_norm_axis % nd
+    shape = list(h.shape[axis:])
+    # the reference takes FLAT 1-D weight/bias of size prod(shape); reshape
+    # to the normalized dims (and validate) before the broadcasting norm
+    want = int(_np.prod(shape))
+
+    def _fit(t, what):
+        if t is None:
+            return None
+        size = int(_np.prod(t.shape))
+        if size != want:
+            raise ValueError(
+                f"fused_layer_norm: {what} has {size} elements but "
+                f"normalization over dims {shape} needs {want}"
+            )
+        return M.reshape(t, shape) if list(t.shape) != shape else t
+
+    out = F.layer_norm(h, shape, weight=_fit(norm_weight, "norm_weight"),
+                       bias=_fit(norm_bias, "norm_bias"), epsilon=epsilon)
+    return (out, h) if residual is not None else out
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """incubate.nn.functional.fused_bias_dropout_residual_layer_norm
+    parity: LN(residual + dropout(x + bias)) — one fused region under
+    XLA."""
+    from ..nn import functional as F
+
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = residual + h
+    d = h.shape[-1]
+    return F.layer_norm(h, [d], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """incubate.nn.functional.fused_linear parity (one matmul+bias-add
+    region; the reference fuses via cublasLt, XLA fuses natively)."""
+    from .. import matmul
+
+    y = matmul(x, weight, transpose_y=transpose_weight)
+    return y if bias is None else y + bias
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """incubate.nn.functional.fused_linear_activation parity:
+    act(x @ y + bias) with act in {gelu, relu, none}."""
+    from .. import matmul
+    from ..nn import functional as F
+
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y) + bias
+    if activation == "gelu":
+        return F.gelu(out)
+    if activation == "relu":
+        return F.relu(out)
+    if activation in (None, "", "none", "identity"):
+        return out
+    raise ValueError(f"fused_linear_activation: unknown activation {activation!r}")
+
+
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True,
                                     name=None):
@@ -399,3 +485,33 @@ def _fused_ec_moe(x, gate, w1, b1, w2, b2, act, num_experts):
     out = jnp.zeros((n_tok, d), x.dtype)
     out = out.at[idx.reshape(-1)].add(out_e.reshape(-1, d))
     return out.reshape(b, s, d)
+
+
+def _make_functional_module():
+    """paddle.incubate.nn.functional namespace parity. A REAL module
+    registered in sys.modules so every reference import form works:
+    ``from ...incubate.nn.functional import fused_linear`` and
+    ``import ...incubate.nn.functional as F`` both resolve (a plain
+    attribute object would fail those with ModuleNotFoundError)."""
+    import sys
+    import types
+
+    this = sys.modules[__name__]
+    mod = types.ModuleType(__name__ + ".functional")
+    mod.__doc__ = "paddle.incubate.nn.functional parity (fused functionals)"
+
+    class _Fwd(types.ModuleType):
+        def __getattr__(self, name):
+            try:
+                return getattr(this, name)
+            except AttributeError:
+                raise AttributeError(
+                    f"paddle.incubate.nn.functional has no attribute {name!r}"
+                ) from None
+
+    mod.__class__ = _Fwd
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+functional = _make_functional_module()

@@ -393,7 +393,7 @@ class TrainStep:
         step LR schedulers between windows. Per-step dropout keys are
         folded from one base key (jax.random.fold_in on the step index).
         """
-        return self._run_multi(int(n), None, batch)
+        return self._run_multi(int(n), False, batch)
 
     def run_steps(self, *stacked_batch):
         """Like ``repeat`` but every batch argument carries a leading
@@ -405,7 +405,8 @@ class TrainStep:
     def _run_multi(self, n, stacked, batch):
         batch_vals = [raw(b) if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         if stacked:
-            short = [i for i, v in enumerate(batch_vals) if v.shape[0] != n]
+            short = [i for i, v in enumerate(batch_vals)
+                     if v.ndim == 0 or v.shape[0] != n]
             if short:
                 raise ValueError(
                     f"run_steps: batch args {short} have leading axis "
@@ -417,10 +418,10 @@ class TrainStep:
             # placement of each per-step slice happens inside the scan body
         else:
             batch_vals = self._place_batch(batch_vals)
-        key = ("multi", bool(stacked), n,
+        key = ("multi", stacked, n,
                tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals))
         losses = self._dispatch(
-            key, lambda: self._jit(self._build_multi(n, bool(stacked))),
+            key, lambda: self._jit(self._build_multi(n, stacked)),
             batch_vals)
         return Tensor(losses)
 

@@ -433,3 +433,36 @@ def test_fleet_util_and_fs(tmp_path):
     client = HDFSClient()
     with _pytest.raises(ExecuteError, match="offline|hadoop"):
         client.mkdirs("/tmp/x")
+
+
+def test_fused_norm_linear_functionals():
+    """incubate.nn fused_layer_norm / fused_bias_dropout_residual_layer_norm
+    / fused_linear / fused_linear_activation vs unfused references."""
+    from paddle_tpu import incubate
+    from paddle_tpu.nn import functional as F
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    res = paddle.to_tensor(rs.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(np.ones(8, "float32"))
+    b = paddle.to_tensor(np.zeros(8, "float32"))
+
+    out, res_out = incubate.nn.fused_layer_norm(
+        x, w, b, epsilon=1e-5, begin_norm_axis=1, residual=res)
+    ref = F.layer_norm(x + res, [8], weight=w, bias=b, epsilon=1e-5)
+    np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_np(res_out), _np(x + res), rtol=1e-6)
+
+    out2 = incubate.nn.fused_bias_dropout_residual_layer_norm(
+        x, res, dropout_rate=0.0, ln_scale=w, ln_bias=b)
+    np.testing.assert_allclose(_np(out2), _np(ref), rtol=1e-5, atol=1e-6)
+
+    wt = paddle.to_tensor(rs.randn(8, 3).astype("float32"))
+    bias3 = paddle.to_tensor(rs.randn(3).astype("float32"))
+    lin = incubate.nn.fused_linear(x, wt, bias3)
+    np.testing.assert_allclose(
+        _np(lin), _np(x) @ _np(wt) + _np(bias3), rtol=1e-5, atol=1e-5)
+    act = incubate.nn.fused_linear_activation(x, wt, bias3, activation="relu")
+    np.testing.assert_allclose(
+        _np(act), np.maximum(_np(x) @ _np(wt) + _np(bias3), 0),
+        rtol=1e-5, atol=1e-5)
