@@ -327,7 +327,57 @@ class HybridParallelOptimizer:
 
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
-    return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group(), strategy or _strategy)
+    strategy = strategy or _strategy
+    optimizer = _apply_meta_optimizers(optimizer, strategy)
+    return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group(), strategy)
+
+
+def _apply_meta_optimizers(optimizer, strategy):
+    """Strategy-driven optimizer swaps, mirroring the reference's
+    meta_optimizers (``fleet/meta_optimizers/lars_optimizer.py`` /
+    ``lamb_optimizer.py``): with ``strategy.lars=True`` a Momentum
+    optimizer becomes Lars (large-batch vision), with ``strategy.lamb=True``
+    an Adam/AdamW becomes Lamb (large-batch LM). Other meta optimizers
+    (amp / recompute / sharding / pipeline) are expressed as first-class
+    mechanisms here rather than optimizer wrappers."""
+    from ... import optimizer as opt_mod
+
+    if getattr(strategy, "lars", False) and isinstance(optimizer, opt_mod.Momentum):
+        cfg = dict(getattr(strategy, "lars_configs", {}) or {})
+        new = opt_mod.Lars(
+            learning_rate=optimizer._learning_rate,
+            momentum=optimizer._momentum,
+            lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+            lars_weight_decay=float(cfg.get("lars_weight_decay", 0.0005)),
+            exclude_from_weight_decay=cfg.get("exclude_from_weight_decay", []),
+            epsilon=float(cfg.get("epsilon", 0.0)),
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip,
+        )
+        # the reference meta optimizer forwards the wrapped optimizer's own
+        # regularization alongside lars_weight_decay
+        new._regularizer = optimizer._regularizer
+        optimizer = new
+    elif getattr(strategy, "lamb", False) and isinstance(
+            optimizer, (opt_mod.Adam, opt_mod.AdamW)):
+        cfg = dict(getattr(strategy, "lamb_configs", {}) or {})
+        excl = list(cfg.get("exclude_from_weight_decay", []) or [])
+        new = opt_mod.Lamb(
+            learning_rate=optimizer._learning_rate,
+            lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)),
+            beta1=optimizer._beta1,
+            beta2=optimizer._beta2,
+            epsilon=optimizer._epsilon,
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip,
+            exclude_from_weight_decay_fn=(
+                (lambda pname: any(s in (pname or "") for s in excl))
+                if excl else None
+            ),
+        )
+        new._regularizer = optimizer._regularizer
+        optimizer = new
+    return optimizer
 
 
 class DistTrainStep(TrainStep):

@@ -62,6 +62,14 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs: _SubConfig = _SubConfig(k_steps=1, avg=True)
         self.lamb = False
+        self.lamb_configs: _SubConfig = _SubConfig(
+            lamb_weight_decay=0.01, exclude_from_weight_decay=[]
+        )
+        self.lars = False
+        self.lars_configs: _SubConfig = _SubConfig(
+            lars_coeff=0.001, lars_weight_decay=0.0005,
+            exclude_from_weight_decay=[], epsilon=0.0,
+        )
         self.dgc = False
         self.fuse_all_reduce_ops = True  # no-op: XLA fuses
         self.fuse_grad_size_in_MB = 32

@@ -1,0 +1,110 @@
+"""paddle.nn.functional.flash_attention — submodule parity.
+
+Reference: ``python/paddle/nn/functional/flash_attention.py`` (wrapping the
+external flashattn CUDA lib). The dense entry points re-export the
+shape-gated TPU implementations from ``attention.py``; the varlen entry
+point ``flash_attn_unpadded`` is implemented TPU-natively as
+SEGMENT-MASKED attention over the packed token axis: one static-shape
+attention call whose visibility mask is block-diagonal per sequence
+(cu_seqlens -> segment ids), the idiomatic packed-sequence form on TPU
+(ragged shapes would defeat XLA tiling).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.op import defop, raw
+from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+
+__all__ = [
+    "flash_attention",
+    "scaled_dot_product_attention",
+    "flash_attn_unpadded",
+    "flash_attention_with_sparse_mask",
+]
+
+
+def _segment_ids(cu_seqlens, total):
+    """cu_seqlens [n+1] -> per-token segment id [total]; tokens beyond
+    cu_seqlens[-1] get id -1 (never visible)."""
+    starts = cu_seqlens[1:-1]
+    seg = jnp.cumsum(
+        jnp.zeros(total, jnp.int32).at[starts].add(
+            jnp.ones(starts.shape, jnp.int32)))
+    return jnp.where(jnp.arange(total) < cu_seqlens[-1], seg, -1)
+
+
+@defop(name="flash_attn_unpadded_op")
+def _unpadded(q, k, v, cu_q, cu_k, scale, causal):
+    tq = q.shape[0]
+    tk = k.shape[0]
+    seg_q = _segment_ids(cu_q, tq)
+    seg_k = _segment_ids(cu_k, tk)
+    visible = (seg_q[:, None] == seg_k[None, :]) & (seg_q[:, None] >= 0)
+    if causal:
+        # causal WITHIN each sequence, BOTTOM-RIGHT aligned when a
+        # sequence's q-length != k-length (decode-style packed calls) —
+        # the same alignment as the dense paths and FA2
+        local_q = jnp.arange(tq) - cu_q[seg_q.clip(0)]
+        local_k = jnp.arange(tk) - cu_k[seg_k.clip(0)]
+        len_q = (cu_q[1:] - cu_q[:-1])[seg_q.clip(0)]
+        len_k = (cu_k[1:] - cu_k[:-1])[seg_q.clip(0)]
+        visible &= local_k[None, :] <= (local_q + (len_k - len_q))[:, None]
+    # padded rows (beyond cu_seqlens[-1]) must not be fully masked — an
+    # all -inf softmax row is NaN and its NaN probs poison dk/dv for every
+    # real token in backward. Let them see key 0, then zero their output.
+    pad_row = seg_q < 0
+    visible = visible.at[:, 0].set(visible[:, 0] | pad_row)
+
+    from .attention import _pallas_backend_ok, _sdpa_reference
+
+    long_seq = max(tq, tk) >= 1024
+    if long_seq and _pallas_backend_ok():
+        from ...ops.pallas.flash_attention import flash_attention as _fa
+
+        out = _fa(q[None], k[None], v[None], causal=False, scale=scale,
+                  mask=visible[None, None], bias_needs_grad=False)[0]
+    else:
+        out = _sdpa_reference(
+            q[None], k[None], v[None], visible[None, None], 0.0, False,
+            scale)[0]
+    return jnp.where(pad_row[:, None, None], 0.0, out).astype(q.dtype)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed) flash attention.
+
+    query/key/value: [total_tokens, num_heads, head_dim]; ``cu_seqlens_*``
+    are the [batch+1] cumulative sequence starts. Dropout inside varlen
+    attention is not supported (matches the TPU-idiomatic inference/packed
+    -training configuration); returns (out, None) like the reference's
+    (out, softmax) with return_softmax=False.
+    """
+    if dropout and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is unsupported on the "
+            "packed path (set dropout=0)"
+        )
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded: return_softmax=True is unsupported "
+            "(the blockwise kernel never materializes the softmax)"
+        )
+    cu_q = Tensor(jnp.asarray(raw(cu_seqlens_q), jnp.int32))
+    cu_k = Tensor(jnp.asarray(raw(cu_seqlens_k), jnp.int32))
+    out = _unpadded(query, key, value, cu_q, cu_k,
+                    float(scale), bool(causal))
+    return out, None
+
+
+def flash_attention_with_sparse_mask(*args, **kwargs):
+    raise NotImplementedError(
+        "flash_attention_with_sparse_mask: use flash_attention(mask=...) / "
+        "scaled_dot_product_attention(attn_mask=...) — the start-row-index "
+        "compressed mask format is a flashattn-CUDA-specific encoding"
+    )

@@ -426,22 +426,29 @@ class Lamb(Optimizer):
 
     def _init_state(self, p):
         pv = raw(p)
-        return {"moment1": jnp.zeros_like(pv), "moment2": jnp.zeros_like(pv),
-                "beta1_pow": jnp.ones((), jnp.float32), "beta2_pow": jnp.ones((), jnp.float32)}
+        st = {"moment1": jnp.zeros_like(pv), "moment2": jnp.zeros_like(pv),
+              "beta1_pow": jnp.ones((), jnp.float32), "beta2_pow": jnp.ones((), jnp.float32)}
+        if self._exclude_fn is not None and self._exclude_fn(p.name or ""):
+            # jit-static exclusion marker (pytree structure, not a bool
+            # leaf — see Lars._init_state)
+            st["wd_excluded"] = ()
+        return st
 
     def _rule(self, p, g, st, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        wd = 0.0 if "wd_excluded" in st else self._wd
         b1p = st["beta1_pow"] * b1
         b2p = st["beta2_pow"] * b2
         m1 = b1 * st["moment1"] + (1 - b1) * g
         m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
         mhat = m1 / (1 - b1p)
         vhat = m2 / (1 - b2p)
-        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
         w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
-        return p - lr * trust * r, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        return p - lr * trust * r, dict(st, moment1=m1, moment2=m2,
+                                        beta1_pow=b1p, beta2_pow=b2p)
 
 
 class Lars(Optimizer):

@@ -264,3 +264,72 @@ def test_ring_attention_grad():
     g = jax.grad(lambda q: (context_parallel_attention(q, k, v, causal=True) ** 2).mean())(q)
     gr = jax.grad(lambda q: (_sdpa_reference(q, k, v, None, 0.0, True, None) ** 2).mean())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.fast
+def test_flash_attn_unpadded_segment_masked():
+    """nn.functional.flash_attention submodule parity: the varlen entry
+    point equals per-sequence dense attention on the unpacked slices."""
+    from paddle_tpu.nn.functional.flash_attention import flash_attn_unpadded
+
+    rng = np.random.default_rng(0)
+    lens = [5, 9, 3]
+    total, h, d = sum(lens), 2, 16
+    q = jnp.asarray(rng.standard_normal((total, h, d)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((total, h, d)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total, h, d)) * 0.3, jnp.float32)
+    cu = np.cumsum([0] + lens).astype("int32")
+    scale = 1.0 / np.sqrt(d)
+
+    for causal in (False, True):
+        out, _ = flash_attn_unpadded(
+            paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+            paddle.to_tensor(np.asarray(v)), paddle.to_tensor(cu),
+            paddle.to_tensor(cu), max(lens), max(lens), scale, causal=causal)
+        got = np.asarray(out._value)
+        for i in range(len(lens)):
+            s, e = cu[i], cu[i + 1]
+            ref = _sdpa_reference(
+                q[None, s:e], k[None, s:e], v[None, s:e], None, 0.0,
+                causal, scale)
+            np.testing.assert_allclose(
+                got[s:e], np.asarray(ref)[0], rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.fast
+def test_flash_attn_unpadded_decode_and_padding():
+    """Bottom-right causal alignment for q-len != k-len (decode-style) and
+    finite grads with padding tokens beyond cu_seqlens[-1]."""
+    from paddle_tpu.nn.functional.flash_attention import flash_attn_unpadded
+
+    rng = np.random.default_rng(1)
+    h, d = 2, 8
+    # one sequence: 1 query vs 5 cached keys, causal -> ALL keys visible
+    q = rng.standard_normal((1, h, d)).astype("float32")
+    k = rng.standard_normal((5, h, d)).astype("float32")
+    v = rng.standard_normal((5, h, d)).astype("float32")
+    scale = 1.0 / np.sqrt(d)
+    out, _ = flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(np.asarray([0, 1], "int32")),
+        paddle.to_tensor(np.asarray([0, 5], "int32")), 1, 5, scale, causal=True)
+    ref = _sdpa_reference(
+        jnp.asarray(q)[None], jnp.asarray(k)[None], jnp.asarray(v)[None],
+        None, 0.0, True, scale)  # dense path is bottom-right aligned
+    np.testing.assert_allclose(
+        np.asarray(out._value), np.asarray(ref)[0], rtol=2e-4, atol=2e-5)
+
+    # padding tail: rows beyond cu[-1] emit zeros and grads stay finite
+    total = 8  # cu[-1] = 6, two padded slots
+    qq = paddle.to_tensor(rng.standard_normal((total, h, d)).astype("float32"))
+    kk = paddle.to_tensor(rng.standard_normal((total, h, d)).astype("float32"))
+    vv = paddle.to_tensor(rng.standard_normal((total, h, d)).astype("float32"))
+    cu = paddle.to_tensor(np.asarray([0, 4, 6], "int32"))
+    qq.stop_gradient = False
+    vv.stop_gradient = False
+    out2, _ = flash_attn_unpadded(qq, kk, vv, cu, cu, 4, 4, scale, causal=True)
+    assert np.all(np.asarray(out2._value)[6:] == 0)
+    loss = (out2 ** 2).sum()
+    loss.backward()
+    assert np.isfinite(np.asarray(qq.grad._value)).all()
+    assert np.isfinite(np.asarray(vv.grad._value)).all()
