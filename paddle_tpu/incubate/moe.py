@@ -223,7 +223,15 @@ def _moe_apply_dropless(flat, logits, w_in, b_in, w_out, b_out, act, top_k):
     row_gid = expert_ids[order]
     xs = flat[order // top_k].astype(flat.dtype)  # [gk, H] sorted copies
 
-    block_m = 128 if gk >= 128 else max(8, 1 << (gk - 1).bit_length())
+    # measured on v5e (8k tokens, 1024->4096, 8 experts): 512-row blocks
+    # are ~6% faster than 128 (less per-visit overhead); tiny inputs keep
+    # a pow2 block so the padding tail stays bounded
+    if gk >= 512:
+        block_m = 512
+    elif gk >= 128:
+        block_m = 128
+    else:
+        block_m = max(8, 1 << (gk - 1).bit_length())
     pad = (-gk) % block_m
     xs_p = jnp.pad(xs, ((0, pad), (0, 0)))
 

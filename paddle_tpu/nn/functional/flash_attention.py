@@ -51,11 +51,14 @@ def _unpadded(q, k, v, cu_q, cu_k, scale, causal):
         len_q = (cu_q[1:] - cu_q[:-1])[seg_q.clip(0)]
         len_k = (cu_k[1:] - cu_k[:-1])[seg_q.clip(0)]
         visible &= local_k[None, :] <= (local_q + (len_k - len_q))[:, None]
-    # padded rows (beyond cu_seqlens[-1]) must not be fully masked — an
-    # all -inf softmax row is NaN and its NaN probs poison dk/dv for every
-    # real token in backward. Let them see key 0, then zero their output.
-    pad_row = seg_q < 0
-    visible = visible.at[:, 0].set(visible[:, 0] | pad_row)
+    # ANY fully-masked query row — padding beyond cu_seqlens[-1], or a
+    # causal row with zero visible keys (per-sequence q-len > k-len under
+    # bottom-right alignment) — must not reach softmax as all -inf: the
+    # NaN row poisons dk/dv for every real token in backward. Let dead
+    # rows see key 0, then zero their outputs (the dense flash kernel's
+    # documented zero-rows contract).
+    dead_row = ~visible.any(-1)
+    visible = visible.at[:, 0].set(visible[:, 0] | dead_row)
 
     from .attention import _pallas_backend_ok, _sdpa_reference
 
@@ -69,7 +72,7 @@ def _unpadded(q, k, v, cu_q, cu_k, scale, causal):
         out = _sdpa_reference(
             q[None], k[None], v[None], visible[None, None], 0.0, False,
             scale)[0]
-    return jnp.where(pad_row[:, None, None], 0.0, out).astype(q.dtype)
+    return jnp.where(dead_row[:, None, None], 0.0, out).astype(q.dtype)
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,

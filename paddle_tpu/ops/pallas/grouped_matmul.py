@@ -41,7 +41,10 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 DEFAULT_BLOCK_M = 128
-DEFAULT_BLOCK_N = 128
+# N auto-pads to a block multiple inside grouped_matmul, so a wide default
+# is safe for any n; measured on v5e it is ~6% faster than 128 at MoE-FFN
+# shapes (the lhs block is reused across the whole N sweep)
+DEFAULT_BLOCK_N = 1024
 
 # schedule columns
 _MTILE, _GID, _RS, _RE, _FIRST_OUT, _LAST_OUT, _FIRST_G, _LAST_G = range(8)

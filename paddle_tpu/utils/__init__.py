@@ -105,3 +105,5 @@ def require_version(min_version: str, max_version: str = None):
         raise Exception(
             f"version {__version__} > allowed maximum {max_version}")
     return True
+
+from . import dlpack  # noqa: E402,F401

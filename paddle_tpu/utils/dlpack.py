@@ -1,0 +1,22 @@
+"""paddle.utils.dlpack parity (``python/paddle/utils/dlpack.py``):
+zero-copy tensor interchange via the DLPack protocol. jax.Arrays implement
+``__dlpack__`` natively, so ``to_dlpack`` hands out a capsule any consumer
+(torch, numpy>=1.23, cupy) accepts, and ``from_dlpack`` ingests capsules or
+any ``__dlpack__``-bearing object (e.g. torch tensors)."""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    from ..framework.op import raw
+
+    return raw(x).__dlpack__()
+
+
+def from_dlpack(capsule_or_tensor):
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    return Tensor(jnp.from_dlpack(capsule_or_tensor))

@@ -333,3 +333,30 @@ def test_flash_attn_unpadded_decode_and_padding():
     loss.backward()
     assert np.isfinite(np.asarray(qq.grad._value)).all()
     assert np.isfinite(np.asarray(vv.grad._value)).all()
+
+
+@pytest.mark.fast
+def test_flash_attn_unpadded_qlen_exceeds_klen():
+    """Causal rows with ZERO visible keys (per-sequence q-len > k-len under
+    bottom-right alignment) emit zeros — not NaN — and grads stay finite."""
+    from paddle_tpu.nn.functional.flash_attention import flash_attn_unpadded
+
+    rng = np.random.default_rng(2)
+    h, d = 2, 8
+    q = paddle.to_tensor(rng.standard_normal((5, h, d)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((3, h, d)).astype("float32"))
+    v = paddle.to_tensor(rng.standard_normal((3, h, d)).astype("float32"))
+    q.stop_gradient = False
+    v.stop_gradient = False
+    out, _ = flash_attn_unpadded(
+        q, k, v, paddle.to_tensor(np.asarray([0, 5], "int32")),
+        paddle.to_tensor(np.asarray([0, 3], "int32")), 5, 3, d ** -0.5,
+        causal=True)
+    got = np.asarray(out._value)
+    assert np.isfinite(got).all()
+    assert np.all(got[:2] == 0)  # first 2 rows see nothing (bottom-right)
+    assert np.abs(got[2:]).max() > 0
+    loss = (out ** 2).sum()
+    loss.backward()
+    assert np.isfinite(np.asarray(q.grad._value)).all()
+    assert np.isfinite(np.asarray(v.grad._value)).all()

@@ -105,3 +105,24 @@ def test_profiler_scheduler_states():
     assert states[0] == profiler.ProfilerState.CLOSED
     assert states[1] == profiler.ProfilerState.READY
     assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+
+
+@pytest.mark.fast
+def test_dlpack_interop_with_torch():
+    """paddle.utils.dlpack roundtrips with torch (CPU) without copies of
+    semantics: values survive both directions."""
+    import numpy as np
+
+    torch = pytest.importorskip("torch")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import dlpack
+
+    a = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    t = torch.from_dlpack(dlpack.to_dlpack(a))
+    assert t.shape == (3, 4)
+    np.testing.assert_array_equal(t.numpy(), a.numpy())
+
+    t2 = torch.arange(6, dtype=torch.float32).reshape(2, 3) * 2
+    b = dlpack.from_dlpack(t2)
+    np.testing.assert_array_equal(b.numpy(), t2.numpy())
