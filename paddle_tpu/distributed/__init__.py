@@ -101,6 +101,9 @@ QueueDataset = None  # PS-mode datasets: deliberate non-goal (SURVEY.md §2.3 PS
 from .collective import P2POp, batch_isend_irecv  # noqa: E402,F401
 from . import launch  # noqa: E402,F401  (paddle.distributed.launch module)
 from . import rpc  # noqa: E402,F401  (paddle.distributed.rpc module)
+from . import utils  # noqa: E402,F401  (paddle.distributed.utils module)
+all_to_all = alltoall  # reference alias
+
 
 
 def split(x, size, operation, axis=0, num_partitions=None, gather_out=True,

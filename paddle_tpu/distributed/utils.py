@@ -1,0 +1,24 @@
+"""paddle.distributed.utils parity (``python/paddle/distributed/utils/``):
+helper surface re-exporting the MoE global scatter/gather ops plus launch
+helpers used by reference scripts."""
+from __future__ import annotations
+
+from ..incubate.moe import global_gather, global_scatter  # noqa: F401
+
+
+def get_cluster(node_ips=None, node_ip=None, trainer_endpoints=None,
+                device_mode=None, devices_per_proc=None):
+    raise NotImplementedError(
+        "get_cluster is a GPU-launcher internal; TPU jobs negotiate ranks "
+        "through paddle_tpu.distributed.launch (TCPStore rendezvous)"
+    )
+
+
+def get_host_name_ip():
+    import socket
+
+    host = socket.gethostname()
+    try:
+        return host, socket.gethostbyname(host)
+    except OSError:
+        return host, "127.0.0.1"

@@ -48,7 +48,35 @@ class Model:
                 out = model(*xs)
                 return self._loss(out, y)
 
-            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+            # distributed hapi (reference: Model.prepare wraps the network
+            # in DataParallel when the parallel env is initialized): with
+            # FLEET initialized over a multi-device mesh, route through it
+            # so the batch is placed on the data axes and params/opt states
+            # keep their shardings — Model.fit then IS data-parallel SPMD
+            # training. A bare global mesh without fleet.init (e.g.
+            # init_parallel_env / auto_parallel.set_mesh) keeps the plain
+            # TrainStep, as before.
+            from .distributed import fleet
+            from .distributed import mesh as _mesh
+
+            m = _mesh.get_global_mesh()
+            hcg = fleet.get_hybrid_communicate_group()
+            if m is not None and m.size > 1 and hcg is not None:
+                placed = fleet.distributed_model(self.network)
+                if placed is not self.network:
+                    # PipelineParallel wrapper: hapi's step loop cannot
+                    # drive a pipeline schedule (same restriction as the
+                    # reference's hapi)
+                    raise NotImplementedError(
+                        "paddle.Model with a PipelineLayer network: use "
+                        "fleet.distributed_model(...).train_batch directly"
+                    )
+                optimizer = fleet.distributed_optimizer(optimizer)
+                self._optimizer = optimizer
+                self._train_step = fleet.DistTrainStep(
+                    self.network, loss_fn, optimizer)
+            else:
+                self._train_step = TrainStep(self.network, loss_fn, optimizer)
         return self
 
     # ---------------------------------------------------------------- steps --

@@ -224,9 +224,11 @@ def _moe_apply_dropless(flat, logits, w_in, b_in, w_out, b_out, act, top_k):
     xs = flat[order // top_k].astype(flat.dtype)  # [gk, H] sorted copies
 
     # measured on v5e (8k tokens, 1024->4096, 8 experts): 512-row blocks
-    # are ~6% faster than 128 (less per-visit overhead); tiny inputs keep
-    # a pow2 block so the padding tail stays bounded
-    if gk >= 512:
+    # are ~6% faster than 128 (less per-visit overhead). Use them only
+    # once the padding tail is amortized (gk >= 2048 keeps the tail under
+    # 25%; at gk just above 512 it would nearly double the row tiles);
+    # tiny inputs keep a pow2 block so the tail stays bounded
+    if gk >= 2048:
         block_m = 512
     elif gk >= 128:
         block_m = 128

@@ -85,7 +85,8 @@ class GPTConfig:
 
     @staticmethod
     def gpt3_6p7b(**kw):
-        return GPTConfig(hidden_size=4096, num_hidden_layers=32, num_attention_heads=32,
+        kw.setdefault("num_hidden_layers", 32)
+        return GPTConfig(hidden_size=4096, num_attention_heads=32,
                          max_position_embeddings=2048, **kw)
 
 

@@ -1,17 +1,49 @@
 """paddle.utils.dlpack parity (``python/paddle/utils/dlpack.py``):
-zero-copy tensor interchange via the DLPack protocol. jax.Arrays implement
-``__dlpack__`` natively, so ``to_dlpack`` hands out a capsule any consumer
-(torch, numpy>=1.23, cupy) accepts, and ``from_dlpack`` ingests capsules or
-any ``__dlpack__``-bearing object (e.g. torch tensors)."""
+zero-copy-where-possible tensor interchange via the DLPack protocol.
+
+``to_dlpack`` first lands the array on host (DLPack has no TPU device
+type; the on-device buffer raises UNIMPLEMENTED for external references
+under PJRT) and hands out a capsule any consumer (torch, numpy>=1.23,
+cupy) accepts. ``from_dlpack`` ingests either a raw capsule (wrapped in a
+CPU-device adapter — jax 0.9 only accepts ``__dlpack__``-bearing objects)
+or any object implementing the protocol (e.g. torch tensors).
+"""
 from __future__ import annotations
 
 __all__ = ["to_dlpack", "from_dlpack"]
 
 
+def _to_host(v):
+    import jax
+
+    dev = getattr(v, "device", None)
+    plat = getattr(dev, "platform", None)
+    if plat == "cpu":
+        return v
+    return jax.device_put(v, jax.devices("cpu")[0])
+
+
 def to_dlpack(x):
     from ..framework.op import raw
 
-    return raw(x).__dlpack__()
+    v = _to_host(raw(x))
+    v.block_until_ready()
+    return v.__dlpack__()
+
+
+class _CapsuleAdapter:
+    """Expose a raw DLPack capsule through the array-protocol form modern
+    consumers require. Capsules we produce are host-resident (see
+    to_dlpack), so the device is kDLCPU; the capsule is consumable once."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, device 0)
 
 
 def from_dlpack(capsule_or_tensor):
@@ -19,4 +51,7 @@ def from_dlpack(capsule_or_tensor):
 
     from ..framework.core import Tensor
 
-    return Tensor(jnp.from_dlpack(capsule_or_tensor))
+    obj = capsule_or_tensor
+    if not hasattr(obj, "__dlpack__"):  # raw PyCapsule
+        obj = _CapsuleAdapter(obj)
+    return Tensor(jnp.from_dlpack(obj))

@@ -126,3 +126,8 @@ def test_dlpack_interop_with_torch():
     t2 = torch.arange(6, dtype=torch.float32).reshape(2, 3) * 2
     b = dlpack.from_dlpack(t2)
     np.testing.assert_array_equal(b.numpy(), t2.numpy())
+
+    # raw-capsule roundtrip (the reference idiom): from_dlpack(to_dlpack(x))
+    c = paddle.to_tensor(np.linspace(0, 1, 8, dtype="float32"))
+    d = dlpack.from_dlpack(dlpack.to_dlpack(c))
+    np.testing.assert_array_equal(d.numpy(), c.numpy())

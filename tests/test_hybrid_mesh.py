@@ -92,3 +92,38 @@ def test_fleet_init_uses_hybrid_mesh(monkeypatch):
     arr = np.vectorize(_dev_id)(m.devices)
     assert {_slice_of(i) for i in arr[0].ravel()} == {0}
     assert {_slice_of(i) for i in arr[1].ravel()} == {1}
+
+
+@pytest.mark.fast
+def test_hapi_model_fit_distributed():
+    """paddle.Model.fit auto-routes through fleet when a multi-device mesh
+    is live (reference: Model.prepare wraps DataParallel under an
+    initialized parallel env)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(dp_degree=8)
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    from paddle_tpu.distributed.fleet import DistTrainStep
+
+    assert isinstance(model._train_step, DistTrainStep)
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((64, 6)).astype("float32")
+    ys = rng.integers(0, 4, (64, 1)).astype("int64")
+    data = [(xs[i], ys[i]) for i in range(64)]
+    model.fit(data, batch_size=16, epochs=2, verbose=0)
+    loss0 = model.train_batch([paddle.to_tensor(xs[:16])],
+                              [paddle.to_tensor(ys[:16])])[0]
+    assert np.isfinite(loss0)
