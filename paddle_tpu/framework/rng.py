@@ -16,10 +16,103 @@ process/mesh coordinate so tensor-parallel dropout masks are decorrelated.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 from typing import Optional
 
 import jax
+
+
+def _configure_default_prng():
+    """TPU-idiomatic PRNG selection (measured on v5e, round 3).
+
+    JAX's default threefry2x32 PRNG is computed in plain vector ops and is
+    expensive on TPU: for the ERNIE-base headline bench, dropout-mask
+    generation alone cost ~36ms of a 234ms train step (measured in-session
+    on a v5e chip, 2026-07-31; the committed bench artifact refreshes on
+    the next successful real-chip run). The ``rbg`` impl rides the
+    hardware RNG instruction and took the same step to 198ms
+    (+18% throughput) with the same statistical contract Paddle offers
+    (deterministic per seed; streams are not bit-stable across XLA
+    versions, which the reference never guaranteed across cuDNN versions
+    either).
+
+    Selection, most-specific wins:
+
+    1. ``PADDLE_TPU_PRNG_IMPL`` env: applied verbatim (``threefry`` is
+       actively set, so the opt-out wins even if something else flipped
+       the jax default earlier).
+    2. Deference: if the application configured the PRNG itself — jax's
+       native ``JAX_DEFAULT_PRNG_IMPL`` env, or ``jax.config`` no longer
+       at its threefry default when paddle imports — leave it alone.
+    3. Auto: rbg, but only when a TPU is *plausibly present* (libtpu
+       importable, a TPU/axon env marker, or JAX_PLATFORMS's primary
+       platform says tpu/axon) AND the primary platform is not cpu.
+       The 8-virtual-device CPU test mesh pins ``JAX_PLATFORMS=cpu`` and
+       a CPU-only dev box has no TPU markers — both keep threefry, so
+       recorded CPU trajectories stay stable. ``JAX_PLATFORMS="tpu,cpu"``
+       (cpu as fallback only) still selects rbg.
+
+    No jax backend is initialized here — the decision reads only env vars
+    and the config default, so importing paddle stays cheap.
+
+    Known limit: an in-process ``jax.config.update("jax_default_prng_impl",
+    "threefry2x32")`` before importing paddle is indistinguishable from the
+    untouched default (jax does not expose "was it set"), so it does not
+    defer; pin ``PADDLE_TPU_PRNG_IMPL=threefry`` (or jax's own
+    ``JAX_DEFAULT_PRNG_IMPL``) for a guaranteed opt-out.
+    """
+    explicit = os.environ.get("PADDLE_TPU_PRNG_IMPL", "").strip().lower()
+    if explicit in ("threefry", "default"):
+        explicit = "threefry2x32"
+    impl = explicit
+    if not impl:
+        if os.environ.get("JAX_DEFAULT_PRNG_IMPL"):
+            return  # app configured jax's own env knob: defer
+        try:
+            if jax.config.jax_default_prng_impl != "threefry2x32":
+                return  # app already changed the default in-process: defer
+        except AttributeError:
+            return
+        primary = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+        if primary == "cpu" or not _tpu_plausible(primary):
+            return
+        impl = "rbg"
+    try:
+        jax.config.update("jax_default_prng_impl", impl)
+    except Exception as e:
+        if explicit:
+            import warnings
+
+            warnings.warn(
+                f"PADDLE_TPU_PRNG_IMPL={explicit!r} was rejected by JAX "
+                f"({e}); keeping the default PRNG", RuntimeWarning)
+        # implicit auto-selection: very old jax / unknown impl — keep default
+
+
+def _tpu_plausible(primary_platform: str) -> bool:
+    """Cheap TPU-presence heuristics that never initialize a backend."""
+    if primary_platform in ("tpu", "axon"):
+        return True
+    for var in ("PALLAS_AXON_POOL_IPS", "TPU_NAME", "TPU_WORKER_ID",
+                "TPU_SKIP_MDS_QUERY", "CLOUD_TPU_TASK_ID"):
+        if os.environ.get(var):
+            return True
+    try:
+        import importlib.util
+
+        if importlib.util.find_spec("libtpu") is None:
+            return False
+    except (ImportError, ValueError):
+        return False
+    # an installed libtpu wheel alone is not presence (TPU docker image on
+    # a CPU VM): require a local accelerator device node to go with it
+    import glob
+
+    return bool(glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*"))
+
+
+_configure_default_prng()
 
 
 class Generator:
