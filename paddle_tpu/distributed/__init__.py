@@ -102,6 +102,13 @@ from .collective import P2POp, batch_isend_irecv  # noqa: E402,F401
 from . import launch  # noqa: E402,F401  (paddle.distributed.launch module)
 from . import rpc  # noqa: E402,F401  (paddle.distributed.rpc module)
 from . import utils  # noqa: E402,F401  (paddle.distributed.utils module)
+from . import communication  # noqa: E402,F401  (reference package path)
+from . import checkpoint  # noqa: E402,F401
+from .auto_parallel import shard_dataloader  # noqa: E402,F401
+from .checkpoint import (  # noqa: E402,F401  (paddle.distributed.* parity)
+    load_state_dict,
+    save_state_dict,
+)
 all_to_all = alltoall  # reference alias
 
 

@@ -123,6 +123,74 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
 
 
+class _ShardDataLoader:
+    """Iterable that places every batch on the mesh as it is yielded."""
+
+    def __init__(self, dataloader, mesh, shard_dim, input_keys):
+        self._dl = dataloader
+        self._mesh = mesh
+        self._dim = shard_dim  # mesh axis NAME or None
+        self._keys = set(input_keys) if input_keys else None
+
+    def __len__(self):
+        return len(self._dl)
+
+    def _place(self, item, shard):
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._place(x, shard) for x in item)
+        if isinstance(item, dict):
+            return {
+                k: self._place(
+                    v, shard and (self._keys is None or k in self._keys))
+                for k, v in item.items()
+            }
+        if not (isinstance(item, Tensor) or hasattr(item, "shape")):
+            return item
+        # one placement per MESH axis; Shard(0) = shard the batch (tensor
+        # dim 0) along the axis named by shard_dims
+        placements = [Replicate()] * self._mesh.ndim
+        if shard and self._dim is not None and len(item.shape):
+            placements[self._mesh.dim_names.index(self._dim)] = Shard(0)
+        return shard_tensor(item, self._mesh, placements)
+
+    def __iter__(self):
+        for batch in self._dl:
+            yield self._place(batch, True)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """paddle.distributed.shard_dataloader parity: wrap a DataLoader so each
+    yielded batch is placed on ``meshes`` with its leading (batch) axis
+    sharded along ``shard_dims``, or fully replicated when ``shard_dims``
+    is None. ``shard_dims`` accepts a mesh axis name (``"dp"``), a mesh
+    axis index, or a list of either (one per mesh, as the reference allows);
+    ``input_keys`` restricts sharding to those keys of a dict batch.
+
+    TPU-native note: placement is a ``jax.device_put`` with a NamedSharding —
+    the SPMD program consumes the batch without further resharding. Multiple
+    meshes (the reference's per-pipeline-stage input feed) collapse to the
+    first mesh here: under one-program SPMD pipeline stages read slices of
+    the same placed batch.
+    """
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    if isinstance(shard_dims, (list, tuple)):
+        # one entry per mesh in the reference; SPMD collapses to one mesh
+        shard_dims = shard_dims[0] if len(shard_dims) else None
+    if isinstance(shard_dims, (int, np.integer)):
+        try:
+            shard_dims = mesh.dim_names[int(shard_dims)]
+        except IndexError:
+            raise ValueError(
+                f"shard_dims index {shard_dims} out of range for mesh axes "
+                f"{mesh.dim_names}") from None
+    if shard_dims is not None:
+        names = tuple(getattr(mesh, "dim_names", ()) or ())
+        if names and shard_dims not in names:
+            raise ValueError(
+                f"shard_dims {shard_dims!r} is not a mesh axis of {names}")
+    return _ShardDataLoader(dataloader, mesh, shard_dims, input_keys)
+
+
 def reshard(tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
     """Move a tensor to a new placement (reference: auto_parallel reshard —
     the comm-inserting pass; here a single resharding device_put / constraint)."""

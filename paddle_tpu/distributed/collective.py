@@ -301,6 +301,7 @@ class stream:
     all_gather = staticmethod(all_gather)
     reduce_scatter = staticmethod(reduce_scatter)
     alltoall = staticmethod(alltoall)
+    alltoall_single = staticmethod(alltoall_single)
     broadcast = staticmethod(broadcast)
     scatter = staticmethod(scatter)
     reduce = staticmethod(reduce)

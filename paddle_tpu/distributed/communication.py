@@ -1,0 +1,34 @@
+"""paddle.distributed.communication path parity.
+
+Reference: ``python/paddle/distributed/communication/`` — the package where
+upstream implements the user-level collective API (``all_reduce`` etc.) and
+its ``stream.*`` variants (explicit comm-stream control + ``sync_op``).
+
+Here the implementations live in :mod:`paddle_tpu.distributed.collective`
+(one module — there are no user-managed comm streams on TPU, SURVEY.md §2.3
+"Comm APIs"); this module re-exports them so code importing the reference's
+``paddle.distributed.communication.stream`` path keeps working.
+"""
+from .collective import (  # noqa: F401
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    gather,
+    get_backend,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    scatter_object_list,
+    send,
+    stream,
+    wait,
+)

@@ -40,7 +40,9 @@ from .topology import (
 )
 from . import topology  # noqa: F401
 from .layers import mpu  # noqa: F401
-from .utils import recompute, sequence_parallel_utils  # noqa: F401
+from .utils import (  # noqa: F401
+    recompute, recompute_hybrid, recompute_sequential, sequence_parallel_utils,
+)
 
 _strategy: Optional[DistributedStrategy] = None
 _initialized = False

@@ -1,3 +1,6 @@
-from .recompute_helper import recompute, recompute_sequential  # noqa: F401
+from . import mix_precision_utils  # noqa: F401
+from .recompute_helper import (  # noqa: F401
+    recompute, recompute_hybrid, recompute_sequential,
+)
 from . import sequence_parallel_utils  # noqa: F401
 from .fs import HDFSClient, LocalFS  # noqa: F401

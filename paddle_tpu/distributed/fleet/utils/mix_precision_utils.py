@@ -1,0 +1,61 @@
+"""fleet.utils.mix_precision_utils parity.
+
+Reference: ``python/paddle/distributed/fleet/utils/mix_precision_utils.py`` —
+``MixPrecisionLayer`` keeps a float32 ``main_grad`` per low-precision
+parameter (grads accumulate in fp32 regardless of the compute dtype) and
+``MixPrecisionOptimizer`` steps from those master grads.
+
+TPU-native design: the same contract falls out of the existing O2 machinery —
+``amp.decorate`` casts parameters to the low-precision dtype and flips the
+optimizer to fp32 master weights, and the fused train step computes the
+parameter update in fp32 (`Optimizer._use_master_weights` path). These
+wrappers exist for API parity with training scripts written against the
+reference; they delegate to that machinery rather than duplicating it.
+"""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+
+class MixPrecisionLayer(Layer):
+    """Wrap ``layers`` for low-precision compute with fp32-mastered updates.
+
+    Casts the wrapped model's fp32 parameters to ``dtype`` (as
+    ``amp.decorate(level="O2")`` does). Gradient mastering happens in the
+    optimizer (see :class:`MixPrecisionOptimizer`), which is where the
+    reference's ``main_grad`` lives too once the update is computed.
+    """
+
+    def __init__(self, layers, dtype="float16"):
+        super().__init__()
+        from .... import amp
+
+        self._layers = amp.decorate(layers, None, level="O2", dtype=dtype)
+        self._dtype = dtype
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+
+class MixPrecisionOptimizer:
+    """Wrap an optimizer to update fp32 master weights from low-precision
+    grads (cast to fp32 before the rule — the ``main_grad`` contract)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        optimizer._use_master_weights = True
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)

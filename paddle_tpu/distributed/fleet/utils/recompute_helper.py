@@ -28,7 +28,8 @@ def _recompute_apply(vals, fn):
     return fn(*vals)
 
 
-def recompute(function, *args, use_reentrant=True, preserve_rng_state=True, policy=None, **kwargs):
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              policy=None, _param_owners=None, **kwargs):
     """Run `function(*args)` under rematerialization."""
     if policy is None:
         policy = jax.checkpoint_policies.dots_saveable
@@ -36,10 +37,14 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True, poli
     tensor_args = [isinstance(a, Tensor) for a in args]
     # The block's parameters must be explicit differentiable inputs of the
     # tape node, or their grads would be lost in eager mode (they are closure
-    # constants otherwise).
-    fn_self = getattr(function, "__self__", None)
-    owner = function if hasattr(function, "named_parameters") else fn_self
-    params = [p for _, p in owner.named_parameters()] if owner is not None else []
+    # constants otherwise). `_param_owners` lets wrappers whose `function` is
+    # a plain closure (recompute_sequential's segment runner) name the Layers
+    # whose parameters the closure touches.
+    if _param_owners is None:
+        fn_self = getattr(function, "__self__", None)
+        owner = function if hasattr(function, "named_parameters") else fn_self
+        _param_owners = [owner] if owner is not None else []
+    params = [p for o in _param_owners for _, p in o.named_parameters()]
     n_args = len(args)
 
     def pure(*vals):
@@ -83,6 +88,26 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     i = 0
     while i < n:
         seg_fns = functions[i : i + per]
-        x = recompute(run_segment(seg_fns), x, **kwargs)
+        # the segment runner is a plain closure: name the layers explicitly
+        # so their parameters become differentiable tape inputs (otherwise
+        # their grads silently vanish in eager mode)
+        owners = [f for f in seg_fns if hasattr(f, "named_parameters")]
+        x = recompute(run_segment(seg_fns), x, _param_owners=owners, **kwargs)
         i += per
     return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """fleet.recompute_hybrid parity. In the reference this variant syncs
+    RNG across the hybrid (mp/pp) groups and optionally offloads stashed
+    activations to host. Under SPMD neither concern exists: randomness is an
+    explicit traced key (identical on every device of the mesh by
+    construction) and there are no stashed activations to offload —
+    ``jax.checkpoint`` re-emits the forward in the backward program. The
+    ``ctx`` dict (mp_group / offload / partition) is therefore accepted and
+    only its unsupported knobs are validated."""
+    if isinstance(ctx, dict) and ctx.get("partition"):
+        raise NotImplementedError(
+            "recompute_hybrid(partition=True): activation-partition offload "
+            "has no SPMD analogue; use sharding (ZeRO-3) placement instead")
+    return recompute(function, *args, **kwargs)

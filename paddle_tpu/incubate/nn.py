@@ -160,6 +160,125 @@ class FusedTransformerEncoderLayer(Layer):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
 
 
+class FusedMultiTransformer(Layer):
+    """Stack of fused decoder blocks for generation serving (reference:
+    ``python/paddle/incubate/nn/layer/fused_transformer.py::FusedMultiTransformer``
+    — the multi-layer CUDA kernel behind PaddleNLP's LLM inference).
+
+    TPU-native design: each layer is pre-norm attention + FFN expressed as
+    large jnp ops (qkv packed as one matmul); incremental decoding uses the
+    ``caches`` argument — a list of (k, v) arrays per layer, matching the
+    reference's CacheKV — with ``time_step`` selecting the write position
+    (static-shape update, serving-loop friendly).
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-layernorm only (as the "
+                "reference kernel)")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.num_layers = num_layers
+        self._act = activation
+        self.layers = []
+        for i in range(num_layers):
+            blk = {
+                "ln": LayerNorm(embed_dim),
+                "qkv": FusedLinear(embed_dim, 3 * embed_dim),
+                "out_proj": FusedLinear(embed_dim, embed_dim),
+                "ffn_ln": LayerNorm(embed_dim),
+                "ffn1": FusedLinear(embed_dim, dim_feedforward),
+                "ffn2": FusedLinear(dim_feedforward, embed_dim),
+            }
+            for k, sub in blk.items():
+                self.add_sublayer(f"layer{i}_{k}", sub)
+            self.layers.append(blk)
+        self.dropout = Dropout(dropout_rate)
+
+    def _attn(self, blk, x, attn_mask, cache, time_step):
+        b, s, _ = x.shape
+        h, hd = self.num_heads, self.head_dim
+        qkv = raw(blk["qkv"](blk["ln"](x)))  # [b, s, 3e]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, h, hd)
+        v = v.reshape(b, s, h, hd)
+        if cache is not None:
+            ck, cv = cache  # [b, max_len, h, hd]
+            if time_step is not None:
+                t = raw(time_step) if isinstance(time_step, Tensor) else time_step
+                t = t if hasattr(t, "shape") else int(t)
+                import jax as _jax
+
+                # write ALL s tokens at [t, t+s) (chunked/speculative decode;
+                # s=1 is the common serving step)
+                ck = _jax.lax.dynamic_update_slice_in_dim(
+                    jnp.asarray(ck), k, t, axis=1)
+                cv = _jax.lax.dynamic_update_slice_in_dim(
+                    jnp.asarray(cv), v, t, axis=1)
+                k, v = ck, cv
+                # query i (absolute position t+i) sees cache slots j <= t+i
+                valid = (jnp.arange(k.shape[1])[None, :]
+                         <= (t + jnp.arange(s))[:, None])[None, None]
+            else:  # prefill: write the prompt into the cache head
+                ck = jnp.asarray(ck).at[:, :s].set(k)
+                cv = jnp.asarray(cv).at[:, :s].set(v)
+                k, v = ck, cv
+                # causal within the prompt; slots >= s are empty (j <= i < s)
+                valid = (jnp.arange(k.shape[1])[None, :]
+                         <= jnp.arange(s)[:, None])[None, None]
+            if attn_mask is None:
+                attn_mask = Tensor(valid)
+            else:
+                m = raw(attn_mask) if isinstance(attn_mask, Tensor) else jnp.asarray(attn_mask)
+                L = k.shape[1]
+                if m.shape[-1] != L:
+                    # reference-shaped prompt mask [.., s, s]: pad the key
+                    # axis to cache length (the tail is already invalidated
+                    # by `valid`, so the pad value is inert)
+                    pad = [(0, 0)] * (m.ndim - 1) + [(0, L - m.shape[-1])]
+                    m = jnp.pad(m, pad, constant_values=(
+                        True if m.dtype == jnp.bool_ else 0.0))
+                if m.dtype == jnp.bool_:
+                    attn_mask = Tensor(m & valid)
+                else:
+                    neg = jnp.asarray(jnp.finfo(m.dtype).min, m.dtype)
+                    attn_mask = Tensor(jnp.where(valid, m, neg))
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        out = F.scaled_dot_product_attention(
+            Tensor(q), Tensor(k), Tensor(v),
+            attn_mask=attn_mask,
+            is_causal=(attn_mask is None and cache is None),
+        )
+        out = raw(out).reshape(b, s, h * hd)
+        return raw(blk["out_proj"](Tensor(out))), new_cache
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        x = raw(src) if isinstance(src, Tensor) else jnp.asarray(src)
+        new_caches = []
+        act = getattr(F, self._act)
+        for i, blk in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            attn_out, new_cache = self._attn(blk, Tensor(x), attn_mask, cache,
+                                             time_step)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+            x = x + raw(self.dropout(Tensor(attn_out)))
+            ffn_in = blk["ffn_ln"](Tensor(x))
+            ffn = blk["ffn2"](act(blk["ffn1"](ffn_in)))
+            x = x + raw(self.dropout(ffn))
+        out = Tensor(x)
+        if caches is not None:
+            return out, new_caches
+        return out
+
+
 class FusedLinear(Layer):
     """paddle.incubate.nn.FusedLinear — on TPU a plain Linear already fuses
     matmul+bias in XLA; provided for API parity."""

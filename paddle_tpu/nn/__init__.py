@@ -1,6 +1,7 @@
 """paddle.nn parity surface."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from .layer import (  # noqa: F401
     Layer, LayerDict, LayerList, ParamAttr, Parameter, ParameterList,
     Sequential,
@@ -8,8 +9,8 @@ from .layer import (  # noqa: F401
 from .layers.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, SELU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
-    RReLU, SiLU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign,
-    Swish, Tanh, Tanhshrink, ThresholdedReLU,
+    RReLU, SiLU, Sigmoid, Silu, Softmax, Softmax2D, Softplus, Softshrink,
+    Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layers.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
@@ -37,7 +38,7 @@ from .layers.norm import (  # noqa: F401
 from .layers.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
     AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
-    MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool2D,
+    MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layers.rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN, SimpleRNNCell,

@@ -33,7 +33,9 @@ __all__ = [
     "adaptive_max_pool1d",
     "adaptive_max_pool2d",
     "adaptive_max_pool3d",
+    "max_unpool1d",
     "max_unpool2d",
+    "max_unpool3d",
     "unfold",
 ]
 
@@ -230,7 +232,18 @@ def _pool(x, ksize, stride, padding, nsp, reducer, init, ceil_mode, data_format,
 
 @defop
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
-    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+    out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+    if not return_mask:
+        return out
+    if ceil_mode or data_format != "NCL" or isinstance(padding, str):
+        raise NotImplementedError(
+            "max_pool1d(return_mask=True) supports NCL, numeric padding, "
+            "ceil_mode=False (the index/unpool path)")
+    k = _tuple(kernel_size, 1)[0]
+    s = _tuple(stride or kernel_size, 1)[0]
+    p = padding if isinstance(padding, int) else _tuple(padding, 1)[0]
+    idx = _pool_argmax_indices(x, (k,), (s,), (p,))
+    return out, idx.reshape(out.shape)
 
 
 @defop
@@ -249,45 +262,71 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     n, c, h, w = x.shape
     k = _tuple(kernel_size, 2)
     s = _tuple(stride or kernel_size, 2)
-    cols = _unfold_nchw(x, k, s, padding)  # [N, C, kh*kw, L]
-    pos = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
-    pos = jnp.broadcast_to(pos, (n, 1, h, w))
-    pcols = _unfold_nchw(pos, k, s, padding)  # [N, 1, kh*kw, L]
+    p = _tuple(padding, 2) if not isinstance(padding, int) else (padding, padding)
+    idx = _pool_argmax_indices(x, k, s, p)
+    oh, ow = out.shape[2], out.shape[3]
+    return out, idx.reshape(n, c, oh, ow)
+
+
+def _unfold_nd(x, k, s, p, pad_value):
+    """[N, C, *spatial] -> [N, C, prod(k), L] sliding windows over any
+    number of spatial dims (helper for the pooling argmax paths)."""
+    import itertools
+    import math
+
+    nd = len(k)
+    xp = jnp.pad(
+        x, ((0, 0), (0, 0)) + tuple((p[i], p[i]) for i in range(nd)),
+        constant_values=pad_value,
+    )
+    sp = xp.shape[2:]
+    osz = [(sp[i] - k[i]) // s[i] + 1 for i in range(nd)]
+    windows = []
+    for offs in itertools.product(*[range(ki) for ki in k]):
+        limit = xp.shape[:2] + tuple(
+            offs[i] + (osz[i] - 1) * s[i] + 1 for i in range(nd))
+        windows.append(jax.lax.slice(
+            xp, (0, 0) + offs, limit, (1, 1) + tuple(s)))
+    return jnp.stack(windows, axis=2).reshape(
+        x.shape[0], x.shape[1], math.prod(k), math.prod(osz))
+
+
+def _pool_argmax_indices(x, k, s, p):
+    """Flat-spatial argmax index per pooled cell ([N, C, L] int32) — the
+    unpool indices the reference's max_pool*_with_index kernels produce.
+    Positions ride an int32 unfold (float32 would corrupt indices past
+    2^24, e.g. 3-D volumes over 16.7M voxels); value windows pad with
+    -inf so padding never wins the argmax."""
+    import math
+
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    cols = _unfold_nd(x, k, s, p, -jnp.inf)  # [N, C, prod(k), L]
+    pos = jnp.arange(math.prod(spatial), dtype=jnp.int32).reshape(
+        (1, 1) + spatial)
+    pos = jnp.broadcast_to(pos, (n, 1) + spatial)
+    pcols = _unfold_nd(pos, k, s, p, 0)  # [N, 1, prod(k), L]
     arg = jnp.argmax(cols, axis=2)  # [N, C, L]
-    idx = jnp.take_along_axis(
+    return jnp.take_along_axis(
         jnp.broadcast_to(pcols, cols.shape), arg[:, :, None, :], axis=2
     )[:, :, 0, :]
-    oh, ow = out.shape[2], out.shape[3]
-    return out, idx.reshape(n, c, oh, ow).astype(jnp.int32)
-
-
-def _unfold_nchw(x, k, s, padding):
-    """[N, C, H, W] -> [N, C, kh*kw, L] sliding windows (helper for the
-    pooling argmax; padded positions carry -inf so they never win)."""
-    p = _tuple(padding, 2) if not isinstance(padding, int) else (padding, padding)
-    xp = jnp.pad(
-        x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
-        constant_values=-jnp.inf,
-    )
-    n, c, h, w = xp.shape
-    oh = (h - k[0]) // s[0] + 1
-    ow = (w - k[1]) // s[1] + 1
-    windows = []
-    for di in range(k[0]):
-        for dj in range(k[1]):
-            windows.append(
-                jax.lax.slice(
-                    xp, (0, 0, di, dj),
-                    (n, c, di + (oh - 1) * s[0] + 1, dj + (ow - 1) * s[1] + 1),
-                    (1, 1, s[0], s[1]),
-                )
-            )
-    return jnp.stack(windows, axis=2).reshape(n, c, k[0] * k[1], oh * ow)
 
 
 @defop
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+    out = _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf, ceil_mode, data_format)
+    if not return_mask:
+        return out
+    if ceil_mode or data_format != "NCDHW" or isinstance(padding, str):
+        raise NotImplementedError(
+            "max_pool3d(return_mask=True) supports NCDHW, numeric padding, "
+            "ceil_mode=False (the index/unpool path)")
+    n, c = x.shape[:2]
+    k = _tuple(kernel_size, 3)
+    s = _tuple(stride or kernel_size, 3)
+    p3 = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    idx = _pool_argmax_indices(x, k, s, p3)
+    return out, idx.reshape((n, c) + out.shape[2:])
 
 
 @defop
@@ -388,16 +427,52 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     """Scatter pooled values back to their argmax positions (reference:
     unpool op). `indices` are flat h*w positions as produced by
     max_pool2d(return_mask=True)."""
-    n, c, ph, pw = x.shape
-    k = _tuple(kernel_size, 2)
-    s = _tuple(stride or kernel_size, 2)
-    if output_size is None:
-        oh = (ph - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else padding[0])
-        ow = (pw - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else padding[1])
-    else:
-        oh, ow = output_size[-2:]
-    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    osz = _unpool_out_sizes(x.shape[2:], kernel_size, stride, padding,
+                            output_size, 2)
+    return _max_unpool_nd(x, indices, osz)
+
+
+@defop
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """1-D unpool: scatter pooled values back to their argmax positions
+    (reference: unpool op over NCL; indices are flat length positions from
+    max_pool1d(return_mask=True))."""
+    osz = _unpool_out_sizes(x.shape[2:], kernel_size, stride, padding,
+                            output_size, 1)
+    return _max_unpool_nd(x, indices, osz)
+
+
+@defop
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """3-D unpool: scatter pooled values back to their argmax positions
+    (reference: unpool3d op; indices are flat d*h*w positions from
+    max_pool3d(return_mask=True))."""
+    osz = _unpool_out_sizes(x.shape[2:], kernel_size, stride, padding,
+                            output_size, 3)
+    return _max_unpool_nd(x, indices, osz)
+
+
+def _unpool_out_sizes(pooled_spatial, kernel_size, stride, padding,
+                      output_size, nd):
+    """Per-dim unpooled sizes: (pooled-1)*stride + kernel - 2*pad."""
+    if output_size is not None:
+        return tuple(output_size[-nd:])
+    k = _tuple(kernel_size, nd)
+    s = _tuple(stride or kernel_size, nd)
+    p = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    return tuple((pooled_spatial[i] - 1) * s[i] + k[i] - 2 * p[i]
+                 for i in range(nd))
+
+
+def _max_unpool_nd(x, indices, out_spatial):
+    """Shared unpool scatter: values land at their flat-spatial indices."""
+    import math
+
+    n, c = x.shape[:2]
+    flat = jnp.zeros((n, c, math.prod(out_spatial)), x.dtype)
     idx = indices.reshape(n, c, -1).astype(jnp.int32)
     vals = x.reshape(n, c, -1)
     out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
-    return out.reshape(n, c, oh, ow)
+    return out.reshape((n, c) + tuple(out_spatial))

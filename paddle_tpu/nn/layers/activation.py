@@ -75,5 +75,22 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs (reference
+    ``python/paddle/nn/layer/activation.py::Softmax2D``): requires a 3-D or
+    4-D input and normalizes along axis -3."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if len(x.shape) not in (3, 4):
+            raise ValueError(
+                f"Softmax2D requires a 3D or 4D tensor, got rank {len(x.shape)}")
+        return F.softmax(x, axis=-3)
+
+
 LogSigmoid = _simple("log_sigmoid")
 SiLU = Silu  # paddle exposes both spellings

@@ -177,3 +177,24 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
         object.__setattr__(layer, "_weight_norm_hooks", {})
     layer._weight_norm_hooks[name] = (hook, remover)
     return layer
+
+
+def remove_spectral_norm(layer, name="weight"):
+    """Fold the spectrally-normalized weight back into a plain parameter
+    (reference ``nn/utils/spectral_norm_hook.py::remove_spectral_norm``)."""
+    from ..layer import Parameter
+
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    hook = hooks.get(name, (None,))[0]
+    if not isinstance(hook, _SpectralNormHook):
+        raise ValueError(f"spectral_norm was not applied to {name!r}")
+    hook, remover = hooks.pop(name)
+    w = hook.compute_weight(layer)
+    remover.remove()
+    orig = layer._parameters.pop(name + "_orig")
+    layer._buffers.pop(name + "_u", None)
+    object.__setattr__(layer, name + "_orig", None)
+    object.__setattr__(layer, name + "_u", None)
+    layer.add_parameter(name, Parameter(raw(w), trainable=orig.trainable,
+                                        name=name))
+    return layer

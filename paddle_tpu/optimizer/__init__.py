@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -515,6 +516,159 @@ class Adadelta(Optimizer):
         update = -jnp.sqrt((st["avg_squared_update"] + self._epsilon) / (asg + self._epsilon)) * g
         asu = self._rho * st["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
         return p + lr * update, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient descent (reference:
+    ``python/paddle/optimizer/asgd.py``). ``d`` holds the running SUM of the
+    last ``batch_num`` gradients via a rotating slot buffer ``ys``:
+    ``d <- d - ys[t % n] + g; ys[t % n] <- g; param <- param - lr * d / m``
+    with ``m`` the number of batches seen, saturating at ``batch_num``.
+    The slot write is a ``dynamic_update_slice`` on a state scalar, so the
+    rule jits. Memory note (as upstream documents): state is
+    ``batch_num x`` the parameter size."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._batch_num = int(batch_num)
+        self._use_master_weights = bool(multi_precision)
+
+    def _init_state(self, p):
+        pv = raw(p)
+        dt = jnp.float32 if self._use_master_weights else pv.dtype
+        return {"d": jnp.zeros(pv.shape, dt),
+                "ys": jnp.zeros((self._batch_num,) + tuple(pv.shape), dt),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _rule(self, p, g, st, lr):
+        slot = st["step"] % self._batch_num
+        y_old = jax.lax.dynamic_index_in_dim(st["ys"], slot, 0,
+                                             keepdims=False)
+        d = st["d"] - y_old + g
+        ys = jax.lax.dynamic_update_index_in_dim(st["ys"], g, slot, 0)
+        m = jnp.minimum(st["step"] + 1, self._batch_num).astype(p.dtype)
+        new_p = p - lr * d / m
+        return new_p.astype(p.dtype), {"d": d, "ys": ys,
+                                       "step": st["step"] + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (reference: ``python/paddle/optimizer/rprop.py``).
+    Maintains a per-element step size that grows by ``etas[1]`` while the
+    gradient keeps its sign and shrinks by ``etas[0]`` on a sign flip (the
+    flipped gradient is dropped for that element); the update uses only the
+    gradient's sign. Batch-size independent — full-batch contract as upstream
+    documents."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = (float(learning_rate_range[0]), float(learning_rate_range[1]))
+        self._etas = (float(etas[0]), float(etas[1]))
+        self._use_master_weights = bool(multi_precision)
+
+    def _init_state(self, p):
+        pv = raw(p)
+        dt = jnp.float32 if self._use_master_weights else pv.dtype
+        return {"prev_grad": jnp.zeros(pv.shape, dt),
+                "lrs": jnp.full(pv.shape, float(self.get_lr()), dt)}
+
+    def _rule(self, p, g, st, lr):
+        sign = jnp.sign(st["prev_grad"] * g)
+        lo, hi = self._lr_range
+        neg, pos = self._etas
+        factor = jnp.where(sign > 0, pos, jnp.where(sign < 0, neg, 1.0))
+        lrs = jnp.clip(st["lrs"] * factor, lo, hi)
+        g_eff = jnp.where(sign < 0, 0.0, g)  # drop sign-flipped elements
+        new_p = p - lrs * jnp.sign(g_eff)
+        return new_p.astype(p.dtype), {"prev_grad": g_eff, "lrs": lrs}
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum and the Dozat momentum schedule
+    (reference: ``python/paddle/optimizer/nadam.py``):
+    ``mu_t = beta1 * (1 - 0.5 * 0.96^(t * decay))``."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = float(momentum_decay)
+        self._use_master_weights = bool(multi_precision)
+
+    def _init_state(self, p):
+        pv = raw(p)
+        dt = jnp.float32 if self._use_master_weights else pv.dtype
+        return {"moment1": jnp.zeros(pv.shape, dt),
+                "moment2": jnp.zeros(pv.shape, dt),
+                "mu_product": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32),
+                "step": jnp.zeros((), jnp.float32)}
+
+    def _rule(self, p, g, st, lr):
+        b1, b2, eps, psi = self._beta1, self._beta2, self._epsilon, self._psi
+        t = st["step"] + 1.0
+        mu_t = b1 * (1.0 - 0.5 * jnp.power(0.96, t * psi))
+        mu_next = b1 * (1.0 - 0.5 * jnp.power(0.96, (t + 1.0) * psi))
+        mu_prod = st["mu_product"] * mu_t
+        b2p = st["beta2_pow"] * b2
+        m1 = b1 * st["moment1"] + (1 - b1) * g
+        m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = mu_next * m1 / (1 - mu_prod * mu_next) + (1 - mu_t) * g / (1 - mu_prod)
+        vhat = m2 / (1 - b2p)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p.astype(p.dtype), {
+            "moment1": m1, "moment2": m2, "mu_product": mu_prod,
+            "beta2_pow": b2p, "step": t}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: ``python/paddle/optimizer/radam.py``):
+    rectifies the adaptive term's variance when enough steps have accrued
+    (rho_t > 4), otherwise falls back to un-adapted momentum SGD. The
+    branch is a ``jnp.where`` on state scalars, so the rule stays one
+    compiled program."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._use_master_weights = bool(multi_precision)
+
+    def _init_state(self, p):
+        pv = raw(p)
+        dt = jnp.float32 if self._use_master_weights else pv.dtype
+        return {"moment1": jnp.zeros(pv.shape, dt),
+                "moment2": jnp.zeros(pv.shape, dt),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32),
+                "step": jnp.zeros((), jnp.float32)}
+
+    def _rule(self, p, g, st, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = st["step"] + 1.0
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        m1 = b1 * st["moment1"] + (1 - b1) * g
+        m2 = b2 * st["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m1 / (1 - b1p)
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2p / (1.0 - b2p)
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num, 0.0) / jnp.maximum(r_den, eps))
+        vhat = jnp.sqrt(m2 / (1 - b2p))
+        adaptive = rect * mhat / (vhat + eps)
+        new_p = p - lr * jnp.where(rho_t > 4.0, adaptive, mhat)
+        return new_p.astype(p.dtype), {
+            "moment1": m1, "moment2": m2, "beta1_pow": b1p,
+            "beta2_pow": b2p, "step": t}
 
 
 class LBFGS(Optimizer):

@@ -467,3 +467,147 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     res = call(*[raw(v) for v in xs])
     res_t = [Tensor(r) for r in res]
     return res_t if multi_out else res_t[0]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity: append backward computation for
+    ``targets`` w.r.t. ``inputs`` to the active Program and return the
+    gradient variables.
+
+    Reference: ``python/paddle/base/backward.py::gradients`` — walks the
+    ProgramDesc emitting one grad op per forward op. TPU-native design: the
+    captured op list IS a pure jax program, so the backward is obtained in
+    one shot with ``jax.vjp`` over a replay closure; the whole backward
+    enters the Program as a single record (XLA CSEs its re-played forward
+    against the already-captured one at compile time, so the compiled
+    executable computes the forward once).
+    """
+    import weakref
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import op as _op
+
+    if no_grad_set:
+        raise NotImplementedError(
+            "static.gradients(no_grad_set=...): mark tensors "
+            "stop_gradient=True before capture instead")
+    prog = _op._capture_program or _default_main
+    targets = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        tgs = [None] * len(targets)
+    else:
+        tg_list = (list(target_gradients)
+                   if isinstance(target_gradients, (list, tuple))
+                   else [target_gradients])
+        tgs = [None if t is None else raw(t) for t in tg_list]
+
+    ops_snapshot = list(prog._ops)
+    target_uids = [t._uid for t in targets]
+    input_uids = [t._uid for t in inputs]
+
+    # every tensor the subgraph reads that no captured op produces is an
+    # external input of the grad record (feeds, parameters, buffers)
+    produced, dep_uids, seen = set(), [], set(input_uids)
+    for _f, _td, descs, out_uids in ops_snapshot:
+        for d in descs:
+            if d[0] == "t" and d[1] not in produced and d[1] not in seen:
+                seen.add(d[1])
+                dep_uids.append(d[1])
+        produced.update(u for u in out_uids if u is not None)
+    missing = [u for u in target_uids if u not in produced and u not in seen]
+    if missing:
+        raise ValueError(
+            "static.gradients: target(s) were not computed by this "
+            "Program's captured ops")
+    all_uids = list(input_uids) + dep_uids
+
+    def grad_record(*vals):
+        base_env = dict(zip(all_uids, vals))
+
+        def pure(in_vals):
+            e = dict(base_env)
+            e.update(zip(input_uids, in_vals))
+            for f, treedef, descs, out_uids in ops_snapshot:
+                rebuilt = [
+                    e[d[1]].astype(d[2]) if d[0] == "t" else d[1]
+                    for d in descs
+                ]
+                a, k = jax.tree_util.tree_unflatten(treedef, rebuilt)
+                out = f(*a, **k)
+                for uid, ov in zip(out_uids, jax.tree_util.tree_leaves(out)):
+                    if uid is not None:
+                        e[uid] = ov
+            return [e[u] for u in target_uids]
+
+        outs, vjp_fn = jax.vjp(pure, [base_env[u] for u in input_uids])
+        cts = [jnp.ones_like(o) if tg is None else tg.astype(o.dtype)
+               for o, tg in zip(outs, tgs)]
+        (gin,) = vjp_fn(list(cts))
+        return tuple(gin)
+
+    # resolve live values for every needed uid (placeholders hold zeros of
+    # the declared shape, so an eager evaluation is always possible)
+    vals = []
+    for u in all_uids:
+        ref = prog._tensor_refs.get(u)
+        t = ref() if ref is not None else None
+        if t is None:
+            t = next((x for x in inputs + targets if x._uid == u), None)
+        if t is None:
+            raise RuntimeError(
+                f"static.gradients: captured tensor uid={u} is no longer "
+                "alive; keep references to Program inputs")
+        vals.append(t._value)
+        prog._tensor_refs[u] = weakref.ref(t)
+
+    # eager evaluation (capture suspended) gives the grad Tensors their
+    # shapes/dtypes; Executor.run recomputes them from the record
+    prev = _op.set_capture_program(None)
+    try:
+        gvals = grad_record(*vals)
+    finally:
+        _op.set_capture_program(prev)
+    grads = [Tensor(g) for g in gvals]
+    for g, inp in zip(grads, inputs):
+        g.name = f"{getattr(inp, 'name', None) or 'var'}@GRAD"
+
+    descs = tuple(("t", u, str(v.dtype)) for u, v in zip(all_uids, vals))
+    treedef = jax.tree_util.tree_flatten(
+        (tuple(range(len(all_uids))), {}))[1]
+    out_uids = tuple(g._uid for g in grads)
+    for g in grads:
+        prog._tensor_refs[g._uid] = weakref.ref(g)
+    prog._ops.append((grad_record, treedef, descs, out_uids))
+    return grads
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """paddle.static.append_backward parity: appends the backward of
+    ``loss`` for every trainable Parameter the Program references and
+    returns ``[(param, param_grad), ...]`` (reference:
+    ``python/paddle/base/backward.py::append_backward``)."""
+    from ..framework import op as _op
+    from ..nn.layer import Parameter
+
+    prog = _op._capture_program or _default_main
+    if parameter_list is None:
+        params, seen = [], set()
+        for _f, _td, descs, _o in prog._ops:
+            for d in descs:
+                if d[0] != "t" or d[1] in seen:
+                    continue
+                seen.add(d[1])
+                ref = prog._tensor_refs.get(d[1])
+                t = ref() if ref is not None else None
+                if isinstance(t, Parameter) and t.trainable:
+                    params.append(t)
+    else:
+        params = [p for p in parameter_list if getattr(p, "trainable", True)]
+    if not params:
+        return []
+    grads = gradients(loss, params, no_grad_set=no_grad_set)
+    return list(zip(params, grads))

@@ -83,13 +83,6 @@ def deprecated(update_to: str = "", since: str = "", reason: str = "", level=1):
     return deco
 
 
-def download(url, path=None, md5sum=None):
-    raise RuntimeError(
-        "paddle_tpu.utils.download: this environment has no network egress; "
-        "place files locally and load them directly"
-    )
-
-
 def require_version(min_version: str, max_version: str = None):
     """Check the installed framework version against bounds
     (paddle.utils.require_version)."""
@@ -107,3 +100,4 @@ def require_version(min_version: str, max_version: str = None):
     return True
 
 from . import dlpack  # noqa: E402,F401
+from . import download  # noqa: E402,F401  (module, as upstream)

@@ -1,0 +1,46 @@
+"""paddle.utils.download parity (offline build).
+
+Reference: ``python/paddle/utils/download.py`` — ``get_weights_path_from_url``
+downloads a weights archive into ``~/.cache/paddle/hapi/weights`` (with md5
+verification and decompression) and returns the local path.
+
+This environment has no network egress, so the download step is gated: a URL
+whose file is ALREADY in the cache directory (placed there out-of-band)
+resolves and verifies exactly as upstream; anything else raises with
+instructions instead of silently hanging on a dead socket.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _md5check(path: str, md5sum: str = None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
+                      check_exist: bool = True):
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(root_dir, fname)
+    if check_exist and os.path.isfile(path) and _md5check(path, md5sum):
+        return path
+    raise RuntimeError(
+        f"paddle.utils.download: {fname!r} is not in the local cache "
+        f"({root_dir}) and this build has no network egress. Place the file "
+        "there manually to use it (md5 is verified when provided)."
+    )
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None):
+    """Resolve a weights URL against the local cache (offline contract)."""
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

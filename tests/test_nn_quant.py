@@ -1,0 +1,161 @@
+"""paddle.nn.quant: weight-only quantization, llm.int8 linear, QAT wrappers.
+
+Reference surface: python/paddle/nn/quant/quantized_linear.py +
+quant_layers.py + functional_layers.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.quant import (
+    QuantizedConv2D,
+    QuantizedLinear,
+    Stub,
+    llm_int8_linear,
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def test_weight_quantize_int8_roundtrip():
+    rs = np.random.RandomState(0)
+    w = paddle.to_tensor(rs.randn(64, 32).astype("float32"))
+    q, s = weight_quantize(w, algo="weight_only_int8")
+    assert _np(q).dtype == np.int8 and _np(q).shape == (64, 32)
+    assert _np(s).shape == (32,)
+    back = _np(weight_dequantize(q, s, algo="weight_only_int8"))
+    # symmetric int8: error bounded by half a quantization step per channel
+    step = _np(s)
+    assert np.abs(back - _np(w)).max() <= (step.max() / 2) + 1e-6
+
+
+def test_weight_quantize_int4_pack_roundtrip():
+    rs = np.random.RandomState(1)
+    w = rs.randn(16, 8).astype("float32")
+    q, s = weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    assert _np(q).shape == (8, 8)  # packed two nibbles per byte along k
+    back = _np(weight_dequantize(q, s, algo="weight_only_int4"))
+    assert back.shape == (16, 8)
+    # re-quantizing the dequantized weight must be a fixed point (pack/unpack
+    # and nibble sign-extension are exact)
+    q2, s2 = weight_quantize(paddle.to_tensor(back), algo="weight_only_int4")
+    np.testing.assert_array_equal(_np(q), _np(q2))
+    np.testing.assert_allclose(_np(s), _np(s2), rtol=1e-6)
+
+
+def test_weight_quantize_grouped():
+    rs = np.random.RandomState(2)
+    w = rs.randn(128, 16).astype("float32")
+    q, s = weight_quantize(paddle.to_tensor(w), group_size=64)
+    assert _np(s).shape == (2, 16)
+    back = _np(weight_dequantize(q, s, group_size=64))
+    assert np.abs(back - w).max() <= _np(s).max() / 2 + 1e-6
+
+
+def test_weight_only_linear_matches_float():
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(4, 64).astype("float32"))
+    w = rs.randn(64, 32).astype("float32")
+    b = rs.randn(32).astype("float32")
+    q, s = weight_quantize(paddle.to_tensor(w))
+    y = _np(weight_only_linear(x, q, paddle.to_tensor(b), s))
+    ref = _np(x) @ w + b
+    assert np.abs(y - ref).max() < 0.15  # int8 weight noise only
+    # int4 is coarser but must still track
+    q4, s4 = weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    y4 = _np(weight_only_linear(x, q4, paddle.to_tensor(b), s4,
+                                weight_dtype="int4"))
+    assert np.abs(y4 - ref).max() < 2.5
+
+
+def test_weight_only_linear_grouped_and_grad():
+    rs = np.random.RandomState(4)
+    xv = rs.randn(4, 128).astype("float32")
+    w = rs.randn(128, 8).astype("float32")
+    q, s = weight_quantize(paddle.to_tensor(w), group_size=64)
+    x = paddle.to_tensor(xv)
+    x.stop_gradient = False
+    y = weight_only_linear(x, q, None, s, group_size=64)
+    loss = paddle.sum(y)
+    loss.backward()
+    g = _np(x.grad)
+    # dL/dx = dequantized weight row-sums — exact, not STE-approximate
+    wdq = _np(weight_dequantize(q, s, group_size=64))
+    np.testing.assert_allclose(g, np.tile(wdq.sum(1), (4, 1)), rtol=2e-5)
+
+
+def test_llm_int8_linear_outlier_split():
+    rs = np.random.RandomState(5)
+    xv = rs.randn(6, 32).astype("float32")
+    xv[:, 3] *= 40.0  # one clear outlier feature column
+    w = rs.randn(32, 16).astype("float32")
+    q, s = weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+    wdq = _np(weight_dequantize(q, s))
+    y = _np(llm_int8_linear(paddle.to_tensor(xv), q, None, s, threshold=6.0))
+    ref = xv @ wdq
+    # outlier column went through in float: closeness is set by the int8
+    # activation noise of the small columns only
+    assert np.abs(y - ref).max() < 0.2
+    # with every column an outlier the result is exactly x @ dequant(w)
+    y_all = _np(llm_int8_linear(paddle.to_tensor(xv), q, None, s,
+                                threshold=0.0))
+    np.testing.assert_allclose(y_all, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_llm_int8_linear_grad_flows():
+    rs = np.random.RandomState(6)
+    x = paddle.to_tensor(rs.randn(3, 16).astype("float32"))
+    x.stop_gradient = False
+    w = rs.randn(16, 4).astype("float32")
+    q, s = weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+    paddle.sum(llm_int8_linear(x, q, None, s)).backward()
+    assert np.isfinite(_np(x.grad)).all() and np.abs(_np(x.grad)).max() > 0
+
+
+def test_quantized_linear_trains():
+    paddle.seed(0)
+    inner = nn.Linear(8, 4)
+    layer = QuantizedLinear(inner)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    rs = np.random.RandomState(7)
+    x = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+    w0 = _np(inner.weight).copy()
+    for _ in range(3):
+        loss = paddle.mean(layer(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # STE let gradients reach the wrapped float weight
+    assert np.abs(_np(inner.weight) - w0).max() > 1e-6
+    # scale buffer learned something
+    assert float(_np(layer.weight_quanter.scale)) > 0
+
+
+def test_quantized_conv2d_forward():
+    paddle.seed(0)
+    layer = QuantizedConv2D(nn.Conv2D(3, 5, 3, padding=1))
+    x = paddle.to_tensor(
+        np.random.RandomState(8).randn(2, 3, 8, 8).astype("float32"))
+    out = layer(x)
+    assert tuple(out.shape) == (2, 5, 8, 8)
+    assert np.isfinite(_np(out)).all()
+
+
+def test_stub_and_functional_layers():
+    from paddle_tpu.nn.quant import add, concat, flatten, reshape
+
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert np.allclose(_np(Stub()(x)), 1.0)
+    assert np.allclose(_np(add()(x, x)), 2.0)
+    assert _np(reshape()(x, [3, 2])).shape == (3, 2)
+    assert _np(concat()([x, x], axis=0)).shape == (4, 3)
+    assert _np(flatten()(x)).shape == (6,)

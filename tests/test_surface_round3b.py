@@ -1,0 +1,384 @@
+"""Round-3 session-2 surface batch: ASGD/Rprop/NAdam/RAdam optimizers,
+Softmax2D, 1-D/3-D max unpool, remove_spectral_norm, recompute_sequential
+param-grad fix + recompute_hybrid, mix_precision_utils, communication.stream
+path, shard_dataloader, static.gradients/append_backward,
+FusedMultiTransformer, utils.download local cache."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+pytestmark = pytest.mark.fast
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+def _train_quadratic(opt_factory, steps=25):
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([3.0, -2.0, 1.5], np.float32))
+    w.stop_gradient = False
+    from paddle_tpu.nn.layer import Parameter
+
+    p = Parameter(_np(w))
+    opt = opt_factory([p])
+    for _ in range(steps):
+        loss = paddle.sum((p - paddle.to_tensor(
+            np.array([1.0, 1.0, 1.0], np.float32))) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy()), _np(p)
+
+
+@pytest.mark.parametrize("name", ["ASGD", "Rprop", "NAdam", "RAdam"])
+def test_new_optimizers_converge(name):
+    from paddle_tpu import optimizer as opt_mod
+
+    cls = getattr(opt_mod, name)
+    kwargs = {"batch_num": 1} if name == "ASGD" else {}
+    # adaptive-momentum rules move ~lr per step regardless of grad scale:
+    # give them enough steps to cover the initial distance (~2.5)
+    lr, steps = (0.2, 60) if name in ("NAdam", "RAdam") else (0.05, 25)
+    loss, w = _train_quadratic(
+        lambda ps: cls(learning_rate=lr, parameters=ps, **kwargs),
+        steps=steps)
+    assert loss < 1.0, f"{name} did not reduce the quadratic (loss={loss})"
+    assert np.isfinite(w).all()
+
+
+def test_asgd_window_average():
+    # with batch_num=n, the update direction is the MEAN of the last n
+    # gradients (rotating slot buffer), not the current gradient alone
+    from paddle_tpu.nn.layer import Parameter
+    from paddle_tpu.optimizer import ASGD
+
+    p = Parameter(np.zeros(2, np.float32))
+    opt = ASGD(learning_rate=1.0, batch_num=2, parameters=[p])
+    grads = [np.array([1.0, 0.0], np.float32),
+             np.array([0.0, 1.0], np.float32),
+             np.array([1.0, 0.0], np.float32)]
+    seen = []
+    w_prev = _np(p).copy()
+    for i, g in enumerate(grads):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        seen.append(g)
+        m = min(i + 1, 2)
+        expect = w_prev - np.sum(seen[-2:], axis=0) / m
+        np.testing.assert_allclose(_np(p), expect, rtol=1e-6)
+        w_prev = _np(p).copy()
+
+
+def test_nadam_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.array([3.0, -2.0, 1.5], np.float32)
+    tgt = np.ones(3, np.float32)
+
+    tw = torch.tensor(w0, requires_grad=True)
+    topt = torch.optim.NAdam([tw], lr=0.05, betas=(0.9, 0.999), eps=1e-8,
+                             momentum_decay=0.004)
+    for _ in range(10):
+        tl = ((tw - torch.tensor(tgt)) ** 2).sum()
+        topt.zero_grad(); tl.backward(); topt.step()
+
+    from paddle_tpu.nn.layer import Parameter
+    from paddle_tpu.optimizer import NAdam
+
+    p = Parameter(w0)
+    popt = NAdam(learning_rate=0.05, parameters=[p])
+    for _ in range(10):
+        loss = paddle.sum((p - paddle.to_tensor(tgt)) ** 2)
+        loss.backward(); popt.step(); popt.clear_grad()
+    np.testing.assert_allclose(_np(p), tw.detach().numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_rprop_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.array([3.0, -2.0, 1.5], np.float32)
+    tgt = np.ones(3, np.float32)
+
+    tw = torch.tensor(w0, requires_grad=True)
+    topt = torch.optim.Rprop([tw], lr=0.05, etas=(0.5, 1.2),
+                             step_sizes=(1e-5, 50.0))
+    for _ in range(8):
+        tl = ((tw - torch.tensor(tgt)) ** 2).sum()
+        topt.zero_grad(); tl.backward(); topt.step()
+
+    from paddle_tpu.nn.layer import Parameter
+    from paddle_tpu.optimizer import Rprop
+
+    p = Parameter(w0)
+    popt = Rprop(learning_rate=0.05, learning_rate_range=(1e-5, 50.0),
+                 parameters=[p], etas=(0.5, 1.2))
+    for _ in range(8):
+        loss = paddle.sum((p - paddle.to_tensor(tgt)) ** 2)
+        loss.backward(); popt.step(); popt.clear_grad()
+    np.testing.assert_allclose(_np(p), tw.detach().numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# nn: Softmax2D, unpool 1d/3d, remove_spectral_norm
+# ---------------------------------------------------------------------------
+def test_softmax2d():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 5, 3, 4).astype("float32"))
+    out = _np(nn.Softmax2D()(x))
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+    with pytest.raises(ValueError):
+        nn.Softmax2D()(paddle.to_tensor(np.zeros((2, 3), np.float32)))
+
+
+def test_max_unpool1d_roundtrip():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 8).astype("float32"))
+    pooled, idx = F.max_pool1d(x, 2, return_mask=True)
+    un = nn.MaxUnPool1D(2)(pooled, idx)
+    assert tuple(un.shape) == (2, 3, 8)
+    # every pooled max lands back at its argmax position
+    flat = _np(un)
+    orig = _np(x)
+    np.testing.assert_allclose(np.sort(flat[flat != 0.0].ravel()),
+                               np.sort(_np(pooled)[
+                                   np.abs(_np(pooled)) > 0].ravel()),
+                               rtol=1e-6)
+    assert np.all((flat == 0) | (np.abs(flat - orig) < 1e-6))
+
+
+def test_max_unpool3d_roundtrip():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(1, 2, 4, 4, 4).astype("float32"))
+    pooled, idx = F.max_pool3d(x, 2, return_mask=True)
+    assert tuple(idx.shape) == (1, 2, 2, 2, 2)
+    un = nn.MaxUnPool3D(2)(pooled, idx)
+    assert tuple(un.shape) == (1, 2, 4, 4, 4)
+    flat, orig = _np(un), _np(x)
+    assert np.all((flat == 0) | (np.abs(flat - orig) < 1e-6))
+    # index values address the flat d*h*w grid
+    assert _np(idx).min() >= 0 and _np(idx).max() < 64
+
+
+def test_remove_spectral_norm():
+    from paddle_tpu.nn.utils import remove_spectral_norm, spectral_norm
+
+    paddle.seed(0)
+    lin = nn.Linear(6, 4)
+    spectral_norm(lin, n_power_iterations=3)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 6).astype("float32"))
+    before = _np(lin(x))
+    remove_spectral_norm(lin)
+    after = _np(lin(x))
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+    assert "weight" in lin._parameters and "weight_orig" not in lin._parameters
+
+
+# ---------------------------------------------------------------------------
+# recompute fixes
+# ---------------------------------------------------------------------------
+def test_recompute_sequential_param_grads_flow():
+    from paddle_tpu.distributed.fleet.utils import recompute_sequential
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    x.stop_gradient = False
+
+    out = net(x)
+    paddle.sum(out).backward()
+    ref_grads = [_np(p.grad) for p in net.parameters()]
+    for p in net.parameters():
+        p.clear_grad()
+    x.clear_grad()
+
+    out = recompute_sequential({"segments": 2}, net, x)
+    paddle.sum(out).backward()
+    for p, ref in zip(net.parameters(), ref_grads):
+        assert p.grad is not None, "recompute_sequential dropped a param grad"
+        np.testing.assert_allclose(_np(p.grad), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_hybrid():
+    from paddle_tpu.distributed.fleet import recompute_hybrid
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 4).astype("float32"))
+    out = recompute_hybrid({"mp_group": None}, lin, x)
+    paddle.sum(out).backward()
+    assert lin.weight.grad is not None
+    with pytest.raises(NotImplementedError):
+        recompute_hybrid({"partition": True}, lin, x)
+
+
+# ---------------------------------------------------------------------------
+# distributed surfaces
+# ---------------------------------------------------------------------------
+def test_communication_stream_path():
+    import paddle_tpu.distributed.communication as comm
+
+    assert comm.stream.all_reduce is paddle.distributed.all_reduce
+    assert comm.stream.alltoall_single is paddle.distributed.alltoall_single
+    assert comm.ReduceOp.SUM == paddle.distributed.ReduceOp.SUM
+
+
+def test_shard_dataloader_places_batches():
+    import jax
+
+    from paddle_tpu.distributed import ProcessMesh, shard_dataloader
+
+    mesh = ProcessMesh(shape=[len(jax.devices()), 1], dim_names=["dp", "mp"])
+    data = [
+        (np.arange(16, dtype=np.float32).reshape(8, 2),
+         np.zeros((8,), np.int32)),
+    ]
+    dl = shard_dataloader(data, mesh, shard_dims="dp")
+    (xb, yb), = list(dl)
+    spec = xb._value.sharding.spec
+    assert spec[0] == "dp", f"batch axis not dp-sharded: {spec}"
+    np.testing.assert_allclose(_np(xb), data[0][0])
+    # int mesh-dim index and dict batches with input_keys
+    dl2 = shard_dataloader(
+        [{"a": data[0][0], "b": data[0][1]}], mesh, shard_dims=0,
+        input_keys=["a"])
+    (batch2,) = list(dl2)
+    assert batch2["a"]._value.sharding.spec[0] == "dp"
+    assert batch2["b"]._value.sharding.spec[0] is None  # not in input_keys
+    with pytest.raises(ValueError):
+        shard_dataloader(data, mesh, shard_dims="nope")
+
+
+def test_mix_precision_utils():
+    from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+        MixPrecisionLayer, MixPrecisionOptimizer)
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    wrapped = MixPrecisionLayer(net, dtype="bfloat16")
+    assert str(net.weight.dtype) in ("bfloat16", "jax.numpy.bfloat16")
+    opt = MixPrecisionOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = paddle.sum(wrapped(x))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert opt._inner._use_master_weights
+
+
+# ---------------------------------------------------------------------------
+# static.gradients / append_backward
+# ---------------------------------------------------------------------------
+def test_static_gradients_feed_dependent():
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3])
+        w = paddle.to_tensor(np.full((3, 1), 2.0, np.float32))
+        y = paddle.matmul(x, w)
+        loss = paddle.sum(y * y)
+        (gx,) = static.gradients(loss, [x])
+    exe = static.Executor()
+    feed = np.arange(6, dtype=np.float32).reshape(2, 3)
+    (gval,) = exe.run(main, feed={"x": feed}, fetch_list=[gx])
+    # d/dx sum((xw)^2) = 2 (x w) w^T
+    ref = 2.0 * (feed @ np.full((3, 1), 2.0)) @ np.full((1, 3), 2.0)
+    np.testing.assert_allclose(gval, ref, rtol=1e-5)
+    # a different feed must give a different gradient (not frozen)
+    feed2 = feed + 1.0
+    (gval2,) = exe.run(main, feed={"x": feed2}, fetch_list=[gx])
+    ref2 = 2.0 * (feed2 @ np.full((3, 1), 2.0)) @ np.full((1, 3), 2.0)
+    np.testing.assert_allclose(gval2, ref2, rtol=1e-5)
+
+
+def test_static_append_backward():
+    import paddle_tpu.static as static
+    from paddle_tpu.nn.layer import Parameter
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 3])
+        w = Parameter(np.ones((3, 2), np.float32))
+        loss = paddle.sum(paddle.matmul(x, w))
+        pairs = static.append_backward(loss)
+    assert len(pairs) == 1 and pairs[0][0] is w
+    exe = static.Executor()
+    feed = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    (gw,) = exe.run(main, feed={"x": feed}, fetch_list=[pairs[0][1]])
+    np.testing.assert_allclose(gw, feed.sum(0)[:, None].repeat(2, 1),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# FusedMultiTransformer
+# ---------------------------------------------------------------------------
+def test_fused_multi_transformer_forward_and_cache():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    m = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    m.eval()
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 5, 32).astype("float32"))
+    full = _np(m(x))
+    assert full.shape == (2, 5, 32)
+
+    # prefill 4 tokens into caches, decode token 5: must match the full run
+    max_len = 8
+    caches = [(np.zeros((2, max_len, 4, 8), np.float32),
+               np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
+    prefix = paddle.to_tensor(_np(x)[:, :4])
+    out_p, caches = m(prefix, caches=caches, time_step=None)
+    np.testing.assert_allclose(_np(out_p), full[:, :4], rtol=2e-4, atol=2e-4)
+    step_in = paddle.to_tensor(_np(x)[:, 4:5])
+    out_s, caches = m(step_in, caches=caches, time_step=4)
+    np.testing.assert_allclose(_np(out_s)[:, 0], full[:, 4], rtol=2e-4,
+                               atol=2e-4)
+
+    # time_step as a framework Tensor (the reference API's usual type)
+    caches_t = [(np.zeros((2, max_len, 4, 8), np.float32),
+                 np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
+    _, caches_t = m(prefix, caches=caches_t)
+    out_t, _ = m(step_in, caches=caches_t,
+                 time_step=paddle.to_tensor(np.array(4, np.int32)))
+    np.testing.assert_allclose(_np(out_t), _np(out_s), rtol=1e-5, atol=1e-6)
+
+    # reference-shaped prompt mask [b,1,s,s] together with caches (prefill)
+    caches_m = [(np.zeros((2, max_len, 4, 8), np.float32),
+                 np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
+    tril = np.tril(np.ones((1, 1, 4, 4), bool))
+    out_m, _ = m(prefix, attn_mask=paddle.to_tensor(tril), caches=caches_m)
+    np.testing.assert_allclose(_np(out_m), full[:, :4], rtol=2e-4, atol=2e-4)
+
+    # chunked decode: prefill 2, then a 3-token chunk at time_step=2
+    caches2 = [(np.zeros((2, max_len, 4, 8), np.float32),
+                np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
+    _, caches2 = m(paddle.to_tensor(_np(x)[:, :2]), caches=caches2)
+    out_c, _ = m(paddle.to_tensor(_np(x)[:, 2:5]), caches=caches2,
+                 time_step=2)
+    np.testing.assert_allclose(_np(out_c), full[:, 2:5], rtol=2e-4,
+                               atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# utils.download
+# ---------------------------------------------------------------------------
+def test_utils_download_local_cache(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download as dl
+
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path))
+    target = tmp_path / "model.pdparams"
+    target.write_bytes(b"weights")
+    got = dl.get_weights_path_from_url("https://example.com/model.pdparams")
+    assert got == str(target)
+    with pytest.raises(RuntimeError):
+        dl.get_weights_path_from_url("https://example.com/absent.pdparams")
