@@ -105,6 +105,14 @@ from . import utils  # noqa: E402,F401  (paddle.distributed.utils module)
 from . import communication  # noqa: E402,F401  (reference package path)
 from . import checkpoint  # noqa: E402,F401
 from .auto_parallel import shard_dataloader  # noqa: E402,F401
+from .parallelize import (  # noqa: E402,F401
+    ColWiseParallel,
+    RowWiseParallel,
+    SequenceParallelBegin,
+    SequenceParallelEnd,
+    parallelize,
+    to_distributed,
+)
 from .checkpoint import (  # noqa: E402,F401  (paddle.distributed.* parity)
     load_state_dict,
     save_state_dict,

@@ -52,6 +52,8 @@ class MixPrecisionOptimizer:
         optimizer._use_master_weights = True
 
     def __getattr__(self, item):
+        if item == "_inner":  # absent during deepcopy/unpickle reconstruction
+            raise AttributeError(item)
         return getattr(self._inner, item)
 
     def step(self):

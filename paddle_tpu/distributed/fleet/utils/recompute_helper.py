@@ -75,26 +75,38 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         functions = list(functions._sub_layers.values())
     n = len(functions)
     per = max(1, n // max(segments, 1))
-    x = args[0] if len(args) == 1 else args
 
-    def run_segment(fs):
-        def seg(x_):
-            for f in fs:
+    def run_segment(fs, first):
+        def seg(*xs):
+            # the first chained function receives the caller's *args
+            # verbatim (reference variadic contract); later ones take the
+            # previous function's single output
+            if first:
+                x_ = fs[0](*xs)
+                rest = fs[1:]
+            else:
+                (x_,) = xs
+                rest = fs
+            for f in rest:
                 x_ = f(x_)
             return x_
 
         return seg
 
-    i = 0
+    cur = tuple(args)
+    i, first = 0, True
     while i < n:
         seg_fns = functions[i : i + per]
         # the segment runner is a plain closure: name the layers explicitly
         # so their parameters become differentiable tape inputs (otherwise
         # their grads silently vanish in eager mode)
         owners = [f for f in seg_fns if hasattr(f, "named_parameters")]
-        x = recompute(run_segment(seg_fns), x, _param_owners=owners, **kwargs)
+        out = recompute(run_segment(seg_fns, first), *cur,
+                        _param_owners=owners, **kwargs)
+        cur = (out,)
+        first = False
         i += per
-    return x
+    return cur[0]
 
 
 def recompute_hybrid(ctx, function, *args, **kwargs):

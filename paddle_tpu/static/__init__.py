@@ -606,7 +606,22 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 if isinstance(t, Parameter) and t.trainable:
                     params.append(t)
     else:
-        params = [p for p in parameter_list if getattr(p, "trainable", True)]
+        resolved = []
+        for p in parameter_list:
+            if isinstance(p, str):  # the reference accepts Parameter names
+                hit = None
+                for ref in prog._tensor_refs.values():
+                    t = ref()
+                    if isinstance(t, Parameter) and t.name == p:
+                        hit = t
+                        break
+                if hit is None:
+                    raise ValueError(
+                        f"append_backward: no Parameter named {p!r} is "
+                        "referenced by this Program")
+                p = hit
+            resolved.append(p)
+        params = [p for p in resolved if getattr(p, "trainable", True)]
     if not params:
         return []
     grads = gradients(loss, params, no_grad_set=no_grad_set)

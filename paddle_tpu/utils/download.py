@@ -31,8 +31,18 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
                       check_exist: bool = True):
     fname = os.path.basename(url.split("?")[0])
     path = os.path.join(root_dir, fname)
-    if check_exist and os.path.isfile(path) and _md5check(path, md5sum):
-        return path
+    if not check_exist:
+        raise RuntimeError(
+            "paddle.utils.download: check_exist=False forces a re-download, "
+            "which this offline build cannot do; pass check_exist=True to "
+            "use the cached copy")
+    if os.path.isfile(path):
+        if _md5check(path, md5sum):
+            return path
+        raise RuntimeError(
+            f"paddle.utils.download: {path!r} exists but its md5 does not "
+            f"match {md5sum!r} — the cached file is corrupt or stale; "
+            "replace it (no network egress to re-download)")
     raise RuntimeError(
         f"paddle.utils.download: {fname!r} is not in the local cache "
         f"({root_dir}) and this build has no network egress. Place the file "
