@@ -78,6 +78,34 @@ from .hapi_model import Model  # noqa: E402,F401
 from .hapi.model_summary import flops, summary  # noqa: E402,F401
 
 
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions parity. Tensor repr renders through numpy, so
+    this maps straight onto numpy's print options (sci_mode -> suppress)."""
+    import numpy as _np
+
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = int(precision)
+    if threshold is not None:
+        kwargs["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kwargs["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kwargs["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        # NB: plain `bool` is shadowed by the paddle.bool dtype here
+        if sci_mode:
+            # numpy has no "force scientific" flag; a float formatter does it
+            prec = int(precision) if precision is not None else 8
+            kwargs["formatter"] = {
+                "float_kind": lambda v: f"%.{prec}e" % v}
+        else:
+            kwargs["suppress"] = True
+            kwargs["formatter"] = None
+    _np.set_printoptions(**kwargs)
+
+
 def iinfo(dtype):
     import numpy as _np
 

@@ -193,4 +193,21 @@ def is_float16_supported(device=None):
 
 def is_bfloat16_supported(device=None):
     return True
+
+
+def white_list():
+    """paddle.amp.white_list parity: ops computed in the low-precision dtype
+    under auto_cast, keyed like the reference ({dtype: {level: set}}).
+    Every entry is an independent copy — mutating one never affects
+    another (or the live dispatch lists)."""
+    return {dt: {lv: set(AMP_WHITE) for lv in ("O1", "O2")}
+            for dt in ("float16", "bfloat16")}
+
+
+def black_list():
+    """paddle.amp.black_list parity: ops kept in float32 under auto_cast."""
+    return {dt: {lv: set(AMP_BLACK) for lv in ("O1", "O2")}
+            for dt in ("float16", "bfloat16")}
+
+
 from . import debugging  # noqa: F401

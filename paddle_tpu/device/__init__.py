@@ -232,3 +232,21 @@ def get_available_device():
         return [f"{d.platform}:{d.id}" for d in jax.devices()]
     except Exception:
         return ["cpu:0"]
+
+
+# paddle.device.Stream / Event parity (reference: python/paddle/device/
+# __init__.py). On TPU there are no user-managed streams — XLA owns the
+# schedule — so these are the same API-complete no-op classes the cuda/tpu
+# sub-namespaces expose.
+Stream = _Stream
+Event = _Event
+
+
+def stream_guard(stream):
+    import contextlib
+
+    return contextlib.nullcontext(stream)
+
+
+def current_stream(device=None):
+    return _Stream(device)

@@ -76,13 +76,13 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     n = len(functions)
     per = max(1, n // max(segments, 1))
 
-    def run_segment(fs, first):
+    def run_segment(fs, first, fn_kwargs):
         def seg(*xs):
-            # the first chained function receives the caller's *args
-            # verbatim (reference variadic contract); later ones take the
-            # previous function's single output
+            # the first chained function receives the caller's *args and
+            # **kwargs verbatim (reference variadic contract); later ones
+            # take the previous function's single output
             if first:
-                x_ = fs[0](*xs)
+                x_ = fs[0](*xs, **fn_kwargs)
                 rest = fs[1:]
             else:
                 (x_,) = xs
@@ -101,8 +101,8 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         # so their parameters become differentiable tape inputs (otherwise
         # their grads silently vanish in eager mode)
         owners = [f for f in seg_fns if hasattr(f, "named_parameters")]
-        out = recompute(run_segment(seg_fns, first), *cur,
-                        _param_owners=owners, **kwargs)
+        out = recompute(run_segment(seg_fns, first, kwargs if first else {}),
+                        *cur, _param_owners=owners)
         cur = (out,)
         first = False
         i += per

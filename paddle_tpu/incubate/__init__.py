@@ -105,3 +105,23 @@ def graph_reindex(x, neighbors, count, **kw):
 
 
 from . import asp  # noqa: E402,F401
+
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax over the last axis with the upper triangle masked (causal) —
+    reference: incubate/operators/softmax_mask_fuse_upper_triangle.py (a
+    fused CUDA kernel for GPT attention); XLA fuses the where+softmax."""
+    return _softmax_mask_fuse_upper_triangle_op(x)
+
+
+@_defop(name="softmax_mask_fuse_upper_triangle_op")
+def _softmax_mask_fuse_upper_triangle_op(x):
+    import jax
+
+    t_q, t_k = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((t_q, t_k), bool), t_k - t_q)
+    masked = jnp.where(causal, x, jnp.asarray(-1e4, x.dtype))
+    return jax.nn.softmax(masked.astype(jnp.float32), axis=-1).astype(x.dtype)

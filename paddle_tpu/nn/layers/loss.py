@@ -186,3 +186,73 @@ class TripletMarginWithDistanceLoss(Layer):
         return F.triplet_margin_with_distance_loss(
             input, positive, negative, *self.cfg
         )
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """paddle.nn.AdaptiveLogSoftmaxWithLoss parity (reference:
+    ``python/paddle/nn/layer/loss.py`` — adaptive softmax of Grave et al.):
+    frequent classes score in the head matmul, rare classes in
+    down-projected tail clusters (projection width shrinks by
+    ``div_value`` per cluster). forward returns ``(target_logprob, loss)``;
+    ``log_prob`` gives the full [N, n_classes] matrix and ``predict`` the
+    argmax class."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if cutoffs != sorted(set(cutoffs)) or not cutoffs \
+                or cutoffs[-1] > n_classes:
+            raise ValueError(f"invalid cutoffs {cutoffs} for {n_classes}")
+        if cutoffs[-1] != n_classes:
+            cutoffs = cutoffs + [n_classes]
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs
+        self.shortlist_size = cutoffs[0]
+        self.n_clusters = len(cutoffs) - 1
+        head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter((in_features, head_size))
+        self.head_bias = (self.create_parameter((head_size,), is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = cutoffs[i + 1] - cutoffs[i]
+            proj = self.create_parameter((in_features, hsz))
+            cluster = self.create_parameter((hsz, osz))
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_cluster_{i}", cluster)
+            self.tail_weights.append((proj, cluster))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, self.head_bias)
+
+    def log_prob(self, input):
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework.core import Tensor
+        from ...framework.op import raw
+
+        x = raw(input)
+        head = x @ raw(self.head_weight)
+        if self.head_bias is not None:
+            head = head + raw(self.head_bias)
+        head_logp = jax.nn.log_softmax(head, axis=1)
+        parts = [head_logp[:, : self.shortlist_size]]
+        for i, (proj, cluster) in enumerate(self.tail_weights):
+            h = (x @ raw(proj)) @ raw(cluster)
+            parts.append(jax.nn.log_softmax(h, axis=1)
+                         + head_logp[:, self.shortlist_size + i][:, None])
+        return Tensor(jnp.concatenate(parts, axis=1))
+
+    def predict(self, input):
+        import jax.numpy as jnp
+
+        from ...framework.core import Tensor
+        from ...framework.op import raw
+
+        return Tensor(jnp.argmax(raw(self.log_prob(input)), axis=1))

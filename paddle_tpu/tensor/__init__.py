@@ -141,6 +141,7 @@ def _patch():
         "concat": mp.concat, "rot90": mp.rot90,
         # linalg
         "matmul": la.matmul, "bmm": la.bmm, "dot": la.dot, "mv": la.mv,
+        "vecdot": la.vecdot, "isin": lg.isin,
         "norm": la.norm, "dist": la.dist, "cholesky": la.cholesky,
         "inverse": la.inverse, "cross": la.cross, "t": mp.t,
         "matrix_power": la.matrix_power,

@@ -422,3 +422,10 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     key = _rng.next_key()
     g = jax.random.normal(key, xv.shape[:-2] + (xv.shape[-1], q), xv.dtype)
     return _svd_lowrank_op(Tensor(xv), Tensor(g), q=q, niter=int(niter))
+
+
+@defop
+def vecdot(x, y, axis=-1, name=None):
+    """paddle.linalg.vecdot parity: batched vector dot along ``axis``
+    (broadcasts like the reference; conjugates nothing — paddle semantics)."""
+    return jnp.sum(x * y, axis=axis)

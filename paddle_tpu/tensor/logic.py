@@ -128,3 +128,10 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+@defop
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """paddle.isin parity: elementwise membership of ``x`` in ``test_x``."""
+    out = jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+    return out

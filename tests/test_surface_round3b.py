@@ -370,6 +370,66 @@ def test_fused_multi_transformer_forward_and_cache():
 
 
 # ---------------------------------------------------------------------------
+# small-surface tail: vecdot/isin, AdaptiveLogSoftmaxWithLoss layer,
+# set_printoptions, device streams, amp lists, fused causal softmax
+# ---------------------------------------------------------------------------
+def test_vecdot_isin():
+    rs = np.random.RandomState(0)
+    a = rs.randn(3, 4).astype("float32")
+    b = rs.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.vecdot(paddle.to_tensor(a), paddle.to_tensor(b))),
+        (a * b).sum(-1), rtol=1e-5)
+    x = paddle.to_tensor(np.array([1, 2, 3, 4], np.int32))
+    got = _np(paddle.isin(x, paddle.to_tensor(np.array([2, 4], np.int32))))
+    np.testing.assert_array_equal(got, [False, True, False, True])
+    # method form
+    assert _np(x.isin(paddle.to_tensor(np.array([3], np.int32)))).sum() == 1
+
+
+def test_adaptive_log_softmax_layer():
+    paddle.seed(0)
+    layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12])
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 20, (8,)).astype("int32"))
+    out, loss = layer(x, y)
+    assert _np(out).shape == (8,) and np.isfinite(float(_np(loss)))
+    # log_prob covers all classes and normalizes
+    lp = _np(layer.log_prob(x))
+    assert lp.shape == (8, 20)
+    np.testing.assert_allclose(np.exp(lp).sum(1), 1.0, rtol=1e-4)
+    # forward's target log-prob agrees with the full matrix
+    np.testing.assert_allclose(
+        _np(out), lp[np.arange(8), _np(y)], rtol=1e-4, atol=1e-5)
+    pred = _np(layer.predict(x))
+    np.testing.assert_array_equal(pred, lp.argmax(1))
+    # trains
+    loss.backward()
+    assert layer.head_weight.grad is not None
+
+
+def test_small_surface_tail():
+    import paddle_tpu.device as device
+    from paddle_tpu import amp, incubate
+
+    paddle.set_printoptions(precision=3, sci_mode=False)
+    s = device.Stream()
+    e = device.Event()
+    e.record(); s.synchronize()
+    assert e.query() and device.current_stream() is not None
+
+    wl = amp.white_list()
+    assert "matmul" in wl["bfloat16"]["O1"]
+    assert isinstance(amp.black_list()["float16"]["O1"], set)
+
+    x = np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32")
+    out = _np(incubate.softmax_mask_fuse_upper_triangle(paddle.to_tensor(x)))
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-5)
+    assert np.all(out[..., 0, 1:] < 1e-4)  # causal: row 0 sees only col 0
+
+
+# ---------------------------------------------------------------------------
 # utils.download
 # ---------------------------------------------------------------------------
 def test_utils_download_local_cache(tmp_path, monkeypatch):
