@@ -78,10 +78,16 @@ from .hapi_model import Model  # noqa: E402,F401
 from .hapi.model_summary import flops, summary  # noqa: E402,F401
 
 
+_printoptions_state = {"sci_mode": None}
+
+
 def set_printoptions(precision=None, threshold=None, edgeitems=None,
                      sci_mode=None, linewidth=None):
     """paddle.set_printoptions parity. Tensor repr renders through numpy, so
-    this maps straight onto numpy's print options (sci_mode -> suppress)."""
+    this maps onto numpy's print options; sci_mode=True installs a float
+    formatter (numpy has no force-scientific flag). The chosen sci_mode is
+    remembered so a later call that only changes precision re-renders the
+    formatter instead of silently keeping the old digit count."""
     import numpy as _np
 
     kwargs = {}
@@ -95,14 +101,14 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
         kwargs["linewidth"] = int(linewidth)
     if sci_mode is not None:
         # NB: plain `bool` is shadowed by the paddle.bool dtype here
-        if sci_mode:
-            # numpy has no "force scientific" flag; a float formatter does it
-            prec = int(precision) if precision is not None else 8
-            kwargs["formatter"] = {
-                "float_kind": lambda v: f"%.{prec}e" % v}
-        else:
-            kwargs["suppress"] = True
-            kwargs["formatter"] = None
+        _printoptions_state["sci_mode"] = True if sci_mode else False
+    if _printoptions_state["sci_mode"]:
+        prec = (int(precision) if precision is not None
+                else _np.get_printoptions()["precision"])
+        kwargs["formatter"] = {"float_kind": lambda v: f"%.{prec}e" % v}
+    elif _printoptions_state["sci_mode"] is False:
+        kwargs["suppress"] = True
+        kwargs["formatter"] = None
     _np.set_printoptions(**kwargs)
 
 

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...framework.core import Tensor
+from ...framework.core import Tensor, no_grad
 from ...framework.op import raw
 from ...jit import TrainStep
 from ...nn.layer import Layer
@@ -328,9 +328,206 @@ class HybridParallelOptimizer:
         return self._inner_opt.set_state_dict(s)
 
 
+class GradientMergeOptimizer:
+    """strategy.gradient_merge meta-optimizer (reference:
+    ``fleet/meta_optimizers/gradient_merge_optimizer.py`` — accumulate
+    gradients for ``k_steps`` micro-steps, apply ONE optimizer update with
+    the merged gradient, repeat).
+
+    TPU-native design: the accumulator is part of the optimizer state, so
+    the whole k-step cycle lives inside the one compiled train step — the
+    boundary update is a ``lax.cond`` over the step counter, and the inner
+    optimizer's own clip + weight-decay + rule run unchanged on the merged
+    gradient (exactly the reference's boundary semantics; accumulation is
+    fp32 regardless of the compute dtype). State leaves are param-shaped,
+    so ZeRO placement via HybridParallelOptimizer applies to the
+    accumulator too.
+    """
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        self._inner = inner
+        self._k = max(int(k_steps), 1)
+        self._avg = bool(avg)
+        self._parameter_list = inner._parameter_list
+        self._accumulators = [None] * len(self._parameter_list)
+        self._eager_acc = None
+        self._eager_ctr = 0
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    @property
+    def _use_master_weights(self):
+        return self._inner._use_master_weights
+
+    @_use_master_weights.setter
+    def _use_master_weights(self, v):
+        self._inner._use_master_weights = v
+
+    @property
+    def _grad_clip(self):
+        return self._inner._grad_clip
+
+    @property
+    def _learning_rate(self):
+        return self._inner._learning_rate
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def set_lr(self, v):
+        return self._inner.set_lr(v)
+
+    def _init_state(self, p):
+        st = {"gm_ctr": jnp.zeros((), jnp.int32),
+              "gm_acc": jnp.zeros(tuple(raw(p).shape), jnp.float32)}
+        for k, v in self._inner._init_state(p).items():
+            st[f"inner_{k}"] = v
+        return st
+
+    def functional_states(self):
+        for i, p in enumerate(self._parameter_list):
+            if self._accumulators[i] is None:
+                self._accumulators[i] = self._init_state(p)
+        return list(self._accumulators)
+
+    def load_functional_states(self, states):
+        self._accumulators = list(states)
+
+    def functional_step(self, param_vals, grad_vals, states, lr):
+        live = [g is not None and p.trainable
+                for p, g in zip(self._parameter_list, grad_vals)]
+        accs = [st["gm_acc"] + g.astype(jnp.float32) if ok else None
+                for ok, g, st in zip(live, grad_vals, states)]
+        inner_states = [
+            {k[len("inner_"):]: v for k, v in st.items()
+             if k.startswith("inner_")} if ok else st
+            for ok, st in zip(live, states)]
+        try:
+            first = live.index(True)
+        except ValueError:
+            return list(param_vals), list(states)
+        ctr = states[first]["gm_ctr"] + 1
+
+        def apply(_):
+            scale = 1.0 / self._k if self._avg else 1.0
+            merged = [
+                (a * scale).astype(pv.dtype) if ok else None
+                for ok, a, pv in zip(live, accs, param_vals)]
+            new_p, new_inner = self._inner.functional_step(
+                param_vals, merged, inner_states, lr)
+            zeroed = [jnp.zeros_like(a) if ok else None
+                      for ok, a in zip(live, accs)]
+            return list(new_p), zeroed, list(new_inner)
+
+        def skip(_):
+            return list(param_vals), accs, list(inner_states)
+
+        new_p, new_accs, new_inner = jax.lax.cond(
+            ctr % self._k == 0, apply, skip, None)
+        new_states = []
+        for ok, st, a, ni in zip(live, states, new_accs, new_inner):
+            if not ok:
+                new_states.append(st)
+                continue
+            out = {"gm_ctr": ctr, "gm_acc": a}
+            out.update({f"inner_{k}": v for k, v in ni.items()})
+            new_states.append(out)
+        return new_p, new_states
+
+    @no_grad()
+    def step(self):
+        """Eager-mode accumulation: every k-th call swaps the merged grads
+        in and runs the inner optimizer's own step."""
+        from ...framework.core import Tensor
+
+        params = self._parameter_list
+        if self._eager_acc is None:
+            self._eager_acc = [None] * len(params)
+        for i, p in enumerate(params):
+            if p.trainable and p.grad is not None:
+                g = raw(p.grad).astype(jnp.float32)
+                self._eager_acc[i] = (g if self._eager_acc[i] is None
+                                      else self._eager_acc[i] + g)
+        self._eager_ctr += 1
+        if self._eager_ctr % self._k:
+            return
+        scale = 1.0 / self._k if self._avg else 1.0
+        saved = []
+        for i, p in enumerate(params):
+            saved.append(p.grad)
+            if self._eager_acc[i] is not None:
+                p.grad = Tensor(
+                    (self._eager_acc[i] * scale).astype(raw(p).dtype))
+        try:
+            self._inner.step()
+        finally:
+            for p, g in zip(params, saved):
+                p.grad = g
+            self._eager_acc = [None] * len(params)
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        """Serialize from the wrapper's OWN accumulators (the functional
+        path stores the inner moments there as ``inner_*`` leaves plus the
+        merge accumulator/counter — delegating to the inner optimizer would
+        save nothing and silently reset moments on resume). Falls back to
+        the inner state dict when only the eager path ran."""
+        if not any(st is not None for st in self._accumulators):
+            return self._inner.state_dict()
+        out = {}
+        for i, st in enumerate(self._accumulators):
+            if st is None:
+                continue
+            name = self._parameter_list[i].name or f"param_{i}"
+            for k, v in st.items():
+                # COPY: the live buffers are donated to the next compiled
+                # step, which would delete the checkpoint out from under us
+                out[f"{name}.{k}"] = (Tensor(jnp.array(v))
+                                      if hasattr(v, "shape") else v)
+        lr = self._inner._learning_rate
+        if hasattr(lr, "state_dict"):
+            out["LR_Scheduler"] = lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        sched = state.get("LR_Scheduler") if hasattr(state, "get") else None
+        lr = self._inner._learning_rate
+        if sched and hasattr(lr, "set_state_dict"):
+            lr.set_state_dict(sched)
+        any_merged = False
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            st = self._init_state(p)
+            found = False
+            for k in list(st):
+                key = f"{name}.{k}"
+                if key in state:
+                    v = state[key]
+                    st[k] = raw(v) if isinstance(v, Tensor) else v
+                    found = True
+            if found:
+                self._accumulators[i] = st
+                any_merged = True
+        if not any_merged:
+            # checkpoint from a plain (non-merged) run: load inner moments
+            return self._inner.set_state_dict(state)
+
+
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     strategy = strategy or _strategy
     optimizer = _apply_meta_optimizers(optimizer, strategy)
+    if getattr(strategy, "gradient_merge", False):
+        cfg = dict(getattr(strategy, "gradient_merge_configs", {}) or {})
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            avg=bool(cfg.get("avg", True)))
     return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group(), strategy)
 
 

@@ -250,9 +250,26 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         return Tensor(jnp.concatenate(parts, axis=1))
 
     def predict(self, input):
+        """Two-phase predict (reference semantics): argmax the head; rows
+        that land in the shortlist are done, the rest descend into ONLY the
+        indicated cluster — no [N, n_classes] matrix is materialized, which
+        is the point of adaptive softmax at vocab scale."""
         import jax.numpy as jnp
 
         from ...framework.core import Tensor
         from ...framework.op import raw
 
-        return Tensor(jnp.argmax(raw(self.log_prob(input)), axis=1))
+        x = raw(input)
+        head = x @ raw(self.head_weight)
+        if self.head_bias is not None:
+            head = head + raw(self.head_bias)
+        best = jnp.argmax(head, axis=1)
+        result = best
+        for i, (proj, cluster) in enumerate(self.tail_weights):
+            rows = jnp.where(best == self.shortlist_size + i)[0]
+            if rows.size == 0:
+                continue
+            h = (x[rows] @ raw(proj)) @ raw(cluster)
+            result = result.at[rows].set(
+                self.cutoffs[i] + jnp.argmax(h, axis=1))
+        return Tensor(result)

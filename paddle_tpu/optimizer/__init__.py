@@ -217,8 +217,11 @@ class Optimizer:
                 continue
             name = self._parameter_list[i].name or f"param_{i}"
             for k, v in st.items():
-                out[f"{name}.{k}" if not isinstance(v, (int, float)) else f"{name}.{k}"] = (
-                    Tensor(v) if not isinstance(v, (int, float)) else v
+                # COPY array leaves: under TrainStep the live state buffers
+                # are donated to the next compiled step, which would delete
+                # a by-reference checkpoint out from under the caller
+                out[f"{name}.{k}"] = (
+                    v if isinstance(v, (int, float)) else Tensor(jnp.array(v))
                 )
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()

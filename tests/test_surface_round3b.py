@@ -402,8 +402,18 @@ def test_adaptive_log_softmax_layer():
     # forward's target log-prob agrees with the full matrix
     np.testing.assert_allclose(
         _np(out), lp[np.arange(8), _np(y)], rtol=1e-4, atol=1e-5)
+    # predict follows the reference two-phase rule: head argmax, descend
+    # only into the indicated cluster (may differ from full-matrix argmax)
     pred = _np(layer.predict(x))
-    np.testing.assert_array_equal(pred, lp.argmax(1))
+    head = _np(x) @ _np(layer.head_weight)
+    best = head.argmax(1)
+    expect = best.copy()
+    for i, (proj, cluster) in enumerate(layer.tail_weights):
+        rows = np.nonzero(best == layer.shortlist_size + i)[0]
+        if rows.size:
+            h = (_np(x)[rows] @ _np(proj)) @ _np(cluster)
+            expect[rows] = layer.cutoffs[i] + h.argmax(1)
+    np.testing.assert_array_equal(pred, expect)
     # trains
     loss.backward()
     assert layer.head_weight.grad is not None
