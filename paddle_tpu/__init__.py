@@ -64,6 +64,7 @@ from . import metric  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
+from . import base  # noqa: E402,F401  (paddle.base path compat)
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401

@@ -1,0 +1,21 @@
+"""paddle.base path compatibility (the reference renamed ``paddle.fluid``
+to ``paddle.base`` in 2.6; many downstream scripts still import framework
+internals through it).
+
+This maps the commonly-imported names onto their owners here:
+``paddle.base.core`` -> :mod:`paddle_tpu.framework.core` (Tensor/tape)
+augmented with the capability predicates scripts poke at, and
+``paddle.base.framework`` -> :mod:`paddle_tpu.framework`.
+"""
+from .. import framework  # noqa: F401
+from ..framework import core  # noqa: F401
+from ..device import (  # noqa: F401
+    is_compiled_with_cuda,
+    is_compiled_with_rocm,
+    is_compiled_with_xpu,
+)
+
+# scripts frequently call these through base.core
+core.is_compiled_with_cuda = is_compiled_with_cuda
+core.is_compiled_with_rocm = is_compiled_with_rocm
+core.is_compiled_with_xpu = is_compiled_with_xpu

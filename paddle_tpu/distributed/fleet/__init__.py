@@ -136,6 +136,30 @@ def is_worker() -> bool:
     return True
 
 
+def save_inference_model(executor=None, dirname=None, feeded_var_names=None,
+                         target_vars=None, main_program=None,
+                         export_for_deployment=True, mode=0,
+                         path_prefix=None, feed_vars=None, fetch_vars=None,
+                         model=None, input_spec=None, **kwargs):
+    """fleet.save_inference_model parity: delegates to
+    ``static.save_inference_model`` (StableHLO artifact + Predictor-loadable
+    layout). Accepts both the legacy (dirname/feeded_var_names/target_vars)
+    and modern (path_prefix/feed_vars/fetch_vars) reference argument names;
+    the exported program comes from ``model`` (a Layer) or a Layer passed
+    as fetch_vars/target_vars — the StableHLO exporter needs the callable,
+    not captured variables."""
+    from ... import static as _static
+
+    prefix = path_prefix or dirname
+    if prefix is None:
+        raise ValueError("save_inference_model requires a path")
+    feeds = feed_vars if feed_vars is not None else feeded_var_names
+    fetches = fetch_vars if fetch_vars is not None else target_vars
+    return _static.save_inference_model(
+        prefix, feeds, fetches, executor, model=model,
+        input_spec=input_spec, **kwargs)
+
+
 def save_persistables(executor=None, dirname=None, main_program=None, mode=0,
                       model=None):
     """PS-mode checkpoint parity: persist every parameter (the whole model
@@ -522,6 +546,16 @@ class GradientMergeOptimizer:
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     strategy = strategy or _strategy
+    for knob in ("dgc", "localsgd", "adaptive_localsgd"):
+        if getattr(strategy, knob, False):
+            import warnings
+
+            warnings.warn(
+                f"DistributedStrategy.{knob} is ignored on TPU: SPMD "
+                "gradient all-reduce is exact and compiled into every step, "
+                "so compressed (DGC) or periodically-averaged (LocalSGD) "
+                "exchange has no XLA analogue (documented non-goal)",
+                stacklevel=2)
     optimizer = _apply_meta_optimizers(optimizer, strategy)
     if getattr(strategy, "gradient_merge", False):
         cfg = dict(getattr(strategy, "gradient_merge_configs", {}) or {})

@@ -10,6 +10,7 @@ from .pipeline_parallel import (  # noqa: F401
     PipelineLayer,
     PipelineParallel,
     SharedLayerDesc,
+    SpmdPipeline,
 )
 from .sharding import GroupShardedStage1, GroupShardedStage2, GroupShardedStage3  # noqa: F401
 from .tensor_parallel import TensorParallel  # noqa: F401

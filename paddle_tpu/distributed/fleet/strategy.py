@@ -70,7 +70,16 @@ class DistributedStrategy:
             lars_coeff=0.001, lars_weight_decay=0.0005,
             exclude_from_weight_decay=[], epsilon=0.0,
         )
+        # dgc / localsgd: accepted for surface parity; distributed_optimizer
+        # WARNS and ignores them — SPMD all-reduce is exact and every-step
+        # (compiled into the program), so sparse-compressed (DGC) or
+        # periodically-averaged (LocalSGD) gradient exchange has no XLA
+        # analogue. Deliberate non-goal, not a silent accept.
         self.dgc = False
+        self.dgc_configs: _SubConfig = _SubConfig(rampup_begin_step=0)
+        self.localsgd = False
+        self.localsgd_configs: _SubConfig = _SubConfig(k_steps=1, begin_step=1)
+        self.adaptive_localsgd = False
         self.fuse_all_reduce_ops = True  # no-op: XLA fuses
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

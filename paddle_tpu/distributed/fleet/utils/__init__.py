@@ -4,3 +4,4 @@ from .recompute_helper import (  # noqa: F401
 )
 from . import sequence_parallel_utils  # noqa: F401
 from .fs import HDFSClient, LocalFS  # noqa: F401
+from . import hybrid_parallel_util  # noqa: E402,F401

@@ -1,0 +1,83 @@
+"""fleet.utils.hybrid_parallel_util parity.
+
+Reference: ``python/paddle/distributed/fleet/utils/hybrid_parallel_util.py``
+— helpers DyGraph hybrid training scripts call between backward and step:
+``fused_allreduce_gradients`` (manual dp grad sync when DataParallel's
+reducer is bypassed, e.g. under pipeline schedules) and the
+broadcast-parameters helpers used at init.
+
+TPU-native note: under the compiled SPMD train step gradients are reduced
+by GSPMD as part of the program, so these helpers matter only for EAGER
+hybrid scripts ported from the reference — there they perform the real
+collectives over the dp/sharding groups.
+"""
+from __future__ import annotations
+
+from ....framework.core import Tensor
+from ....framework.op import raw
+from ... import collective as _collective
+
+
+def _hcg():
+    # lazy: fleet/__init__ imports this package during its own init
+    from .. import get_hybrid_communicate_group
+
+    return get_hybrid_communicate_group()
+
+
+def _data_group(hcg):
+    if hcg is None:
+        return None
+    try:
+        return hcg.get_data_parallel_group()
+    except Exception:
+        return None
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """All-reduce (mean) every present gradient over the data-parallel
+    group — the reference's manual dp sync point for pipeline/no-reducer
+    scripts. No-op when there is no dp group or it has size 1."""
+    hcg = hcg or _hcg()
+    group = _data_group(hcg)
+    size = getattr(group, "nranks", 1) if group is not None else 1
+    if size <= 1:
+        return
+    for p in parameter_list:
+        if getattr(p, "grad", None) is None:
+            continue
+        g = p.grad if isinstance(p.grad, Tensor) else Tensor(raw(p.grad))
+        _collective.all_reduce(g, group=group)
+        p.grad = Tensor(raw(g) / float(size))
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """Broadcast parameters from dp rank 0 (init-time sync). Under SPMD
+    every rank holds the same placed value already; kept for script
+    parity — re-broadcast is the identity then."""
+    hcg = hcg or _hcg()
+    group = _data_group(hcg)
+    if group is None or getattr(group, "nranks", 1) <= 1:
+        return
+    for _, p in model.named_parameters():
+        _collective.broadcast(p, src=0, group=group)
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    hcg = hcg or _hcg()
+    if hcg is None:
+        return
+    try:
+        group = hcg.get_model_parallel_group()
+    except Exception:
+        return
+    if getattr(group, "nranks", 1) <= 1:
+        return
+    for _, p in model.named_parameters():
+        if getattr(p, "dist_spec", None):
+            continue  # mp-sharded params are intentionally different
+        _collective.broadcast(p, src=0, group=group)
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    return broadcast_dp_parameters(model, hcg)

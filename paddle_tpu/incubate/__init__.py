@@ -7,9 +7,20 @@ from .moe import MoELayer, global_gather, global_scatter  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 
-class distributed:  # paddle.incubate.distributed.models.moe path parity
+class distributed:  # paddle.incubate.distributed.* path parity
     class models:
         from . import moe
+
+    # paddle.incubate.distributed.fleet: the reference's incubate fleet
+    # utilities live in the same module tree as fleet proper here; resolved
+    # lazily to avoid an import cycle with paddle_tpu.distributed
+    class _FleetProxy:
+        def __getattr__(self, name):
+            from ..distributed import fleet as _f
+
+            return getattr(_f, name)
+
+    fleet = _FleetProxy()
 
 
 def graph_send_recv(x, src_index, dst_index, pool_type="sum",

@@ -104,7 +104,7 @@ class TracedLayer:
             # callable's ops are recorded (a jit trace would freeze its
             # output as a capture-time constant)
             return self._fn(*args, **kwargs)
-        if self._eager_fallback:
+        if self._eager_fallback or not _to_static_enabled:
             return self._fn(*args, **kwargs)
         from .dy2static import Dy2StaticError
 
@@ -224,6 +224,17 @@ class TracedLayer:
     @property
     def program_cache_size(self):
         return len(self._cache)
+
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True):
+    """paddle.jit.enable_to_static parity: a global kill-switch for
+    ``to_static`` (debugging aid — with it off, decorated functions run
+    eagerly; already-built TracedLayers bypass their compiled cache)."""
+    global _to_static_enabled
+    _to_static_enabled = True if enable else False
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, full_graph=True, backend=None, **kwargs):

@@ -256,10 +256,24 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         is the point of adaptive softmax at vocab scale."""
         import jax.numpy as jnp
 
-        from ...framework.core import Tensor
+        from ...framework.core import Tensor, is_tracer_value
         from ...framework.op import raw
 
         x = raw(input)
+        if is_tracer_value(x):
+            # under jit/to_static the data-dependent row gather below will
+            # not trace; masked full-cluster evaluation keeps it compilable
+            head = x @ raw(self.head_weight)
+            if self.head_bias is not None:
+                head = head + raw(self.head_bias)
+            best = jnp.argmax(head, axis=1)
+            result = best
+            for i, (proj, cluster) in enumerate(self.tail_weights):
+                h = (x @ raw(proj)) @ raw(cluster)
+                cand = self.cutoffs[i] + jnp.argmax(h, axis=1)
+                result = jnp.where(best == self.shortlist_size + i, cand,
+                                   result)
+            return Tensor(result)
         head = x @ raw(self.head_weight)
         if self.head_bias is not None:
             head = head + raw(self.head_bias)

@@ -36,7 +36,8 @@ from ..layer import Layer
 
 __all__ = [
     "weight_quantize", "weight_dequantize", "weight_only_linear",
-    "llm_int8_linear", "QuantizedLinear", "QuantizedConv2D", "Stub",
+    "llm_int8_linear", "dynamic_quantize", "quantized_matmul",
+    "QuantizedLinear", "QuantizedConv2D", "Stub",
     "FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
     "reshape", "transpose", "concat", "flatten",
 ]
@@ -192,6 +193,52 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     if bias is not None:
         y = y + bias.astype(cdt)
     return y
+
+
+@defop(name="dynamic_quantize")
+def dynamic_quantize(x, bits=8):
+    """Per-row (last-axis) symmetric dynamic quantization of activations:
+    returns ``(int8 values, float32 row scales)``. The inverse is
+    ``q * scale`` (scales broadcast over the last axis)."""
+    if not (2 <= int(bits) <= 8):
+        raise ValueError(
+            f"dynamic_quantize supports 2..8 bits (int8 storage), got {bits}")
+    qmax = 2.0 ** (bits - 1) - 1
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.abs(xf).max(axis=-1, keepdims=True), 1e-8) / qmax
+    q = jnp.clip(jnp.round(xf / scale), -qmax, qmax).astype(jnp.int8)
+    return q, scale
+
+
+@defop(name="quantized_matmul")
+def quantized_matmul(x, weight, x_scale=None, weight_scale=None,
+                     out_dtype="float32"):
+    """TRUE int8 GEMM: ``x_int8 @ w_int8`` accumulated in int32 on the MXU
+    (``preferred_element_type=int32`` — the TPU's native int8 systolic
+    path, which the bf16-widening ``weight_only_linear`` avoids paying HBM
+    for but not compute), then dequantized by the row/column scales.
+
+    The int math is exact, so this equals the float-simulated quantized
+    matmul bit-for-bit after scaling.
+    """
+    if x.dtype != jnp.int8 or weight.dtype != jnp.int8:
+        raise ValueError(
+            f"quantized_matmul expects int8 operands, got {x.dtype} @ "
+            f"{weight.dtype} (use dynamic_quantize / weight_quantize)")
+    if weight_scale is not None and weight_scale.ndim != 1:
+        raise ValueError(
+            "quantized_matmul requires per-channel [n] weight scales; "
+            "grouped scales do not commute with the GEMM — use "
+            "weight_only_linear(group_size=...) for that path")
+    acc = jax.lax.dot_general(
+        x, weight, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32)
+    if x_scale is not None:
+        out = out * x_scale  # [.., 1] broadcasts over columns
+    if weight_scale is not None:
+        out = out * weight_scale  # [n] broadcasts over rows
+    return out.astype(jnp.dtype(out_dtype))
 
 
 # ---------------------------------------------------------------------------

@@ -120,6 +120,25 @@ def test_llm_int8_linear_grad_flows():
     assert np.isfinite(_np(x.grad)).all() and np.abs(_np(x.grad)).max() > 0
 
 
+def test_quantized_matmul_int8_exact():
+    from paddle_tpu.nn.quant import dynamic_quantize, quantized_matmul
+
+    rs = np.random.RandomState(9)
+    x = paddle.to_tensor(rs.randn(5, 32).astype("float32"))
+    w = rs.randn(32, 16).astype("float32")
+    qw, ws = weight_quantize(paddle.to_tensor(w))
+    qx, xs = dynamic_quantize(x)
+    # int32-accumulated GEMM equals the int-math reference exactly
+    ref_int = _np(qx).astype(np.int32) @ _np(qw).astype(np.int32)
+    y = quantized_matmul(qx, qw, xs, ws)
+    np.testing.assert_allclose(
+        _np(y), ref_int.astype(np.float32) * _np(xs) * _np(ws), rtol=1e-6)
+    # and tracks the float matmul within combined int8 noise
+    assert np.abs(_np(y) - _np(x) @ w).max() < 0.25
+    with pytest.raises(ValueError):
+        quantized_matmul(x, qw)
+
+
 def test_quantized_linear_trains():
     paddle.seed(0)
     inner = nn.Linear(8, 4)

@@ -439,6 +439,49 @@ def test_small_surface_tail():
     assert np.all(out[..., 0, 1:] < 1e-4)  # causal: row 0 sees only col 0
 
 
+def test_fleet_surface_tail():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util as hpu
+
+    # path exports
+    assert hasattr(fleet.meta_parallel, "SpmdPipeline")
+    assert callable(fleet.save_inference_model)
+    assert callable(hpu.fused_allreduce_gradients)
+    # no hcg -> helpers are safe no-ops
+    lin = nn.Linear(2, 2)
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    paddle.sum(lin(x)).backward()
+    hpu.fused_allreduce_gradients(list(lin.parameters()), hcg=None)
+    hpu.broadcast_dp_parameters(lin, hcg=None)
+    # incubate path proxy + base compat
+    import paddle_tpu.base as base
+
+    assert paddle.incubate.distributed.fleet.distributed_optimizer \
+        is fleet.distributed_optimizer
+    assert base.core.is_compiled_with_cuda() is False
+    # dgc/localsgd warn-and-ignore
+    strat = fleet.DistributedStrategy()
+    strat.localsgd = True
+    with pytest.warns(UserWarning, match="ignored on TPU"):
+        fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters()), strat)
+
+
+def test_enable_to_static_kill_switch():
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    f = paddle.jit.to_static(lambda x: net(x) * 2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    a = _np(f(x))
+    try:
+        paddle.jit.enable_to_static(False)
+        b = _np(f(x))
+    finally:
+        paddle.jit.enable_to_static(True)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # utils.download
 # ---------------------------------------------------------------------------
