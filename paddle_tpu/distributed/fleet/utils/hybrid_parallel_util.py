@@ -47,8 +47,8 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
         if getattr(p, "grad", None) is None:
             continue
         g = p.grad if isinstance(p.grad, Tensor) else Tensor(raw(p.grad))
-        _collective.all_reduce(g, group=group)
-        p.grad = Tensor(raw(g) / float(size))
+        p.grad = _collective.all_reduce(
+            g, op=_collective.ReduceOp.AVG, group=group)
 
 
 def broadcast_dp_parameters(model, hcg=None):
@@ -80,4 +80,16 @@ def broadcast_mp_parameters(model, hcg=None):
 
 
 def broadcast_sharding_parameters(model, hcg=None):
-    return broadcast_dp_parameters(model, hcg)
+    """Broadcast over the SHARDING group (not dp — the reference syncs
+    each axis with its own helper)."""
+    hcg = hcg or _hcg()
+    if hcg is None:
+        return
+    try:
+        group = hcg.get_sharding_parallel_group()
+    except Exception:
+        return
+    if getattr(group, "nranks", 1) <= 1:
+        return
+    for _, p in model.named_parameters():
+        _collective.broadcast(p, src=0, group=group)

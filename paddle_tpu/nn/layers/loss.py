@@ -260,25 +260,20 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         from ...framework.op import raw
 
         x = raw(input)
+        head = x @ raw(self.head_weight)
+        if self.head_bias is not None:
+            head = head + raw(self.head_bias)
+        best = jnp.argmax(head, axis=1)
+        result = best
         if is_tracer_value(x):
             # under jit/to_static the data-dependent row gather below will
             # not trace; masked full-cluster evaluation keeps it compilable
-            head = x @ raw(self.head_weight)
-            if self.head_bias is not None:
-                head = head + raw(self.head_bias)
-            best = jnp.argmax(head, axis=1)
-            result = best
             for i, (proj, cluster) in enumerate(self.tail_weights):
                 h = (x @ raw(proj)) @ raw(cluster)
                 cand = self.cutoffs[i] + jnp.argmax(h, axis=1)
                 result = jnp.where(best == self.shortlist_size + i, cand,
                                    result)
             return Tensor(result)
-        head = x @ raw(self.head_weight)
-        if self.head_bias is not None:
-            head = head + raw(self.head_bias)
-        best = jnp.argmax(head, axis=1)
-        result = best
         for i, (proj, cluster) in enumerate(self.tail_weights):
             rows = jnp.where(best == self.shortlist_size + i)[0]
             if rows.size == 0:

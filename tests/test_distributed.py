@@ -377,3 +377,22 @@ def test_strategy_lars_lamb_meta_optimizers():
     loss.backward()
     wrapped.step()
     wrapped.clear_grad()
+
+
+def test_hybrid_parallel_util_fused_allreduce():
+    """Eager dp grad sync helper: with replicated grads the dp-mean is the
+    identity (sum over the group / group size), and the helper must leave
+    grads finite and unchanged rather than double-counting."""
+    from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util as hpu
+
+    _init(dp=2, mp=2, sharding=2)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    paddle.sum(lin(x)).backward()
+    before = np.asarray(lin.weight.grad._value).copy()
+    hpu.fused_allreduce_gradients(list(lin.parameters()))
+    after = np.asarray(lin.weight.grad._value)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+    hpu.broadcast_dp_parameters(lin)
+    hpu.broadcast_mp_parameters(lin)
+    assert np.isfinite(np.asarray(lin.weight._value)).all()
