@@ -92,4 +92,6 @@ def broadcast_sharding_parameters(model, hcg=None):
     if getattr(group, "nranks", 1) <= 1:
         return
     for _, p in model.named_parameters():
+        if getattr(p, "dist_spec", None):
+            continue  # ZeRO-sharded params hold distinct shards by design
         _collective.broadcast(p, src=0, group=group)

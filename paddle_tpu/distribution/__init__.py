@@ -777,6 +777,64 @@ class ChainTransform(Transform):
         return total
 
 
+class StackTransform(Transform):
+    """Apply one transform per slice along ``axis`` (reference
+    ``paddle.distribution.StackTransform``)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis) for s in
+                jnp.split(x, len(self.transforms), axis=self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(s) for t, s in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _fldj(self, x):
+        return jnp.stack([t._fldj(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> open (k+1)-simplex via stick-breaking
+    (reference ``paddle.distribution.StickBreakingTransform``)."""
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        cumprod = jnp.cumprod(1 - z, axis=-1)
+        head = z * jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), cumprod[..., :-1]], axis=-1)
+        return jnp.concatenate([head, cumprod[..., -1:]], axis=-1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / jnp.maximum(rest, 1e-30)
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        cumprod = jnp.cumprod(1 - z, axis=-1)
+        stick = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), cumprod[..., :-1]], axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(stick), axis=-1)
+
+
 class TransformedDistribution(Distribution):
     def __init__(self, base, transforms):
         self.base = base
@@ -1203,6 +1261,7 @@ __all__ = [
     "Bernoulli", "Categorical", "Multinomial", "Binomial", "Geometric",
     "Poisson", "Independent", "Chi2", "ContinuousBernoulli", "ExponentialFamily", "LKJCholesky", "MultivariateNormal", "VonMises", "TransformedDistribution", "Transform",
     "ExpTransform", "AffineTransform", "SigmoidTransform", "TanhTransform",
-    "AbsTransform", "PowerTransform", "ChainTransform", "kl_divergence",
+    "AbsTransform", "PowerTransform", "ChainTransform", "StackTransform",
+    "StickBreakingTransform", "kl_divergence",
     "register_kl",
 ]

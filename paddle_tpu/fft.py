@@ -89,6 +89,41 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return Tensor(out * (_norm_factor(norm, n, "ihfft2") / n))
 
 
+def _default_axes(ndim, s, axes):
+    """numpy convention: axes=None means all axes, or the LAST len(s) axes
+    when a shorter `s` is given."""
+    if axes is not None:
+        return tuple(axes)
+    if s is not None:
+        return tuple(range(ndim - len(s), ndim))
+    return tuple(range(ndim))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """n-dim Hermitian FFT (same identity as hfft2, arbitrary axes)."""
+    xv = _val(x)
+    ax = _default_axes(xv.ndim, s, axes)
+    out = jnp.fft.irfftn(jnp.conj(xv), s=s, axes=ax)
+    n = 1
+    for a in ax:
+        n *= out.shape[a]
+    return Tensor(out * (n / _norm_factor(norm, n, "hfftn")))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    xv = _val(x)
+    ax = _default_axes(xv.ndim, s, axes)
+    out = jnp.conj(jnp.fft.rfftn(xv, s=s, axes=ax))
+    n = 1
+    if s is not None:
+        for m in s:
+            n *= m
+    else:
+        for a in ax:
+            n *= xv.shape[a]
+    return Tensor(out * (_norm_factor(norm, n, "ihfftn") / n))
+
+
 def fftfreq(n, d=1.0, dtype=None, name=None):
     return Tensor(jnp.fft.fftfreq(n, d=d))
 

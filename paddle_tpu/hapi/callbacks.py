@@ -289,3 +289,33 @@ class ReduceLROnPlateau(Callback):
             self._sched = ReduceOnPlateau(learning_rate=lr, **self._kw)
             opt._learning_rate = self._sched
         self._sched.step(float(val))
+
+
+class WandbCallback(Callback):
+    """paddle.callbacks.WandbCallback parity: logs train/eval metrics to a
+    Weights & Biases run. The wandb client is an optional dependency in the
+    reference too — constructing this without it installed raises with the
+    same guidance."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package, which is not "
+                "installed in this environment (`pip install wandb`)") from e
+        self.wandb = wandb
+        self._run = wandb.init(
+            project=project, entity=entity, name=name, dir=dir, mode=mode,
+            job_type=job_type, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._run.log({f"train/{k}": v for k, v in (logs or {}).items()})
+
+    def on_eval_end(self, logs=None):
+        self._run.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+
+    def on_train_end(self, logs=None):
+        self._run.finish()

@@ -201,6 +201,42 @@ def transpose(x: SparseTensor, perm: Sequence[int]) -> SparseTensor:
     return SparseTensor(_as_bcoo(x).transpose(tuple(perm)), "coo")
 
 
+def reshape(x: SparseTensor, shape: Sequence[int]) -> SparseTensor:
+    """paddle.sparse.reshape parity: remap COO coordinates through the flat
+    index (structure-exact — no densify; one -1 wildcard as in dense
+    reshape)."""
+    import numpy as _np
+
+    xb = _as_bcoo(x)
+    old = tuple(int(s) for s in xb.shape)
+    new = [int(s) for s in shape]
+    if new.count(-1) > 1:
+        raise ValueError("reshape accepts at most one -1")
+    total = int(_np.prod(old))
+    if -1 in new:
+        known = int(_np.prod([s for s in new if s != -1]))
+        new[new.index(-1)] = total // known
+    if int(_np.prod(new)) != total:
+        raise ValueError(f"cannot reshape {old} -> {tuple(shape)}")
+    def strides(dims):
+        out = [1]
+        for d in reversed(dims[1:]):
+            out.append(out[-1] * int(d))
+        return list(reversed(out))
+
+    idx_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    if total >= 2 ** 31 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"sparse.reshape: dense size {total} exceeds int32 flat-index "
+            "range; enable jax_enable_x64 for >2^31-element sparse shapes")
+    old_strides = jnp.asarray(strides(old), idx_dtype)
+    flat = (xb.indices.astype(idx_dtype) * old_strides[None, :]).sum(axis=1)
+    idx_cols = [(flat // st) % int(d) for st, d in zip(strides(new), new)]
+    indices = jnp.stack(idx_cols, axis=1).astype(xb.indices.dtype)
+    return SparseTensor(
+        jsparse.BCOO((xb.data, indices), shape=tuple(new)), "coo")
+
+
 def is_same_shape(x, y) -> bool:
     return list(x.shape) == list(y.shape)
 

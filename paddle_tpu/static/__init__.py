@@ -626,3 +626,31 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         return []
     grads = gradients(loss, params, no_grad_set=no_grad_set)
     return list(zip(params, grads))
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """paddle.static.Print parity: a debug print that survives compilation.
+
+    Reference: the Print op (``paddle/fluid/operators/print_op.cc``) prints
+    a variable's value at execution time. Here the op lowers to
+    ``jax.debug.print`` — a host callback that fires every time the
+    compiled program executes (not at trace time) — and returns the input
+    unchanged so it composes inside expressions.
+    """
+    import jax as _jax
+
+    from ..framework.op import defop as _defop
+
+    msg = str(message or getattr(input, "name", None) or "var")
+
+    @_defop(name="print_op")
+    def _print_op(x):
+        # debug.callback, not debug.print: the message is user text, not a
+        # format template (braces in it would crash jax's formatter)
+        _jax.debug.callback(lambda v: print(f"{msg} = {v}"), x)
+        return x
+
+    return _print_op(input)

@@ -1,2 +1,41 @@
 """paddle.vision parity: models, transforms, datasets."""
 from . import datasets, models, ops, transforms  # noqa: F401
+
+# ---------------------------------------------------------------- image IO --
+# reference: python/paddle/vision/image.py (backend registry + image_load)
+_image_backend = "pil"
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def set_image_backend(backend: str):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"image backend must be 'pil'/'cv2'/'tensor', got {backend!r}")
+    _image_backend = backend
+
+
+def image_load(path, backend=None):
+    """Load an image file with the selected backend (PIL Image, cv2 BGR
+    ndarray, or a paddle Tensor in HWC uint8 — the reference's contracts)."""
+    backend = backend or _image_backend
+    if backend == "pil":
+        from PIL import Image
+
+        return Image.open(path)
+    if backend == "cv2":
+        import cv2
+
+        return cv2.imread(str(path), cv2.IMREAD_UNCHANGED)
+    if backend == "tensor":
+        import numpy as _np
+        from PIL import Image
+
+        from ..framework.core import Tensor
+
+        arr = _np.asarray(Image.open(path).convert("RGB"), _np.uint8)
+        return Tensor(arr)
+    raise ValueError(f"unknown image backend {backend!r}")
