@@ -6,7 +6,8 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.fast
+# NOT in the fast tier: six subprocess jax imports cost ~18s on this box;
+# the selection contract still runs in the full suite.
 
 _CODE = """
 import os, jax

@@ -75,53 +75,6 @@ def test_asgd_window_average():
         w_prev = _np(p).copy()
 
 
-def test_nadam_matches_torch():
-    torch = pytest.importorskip("torch")
-    w0 = np.array([3.0, -2.0, 1.5], np.float32)
-    tgt = np.ones(3, np.float32)
-
-    tw = torch.tensor(w0, requires_grad=True)
-    topt = torch.optim.NAdam([tw], lr=0.05, betas=(0.9, 0.999), eps=1e-8,
-                             momentum_decay=0.004)
-    for _ in range(10):
-        tl = ((tw - torch.tensor(tgt)) ** 2).sum()
-        topt.zero_grad(); tl.backward(); topt.step()
-
-    from paddle_tpu.nn.layer import Parameter
-    from paddle_tpu.optimizer import NAdam
-
-    p = Parameter(w0)
-    popt = NAdam(learning_rate=0.05, parameters=[p])
-    for _ in range(10):
-        loss = paddle.sum((p - paddle.to_tensor(tgt)) ** 2)
-        loss.backward(); popt.step(); popt.clear_grad()
-    np.testing.assert_allclose(_np(p), tw.detach().numpy(), rtol=2e-4,
-                               atol=2e-4)
-
-
-def test_rprop_matches_torch():
-    torch = pytest.importorskip("torch")
-    w0 = np.array([3.0, -2.0, 1.5], np.float32)
-    tgt = np.ones(3, np.float32)
-
-    tw = torch.tensor(w0, requires_grad=True)
-    topt = torch.optim.Rprop([tw], lr=0.05, etas=(0.5, 1.2),
-                             step_sizes=(1e-5, 50.0))
-    for _ in range(8):
-        tl = ((tw - torch.tensor(tgt)) ** 2).sum()
-        topt.zero_grad(); tl.backward(); topt.step()
-
-    from paddle_tpu.nn.layer import Parameter
-    from paddle_tpu.optimizer import Rprop
-
-    p = Parameter(w0)
-    popt = Rprop(learning_rate=0.05, learning_rate_range=(1e-5, 50.0),
-                 parameters=[p], etas=(0.5, 1.2))
-    for _ in range(8):
-        loss = paddle.sum((p - paddle.to_tensor(tgt)) ** 2)
-        loss.backward(); popt.step(); popt.clear_grad()
-    np.testing.assert_allclose(_np(p), tw.detach().numpy(), rtol=2e-4,
-                               atol=2e-4)
 
 
 # ---------------------------------------------------------------------------
@@ -321,53 +274,6 @@ def test_static_append_backward():
 # ---------------------------------------------------------------------------
 # FusedMultiTransformer
 # ---------------------------------------------------------------------------
-def test_fused_multi_transformer_forward_and_cache():
-    from paddle_tpu.incubate.nn import FusedMultiTransformer
-
-    paddle.seed(0)
-    m = FusedMultiTransformer(32, 4, 64, num_layers=2)
-    m.eval()
-    rs = np.random.RandomState(0)
-    x = paddle.to_tensor(rs.randn(2, 5, 32).astype("float32"))
-    full = _np(m(x))
-    assert full.shape == (2, 5, 32)
-
-    # prefill 4 tokens into caches, decode token 5: must match the full run
-    max_len = 8
-    caches = [(np.zeros((2, max_len, 4, 8), np.float32),
-               np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
-    prefix = paddle.to_tensor(_np(x)[:, :4])
-    out_p, caches = m(prefix, caches=caches, time_step=None)
-    np.testing.assert_allclose(_np(out_p), full[:, :4], rtol=2e-4, atol=2e-4)
-    step_in = paddle.to_tensor(_np(x)[:, 4:5])
-    out_s, caches = m(step_in, caches=caches, time_step=4)
-    np.testing.assert_allclose(_np(out_s)[:, 0], full[:, 4], rtol=2e-4,
-                               atol=2e-4)
-
-    # time_step as a framework Tensor (the reference API's usual type)
-    caches_t = [(np.zeros((2, max_len, 4, 8), np.float32),
-                 np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
-    _, caches_t = m(prefix, caches=caches_t)
-    out_t, _ = m(step_in, caches=caches_t,
-                 time_step=paddle.to_tensor(np.array(4, np.int32)))
-    np.testing.assert_allclose(_np(out_t), _np(out_s), rtol=1e-5, atol=1e-6)
-
-    # reference-shaped prompt mask [b,1,s,s] together with caches (prefill)
-    caches_m = [(np.zeros((2, max_len, 4, 8), np.float32),
-                 np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
-    tril = np.tril(np.ones((1, 1, 4, 4), bool))
-    out_m, _ = m(prefix, attn_mask=paddle.to_tensor(tril), caches=caches_m)
-    np.testing.assert_allclose(_np(out_m), full[:, :4], rtol=2e-4, atol=2e-4)
-
-    # chunked decode: prefill 2, then a 3-token chunk at time_step=2
-    caches2 = [(np.zeros((2, max_len, 4, 8), np.float32),
-                np.zeros((2, max_len, 4, 8), np.float32)) for _ in range(2)]
-    _, caches2 = m(paddle.to_tensor(_np(x)[:, :2]), caches=caches2)
-    out_c, _ = m(paddle.to_tensor(_np(x)[:, 2:5]), caches=caches2,
-                 time_step=2)
-    np.testing.assert_allclose(_np(out_c), full[:, 2:5], rtol=2e-4,
-                               atol=2e-4)
-
 
 # ---------------------------------------------------------------------------
 # small-surface tail: vecdot/isin, AdaptiveLogSoftmaxWithLoss layer,
@@ -386,37 +292,6 @@ def test_vecdot_isin():
     # method form
     assert _np(x.isin(paddle.to_tensor(np.array([3], np.int32)))).sum() == 1
 
-
-def test_adaptive_log_softmax_layer():
-    paddle.seed(0)
-    layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12])
-    rs = np.random.RandomState(1)
-    x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
-    y = paddle.to_tensor(rs.randint(0, 20, (8,)).astype("int32"))
-    out, loss = layer(x, y)
-    assert _np(out).shape == (8,) and np.isfinite(float(_np(loss)))
-    # log_prob covers all classes and normalizes
-    lp = _np(layer.log_prob(x))
-    assert lp.shape == (8, 20)
-    np.testing.assert_allclose(np.exp(lp).sum(1), 1.0, rtol=1e-4)
-    # forward's target log-prob agrees with the full matrix
-    np.testing.assert_allclose(
-        _np(out), lp[np.arange(8), _np(y)], rtol=1e-4, atol=1e-5)
-    # predict follows the reference two-phase rule: head argmax, descend
-    # only into the indicated cluster (may differ from full-matrix argmax)
-    pred = _np(layer.predict(x))
-    head = _np(x) @ _np(layer.head_weight)
-    best = head.argmax(1)
-    expect = best.copy()
-    for i, (proj, cluster) in enumerate(layer.tail_weights):
-        rows = np.nonzero(best == layer.shortlist_size + i)[0]
-        if rows.size:
-            h = (_np(x)[rows] @ _np(proj)) @ _np(cluster)
-            expect[rows] = layer.cutoffs[i] + h.argmax(1)
-    np.testing.assert_array_equal(pred, expect)
-    # trains
-    loss.backward()
-    assert layer.head_weight.grad is not None
 
 
 def test_small_surface_tail():
