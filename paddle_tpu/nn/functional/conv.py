@@ -36,6 +36,8 @@ __all__ = [
     "max_unpool1d",
     "max_unpool2d",
     "max_unpool3d",
+    "lp_pool1d",
+    "lp_pool2d",
     "unfold",
 ]
 
@@ -476,3 +478,35 @@ def _max_unpool_nd(x, indices, out_spatial):
     vals = x.reshape(n, c, -1)
     out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
     return out.reshape((n, c) + tuple(out_spatial))
+
+
+@defop
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """paddle.nn.functional.lp_pool1d: (sum |x|^p over window)^(1/p)."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode, 1,
+                    data_format)
+
+
+@defop
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """paddle.nn.functional.lp_pool2d: (sum |x|^p over window)^(1/p)."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode, 2,
+                    data_format)
+
+
+def _lp_pool(x, p, kernel_size, stride, padding, ceil_mode, nsp, data_format):
+    p = float(p)
+    if p == float("inf"):
+        return _pool(x, kernel_size, stride, padding, nsp, jax.lax.max,
+                     -jnp.inf, ceil_mode, data_format)
+    k = _tuple(kernel_size, nsp)
+    window = float(np.prod(k))
+    # literal reference formula: (sum x^p)^(1/p) — NO abs, exactly as the
+    # torch/paddle op (negative sums under odd p produce NaN there too)
+    powed = jnp.power(x, p)
+    # _pool's add-reducer divides by the window (average); undo for the SUM
+    avg = _pool(powed, kernel_size, stride, padding, nsp, jax.lax.add, 0.0,
+                ceil_mode, data_format, count_include_pad=True)
+    return jnp.power(avg * window, 1.0 / p)

@@ -141,7 +141,12 @@ def _patch():
         "concat": mp.concat, "rot90": mp.rot90,
         # linalg
         "matmul": la.matmul, "bmm": la.bmm, "dot": la.dot, "mv": la.mv,
-        "vecdot": la.vecdot, "isin": lg.isin,
+        "vecdot": la.vecdot, "isin": lg.isin, "cdist": la.cdist,
+        "bitwise_invert": lg.bitwise_invert,
+        "strided_slice": mp.strided_slice,
+        "fill_diagonal": mp.fill_diagonal,
+        "fill_diagonal_tensor": mp.fill_diagonal_tensor,
+        "histogram_bin_edges": math.histogram_bin_edges,
         "norm": la.norm, "dist": la.dist, "cholesky": la.cholesky,
         "inverse": la.inverse, "cross": la.cross, "t": mp.t,
         "matrix_power": la.matrix_power,
@@ -212,8 +217,7 @@ def _patch():
         meth.__name__ = nm
         setattr(T, nm, meth)
 
-    def remainder_(self, y):
-        return self._rebind(jnp.mod(self._value, raw(y)))
+    # remainder_ / pow_ come from the _inplace_of loop below (tape-recording)
 
     def flatten_(self, start_axis=0, stop_axis=-1):
         out = mp.flatten(self, start_axis, stop_axis)
@@ -227,7 +231,7 @@ def _patch():
     T.value = lambda self: self
 
     for f in (zero_, fill_, add_, subtract_, multiply_, divide_, scale_, clip_,
-              exponential_, uniform_, normal_, remainder_, flatten_,
+              exponential_, uniform_, normal_, flatten_,
               bernoulli_, log_normal_):
         setattr(T, f.__name__, f)
 
@@ -245,7 +249,8 @@ def _patch():
     for base in ("lerp", "erfinv", "put_along_axis", "index_add",
                  "index_put", "masked_fill", "masked_scatter", "sigmoid",
                  "tanh", "sqrt", "rsqrt", "ceil", "floor", "round",
-                 "reciprocal", "index_copy"):
+                 "reciprocal", "index_copy", "remainder", "pow",
+                 "fill_diagonal", "fill_diagonal_tensor"):
         if hasattr(T, base):
             setattr(T, base + "_", _inplace_of(base))
 

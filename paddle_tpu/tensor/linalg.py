@@ -429,3 +429,23 @@ def vecdot(x, y, axis=-1, name=None):
     """paddle.linalg.vecdot parity: batched vector dot along ``axis``
     (broadcasts like the reference; conjugates nothing — paddle semantics)."""
     return jnp.sum(x * y, axis=axis)
+
+
+@defop
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """paddle.cdist parity: pairwise p-norm distances between the rows of
+    the last-2-dim matrices of x [.., n, d] and y [.., m, d] -> [.., n, m].
+    p=2 uses the GEMM form ||a-b||^2 = ||a||^2 + ||b||^2 - 2ab (the MXU
+    path, matching compute_mode's default)."""
+    if p == 2.0 and not str(compute_mode).startswith("donot"):
+        x2 = jnp.sum(x * x, axis=-1)[..., :, None]
+        y2 = jnp.sum(y * y, axis=-1)[..., None, :]
+        xy = jnp.matmul(x, jnp.swapaxes(y, -1, -2))
+        return jnp.sqrt(jnp.maximum(x2 + y2 - 2 * xy, 0.0))
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    if jnp.isinf(p):
+        return jnp.max(diff, axis=-1)
+    return jnp.power(jnp.sum(jnp.power(diff, p), axis=-1), 1.0 / p)

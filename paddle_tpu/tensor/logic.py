@@ -135,3 +135,7 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
     """paddle.isin parity: elementwise membership of ``x`` in ``test_x``."""
     out = jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
     return out
+
+
+# paddle 3.x aliases (operator-name spellings)
+bitwise_invert = bitwise_not

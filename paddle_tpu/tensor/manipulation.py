@@ -942,3 +942,67 @@ def scatter_nd(index, updates, shape, name=None):
     out = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
     idx = tuple(jnp.moveaxis(jnp.asarray(index), -1, 0))
     return out.at[idx].add(updates)
+
+
+@defop
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    """paddle.strided_slice parity: python-slice semantics per axis
+    (negative indices/strides as numpy)."""
+    import builtins
+
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+
+@defop
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Out-of-place core of paddle.Tensor.fill_diagonal_ (2-D and the
+    batched square case, as the reference): writes `value` on the
+    diagonal."""
+    if x.ndim < 2:
+        raise ValueError("fill_diagonal needs at least 2 dims")
+    if x.ndim > 2:
+        # reference semantics: the single [i, i, ..., i] hyper-diagonal
+        # (all dims must be equal, as numpy/torch/paddle require)
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal on >2-D tensors requires all dims equal")
+        n = x.shape[0]
+        grids = jnp.meshgrid(*([jnp.arange(n)] * x.ndim), indexing="ij")
+        mask = jnp.ones(x.shape, bool)
+        for g in grids[1:]:
+            mask = mask & (grids[0] == g)
+        return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+    h, w = x.shape[-2], x.shape[-1]
+    i = jnp.arange(h)[:, None]
+    j = jnp.arange(w)[None, :]
+    if wrap and h > w and int(offset) == 0:
+        # numpy-style wrap for tall matrices: flat index steps of w+1
+        mask = (i * w + j) % (w + 1) == 0
+    else:
+        mask = (j - i) == int(offset)
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@defop
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """paddle.Tensor.fill_diagonal_tensor parity: write tensor `y` along
+    the (dim1, dim2) diagonal of `x`."""
+    nd = x.ndim
+    d1, d2 = int(dim1) % nd, int(dim2) % nd
+    perm = [a for a in range(nd) if a not in (d1, d2)] + [d1, d2]
+    inv = [perm.index(a) for a in range(nd)]
+    xt = jnp.transpose(x, perm)
+    h, w = xt.shape[-2], xt.shape[-1]
+    i = jnp.arange(h)[:, None]
+    j = jnp.arange(w)[None, :]
+    mask = (j - i) == int(offset)
+    # y carries the diagonal entries in its LAST axis; the diagonal index
+    # is the row (offset >= 0) or the column (offset < 0)
+    k = i + jnp.zeros_like(j) if int(offset) >= 0 else j + jnp.zeros_like(i)
+    yv = jnp.asarray(y, x.dtype)
+    vals = jnp.take(yv, jnp.clip(k, 0, yv.shape[-1] - 1), axis=-1)
+    out = jnp.where(mask, vals, xt)
+    return jnp.transpose(out, inv)

@@ -908,3 +908,22 @@ def msort(x, name=None):
 @defop(name="msort_op")
 def _msort_op(x):
     return jnp.sort(x, axis=0)
+
+
+@defop
+def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):
+    """paddle.histogram_bin_edges parity: the bin edges histogram() would
+    use (min==max==0 means use the data range)."""
+    v = input.reshape(-1).astype(jnp.float32)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo_v, hi_v = jnp.min(v), jnp.max(v)
+    else:
+        lo_v = jnp.asarray(lo, jnp.float32)
+        hi_v = jnp.asarray(hi, jnp.float32)
+    # constant data: widen the empty range (the reference kernels expand by
+    # 1 each side so downstream binning stays well-defined)
+    same = lo_v == hi_v
+    lo_v = jnp.where(same, lo_v - 1.0, lo_v)
+    hi_v = jnp.where(same, hi_v + 1.0, hi_v)
+    return jnp.linspace(lo_v, hi_v, int(bins) + 1)

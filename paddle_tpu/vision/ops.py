@@ -553,3 +553,66 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
     return rois, rscores
+
+
+class RoIAlign:
+    """paddle.vision.ops.RoIAlign layer parity (callable wrapper over
+    :func:`roi_align`)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """paddle.vision.ops.RoIPool layer parity (callable wrapper over
+    :func:`roi_pool`)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """paddle.vision.ops.read_file parity: raw file bytes as a uint8
+    Tensor (host IO — call outside jit, as the reference's CPU-only op)."""
+    import numpy as _np
+
+    from ..framework.core import Tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(_np.frombuffer(data, _np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """paddle.vision.ops.decode_jpeg parity: decode a uint8 byte Tensor to
+    a CHW uint8 image Tensor (PIL-backed; the reference uses nvjpeg on GPU
+    — host decode is the TPU-correct place for this)."""
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+
+    from ..framework.core import Tensor
+    from ..framework.op import raw as _raw
+
+    data = bytes(_np.asarray(_raw(x), _np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(data))
+    if mode != "unchanged":
+        img = img.convert(
+            {"gray": "L", "rgb": "RGB"}.get(str(mode).lower(), mode))
+    arr = _np.asarray(img, _np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]  # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)  # [C, H, W]
+    return Tensor(arr)

@@ -183,3 +183,72 @@ def test_qat_to_weight_only_serving_flow():
     c = np.corrcoef(got.ravel(), ref.ravel())[0, 1]
     assert c > 0.99, c
 
+
+
+def test_round3d_tensor_ops_vs_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 5).astype("float32")
+    b = rs.randn(3, 5).astype("float32")
+    for p in (2.0, 1.0, 3.0, float("inf")):
+        ours = _np(paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b), p=p))
+        ref = torch.cdist(torch.tensor(a), torch.tensor(b), p=p).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    xv = rs.randn(2, 3, 8, 8).astype("float32")
+    ours = _np(paddle.nn.functional.lp_pool2d(paddle.to_tensor(xv), 2.0, 2))
+    ref = torch.nn.functional.lp_pool2d(torch.tensor(xv), 2.0, 2).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    # odd p: the literal (sum x^p)^(1/p) formula, NaNs where torch has them
+    ours1 = _np(paddle.nn.functional.lp_pool1d(
+        paddle.to_tensor(xv.reshape(2, 3, 64)), 3.0, 4))
+    ref1 = torch.nn.functional.lp_pool1d(
+        torch.tensor(xv.reshape(2, 3, 64)), 3.0, 4).numpy()
+    np.testing.assert_allclose(ours1, ref1, rtol=1e-4, atol=1e-5)
+
+
+def test_round3d_fill_strided_image_io(tmp_path):
+    rs = np.random.RandomState(1)
+    # fill_diagonal / fill_diagonal_tensor / inplace variants
+    x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    np.testing.assert_allclose(
+        np.diag(_np(x.fill_diagonal(7.0))), 7.0)
+    y = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(
+        np.diag(_np(x.fill_diagonal_tensor(y))), np.arange(4))
+    x.fill_diagonal_(3.0)
+    np.testing.assert_allclose(np.diag(_np(x)), 3.0)
+    # tall wrap
+    t = paddle.to_tensor(np.zeros((5, 2), np.float32))
+    w = _np(t.fill_diagonal(1.0, wrap=True))
+    np.testing.assert_allclose(w, np.array(
+        [[1, 0], [0, 1], [0, 0], [1, 0], [0, 1]], np.float32))
+    # strided_slice
+    s = paddle.strided_slice(
+        paddle.to_tensor(np.arange(24).reshape(4, 6)), [1], [1], [6], [2])
+    np.testing.assert_array_equal(
+        _np(s), np.arange(24).reshape(4, 6)[:, 1:6:2])
+    # read_file + decode_jpeg roundtrip (mode conversion too)
+    from PIL import Image
+
+    fp = tmp_path / "img.jpg"
+    Image.fromarray((rs.rand(8, 8, 3) * 255).astype(np.uint8)).save(
+        str(fp), "JPEG")
+    by = paddle.vision.ops.read_file(str(fp))
+    assert _np(by).dtype == np.uint8
+    img = paddle.vision.ops.decode_jpeg(by)
+    assert tuple(img.shape) == (3, 8, 8)
+    gray = paddle.vision.ops.decode_jpeg(by, mode="gray")
+    assert tuple(gray.shape) == (1, 8, 8)
+    # RoI layer wrappers ride the functional ops
+    feat = paddle.to_tensor(rs.randn(1, 2, 8, 8).astype("float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    out = paddle.vision.ops.RoIAlign(2)(feat, boxes, bn)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    out2 = paddle.vision.ops.RoIPool(2)(feat, boxes, bn)
+    assert tuple(out2.shape) == (1, 2, 2, 2)
+    # misc new ops
+    assert _np(paddle.histogram_bin_edges(
+        paddle.to_tensor(rs.randn(10).astype("float32")), 4)).shape == (5,)
+    assert int(_np(paddle.bitwise_invert(
+        paddle.to_tensor(np.array([0], np.int32))))[0]) == -1
