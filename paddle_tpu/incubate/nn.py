@@ -590,7 +590,12 @@ def _fused_ec_moe(x, gate, w1, b1, w2, b2, act, num_experts):
     tokens = x.reshape(b * s, d)
     n_tok = b * s
     cap = max(n_tok // num_experts, 1)
-    scores = jax.nn.softmax(tokens @ gate, axis=-1)  # [T, E]
+    if gate.ndim == 3:
+        # functional form: precomputed gate LOGITS [b, s, E] (the layer
+        # passes its [d, E] gate weight instead)
+        scores = jax.nn.softmax(gate.reshape(n_tok, -1), axis=-1)
+    else:
+        scores = jax.nn.softmax(tokens @ gate, axis=-1)  # [T, E]
     # expert choice: each expert takes its top-cap tokens by score
     g, idx = jax.lax.top_k(scores.T, cap)  # [E, cap]
     picked = jnp.take(tokens, idx.reshape(-1), axis=0).reshape(
@@ -604,6 +609,192 @@ def _fused_ec_moe(x, gate, w1, b1, w2, b2, act, num_experts):
     out = jnp.zeros((n_tok, d), x.dtype)
     out = out.at[idx.reshape(-1)].add(out_e.reshape(-1, d))
     return out.reshape(b, s, d)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """incubate.nn.functional.fused_dropout_add: dropout(x) + y in one
+    fused op (XLA fuses the mask-mul-add chain natively)."""
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """incubate.nn.functional.fused_matmul_bias: one GEMM + bias add
+    (the reference's cublasLt epilogue fusion; XLA does it on the MXU).
+    Rides the shared tensor matmul (centralized transpose handling)."""
+    from ..tensor.linalg import matmul
+
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        return out + bias
+    return out
+
+
+def swiglu(x, y=None, name=None):
+    """incubate.nn.functional.swiglu: silu(x) * y; with y=None, x splits in
+    half on the last axis (the Llama MLP gate form)."""
+    xv = raw(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if y is None:
+        xv, yv = jnp.split(xv, 2, axis=-1)
+    else:
+        yv = raw(y) if isinstance(y, Tensor) else jnp.asarray(y)
+    import jax as _jax
+
+    return Tensor(_jax.nn.silu(xv) * yv)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """incubate.nn.functional.fused_ec_moe — functional form of
+    :class:`FusedEcMoe` (expert-choice routing over batched expert GEMMs)."""
+    num_experts = raw(bmm0_weight).shape[0]
+    out = _fused_ec_moe(
+        raw(x) if isinstance(x, Tensor) else jnp.asarray(x),
+        raw(gate) if isinstance(gate, Tensor) else jnp.asarray(gate),
+        raw(bmm0_weight), raw(bmm0_bias), raw(bmm1_weight), raw(bmm1_bias),
+        act_type, num_experts)
+    return Tensor(out) if not isinstance(out, Tensor) else out
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """incubate.nn.functional.variable_length_memory_efficient_attention
+    parity: [b, h, s, d] layout with per-sequence valid lengths. On TPU the
+    static-shape form is a length-masked attention (the memory-efficiency
+    the CUDA kernel buys is XLA's/flash's concern); routes through
+    scaled_dot_product_attention, which shape-gates onto the Pallas flash
+    kernel for long sequences."""
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention: pre_cache_length "
+            "is a CUDA prefix-cache extra with no path here; prepend the "
+            "cache to key/value and extend kv_seq_lens instead")
+    q = raw(query) if isinstance(query, Tensor) else jnp.asarray(query)
+    k = raw(key) if isinstance(key, Tensor) else jnp.asarray(key)
+    v = raw(value) if isinstance(value, Tensor) else jnp.asarray(value)
+    sl = jnp.asarray(raw(seq_lens)).reshape(-1)
+    kvl = jnp.asarray(raw(kv_seq_lens)).reshape(-1)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    q_valid = jnp.arange(sq)[None, :] < sl[:, None]  # [b, sq]
+    kv_valid = jnp.arange(skv)[None, :] < kvl[:, None]  # [b, skv]
+    m = (q_valid[:, None, :, None] & kv_valid[:, None, None, :])
+    if causal:
+        # varlen sequences are START-aligned: query i of row b sits at
+        # absolute position i + (kvl[b] - sl[b]), so it sees keys
+        # j <= i + (kvl[b] - sl[b]) — not the padded-shape diagonal
+        off = (kvl - sl)[:, None, None, None]
+        i = jnp.arange(sq)[None, None, :, None]
+        j = jnp.arange(skv)[None, None, None, :]
+        m = m & (j <= i + off)
+    if mask is not None:
+        mv = raw(mask) if isinstance(mask, Tensor) else jnp.asarray(mask)
+        if mv.dtype == jnp.bool_:
+            attn_mask = Tensor(m & mv)
+        else:
+            # ADDITIVE mask (paddle semantics: 0 keep, -inf drop): add it
+            # on top of the validity mask expressed additively
+            neg = jnp.asarray(jnp.finfo(mv.dtype).min, mv.dtype)
+            attn_mask = Tensor(jnp.where(m, mv, neg))
+    else:
+        attn_mask = Tensor(m)
+    # to [b, s, h, d] (sdpa layout), masked, back
+    out = F.scaled_dot_product_attention(
+        Tensor(jnp.swapaxes(q, 1, 2)), Tensor(jnp.swapaxes(k, 1, 2)),
+        Tensor(jnp.swapaxes(v, 1, 2)), attn_mask=attn_mask,
+        is_causal=False, scale=scale)
+    out = jnp.swapaxes(raw(out), 1, 2)
+    # zero the padding queries (NaN-safe: fully-masked rows)
+    out = jnp.where(q_valid[:, None, :, None], out, 0.0)
+    return Tensor(jnp.nan_to_num(out))
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, sequence_lengths=None,
+        rotary_tensor=None, beam_cache_offset=None, qkv_out_scale=None,
+        out_shift=None, num_heads=None, seq_len=1, rotary_emb_dims=0,
+        use_neox_rotary_style=False, name=None, **kwargs):
+    """incubate.nn.functional.masked_multihead_attention parity (the
+    one-token decode-step attention behind LLM serving).
+
+    Supported core: ``x`` [b, 3*h*d] packed qkv for ONE step, ``cache_kv``
+    [2, b, h, max_len, d], ``sequence_lengths`` [b] giving the write
+    position (default: append at the first empty slot is not knowable
+    statically, so it defaults to position 0). Quantization/beam/rotary
+    extras of the CUDA kernel raise if passed. Returns (out [b, h*d],
+    updated cache_kv).
+    """
+    for extra, label in ((rotary_tensor, "rotary_tensor"),
+                         (beam_cache_offset, "beam_cache_offset"),
+                         (qkv_out_scale, "qkv_out_scale"),
+                         (out_shift, "out_shift")):
+        if extra is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {label} is a CUDA-kernel "
+                "quantization/beam extra with no TPU path here")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    import jax as _jax
+
+    xv = raw(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    ck = raw(cache_kv) if isinstance(cache_kv, Tensor) else jnp.asarray(cache_kv)
+    _, b, h, max_len, d = ck.shape
+    qkv = xv.reshape(b, 3, h, d)
+    if bias is not None:
+        qkv = qkv + raw(bias).reshape(1, 3, h, d)
+    q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, h, d]
+    if sequence_lengths is not None:
+        t = jnp.asarray(raw(sequence_lengths)).reshape(-1)  # [b]
+    else:
+        t = jnp.zeros((b,), jnp.int32)
+    # write the new k/v at position t per batch row
+    onehot = _jax.nn.one_hot(t, max_len, dtype=ck.dtype)  # [b, max_len]
+    k_cache = ck[0] * (1 - onehot[:, None, :, None]) + \
+        k_new[:, :, None, :] * onehot[:, None, :, None]
+    v_cache = ck[1] * (1 - onehot[:, None, :, None]) + \
+        v_new[:, :, None, :] * onehot[:, None, :, None]
+    valid = jnp.arange(max_len)[None, :] <= t[:, None]  # [b, max_len]
+    logits = jnp.einsum("bhd,bhld->bhl", q, k_cache) / jnp.sqrt(
+        jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    if src_mask is not None:
+        logits = logits + raw(src_mask).reshape(b, 1, -1)[:, :, :max_len]
+    logits = jnp.where(valid[:, None, :], logits,
+                       jnp.asarray(-1e9, logits.dtype))
+    probs = _jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhl,bhld->bhd", probs, v_cache).reshape(b, h * d)
+    new_cache = jnp.stack([k_cache, v_cache], axis=0)
+    return Tensor(out), Tensor(new_cache)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """incubate.nn.FusedBiasDropoutResidualLayerNorm layer over the
+    existing functional (LN(residual + dropout(x + bias))). Parameters are
+    flat with the reference's names (linear_bias / ln_scale / ln_bias), so
+    reference checkpoints map key-for-key."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self.p = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, residual):
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            dropout_rate=self.p, ln_epsilon=self._epsilon,
+            training=self.training)
 
 
 def _make_functional_module():

@@ -252,3 +252,73 @@ def test_round3d_fill_strided_image_io(tmp_path):
         paddle.to_tensor(rs.randn(10).astype("float32")), 4)).shape == (5,)
     assert int(_np(paddle.bitwise_invert(
         paddle.to_tensor(np.array([0], np.int32))))[0]) == -1
+
+
+def test_incubate_functional_tail():
+    """fused_dropout_add / fused_matmul_bias / swiglu / fused_ec_moe
+    functional / varlen memory-efficient attention / masked MHA decode /
+    FusedBiasDropoutResidualLayerNorm."""
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randn(2, 8).astype("float32"))
+    np.testing.assert_allclose(
+        _np(IF.fused_dropout_add(x, y, p=0.5, training=False)),
+        _np(x) + _np(y), rtol=1e-6)
+    w = paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+    b = paddle.to_tensor(rs.randn(4).astype("float32"))
+    np.testing.assert_allclose(
+        _np(IF.fused_matmul_bias(x, w, b)), _np(x) @ _np(w) + _np(b),
+        rtol=1e-5, atol=1e-6)
+    tx = torch.tensor(_np(x))
+    a, g = tx.chunk(2, -1)
+    np.testing.assert_allclose(
+        _np(IF.swiglu(x)), (torch.nn.functional.silu(a) * g).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+    # varlen attention: padded queries come back exactly zero
+    q = rs.randn(2, 2, 4, 8).astype("float32")
+    fv = _np(IF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(np.array([4, 2], np.int32)),
+        paddle.to_tensor(np.array([4, 2], np.int32))))
+    assert np.allclose(fv[1, :, 2:], 0.0) and np.isfinite(fv).all()
+
+    # masked MHA: two decode steps equal dense attention over the prefix
+    b_, h_, d_, L = 2, 2, 4, 8
+    cache_t = paddle.to_tensor(np.zeros((2, b_, h_, L, d_), np.float32))
+    xs = [rs.randn(b_, 3 * h_ * d_).astype("float32") for _ in range(2)]
+    outs, seq = [], np.zeros((b_,), np.int32)
+    for xv in xs:
+        o, cache_t = IF.masked_multihead_attention(
+            paddle.to_tensor(xv), cache_kv=cache_t,
+            sequence_lengths=paddle.to_tensor(seq))
+        outs.append(_np(o))
+        seq = seq + 1
+    qkv = [v.reshape(b_, 3, h_, d_) for v in xs]
+    k = np.stack([qkv[0][:, 1], qkv[1][:, 1]], axis=2)
+    vv = np.stack([qkv[0][:, 2], qkv[1][:, 2]], axis=2)
+    lg = np.einsum("bhd,bhld->bhl", qkv[1][:, 0], k) / np.sqrt(d_)
+    p = np.exp(lg - lg.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhl,bhld->bhd", p, vv).reshape(b_, h_ * d_)
+    np.testing.assert_allclose(outs[1], ref, rtol=1e-4, atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        IF.masked_multihead_attention(
+            paddle.to_tensor(xs[0]), cache_kv=cache_t, qkv_out_scale=1.0)
+
+    # functional ec_moe accepts precomputed gate logits
+    out = IF.fused_ec_moe(
+        paddle.to_tensor(rs.randn(2, 4, 8).astype("float32")),
+        paddle.to_tensor(rs.randn(2, 4, 2).astype("float32")),
+        paddle.to_tensor(rs.randn(2, 8, 16).astype("float32")),
+        paddle.to_tensor(rs.randn(2, 1, 16).astype("float32")),
+        paddle.to_tensor(rs.randn(2, 16, 8).astype("float32")),
+        paddle.to_tensor(rs.randn(2, 1, 8).astype("float32")))
+    assert _np(out).shape == (2, 4, 8)
+
+    lyr = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    assert np.isfinite(_np(lyr(x, y))).all()
