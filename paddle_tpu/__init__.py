@@ -214,3 +214,19 @@ def batch(reader, batch_size, drop_last=False):
             yield buf
 
     return batched
+
+
+def get_cuda_rng_state():
+    """Device-RNG state alias (paddle.get_cuda_rng_state parity): one
+    counter-based PRNG serves every backend here, so this is the global
+    generator state."""
+    from .framework import rng as _rng
+
+    return [_rng.get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from .framework import rng as _rng
+
+    state = state_list[0] if isinstance(state_list, (list, tuple)) else state_list
+    _rng.set_rng_state(state)

@@ -305,3 +305,18 @@ def _patch():
 
 _patch()
 del _patch
+
+
+# ---- top-level inplace function forms (paddle.clip_/masked_fill_/where_) ----
+def clip_(x, min=None, max=None, name=None):
+    return x.clip_(min, max)
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x.masked_fill_(mask, value)
+
+
+def where_(condition, x=None, y=None, name=None):
+    """paddle.where_ parity: in-place select into ``x``."""
+    out = manipulation.where(condition, x, y)
+    return x._rebind(out._value, out._node)

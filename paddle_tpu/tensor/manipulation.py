@@ -1006,3 +1006,44 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
     vals = jnp.take(yv, jnp.clip(k, 0, yv.shape[-1] - 1), axis=-1)
     out = jnp.where(mask, vals, xt)
     return jnp.transpose(out, inv)
+
+
+@defop(name="hstack_op")
+def _hstack_op(xs):
+    return jnp.hstack(xs)
+
+
+def hstack(x, name=None):
+    """paddle.hstack parity (numpy semantics)."""
+    return _hstack_op(list(x))
+
+
+@defop(name="dstack_op")
+def _dstack_op(xs):
+    return jnp.dstack(xs)
+
+
+def dstack(x, name=None):
+    """paddle.dstack parity (numpy semantics)."""
+    return _dstack_op(list(x))
+
+
+vstack = row_stack  # paddle exposes both names for the same op
+
+
+@defop
+def matrix_transpose(x, name=None):
+    """paddle.matrix_transpose parity: swap the last two dims."""
+    if x.ndim < 2:
+        raise ValueError("matrix_transpose needs at least 2 dims")
+    return jnp.swapaxes(x, -1, -2)
+
+
+@defop
+def multiplex(inputs, index, name=None):
+    """paddle.multiplex parity: row r of the output is row r of
+    inputs[index[r]]."""
+    stacked = jnp.stack(inputs, axis=0)  # [K, N, ...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]

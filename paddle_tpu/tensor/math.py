@@ -927,3 +927,42 @@ def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):
     lo_v = jnp.where(same, lo_v - 1.0, lo_v)
     hi_v = jnp.where(same, hi_v + 1.0, hi_v)
     return jnp.linspace(lo_v, hi_v, int(bins) + 1)
+
+
+@defop
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """paddle.baddbmm parity: beta*input + alpha*(x @ y), batched. At
+    beta==0 the input is IGNORED (contract: it may be an uninitialized
+    buffer — 0*inf must not produce NaN)."""
+    if beta == 0:
+        return alpha * jnp.matmul(x, y)
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def is_floating_point(x):
+    """paddle.is_floating_point parity (dtype predicate)."""
+    from ..framework import dtypes as _dt
+    from ..framework.op import raw as _raw
+
+    return _dt.is_floating_point(_raw(x).dtype)
+
+
+def is_integer(x):
+    """paddle.is_integer parity."""
+    from ..framework import dtypes as _dt
+    from ..framework.op import raw as _raw
+
+    return _dt.is_integer(_raw(x).dtype)
+
+
+def is_complex(x):
+    """paddle.is_complex parity."""
+    from ..framework import dtypes as _dt
+    from ..framework.op import raw as _raw
+
+    return _dt.is_complex(_raw(x).dtype)
+
+
+def tolist(x):
+    """paddle.tolist parity (one source of truth: Tensor.tolist)."""
+    return x.tolist()

@@ -446,3 +446,35 @@ def test_utils_download_local_cache(tmp_path, monkeypatch):
     assert got == str(target)
     with pytest.raises(RuntimeError):
         dl.get_weights_path_from_url("https://example.com/absent.pdparams")
+
+
+def test_top_level_tail_round3e():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+    assert _np(paddle.hstack([x, x])).shape == (2, 4)
+    assert _np(paddle.dstack([x, x])).shape == (2, 2, 2)
+    assert _np(paddle.vstack([x, x])).shape == (4, 2)
+    assert float(_np(paddle.matrix_transpose(x))[0, 1]) == 3.0
+    m = paddle.multiplex([x, x * 10], paddle.to_tensor(np.array([1, 0], np.int32)))
+    np.testing.assert_allclose(_np(m), [[10., 20.], [3., 4.]])
+    b = _np(paddle.baddbmm(
+        paddle.to_tensor(np.ones((1, 2, 2), np.float32)),
+        paddle.to_tensor(np.ones((1, 2, 3), np.float32)),
+        paddle.to_tensor(np.ones((1, 3, 2), np.float32)),
+        beta=2.0, alpha=0.5))
+    np.testing.assert_allclose(b, 2.0 + 0.5 * 3.0)
+    assert paddle.is_floating_point(x) and not paddle.is_integer(x)
+    assert not paddle.is_complex(x)
+    assert paddle.tolist(x) == [[1.0, 2.0], [3.0, 4.0]]
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    y = x * 1.0
+    paddle.where_(paddle.to_tensor(np.array([[True, False], [False, True]])),
+                  y, paddle.to_tensor(np.zeros((2, 2), np.float32)))
+    np.testing.assert_allclose(_np(y), [[1, 0], [0, 4]])
+    z = x * 1.0
+    paddle.clip_(z, 0.0, 2.0)
+    assert float(_np(z).max()) == 2.0
+    w = x * 1.0
+    paddle.masked_fill_(
+        w, paddle.to_tensor(np.array([[True, False], [False, False]])), -1.0)
+    assert float(_np(w)[0, 0]) == -1.0
