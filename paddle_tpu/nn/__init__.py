@@ -2,6 +2,12 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
+from . import utils  # noqa: F401
+from ..optimizer import (  # noqa: F401  (paddle.nn re-exports the clip trio)
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
 from .layer import (  # noqa: F401
     Layer, LayerDict, LayerList, ParamAttr, Parameter, ParameterList,
     Sequential,
@@ -49,6 +55,7 @@ from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
+from .layers.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 
 from ..framework.core import Tensor as _Tensor
 

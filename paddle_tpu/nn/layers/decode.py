@@ -1,0 +1,151 @@
+"""Seq2seq decoding (paddle.nn.BeamSearchDecoder / dynamic_decode parity).
+
+Reference: ``python/paddle/nn/decode.py`` — ``BeamSearchDecoder`` wraps an
+RNN cell (paddle cell contract: ``cell(inputs, states) -> (outputs,
+new_states)``) and ``dynamic_decode`` drives the initialize/step loop until
+every beam finishes or ``max_step_num`` is hit, then finalizes by
+backtracing parent pointers.
+
+TPU note: the decode loop is a host loop over compiled cell steps (the
+eager serving shape, as in the reference's dygraph mode); each step's math
+is pure jnp, so a fixed-length ``lax.scan`` variant falls out of
+``jit.TrainStep``-style capture when a static bound is given. Beam-search
+state is kept flat ([batch*beam, ...]) so cell weights see ordinary batched
+GEMMs on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.op import raw
+from ..layer import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Beam-search wrapper over an RNN cell (paddle.nn.BeamSearchDecoder).
+
+    ``embedding_fn`` maps token ids -> cell inputs; ``output_fn`` maps cell
+    outputs -> vocab logits. ``finalize`` backtraces ``parent_ids`` into
+    the predicted sequences (beam-major last axis, paddle layout).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- decoder protocol (initialize/step/finalize as the reference) -------
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: self._tile(raw(s)), initial_cell_states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] // self.beam_size
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int32)
+        # beam 0 starts live, the rest start at -inf so step 1 expands from
+        # a single beam (the standard initialization)
+        row = jnp.where(jnp.arange(self.beam_size) == 0, 0.0, -1e9)
+        log_probs = jnp.broadcast_to(
+            row.astype(jnp.float32), (batch, self.beam_size))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, (states, log_probs, finished), finished
+
+    def _tile(self, s):
+        """[batch, ...] -> [batch*beam, ...] (beam-minor tiling)."""
+        return jnp.repeat(s, self.beam_size, axis=0)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states, log_probs, finished = states
+        ids = inputs  # [batch, beam] int32
+        batch, beam = ids.shape
+        emb = self.embedding_fn(Tensor(ids.reshape(batch * beam)))
+        cell_out, next_cell_states = self.cell(emb, cell_states)
+        logits = self.output_fn(cell_out) if self.output_fn is not None else cell_out
+        logp = jax.nn.log_softmax(raw(logits).astype(jnp.float32), axis=-1)
+        vocab = logp.shape[-1]
+        logp = logp.reshape(batch, beam, vocab)
+        # finished beams may only continue with end_token at zero cost
+        fin_mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], fin_mask[None, None, :], logp)
+        scores = log_probs[..., None] + logp  # [batch, beam, vocab]
+        top_scores, top_idx = jax.lax.top_k(
+            scores.reshape(batch, beam * vocab), beam)
+        parents = top_idx // vocab  # [batch, beam]
+        tokens = top_idx % vocab
+        next_finished = finished[jnp.arange(batch)[:, None], parents] | (
+            tokens == self.end_token)
+        # reorder flat cell states by the selected parents
+        flat_parent = (jnp.arange(batch)[:, None] * beam + parents).reshape(-1)
+        next_cell_states = jax.tree_util.tree_map(
+            lambda s: self._gather_state(s, flat_parent), next_cell_states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        outputs = {"predicted_ids": tokens, "parent_ids": parents,
+                   "scores": top_scores}
+        return outputs, (next_cell_states, top_scores, next_finished), \
+            tokens, next_finished
+
+    @staticmethod
+    def _gather_state(s, flat_parent):
+        v = raw(s)
+        return Tensor(jnp.take(v, flat_parent, axis=0)) \
+            if isinstance(s, Tensor) else jnp.take(v, flat_parent, axis=0)
+
+    def finalize(self, step_outputs):
+        """Backtrace parent pointers -> predicted_ids [batch, time, beam]."""
+        pred = jnp.stack([o["predicted_ids"] for o in step_outputs], axis=0)
+        par = jnp.stack([o["parent_ids"] for o in step_outputs], axis=0)
+        tmax, batch, beam = pred.shape
+        beams = jnp.broadcast_to(jnp.arange(beam), (batch, beam))
+        seqs = []
+        for t in range(tmax - 1, -1, -1):
+            seqs.append(pred[t][jnp.arange(batch)[:, None], beams])
+            beams = par[t][jnp.arange(batch)[:, None], beams]
+        out = jnp.stack(seqs[::-1], axis=1)  # [batch, time, beam]
+        return out
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """paddle.nn.dynamic_decode parity: drive the decoder protocol until
+    every sequence finishes (or ``max_step_num``). Returns
+    ``(outputs, final_states)`` — with ``return_length=True`` also the
+    per-sequence*beam lengths. For BeamSearchDecoder the outputs are the
+    finalized predicted ids ([batch, time, beam], or time-major when
+    requested)."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    # max_step_num=None decodes until every sequence finishes (reference
+    # semantics — a model that never emits end_token loops, as upstream)
+    limit = int(max_step_num) if max_step_num is not None else None
+    lengths = jnp.zeros(finished.shape, jnp.int32)
+    time = 0
+    while True:
+        outputs, states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        step_outputs.append(outputs)
+        lengths = lengths + (~finished).astype(lengths.dtype)
+        time += 1
+        if bool(jnp.all(finished)) or (limit is not None and time >= limit):
+            break
+    if hasattr(decoder, "finalize"):
+        out = decoder.finalize(step_outputs)
+    else:
+        # per-field stacking for structured step outputs (map_structure
+        # semantics, as the reference)
+        out = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack([raw(x) for x in xs], axis=1),
+            *step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
+    if output_time_major and hasattr(out, "ndim"):
+        out = jnp.swapaxes(out, 0, 1)
+    out_t = Tensor(out) if hasattr(out, "ndim") else out
+    if return_length:
+        return out_t, states, Tensor(lengths)
+    return out_t, states

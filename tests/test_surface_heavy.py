@@ -322,3 +322,54 @@ def test_incubate_functional_tail():
 
     lyr = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
     assert np.isfinite(_np(lyr(x, y))).all()
+
+
+def test_beam_search_decoder():
+    """nn.BeamSearchDecoder + dynamic_decode: beam_size=1 equals a greedy
+    argmax rollout of the same cell; wider beams contain the greedy path's
+    score and finish on end_token."""
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    vocab, emb_d, hid = 12, 8, 16
+    emb = nn.Embedding(vocab, emb_d)
+    cell = nn.GRUCell(emb_d, hid)
+    proj = nn.Linear(hid, vocab)
+
+    def run_beam(beam):
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=beam, embedding_fn=emb,
+                                   output_fn=proj)
+        h0 = paddle.to_tensor(np.zeros((3, hid), np.float32))
+        out, states = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        return _np(out), np.asarray(states[1])  # final beam log-probs
+
+    got1, scores1 = run_beam(1)  # [batch, time, 1]
+    assert got1.shape[0] == 3 and got1.shape[2] == 1
+
+    # greedy reference rollout (+ its cumulative log-prob)
+    ids = np.full((3,), 1, np.int32)
+    h = paddle.to_tensor(np.zeros((3, hid), np.float32))
+    ref, greedy_lp = [], np.zeros(3, np.float64)
+    done = np.zeros(3, bool)
+    for _ in range(got1.shape[1]):
+        o, h = cell(emb(paddle.to_tensor(ids)), h)
+        logits = _np(proj(o)).astype(np.float64)
+        lsm = logits - np.log(np.exp(
+            logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+            - logits.max(-1, keepdims=True)
+        nxt = logits.argmax(-1).astype(np.int32)
+        nxt = np.where(done, 2, nxt)
+        greedy_lp += np.where(done, 0.0, lsm[np.arange(3), nxt])
+        ref.append(nxt)
+        done = done | (nxt == 2)
+        ids = nxt
+    np.testing.assert_array_equal(got1[:, :, 0], np.stack(ref, axis=1))
+
+    got4, scores4 = run_beam(4)
+    assert got4.shape[2] == 4
+    # beam search can only improve on greedy: best-beam cumulative log-prob
+    # >= the greedy path's (catches swapped parent/token decoding)
+    assert np.all(scores4[:, 0] >= greedy_lp - 1e-3), (scores4[:, 0], greedy_lp)
+    # and the 1-beam run's score IS the greedy score
+    np.testing.assert_allclose(scores1[:, 0], greedy_lp, rtol=1e-4, atol=1e-4)
