@@ -263,6 +263,12 @@ def ignore_module(modules):
 
 
 from .save_load import save, load, TranslatedLayer  # noqa: E402
+from .dy2static import (  # noqa: E402,F401  (debug verbosity parity)
+    get_code_level,
+    get_verbosity,
+    set_code_level,
+    set_verbosity,
+)
 
 
 class TrainStep:

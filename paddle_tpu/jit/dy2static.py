@@ -808,9 +808,38 @@ def _transformed_code(func):
     else:
         mod = ast.Module(body=[fndef], type_ignores=[])
     ast.fix_missing_locations(mod)
+    if get_code_level() > 0:
+        print(f"# dy2static transformed code of {func.__qualname__}:\n"
+              + ast.unparse(mod))
     code = compile(mod, filename=f"<dy2static {func.__qualname__}>", mode="exec")
     _cache[key] = (code, fndef.name, freevars)
     return _cache[key]
+
+
+# ---- debug verbosity (paddle.jit.set_code_level / set_verbosity parity) ----
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """paddle.jit.set_code_level parity: level > 0 prints the dy2static-
+    transformed source the next time a function is converted."""
+    global _code_level
+    _code_level = int(level)
+
+
+def get_code_level():
+    return _code_level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """paddle.jit.set_verbosity parity (conversion logging level)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def get_verbosity():
+    return _verbosity
 
 
 def _convert_raw(func):

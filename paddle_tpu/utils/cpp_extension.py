@@ -1,0 +1,74 @@
+"""paddle.utils.cpp_extension parity — build-and-load custom native code.
+
+Reference: ``python/paddle/utils/cpp_extension/`` — compiles user C++ (with
+paddle headers) into a custom-op module via setuptools.
+
+TPU-native design: custom device kernels are Pallas's job, so the real
+remaining use case is HOST-side native code. ``load`` compiles the given
+C/C++ sources into a shared library with the toolchain in this image (g++)
+and returns a ``ctypes.CDLL`` — the same mechanism the framework's own
+C++ runtime uses (``paddle_tpu/runtime/native.py``). Wrap exported
+functions with ``paddle.static.py_func`` / ``jax.pure_callback`` to call
+them inside compiled programs.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile ``sources`` (C/C++ files) into ``lib<name>.so`` and return
+    the loaded ``ctypes.CDLL``. Raises CalledProcessError with the full
+    compiler output on failure."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_cpp_ext")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", out]
+    cmd += [str(s) for s in (sources if isinstance(sources, (list, tuple))
+                             else [sources])]
+    cmd += list(extra_cxx_cflags or [])
+    cmd += list(extra_ldflags or [])
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if verbose:
+        print(" ".join(cmd))
+        print(proc.stdout, proc.stderr)
+    if proc.returncode != 0:
+        raise subprocess.CalledProcessError(
+            proc.returncode, cmd, proc.stdout, proc.stderr)
+    return ctypes.CDLL(out)
+
+
+class CppExtension:
+    """Recorded extension spec (setup()-style API surface). ``name``
+    distinguishes extensions when several are built in one setup() call."""
+
+    def __init__(self, sources, name=None, *args, **kwargs):
+        self.sources = sources
+        self.name = name
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension: no CUDA on this TPU build — write device kernels "
+        "with Pallas (paddle_tpu.ops.pallas) and host code with CppExtension")
+
+
+def setup(**kwargs):
+    """Minimal setup(): compiles every CppExtension in ext_modules eagerly
+    (the reference delegates to setuptools; here load() is the mechanism).
+    Each extension gets its own library name — ext.name, or
+    ``<setup name>_<i>`` — so multiple extensions never overwrite each
+    other's .so (dlopen caches by path)."""
+    mods = {}
+    base = kwargs.get("name", "custom_ops")
+    exts = list(kwargs.get("ext_modules", []))
+    for i, ext in enumerate(exts):
+        name = ext.name or (base if len(exts) == 1 else f"{base}_{i}")
+        mods[name] = load(name, ext.sources, **ext.kwargs)
+    return mods

@@ -478,3 +478,41 @@ def test_top_level_tail_round3e():
     paddle.masked_fill_(
         w, paddle.to_tensor(np.array([[True, False], [False, False]])), -1.0)
     assert float(_np(w)[0, 0]) == -1.0
+
+
+def test_jit_static_misc_round3f(tmp_path, capsys):
+    # cpp_extension builds and loads real native code
+    src = tmp_path / "ext.cc"
+    src.write_text('extern "C" int add3(int x) { return x + 3; }\n')
+    lib = paddle.utils.cpp_extension.load(
+        "exttest_r3f", [str(src)], build_directory=str(tmp_path))
+    assert lib.add3(4) == 7
+
+    # set_code_level prints the transformed source at conversion time
+    paddle.jit.set_code_level(100)
+    try:
+        @paddle.jit.to_static
+        def branchy(x):
+            if x.sum() > 0:
+                return x + 1
+            return x - 1
+
+        out = branchy(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(_np(out), 2.0)
+        assert "dy2static transformed code" in capsys.readouterr().out
+    finally:
+        paddle.jit.set_code_level(0)
+    assert paddle.jit.get_code_level() == 0
+    paddle.jit.set_verbosity(3)
+    assert paddle.jit.get_verbosity() == 3
+    paddle.jit.set_verbosity(0)
+
+    import paddle_tpu.static as static
+
+    with static.device_guard("cpu"):
+        pass
+    with pytest.raises(RuntimeError):
+        with static.ipu_shard_guard(0):
+            pass
+    prog = static.Program()
+    assert static.normalize_program(prog, [], []) is not prog
