@@ -115,8 +115,9 @@ def _where_rows(finished, old, new):
     leading-dim alignment (leaves whose batch dim doesn't match pass
     through updated)."""
     o, n = raw(old), raw(new)
-    if getattr(o, "shape", None) != getattr(n, "shape", None) or n.ndim == 0:
-        return new
+    if not hasattr(n, "ndim") or n.ndim == 0 \
+            or getattr(o, "shape", None) != getattr(n, "shape", None):
+        return new  # scalar/py leaves and shape mismatches pass through
     f = jnp.reshape(finished, (-1,))
     if n.shape[0] != f.shape[0]:
         return new

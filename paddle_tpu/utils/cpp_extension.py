@@ -24,10 +24,19 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
     """Compile ``sources`` (C/C++ files) into ``lib<name>.so`` and return
     the loaded ``ctypes.CDLL``. Raises CalledProcessError with the full
     compiler output on failure."""
-    build_dir = build_directory or os.path.join(
-        tempfile.gettempdir(), "paddle_tpu_cpp_ext")
-    os.makedirs(build_dir, exist_ok=True)
-    out = os.path.join(build_dir, f"lib{name}.so")
+    if build_directory is None:
+        # per-user, 0700: a world-shared fixed /tmp path would both break
+        # on multi-user boxes and allow lib planting between build and load
+        build_directory = os.path.join(
+            tempfile.gettempdir(), f"paddle_tpu_cpp_ext_{os.getuid()}")
+    build_dir = build_directory
+    os.makedirs(build_dir, mode=0o700, exist_ok=True)
+    # version the artifact by source mtimes: dlopen caches by PATH, so
+    # rebuilding into the same .so would silently serve the old image
+    stamp = max(
+        int(os.path.getmtime(s)) for s in (
+            sources if isinstance(sources, (list, tuple)) else [sources]))
+    out = os.path.join(build_dir, f"lib{name}_{stamp}.so")
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", out]
     cmd += [str(s) for s in (sources if isinstance(sources, (list, tuple))
                              else [sources])]

@@ -380,25 +380,6 @@ def test_asp_2to4_pruning_and_decorated_optimizer():
     incubate.asp.reset_excluded_layers()
 
 
-def test_fused_ec_moe_and_dropout_add():
-    from paddle_tpu import incubate
-
-    paddle.seed(0)
-    moe = incubate.nn.FusedEcMoe(hidden_size=8, inter_size=16, num_experts=2)
-    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 8).astype("float32"))
-    out = moe(x)
-    assert _np(out).shape == (2, 4, 8)
-    assert np.isfinite(_np(out)).all()
-    # gradient flows to the gate (routing is differentiable via scores)
-    loss = (out * out).sum()
-    loss.backward()
-    assert np.abs(_np(moe.gate.grad)).max() > 0
-
-    fda = incubate.nn.FusedDropoutAdd(p=0.0)
-    a = paddle.to_tensor(np.ones((2, 2), "float32"))
-    b = paddle.to_tensor(np.full((2, 2), 3.0, "float32"))
-    np.testing.assert_allclose(_np(fda(a, b)), 4.0)
-
 
 def test_fleet_util_and_fs(tmp_path):
     from paddle_tpu.distributed import fleet

@@ -35,7 +35,7 @@ def _sparse_sites(st):
     return sorted(tuple(int(i) for i in row) for row in np.unique(idx, axis=0))
 
 
-@pytest.mark.fast
+# compile-heavy: full-suite only (fast tier keeps the sibling smokes)
 def test_subm_conv3d_values_and_structure():
     rng = np.random.default_rng(0)
     dense, sites = _random_sparse_input(rng)
@@ -121,7 +121,7 @@ def test_sparse_max_pool3d():
         np.testing.assert_allclose(got[s], ref[s], rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.fast
+# compile-heavy: full-suite only (fast tier keeps the sibling smokes)
 def test_sparse_max_pool3d_all_negative_window():
     dense = np.zeros((1, 2, 2, 2, 1), np.float32)
     dense[0, 0, 0, 0, 0] = -2.0  # only stored value in the window

@@ -373,3 +373,23 @@ def test_beam_search_decoder():
     assert np.all(scores4[:, 0] >= greedy_lp - 1e-3), (scores4[:, 0], greedy_lp)
     # and the 1-beam run's score IS the greedy score
     np.testing.assert_allclose(scores1[:, 0], greedy_lp, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_ec_moe_and_dropout_add():
+    from paddle_tpu import incubate
+
+    paddle.seed(0)
+    moe = incubate.nn.FusedEcMoe(hidden_size=8, inter_size=16, num_experts=2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 8).astype("float32"))
+    out = moe(x)
+    assert _np(out).shape == (2, 4, 8)
+    assert np.isfinite(_np(out)).all()
+    # gradient flows to the gate (routing is differentiable via scores)
+    loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(_np(moe.gate.grad)).max() > 0
+
+    fda = incubate.nn.FusedDropoutAdd(p=0.0)
+    a = paddle.to_tensor(np.ones((2, 2), "float32"))
+    b = paddle.to_tensor(np.full((2, 2), 3.0, "float32"))
+    np.testing.assert_allclose(_np(fda(a, b)), 4.0)
