@@ -130,3 +130,30 @@ def test_gradient_merge_eager_matches_full_batch():
     loss.backward()
     opt2.step()
     np.testing.assert_allclose(w_merged, _np(m2.weight), rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_with_global_norm_clip():
+    """Clip must apply to the MERGED gradient at the boundary (one clip per
+    k steps, inner optimizer semantics), matching a full-batch clipped step."""
+    from paddle_tpu.jit import TrainStep
+
+    m, x, y = _model_and_data()
+    clip = paddle.nn.ClipGradByGlobalNorm(0.01)
+    strat = fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, grad_clip=clip,
+                             parameters=m.parameters()), strat)
+    step = TrainStep(m, lambda mm, a, b: paddle.mean((mm(a) - b) ** 2), opt)
+    step(paddle.to_tensor(x[:4]), paddle.to_tensor(y[:4]))
+    step(paddle.to_tensor(x[4:]), paddle.to_tensor(y[4:]))
+    w_merged = _np(m.weight).copy()
+
+    m2, _, _ = _model_and_data()
+    opt2 = paddle.optimizer.SGD(
+        learning_rate=0.1, grad_clip=paddle.nn.ClipGradByGlobalNorm(0.01),
+        parameters=m2.parameters())
+    step2 = TrainStep(m2, lambda mm, a, b: paddle.mean((mm(a) - b) ** 2), opt2)
+    step2(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(w_merged, _np(m2.weight), rtol=1e-5, atol=1e-6)

@@ -128,3 +128,35 @@ def test_to_distributed_dp_default():
         assert xb._value.sharding.spec[0] == "dp"
     finally:
         mesh_mod.set_global_mesh(prev)  # don't leak into other tests
+
+
+def test_parallelize_sequence_parallel_markers():
+    """SequenceParallelBegin/End install sharding-constraint hooks and the
+    constrained model still trains with loss parity to the plain run."""
+    from paddle_tpu.distributed import (ColWiseParallel, RowWiseParallel,
+                                        SequenceParallelBegin,
+                                        SequenceParallelEnd, parallelize)
+    from paddle_tpu.jit import TrainStep
+
+    rs = np.random.RandomState(0)
+    xb = rs.randn(4, 6, 16).astype("float32")  # [batch, seq, hidden]
+    yb = rs.randn(4, 6, 16).astype("float32")
+
+    def run(parallel):
+        paddle.seed(7)
+        m = MLP()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        if parallel:
+            mesh = _mesh2d()
+            m, opt = parallelize(
+                m, opt, mesh,
+                {"mp_config": {"parallelize_plan": {
+                    "up": [ColWiseParallel(), SequenceParallelBegin()],
+                    "down": [RowWiseParallel(), SequenceParallelEnd()]}}})
+        step = TrainStep(
+            m, lambda mm, a, b: paddle.mean((mm(a) - b) ** 2), opt)
+        return [float(step(paddle.to_tensor(xb),
+                           paddle.to_tensor(yb))._value) for _ in range(3)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=1e-6)
