@@ -406,6 +406,7 @@ class GradientMergeOptimizer:
 
     def _init_state(self, p):
         st = {"gm_ctr": jnp.zeros((), jnp.int32),
+              "gm_saw": jnp.zeros((), jnp.int32),
               "gm_acc": jnp.zeros(tuple(raw(p).shape), jnp.float32)}
         for k, v in self._inner._init_state(p).items():
             st[f"inner_{k}"] = v
@@ -423,40 +424,81 @@ class GradientMergeOptimizer:
     def functional_step(self, param_vals, grad_vals, states, lr):
         live = [g is not None and p.trainable
                 for p, g in zip(self._parameter_list, grad_vals)]
-        accs = [st["gm_acc"] + g.astype(jnp.float32) if ok else None
-                for ok, g, st in zip(live, grad_vals, states)]
+        # Every trainable param participates in the boundary: one whose
+        # grad is None right now may still hold accumulated gradient from
+        # earlier micro-steps of the cycle — that must be applied AT the
+        # boundary, not leak into the next cycle's average.
+        part = [p.trainable for p in self._parameter_list]
+        accs = [st["gm_acc"] + g.astype(jnp.float32) if ok
+                else (st["gm_acc"] if p_ else None)
+                for ok, p_, g, st in zip(live, part, grad_vals, states)]
+        # gm_saw: traced received-a-grad-this-cycle flag. Inferring it
+        # from acc != 0 would mis-skip a param whose real grads were
+        # exactly zero (it must still get weight-decay/moment updates).
+        saws = [jnp.maximum(st["gm_saw"], 1) if ok
+                else (st["gm_saw"] if p_ else None)
+                for ok, p_, st in zip(live, part, states)]
         inner_states = [
             {k[len("inner_"):]: v for k, v in st.items()
-             if k.startswith("inner_")} if ok else st
-            for ok, st in zip(live, states)]
+             if k.startswith("inner_")} if p_ else st
+            for p_, st in zip(part, states)]
         try:
             first = live.index(True)
         except ValueError:
             return list(param_vals), list(states)
         ctr = states[first]["gm_ctr"] + 1
+        is_boundary = ctr % self._k == 0
 
         def apply(_):
             scale = 1.0 / self._k if self._avg else 1.0
             merged = [
-                (a * scale).astype(pv.dtype) if ok else None
-                for ok, a, pv in zip(live, accs, param_vals)]
+                (a * scale).astype(pv.dtype) if p_ else None
+                for p_, a, pv in zip(part, accs, param_vals)]
             new_p, new_inner = self._inner.functional_step(
                 param_vals, merged, inner_states, lr)
-            zeroed = [jnp.zeros_like(a) if ok else None
-                      for ok, a in zip(live, accs)]
-            return list(new_p), zeroed, list(new_inner)
+            # A participating-but-not-live param only truly updates if it
+            # received a grad at some point this cycle: a never-grad
+            # trainable param must not get weight-decay/moment updates
+            # from a fabricated zero gradient.
+            outs_p, outs_inner = [], []
+            for ok, p_, sw, pv, np_, st_in, ni in zip(
+                    live, part, saws, param_vals, new_p, inner_states,
+                    new_inner):
+                if p_ and not ok:
+                    sel = sw != 0
+                    np_ = jnp.where(sel, np_, pv)
+                    ni = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(sel, new, old), ni, st_in)
+                outs_p.append(np_)
+                outs_inner.append(ni)
+            zeroed = [jnp.zeros_like(a) if p_ else None
+                      for p_, a in zip(part, accs)]
+            return outs_p, zeroed, outs_inner
 
         def skip(_):
             return list(param_vals), accs, list(inner_states)
 
         new_p, new_accs, new_inner = jax.lax.cond(
-            ctr % self._k == 0, apply, skip, None)
+            is_boundary, apply, skip, None)
         new_states = []
-        for ok, st, a, ni in zip(live, states, new_accs, new_inner):
-            if not ok:
-                new_states.append(st)
+        for p_, st, a, sw, ni in zip(part, states, new_accs, saws, new_inner):
+            if not p_:
+                # non-trainable: carry state, but gm_ctr is CYCLE state —
+                # advance it so liveness variation never desyncs a param
+                # from the merge boundary — and the boundary clears the
+                # accumulator/saw flag so a param frozen mid-cycle can't
+                # leak its stale accumulated gradient into a later cycle
+                # when unfrozen.
+                out = dict(st)
+                out["gm_ctr"] = ctr
+                if "gm_acc" in out:
+                    out["gm_acc"] = jnp.where(is_boundary, 0, out["gm_acc"])
+                if "gm_saw" in out:
+                    out["gm_saw"] = jnp.where(is_boundary, 0, out["gm_saw"])
+                new_states.append(out)
                 continue
-            out = {"gm_ctr": ctr, "gm_acc": a}
+            out = {"gm_ctr": ctr, "gm_acc": a,
+                   "gm_saw": jnp.where(is_boundary, 0, sw)}
             out.update({f"inner_{k}": v for k, v in ni.items()})
             new_states.append(out)
         return new_p, new_states
@@ -502,9 +544,20 @@ class GradientMergeOptimizer:
         path stores the inner moments there as ``inner_*`` leaves plus the
         merge accumulator/counter — delegating to the inner optimizer would
         save nothing and silently reset moments on resume). Falls back to
-        the inner state dict when only the eager path ran."""
+        the inner state dict when only the eager path ran; in that case the
+        mid-cycle eager accumulators and counter are serialized too, so a
+        checkpoint taken between merge boundaries resumes without dropping
+        up to k-1 micro-steps of accumulated gradient."""
         if not any(st is not None for st in self._accumulators):
-            return self._inner.state_dict()
+            out = self._inner.state_dict()
+            if self._eager_ctr % self._k:
+                out["gm_eager_ctr"] = int(self._eager_ctr % self._k)
+                for i, a in enumerate(self._eager_acc or []):
+                    if a is not None:
+                        name = self._parameter_list[i].name or f"param_{i}"
+                        # COPY for the same donation reason as below
+                        out[f"{name}.gm_eager_acc"] = Tensor(jnp.array(a))
+            return out
         out = {}
         for i, st in enumerate(self._accumulators):
             if st is None:
@@ -525,6 +578,22 @@ class GradientMergeOptimizer:
         lr = self._inner._learning_rate
         if sched and hasattr(lr, "set_state_dict"):
             lr.set_state_dict(sched)
+        if hasattr(state, "get") and "gm_eager_ctr" in state:
+            state = dict(state)
+            self._eager_ctr = int(state.pop("gm_eager_ctr"))
+            self._eager_acc = [None] * len(self._parameter_list)
+            for i, p in enumerate(self._parameter_list):
+                name = p.name or f"param_{i}"
+                v = state.pop(f"{name}.gm_eager_acc", None)
+                if v is not None:
+                    self._eager_acc[i] = jnp.asarray(
+                        raw(v) if isinstance(v, Tensor) else v, jnp.float32)
+        else:
+            # absence of eager keys means the checkpoint sits on a cycle
+            # boundary: reset any stale in-memory mid-cycle state so a
+            # rollback-restore doesn't merge a dropped micro-step's grads
+            self._eager_ctr = 0
+            self._eager_acc = None
         any_merged = False
         for i, p in enumerate(self._parameter_list):
             name = p.name or f"param_{i}"

@@ -75,6 +75,11 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         functions = list(functions._sub_layers.values())
     n = len(functions)
     per = max(1, n // max(segments, 1))
+    # recompute-knob kwargs belong to recompute(), not the first layer
+    # (reference contract: recompute_sequential consumes them upstream)
+    rc_kwargs = {k: kwargs.pop(k)
+                 for k in ("use_reentrant", "preserve_rng_state", "policy")
+                 if k in kwargs}
 
     def run_segment(fs, first, fn_kwargs):
         def seg(*xs):
@@ -102,7 +107,7 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         # their grads silently vanish in eager mode)
         owners = [f for f in seg_fns if hasattr(f, "named_parameters")]
         out = recompute(run_segment(seg_fns, first, kwargs if first else {}),
-                        *cur, _param_owners=owners)
+                        *cur, _param_owners=owners, **rc_kwargs)
         cur = (out,)
         first = False
         i += per

@@ -721,10 +721,10 @@ def masked_multihead_attention(
 
     Supported core: ``x`` [b, 3*h*d] packed qkv for ONE step, ``cache_kv``
     [2, b, h, max_len, d], ``sequence_lengths`` [b] giving the write
-    position (default: append at the first empty slot is not knowable
-    statically, so it defaults to position 0). Quantization/beam/rotary
-    extras of the CUDA kernel raise if passed. Returns (out [b, h*d],
-    updated cache_kv).
+    position (REQUIRED: the first empty slot is not knowable statically,
+    and silently writing slot 0 every call would make repeated decode
+    steps attend to one token). Quantization/beam/rotary extras of the
+    CUDA kernel raise if passed. Returns (out [b, h*d], updated cache_kv).
     """
     for extra, label in ((rotary_tensor, "rotary_tensor"),
                          (beam_cache_offset, "beam_cache_offset"),
@@ -745,10 +745,15 @@ def masked_multihead_attention(
     if bias is not None:
         qkv = qkv + raw(bias).reshape(1, 3, h, d)
     q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, h, d]
-    if sequence_lengths is not None:
-        t = jnp.asarray(raw(sequence_lengths)).reshape(-1)  # [b]
-    else:
-        t = jnp.zeros((b,), jnp.int32)
+    if sequence_lengths is None:
+        # matching the other unsupported-extra guards: defaulting the write
+        # position to 0 would silently overwrite slot 0 on every decode
+        # step and attend to a single token
+        raise NotImplementedError(
+            "masked_multihead_attention: sequence_lengths is required on "
+            "TPU — the CUDA kernel tracks the decode position internally; "
+            "here the caller must pass the per-row write position [b]")
+    t = jnp.asarray(raw(sequence_lengths)).reshape(-1)  # [b]
     # write the new k/v at position t per batch row
     onehot = _jax.nn.one_hot(t, max_len, dtype=ck.dtype)  # [b, max_len]
     k_cache = ck[0] * (1 - onehot[:, None, :, None]) + \

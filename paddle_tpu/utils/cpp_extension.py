@@ -31,17 +31,26 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
             tempfile.gettempdir(), f"paddle_tpu_cpp_ext_{os.getuid()}")
     build_dir = build_directory
     os.makedirs(build_dir, mode=0o700, exist_ok=True)
-    # version the artifact by source mtimes: dlopen caches by PATH, so
-    # rebuilding into the same .so would silently serve the old image
-    stamp = max(
-        int(os.path.getmtime(s)) for s in (
-            sources if isinstance(sources, (list, tuple)) else [sources]))
+    src_list = [str(s) for s in (
+        sources if isinstance(sources, (list, tuple)) else [sources])]
+    cmd_tail = src_list + list(extra_cxx_cflags or []) + list(extra_ldflags or [])
+    # version the artifact by source mtimes AND the full compile command:
+    # dlopen caches by PATH, so rebuilding into the same .so would silently
+    # serve the old image — including one built with different flags
+    import hashlib
+
+    stamp = hashlib.sha256(("\x00".join(
+        cmd_tail + [str(os.stat(s).st_mtime_ns) for s in src_list]
+    )).encode()).hexdigest()[:16]
     out = os.path.join(build_dir, f"lib{name}_{stamp}.so")
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", out]
-    cmd += [str(s) for s in (sources if isinstance(sources, (list, tuple))
-                             else [sources])]
-    cmd += list(extra_cxx_cflags or [])
-    cmd += list(extra_ldflags or [])
+    if os.path.exists(out):
+        # the stamp covers sources' ns-precision mtimes + the full compile
+        # command, so an existing artifact is exactly what a rebuild would
+        # produce (and it only appears at this path via the atomic rename
+        # below — never partially written)
+        return ctypes.CDLL(out)
+    tmp = f"{out}.tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", tmp] + cmd_tail
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if verbose:
         print(" ".join(cmd))
@@ -49,6 +58,7 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
     if proc.returncode != 0:
         raise subprocess.CalledProcessError(
             proc.returncode, cmd, proc.stdout, proc.stderr)
+    os.replace(tmp, out)
     return ctypes.CDLL(out)
 
 

@@ -132,6 +132,132 @@ def test_gradient_merge_eager_matches_full_batch():
     np.testing.assert_allclose(w_merged, _np(m2.weight), rtol=1e-5, atol=1e-6)
 
 
+def test_gradient_merge_ctr_advances_without_grad():
+    """gm_ctr is cycle state: a param whose grad is None for a micro-step
+    must still see its counter advance, or varying grad-liveness desyncs
+    its accumulator from the merge boundary."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet import GradientMergeOptimizer
+
+    m, _, _ = _model_and_data()
+    gm = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+        k_steps=2, avg=True)
+    states = gm.functional_states()
+    p_vals = [p._value for p in m.parameters()]
+    # micro-step 1: only param 0 has a grad
+    grads = [jnp.ones_like(p_vals[0]), None]
+    p_vals, states = gm.functional_step(p_vals, grads, states, 0.1)
+    assert int(states[0]["gm_ctr"]) == 1
+    assert int(states[1]["gm_ctr"]) == 1  # advanced despite grad=None
+    # micro-step 2: both live — boundary applies for BOTH in sync
+    grads = [jnp.ones_like(v) for v in p_vals]
+    p_vals, states = gm.functional_step(p_vals, grads, states, 0.1)
+    assert int(states[0]["gm_ctr"]) == 2 and int(states[1]["gm_ctr"]) == 2
+    assert float(jnp.abs(states[1]["gm_acc"]).max()) == 0.0  # zeroed at boundary
+
+
+def test_gradient_merge_nonlive_at_boundary_applies_accumulated():
+    """A param live mid-cycle but grad-less AT the boundary must have its
+    accumulated gradient applied at that boundary (and its accumulator
+    zeroed), not leak it into the next cycle's average."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet import GradientMergeOptimizer
+
+    m, _, _ = _model_and_data()
+    gm = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+        k_steps=2, avg=True)
+    states = gm.functional_states()
+    p_vals = [p._value for p in m.parameters()]
+    b0 = np.asarray(p_vals[1]).copy()
+    # micro-step 1: both live
+    grads = [jnp.ones_like(v) for v in p_vals]
+    p_vals, states = gm.functional_step(p_vals, grads, states, 0.1)
+    # micro-step 2 (boundary): param 1's grad is None
+    grads = [jnp.ones_like(p_vals[0]), None]
+    p_vals, states = gm.functional_step(p_vals, grads, states, 0.1)
+    # param 1's step-1 grad (1.0), averaged over k=2, applied: -0.1 * 0.5
+    np.testing.assert_allclose(np.asarray(p_vals[1]), b0 - 0.05,
+                               rtol=1e-6, atol=1e-7)
+    assert float(jnp.abs(states[1]["gm_acc"]).max()) == 0.0  # no leak
+    # a never-grad trainable param is untouched at the boundary
+    states2 = gm.functional_states()
+    v0 = np.asarray(p_vals[1]).copy()
+    pv = list(p_vals)
+    pv, states2 = gm.functional_step(
+        pv, [jnp.ones_like(pv[0]), None], states2, 0.1)
+    pv, states2 = gm.functional_step(
+        pv, [jnp.ones_like(pv[0]), None], states2, 0.1)
+    np.testing.assert_array_equal(np.asarray(pv[1]), v0)
+
+
+def test_gradient_merge_exact_zero_grad_still_updates_at_boundary():
+    """A param that received an EXACTLY-ZERO grad mid-cycle (then None at
+    the boundary) did see a gradient — weight decay must still apply at
+    the boundary (gm_saw flag, not acc!=0 inference)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet import GradientMergeOptimizer
+
+    m, _, _ = _model_and_data()
+    gm = GradientMergeOptimizer(
+        paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.1,
+                               parameters=m.parameters()),
+        k_steps=2, avg=True)
+    states = gm.functional_states()
+    p_vals = [p._value for p in m.parameters()]
+    w0 = np.asarray(p_vals[0]).copy()  # weight init is nonzero (decay visible)
+    # micro-step 1: param 0 live with an exactly-zero grad
+    pv, states = gm.functional_step(
+        p_vals, [jnp.zeros_like(p_vals[0]), jnp.ones_like(p_vals[1])],
+        states, 0.1)
+    # boundary: param 0's grad is None — decay must still land
+    pv, states = gm.functional_step(
+        pv, [None, jnp.ones_like(pv[1])], states, 0.1)
+    assert np.abs(np.asarray(pv[0]) - w0).max() > 1e-8, \
+        "weight decay skipped for zero-grad param at boundary"
+
+
+def test_gradient_merge_eager_midcycle_checkpoint():
+    """An EAGER-mode checkpoint taken between merge boundaries must carry
+    the accumulated micro-step gradients and cycle counter — resuming and
+    finishing the cycle matches the uninterrupted run exactly."""
+    m, x, y = _model_and_data()
+    strat = fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+
+    def _opt_for(model):
+        return fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()), strat)
+
+    def _micro(model, opt_, lo, hi):
+        loss = paddle.mean((model(paddle.to_tensor(x[lo:hi]))
+                            - paddle.to_tensor(y[lo:hi])) ** 2)
+        loss.backward()
+        opt_.step()
+        opt_.clear_grad()
+
+    # uninterrupted run: both micro-steps, boundary applies at step 2
+    opt = _opt_for(m)
+    _micro(m, opt, 0, 4)
+    sd = opt.state_dict()  # mid-cycle checkpoint (1 of 2 accumulated)
+    assert any("gm_eager" in str(k) for k in sd), sorted(sd)
+    _micro(m, opt, 4, 8)
+    w_full = _np(m.weight).copy()
+
+    # resumed run: fresh optimizer, restore mid-cycle state, finish cycle
+    m2, _, _ = _model_and_data()
+    opt2 = _opt_for(m2)
+    opt2.set_state_dict(sd)
+    _micro(m2, opt2, 4, 8)
+    np.testing.assert_allclose(_np(m2.weight), w_full, rtol=1e-5, atol=1e-6)
+
+
 def test_gradient_merge_with_global_norm_clip():
     """Clip must apply to the MERGED gradient at the boundary (one clip per
     k steps, inner optimizer semantics), matching a full-batch clipped step."""

@@ -309,6 +309,9 @@ def test_incubate_functional_tail():
     with pytest.raises(NotImplementedError):
         IF.masked_multihead_attention(
             paddle.to_tensor(xs[0]), cache_kv=cache_t, qkv_out_scale=1.0)
+    # omitting sequence_lengths must raise, not silently write slot 0
+    with pytest.raises(NotImplementedError):
+        IF.masked_multihead_attention(paddle.to_tensor(xs[0]), cache_kv=cache_t)
 
     # functional ec_moe accepts precomputed gate logits
     out = IF.fused_ec_moe(
