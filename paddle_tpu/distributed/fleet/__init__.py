@@ -606,6 +606,13 @@ class GradientMergeOptimizer:
                     st[k] = raw(v) if isinstance(v, Tensor) else v
                     found = True
             if found:
+                if f"{name}.gm_saw" not in state:
+                    # pre-gm_saw checkpoint: infer the received-a-grad flag
+                    # from the accumulator, or a mid-cycle restore would
+                    # silently drop this param's accumulated gradient at
+                    # the next boundary
+                    st["gm_saw"] = jnp.any(
+                        st["gm_acc"] != 0).astype(jnp.int32)
                 self._accumulators[i] = st
                 any_merged = True
         if not any_merged:

@@ -27,11 +27,30 @@ the existing guard: trace again, and if the untouched statement still
 host-syncs, fall back to eager with a warning — exactly the reference's
 dygraph fallback, but now a last resort instead of the only answer.
 
-Known limits (documented, reference has analogues): closure variables and
-module globals are snapshotted at conversion time; functions CALLED from a
-converted function are not themselves converted (paddle's convert_call
-recursion is a non-goal here); loop-carried variables must exist before a
-lax-lowered loop.
+Functions CALLED from a converted function are themselves converted:
+every call site is rewritten to route through ``convert_call`` (the
+reference's ``convert_call_func.py`` contract), which recursively converts
+plain functions, bound methods, and user Layer forwards — cached per
+function object, depth-bounded, with per-callee fallback to the original
+when a callee's source can't convert.
+
+Known limits (documented, reference has analogues unless noted):
+
+- **Snapshot semantics**: closure variables and module globals are
+  snapshotted at CONVERSION time (the first trace that hit a host sync).
+  The reference resolves globals live at every call; here a converted
+  function keeps reading the values its module/closure had when it was
+  converted. Rebinding a global after conversion is NOT seen by the
+  converted function (a guard test pins this divergence).
+- **Attribute stores in converted branches**: ``self.x = v`` inside a
+  tensor-``if`` branch keeps the whole ``if`` in Python — if the predicate
+  is traced, the function degrades to the eager guard with the standard
+  fallback warning rather than silently tracing a side effect into one
+  branch.
+- Functions defined INSIDE a converted function are not re-converted
+  (their source lives in the transformed module, invisible to
+  ``inspect.getsource``).
+- Loop-carried variables must exist before a lax-lowered loop.
 """
 from __future__ import annotations
 
@@ -273,6 +292,140 @@ def ifexp(pred, t_thunk, f_thunk):
 
 
 # --------------------------------------------------------------------- #
+# convert_call: recursive callee conversion
+# --------------------------------------------------------------------- #
+
+# Roots whose functions are never converted: framework/numeric libraries
+# are already tensor-safe (they use lax / raise host-sync intentionally),
+# and converting them would only burn compile time.
+_SKIP_ROOTS = frozenset({
+    "builtins", "paddle_tpu", "jax", "jaxlib", "numpy", "flax", "optax",
+    "chex", "einops", "torch", "math", "cmath", "functools", "itertools",
+    "operator", "typing", "collections", "abc", "copy", "random", "re",
+    "os", "sys", "warnings", "logging", "dataclasses",
+})
+
+# Bounds runaway conversion chains (mutually recursive helpers, deep call
+# stacks): beyond this depth of nested CONVERTED frames, callees run
+# unconverted (tensor control flow there degrades to the eager guard).
+_MAX_CONVERT_DEPTH = 32
+_call_depth = 0
+
+_ccall_cache: dict = {}  # id-keyed {raw_fn_id: (weakref, converted|False)}
+
+
+def _depth_guard(converted):
+    import functools
+
+    @functools.wraps(converted)
+    def run(*a, **k):
+        global _call_depth
+        _call_depth += 1
+        try:
+            return converted(*a, **k)
+        finally:
+            _call_depth -= 1
+
+    return run
+
+
+def _convert_fn_cached(raw_fn):
+    """Convert a plain function once per function OBJECT (closure cells are
+    snapshotted per object); False caches a failed attempt."""
+    import weakref
+
+    key = id(raw_fn)
+    hit = _ccall_cache.get(key)
+    if hit is not None and hit[0]() is raw_fn:
+        return hit[1] or None
+    try:
+        conv = _convert_raw(raw_fn)
+        conv = _depth_guard(conv)
+    except Dy2StaticUnsupported:
+        conv = None
+    except (RecursionError, MemoryError):
+        raise
+    except Exception:
+        conv = None
+    try:
+        ref = weakref.ref(
+            raw_fn, lambda _r, _k=key, _c=_ccall_cache: _c.pop(_k, None))
+        _ccall_cache[key] = (ref, conv if conv is not None else False)
+    except TypeError:
+        pass
+    return conv
+
+
+def _layer_forward_call(layer, fwd):
+    """Invoke a converted forward through the Layer hook protocol (the one
+    definition lives on Layer._run_with_hooks)."""
+
+    def run(*inputs, **kwargs):
+        return layer._run_with_hooks(fwd, inputs, kwargs)
+
+    return run
+
+
+def convert_call(f):
+    """Reference ``dy2static/convert_call_func.py::convert_call`` parity:
+    every call site inside a converted function routes its callee through
+    here, so tensor-dependent control flow in a HELPER (function, bound
+    method, or a user Layer's forward) compiles too — the whole reachable
+    call graph converts, not just the entry.
+
+    Returns the converted callable when ``f`` is a user-defined function /
+    method / Layer whose source converts; otherwise returns ``f`` itself
+    (per-callee fallback — an inconvertible callee degrades that callee,
+    not the whole program). Conversions are cached per function object and
+    bounded at ``_MAX_CONVERT_DEPTH`` nested converted frames.
+
+    Not converted (documented): callables from framework/stdlib modules
+    (``_SKIP_ROOTS``), classes (constructors), arbitrary callable objects,
+    and functions defined INSIDE a converted function (their source lives
+    in the transformed module and is unavailable to ``inspect``)."""
+    global _call_depth
+    if not callable(f) or isinstance(f, type):
+        return f
+    if _call_depth >= _MAX_CONVERT_DEPTH:
+        return f
+    if isinstance(f, (types.BuiltinFunctionType, types.BuiltinMethodType)):
+        return f
+    import functools
+
+    if isinstance(f, functools.partial):
+        inner = convert_call(f.func)
+        if inner is f.func:
+            return f
+        return functools.partial(inner, *f.args, **(f.keywords or {}))
+    # a Layer instance: convert its forward, keep the hook protocol
+    try:
+        from ..nn.layer import Layer
+    except Exception:
+        Layer = None
+    if Layer is not None and isinstance(f, Layer):
+        fwd0 = f.forward  # capture once: attribute access rebinds each time
+        fwd = convert_call(fwd0)
+        if fwd is fwd0:
+            return f
+        return _layer_forward_call(f, fwd)
+    if isinstance(f, types.MethodType):
+        raw_fn, bound_self = f.__func__, f.__self__
+    elif isinstance(f, types.FunctionType):
+        raw_fn, bound_self = f, None
+    else:
+        return f
+    if getattr(raw_fn, "__dy2static_original__", None) is not None:
+        return f  # already converted
+    mod_root = (getattr(raw_fn, "__module__", "") or "").split(".")[0]
+    if mod_root in _SKIP_ROOTS:
+        return f
+    conv = _convert_fn_cached(raw_fn)
+    if conv is None:
+        return f
+    return conv.__get__(bound_self) if bound_self is not None else conv
+
+
+# --------------------------------------------------------------------- #
 # static analysis
 # --------------------------------------------------------------------- #
 
@@ -421,12 +574,24 @@ def _loaded_names(node) -> set:
 
 class _ExprRewriter(ast.NodeTransformer):
     """``and``/``or``/``not``/ternary → runtime dispatch helpers (preserving
-    Python short-circuiting via thunks). Stops at nested function scopes."""
+    Python short-circuiting via thunks), and every user call site
+    ``f(args)`` → ``convert_call(f)(args)`` so callees are recursively
+    converted at call time (the reference's convert_call_func.convert_call
+    contract). Stops at nested function scopes."""
 
     def visit_FunctionDef(self, node):
         return node
 
     visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        # zero-arg super() is compiled magic (needs the caller frame's
+        # __class__ cell) — routing it through convert_call would break it
+        if isinstance(node.func, ast.Name) and node.func.id == "super":
+            return node
+        node.func = self._call("convert_call", [node.func])
+        return node
 
     @staticmethod
     def _thunk(expr):
@@ -862,7 +1027,13 @@ def _convert_raw(func):
         converted = ns[fname]
     converted.__defaults__ = func.__defaults__
     converted.__kwdefaults__ = func.__kwdefaults__
-    converted.__dy2static_original__ = func
+    # a WEAK ref: a strong one would chain _ccall_cache -> converted ->
+    # func and keep the cache's weakref eviction from ever firing for
+    # dynamically created functions (the attribute is only used as an
+    # is-converted marker)
+    import weakref
+
+    converted.__dy2static_original__ = weakref.ref(func)
     return converted
 
 

@@ -286,17 +286,23 @@ class Layer:
     def forward(self, *inputs, **kwargs):
         raise NotImplementedError
 
-    def __call__(self, *inputs, **kwargs):
+    def _run_with_hooks(self, forward, inputs, kwargs):
+        """The hook protocol around an arbitrary forward callable — the ONE
+        definition of pre/post-hook semantics (dy2static's convert_call
+        routes converted forwards through here too)."""
         for hook in self._forward_pre_hooks.values():
             res = hook(self, inputs)
             if res is not None:
                 inputs = res if isinstance(res, tuple) else (res,)
-        out = self.forward(*inputs, **kwargs)
+        out = forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             res = hook(self, inputs, out)
             if res is not None:
                 out = res
         return out
+
+    def __call__(self, *inputs, **kwargs):
+        return self._run_with_hooks(self.forward, inputs, kwargs)
 
     def full_name(self):
         return self._name

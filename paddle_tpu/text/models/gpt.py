@@ -56,6 +56,7 @@ class GPTConfig:
         sequence_parallel: bool = False,
         tie_word_embeddings: bool = True,
         layer_norm_epsilon: float = 1e-5,
+        fold_layers: bool = False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -72,6 +73,13 @@ class GPTConfig:
         self.sequence_parallel = sequence_parallel
         self.tie_word_embeddings = tie_word_embeddings
         self.layer_norm_epsilon = layer_norm_epsilon
+        # fold_layers: build the decoder as ONE lax.scan over layer-stacked
+        # parameters even without pipeline parallelism. XLA then compiles a
+        # single block body instead of num_hidden_layers unrolled copies —
+        # compile time drops from O(layers) to O(1) (the jax large-model
+        # idiom; same mechanism SpmdPipeline uses per stage). Checkpoint
+        # keys become the stacked `decoder.*__stacked` form.
+        self.fold_layers = fold_layers
 
     # canonical sizes (PaddleNLP gpt configs / GPT-3 table)
     @staticmethod
@@ -187,6 +195,15 @@ class GPTModel(nn.Layer):
 
             self.decoder = SpmdPipeline(
                 blocks, num_stages=pp, recompute_block=config.use_recompute
+            )
+        elif getattr(config, "fold_layers", False) and len(blocks) > 1:
+            # layer-dim scan without pp: one compiled block body (see
+            # GPTConfig.fold_layers). num_stages=1 routes SpmdPipeline's
+            # scan fallback — no micro-batch schedule involved.
+            from ...distributed.fleet.meta_parallel.pipeline_parallel import SpmdPipeline
+
+            self.decoder = SpmdPipeline(
+                blocks, num_stages=1, recompute_block=config.use_recompute
             )
         else:
             self.decoder = nn.LayerList(blocks)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 import subprocess
 import tempfile
 
@@ -34,13 +35,36 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
     src_list = [str(s) for s in (
         sources if isinstance(sources, (list, tuple)) else [sources])]
     cmd_tail = src_list + list(extra_cxx_cflags or []) + list(extra_ldflags or [])
-    # version the artifact by source mtimes AND the full compile command:
-    # dlopen caches by PATH, so rebuilding into the same .so would silently
-    # serve the old image — including one built with different flags
+    # version the artifact by source (+ locally-included header) mtimes AND
+    # the full compile command: dlopen caches by PATH, so rebuilding into
+    # the same .so would silently serve the old image — including one built
+    # with different flags or edited #include'd headers
     import hashlib
 
+    inc_dirs = [a[2:] for a in cmd_tail if a.startswith("-I") and len(a) > 2]
+    deps = list(src_list)
+    seen = set(deps)
+    queue = list(src_list)
+    while queue:
+        path = queue.pop()
+        try:
+            with open(path, "r", errors="ignore") as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        for m in re.finditer(r'^\s*#\s*include\s*"([^"]+)"', text, re.M):
+            # quoted includes resolve includer-relative first, then through
+            # any -I dirs from the flags (both must stamp the artifact)
+            for base in [os.path.dirname(os.path.abspath(path))] + inc_dirs:
+                cand = os.path.normpath(os.path.join(base, m.group(1)))
+                if os.path.exists(cand):
+                    if cand not in seen:
+                        seen.add(cand)
+                        deps.append(cand)
+                        queue.append(cand)
+                    break
     stamp = hashlib.sha256(("\x00".join(
-        cmd_tail + [str(os.stat(s).st_mtime_ns) for s in src_list]
+        cmd_tail + [f"{d}:{os.stat(d).st_mtime_ns}" for d in sorted(deps)]
     )).encode()).hexdigest()[:16]
     out = os.path.join(build_dir, f"lib{name}_{stamp}.so")
     if os.path.exists(out):
@@ -51,14 +75,21 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
         return ctypes.CDLL(out)
     tmp = f"{out}.tmp{os.getpid()}"
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", tmp] + cmd_tail
-    proc = subprocess.run(cmd, capture_output=True, text=True)
-    if verbose:
-        print(" ".join(cmd))
-        print(proc.stdout, proc.stderr)
-    if proc.returncode != 0:
-        raise subprocess.CalledProcessError(
-            proc.returncode, cmd, proc.stdout, proc.stderr)
-    os.replace(tmp, out)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if verbose:
+            print(" ".join(cmd))
+            print(proc.stdout, proc.stderr)
+        if proc.returncode != 0:
+            raise subprocess.CalledProcessError(
+                proc.returncode, cmd, proc.stdout, proc.stderr)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     return ctypes.CDLL(out)
 
 

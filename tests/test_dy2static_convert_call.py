@@ -1,0 +1,284 @@
+"""convert_call recursion: tensor-dependent control flow in CALLEES of a
+to_static function compiles too (functions, bound methods, Layer forwards).
+
+Reference test model: ``test/dygraph_to_static/test_convert_call.py`` —
+the reference recursively converts every function reachable from a
+to_static entry (``jit/dy2static/convert_call_func.py``). VERDICT r3 #4's
+done-criterion: a model whose tensor-``if`` lives in a called helper
+compiles under ``to_static`` with no fallback warning, output-parity vs
+eager.
+
+Also the r3 #8 guard tests: snapshot semantics (module globals are bound
+at conversion time — a documented divergence from the reference's live
+lookup) and the attribute-store-in-branch case (falls back WITH a warning
+rather than silently tracing one branch's side effect).
+"""
+import functools
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+pytestmark = pytest.mark.fast
+
+
+def _assert_no_fallback(record):
+    msgs = [str(w.message) for w in record if "EAGER" in str(w.message)]
+    assert not msgs, f"dy2static fell back to eager: {msgs}"
+
+
+def _run_static(fn, *argsets):
+    sfn = paddle.jit.to_static(fn)
+    outs = []
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for args in argsets:
+            outs.append(sfn(*args))
+    _assert_no_fallback(rec)
+    return outs, sfn
+
+
+# module-level helpers (the common shape: loss branches / beam utilities)
+
+def _branchy_helper(x):
+    if x.sum() > 0:
+        return x * 2.0
+    return x - 1.0
+
+
+def _loopy_helper(x):
+    s = paddle.zeros([])
+    while s < 10.0:
+        s = s + x.mean() + 1.0
+    return s
+
+
+def test_tensor_if_in_called_function():
+    def entry(x):
+        y = _branchy_helper(x)
+        return y + 1.0
+
+    pos = paddle.to_tensor(np.ones((2, 3), "float32"))
+    neg = paddle.to_tensor(-np.ones((2, 3), "float32"))
+    (got_p, got_n), sfn = _run_static(entry, (pos,), (neg,))
+    np.testing.assert_allclose(got_p.numpy(), entry(pos).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(got_n.numpy(), entry(neg).numpy(), rtol=1e-6)
+    # one program serves both directions: the helper's if is a lax.cond
+    assert sfn.program_cache_size == 1
+
+
+def test_tensor_while_in_called_function():
+    def entry(x):
+        return _loopy_helper(x) * 2.0
+
+    x = paddle.to_tensor(np.full((4,), 0.5, "float32"))
+    (got,), _ = _run_static(entry, (x,))
+    np.testing.assert_allclose(got.numpy(), entry(x).numpy(), rtol=1e-6)
+
+
+def test_helper_chain_converts_transitively():
+    """A -> B -> C: the tensor-if sits two calls deep."""
+
+    def c(x):
+        if x.mean() > 0:
+            return x + 10.0
+        return x - 10.0
+
+    def b(x):
+        return c(x * 2.0) + 1.0
+
+    def entry(x):
+        return b(x) * 3.0
+
+    pos = paddle.to_tensor(np.ones((3,), "float32"))
+    neg = paddle.to_tensor(-np.ones((3,), "float32"))
+    (got_p, got_n), sfn = _run_static(entry, (pos,), (neg,))
+    np.testing.assert_allclose(got_p.numpy(), entry(pos).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(got_n.numpy(), entry(neg).numpy(), rtol=1e-6)
+    assert sfn.program_cache_size == 1
+
+
+def test_tensor_if_in_bound_method():
+    class Helper:
+        def __init__(self, k):
+            self.k = k
+
+        def gate(self, x):
+            if x.max() > 0:
+                return x * self.k
+            return x / self.k
+
+    h = Helper(4.0)
+
+    def entry(x):
+        return h.gate(x) + 0.5
+
+    pos = paddle.to_tensor(np.ones((2,), "float32"))
+    neg = paddle.to_tensor(-np.ones((2,), "float32"))
+    (got_p, got_n), _ = _run_static(entry, (pos,), (neg,))
+    np.testing.assert_allclose(got_p.numpy(), entry(pos).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(got_n.numpy(), entry(neg).numpy(), rtol=1e-6)
+
+
+def test_tensor_if_in_layer_forward_with_hooks():
+    """A user Layer called from inside a to_static fn: its forward
+    converts, and the __call__ hook protocol still runs."""
+
+    class Gate(nn.Layer):
+        def forward(self, x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x * -3.0
+
+    gate = Gate()
+    seen = []
+    gate.register_forward_post_hook(lambda lyr, inp, out: seen.append(1))
+
+    def entry(x):
+        return gate(x) + 1.0
+
+    pos = paddle.to_tensor(np.ones((2,), "float32"))
+    neg = paddle.to_tensor(-np.ones((2,), "float32"))
+    (got_p, got_n), _ = _run_static(entry, (pos,), (neg,))
+    np.testing.assert_allclose(got_p.numpy(), entry(pos).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(got_n.numpy(), entry(neg).numpy(), rtol=1e-6)
+    assert seen, "forward_post_hook did not run through convert_call"
+
+
+def test_partial_helper_converts():
+    def scaled_gate(x, k):
+        if x.sum() > 0:
+            return x * k
+        return x - k
+
+    gate2 = functools.partial(scaled_gate, k=2.0)
+
+    def entry(x):
+        return gate2(x) + 1.0
+
+    pos = paddle.to_tensor(np.ones((2,), "float32"))
+    neg = paddle.to_tensor(-np.ones((2,), "float32"))
+    (got_p, got_n), _ = _run_static(entry, (pos,), (neg,))
+    np.testing.assert_allclose(got_p.numpy(), entry(pos).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(got_n.numpy(), entry(neg).numpy(), rtol=1e-6)
+
+
+def test_inconvertible_callee_falls_back_per_callee():
+    """A callee that genuinely host-syncs (.numpy()) keeps the standard
+    eager fallback WITH its warning — per-callee failure doesn't crash."""
+
+    def bad(x):
+        if x.sum() > 0:  # forces conversion attempt of the entry
+            v = float(np.asarray(x.numpy()).sum())
+            return x + v
+        return x
+
+    def entry(x):
+        return bad(x) * 2.0
+
+    sfn = paddle.jit.to_static(entry)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = sfn(x)
+    assert any("EAGER" in str(w.message) for w in rec)
+    np.testing.assert_allclose(out.numpy(), entry(x).numpy(), rtol=1e-6)
+
+
+def test_convert_call_skips_framework_and_builtins():
+    from paddle_tpu.jit.dy2static import convert_call
+
+    assert convert_call(len) is len
+    assert convert_call(paddle.sum) is paddle.sum
+    assert convert_call(np.sum) is np.sum
+    assert convert_call(int) is int
+    lin = nn.Linear(2, 2)
+    assert convert_call(lin) is lin  # framework Layer: not converted
+
+
+def test_convert_call_caches_per_function_object():
+    from paddle_tpu.jit import dy2static as d
+
+    def helper(x):
+        if x.sum() > 0:
+            return x
+        return -x
+
+    c1 = d.convert_call(helper)
+    c2 = d.convert_call(helper)
+    assert c1 is not helper  # converted
+    assert c1 is c2  # cached
+
+
+def test_depth_bound_returns_original():
+    from paddle_tpu.jit import dy2static as d
+
+    def helper(x):
+        return x
+
+    old = d._call_depth
+    d._call_depth = d._MAX_CONVERT_DEPTH
+    try:
+        assert d.convert_call(helper) is helper
+    finally:
+        d._call_depth = old
+
+
+# ----- r3 #8 guard tests: snapshot semantics ----- #
+
+_SNAP_GLOBAL = 10.0
+
+
+def test_global_snapshot_semantics_documented():
+    """Module globals are snapshotted at conversion time (documented
+    divergence from the reference's live lookup): rebinding the global
+    AFTER conversion is not seen by the compiled function."""
+    global _SNAP_GLOBAL
+    _SNAP_GLOBAL = 10.0
+
+    def f(x):
+        if x.sum() > 0:
+            return x + _SNAP_GLOBAL
+        return x - _SNAP_GLOBAL
+
+    sfn = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out1 = sfn(x)
+    _assert_no_fallback(rec)
+    np.testing.assert_allclose(out1.numpy(), np.full((2,), 11.0), rtol=1e-6)
+    _SNAP_GLOBAL = 99.0
+    out2 = sfn(x)  # still sees the snapshot (and the compiled constant)
+    np.testing.assert_allclose(out2.numpy(), np.full((2,), 11.0), rtol=1e-6)
+    _SNAP_GLOBAL = 10.0
+
+
+def test_attr_store_in_branch_warns_not_silent():
+    """``self.x = v`` inside a tensor-if branch cannot convert: the whole
+    callable degrades to eager WITH the fallback warning (never a silent
+    one-branch trace), and eager results stay correct."""
+
+    class Holder:
+        hits = 0
+
+    h = Holder()
+
+    def f(x):
+        if x.sum() > 0:
+            h.hits = h.hits + 1  # attribute store inside the branch
+            return x * 2.0
+        return x
+
+    sfn = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = sfn(x)
+    assert any("EAGER" in str(w.message) for w in rec), \
+        "attribute store in a traced branch must warn + fall back"
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 2.0), rtol=1e-6)
+    assert h.hits == 1  # the eager path really ran the store

@@ -1,0 +1,53 @@
+"""fold_layers: the GPT decoder as ONE lax.scan over layer-stacked params
+(compile-time O(1) in depth) must match the unrolled LayerList exactly.
+
+Reference capability: compile-time scaling of deep stacks — the reference
+amortizes per-layer cost through fused program passes; the TPU-native
+answer is the jax scan-over-layers idiom (BENCH weak #5: GPT-1.3B CPU-mesh
+compile 1093s unrolled)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.fast
+
+
+def _mk(fold):
+    paddle.seed(11)
+    cfg = GPTConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=2, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        fold_layers=fold)
+    return GPTForCausalLM(cfg)
+
+
+def test_fold_layers_forward_parity():
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 16)).astype(np.int32))
+    m_un = _mk(False)
+    m_fold = _mk(True)
+    lo_un = m_un(ids).numpy()
+    lo_fold = m_fold(ids).numpy()
+    np.testing.assert_allclose(lo_fold, lo_un, rtol=2e-5, atol=2e-5)
+
+
+def test_fold_layers_training_parity():
+    from paddle_tpu.jit import TrainStep
+
+    rs = np.random.RandomState(1)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 16)).astype(np.int32))
+
+    losses = {}
+    for fold in (False, True):
+        m = _mk(fold)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        step = TrainStep(m, lambda mm, i, l: mm(i, labels=l), opt)
+        traj = [float(step(ids, ids).numpy()) for _ in range(3)]
+        losses[fold] = traj
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=5e-5, atol=5e-5)
+    assert losses[True][-1] < losses[True][0]  # actually learning
