@@ -1,0 +1,219 @@
+// paddle_tpu_serve — C ABI serving entry (reference capability:
+// paddle_inference_api.h's C++ AnalysisPredictor: deploy a saved model from
+// native code without writing Python).
+//
+// TPU-native design: the saved artifact is a StableHLO module executed by
+// PJRT, whose production host runtime is reached through the Python
+// bindings — so this library embeds a CPython interpreter once per process
+// and drives the SAME paddle_tpu.inference.Predictor the Python serving
+// path uses (one predictor implementation, two ABIs). The C surface is
+// deliberately small and stable:
+//
+//   pts_init()                      — start the embedded runtime (idempotent)
+//   pts_create(model_prefix)        — load a jit.save'd artifact
+//   pts_run_f32(...)                — run one fp32 input -> first fp32 output
+//   pts_destroy(handle)             — drop the predictor
+//   pts_last_error()                — thread-local error string
+//
+// All entry points are thread-safe: each acquires the GIL via
+// PyGILState_Ensure, so a C server can call one handle from many threads
+// (the Predictor itself serializes on the executable, same as Python).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string t_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  t_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) t_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct GilGuard {
+  PyGILState_STATE st;
+  GilGuard() : st(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(st); }
+};
+
+std::once_flag g_init_once;
+
+struct Handle {
+  PyObject* predictor;  // owned
+};
+
+}  // namespace
+
+#define PTS_EXPORT __attribute__((visibility("default")))
+
+extern "C" {
+
+PTS_EXPORT const char* pts_last_error(void) { return t_last_error.c_str(); }
+
+// Idempotent and thread-safe; returns 0 on success. When the host process
+// already embeds Python (e.g. tests driving this library from a Python
+// process via ctypes), the existing interpreter is reused.
+PTS_EXPORT int pts_init(void) {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL: every later entry point takes it with PyGILState
+      PyEval_SaveThread();
+    }
+  });
+  return 0;
+}
+
+PTS_EXPORT void* pts_create(const char* model_prefix) {
+  if (pts_init() != 0) return nullptr;
+  GilGuard gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* pred = nullptr;
+  PyObject* cfg = PyObject_CallMethod(mod, "Config", "s", model_prefix);
+  if (cfg) {
+    pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+    Py_DECREF(cfg);
+  }
+  Py_DECREF(mod);
+  if (!pred) {
+    set_error_from_python();
+    return nullptr;
+  }
+  Handle* h = new Handle{pred};
+  return h;
+}
+
+// Run ONE step: a single fp32 input tensor of `shape[0..rank-1]` ->
+// the first fp32 output. Writes up to out_cap floats into `out`, the
+// output rank into *out_rank and dims into out_shape[0..*out_rank-1]
+// (out_shape must have room for 8 dims). Returns the number of floats
+// in the full output (even if > out_cap; nothing beyond out_cap is
+// written), or -1 on error (see pts_last_error).
+PTS_EXPORT int64_t pts_run_f32(void* handle, const float* data,
+                               const int64_t* shape, int rank, float* out,
+                               int64_t out_cap, int64_t* out_shape,
+                               int* out_rank) {
+  if (!handle) {
+    t_last_error = "null handle";
+    return -1;
+  }
+  GilGuard gil;
+  Handle* h = static_cast<Handle*>(handle);
+
+  int64_t n_in = 1;
+  for (int i = 0; i < rank; i++) n_in *= shape[i];
+
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    set_error_from_python();
+    return -1;
+  }
+  int64_t result = -1;
+  PyObject* mv = nullptr;
+  PyObject* flat = nullptr;
+  PyObject* arr = nullptr;
+  PyObject* shp = nullptr;
+  PyObject* in_list = nullptr;
+  PyObject* outs = nullptr;
+  do {
+    mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(data)),
+        n_in * static_cast<int64_t>(sizeof(float)), PyBUF_READ);
+    if (!mv) break;
+    // frombuffer is zero-copy over the caller's memory; reshape().copy()
+    // hands Python an owned array before we leave this frame
+    flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+    if (!flat) break;
+    shp = PyTuple_New(rank);
+    if (!shp) break;
+    for (int i = 0; i < rank; i++)
+      PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+    arr = PyObject_CallMethod(flat, "reshape", "O", shp);
+    if (!arr) break;
+    PyObject* owned = PyObject_CallMethod(arr, "copy", nullptr);
+    if (!owned) break;
+    Py_DECREF(arr);
+    arr = owned;
+
+    in_list = PyList_New(1);
+    if (!in_list) break;
+    Py_INCREF(arr);
+    PyList_SET_ITEM(in_list, 0, arr);
+    outs = PyObject_CallMethod(h->predictor, "run", "O", in_list);
+    if (!outs) break;
+    PyObject* o0 = PySequence_GetItem(outs, 0);
+    if (!o0) break;
+    PyObject* o32 = PyObject_CallMethod(np, "ascontiguousarray", "Os", o0,
+                                        "float32");
+    Py_DECREF(o0);
+    if (!o32) break;
+
+    // shape out
+    PyObject* oshape = PyObject_GetAttrString(o32, "shape");
+    if (!oshape) {
+      Py_DECREF(o32);
+      break;
+    }
+    Py_ssize_t orank = PyTuple_Size(oshape);
+    if (out_rank) *out_rank = static_cast<int>(orank);
+    int64_t n_out = 1;
+    for (Py_ssize_t i = 0; i < orank; i++) {
+      int64_t d = PyLong_AsLongLong(PyTuple_GET_ITEM(oshape, i));
+      n_out *= d;
+      if (out_shape && i < 8) out_shape[i] = d;
+    }
+    Py_DECREF(oshape);
+
+    Py_buffer view;
+    if (PyObject_GetBuffer(o32, &view, PyBUF_C_CONTIGUOUS) != 0) {
+      Py_DECREF(o32);
+      break;
+    }
+    int64_t n_copy = n_out < out_cap ? n_out : out_cap;
+    std::memcpy(out, view.buf,
+                static_cast<size_t>(n_copy) * sizeof(float));
+    PyBuffer_Release(&view);
+    Py_DECREF(o32);
+    result = n_out;
+  } while (false);
+  if (result < 0) set_error_from_python();
+  Py_XDECREF(outs);
+  Py_XDECREF(in_list);
+  Py_XDECREF(arr);
+  Py_XDECREF(shp);
+  Py_XDECREF(flat);
+  Py_XDECREF(mv);
+  Py_DECREF(np);
+  return result;
+}
+
+PTS_EXPORT void pts_destroy(void* handle) {
+  if (!handle) return;
+  GilGuard gil;
+  Handle* h = static_cast<Handle*>(handle);
+  Py_XDECREF(h->predictor);
+  delete h;
+}
+
+}  // extern "C"
