@@ -52,6 +52,7 @@ class LlamaConfig:
         use_flash_attention: bool = True,
         use_recompute: bool = False,
         sequence_parallel: bool = False,
+        fold_layers: bool = False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -71,6 +72,9 @@ class LlamaConfig:
         self.use_flash_attention = use_flash_attention
         self.use_recompute = use_recompute
         self.sequence_parallel = sequence_parallel
+        # one lax.scan over layer-stacked params without pp: compile time
+        # O(1) in depth (see GPTConfig.fold_layers; same scan machinery)
+        self.fold_layers = fold_layers
 
 
 def _rope_cache(max_t: int, dim: int, theta: float):
@@ -208,6 +212,14 @@ class LlamaModel(nn.Layer):
 
             self.layers = SpmdPipeline(
                 blocks, num_stages=pp, recompute_block=config.use_recompute
+            )
+        elif getattr(config, "fold_layers", False) and len(blocks) > 1:
+            from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+                SpmdPipeline,
+            )
+
+            self.layers = SpmdPipeline(
+                blocks, num_stages=1, recompute_block=config.use_recompute
             )
         else:
             if pp > 1:

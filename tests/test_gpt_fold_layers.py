@@ -34,6 +34,23 @@ def test_fold_layers_forward_parity():
     np.testing.assert_allclose(lo_fold, lo_un, rtol=2e-5, atol=2e-5)
 
 
+def test_llama_fold_layers_forward_parity():
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    def mk(fold):
+        paddle.seed(13)
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=4,
+            num_attention_heads=2, max_position_embeddings=64,
+            fold_layers=fold)
+        return LlamaForCausalLM(cfg)
+
+    rs = np.random.RandomState(2)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 16)).astype(np.int32))
+    np.testing.assert_allclose(mk(True)(ids).numpy(), mk(False)(ids).numpy(),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_fold_layers_training_parity():
     from paddle_tpu.jit import TrainStep
 
