@@ -1,0 +1,26 @@
+"""Shared XLA_FLAGS setup for emulated CPU meshes.
+
+One definition of the virtual-device count + CPU collective-watchdog
+relaxation (the default warn-20s/terminate-40s watchdog SIGABRTs
+legitimate heavy programs when one host core emulates 8 devices).
+
+NO jax imports here: callers (tests/conftest.py, bench_configs.py,
+__graft_entry__.py) must apply this BEFORE any jax backend init.
+Each flag is guarded separately so a user-supplied value for one is
+never overridden by appending our default for the other.
+"""
+
+
+def apply(env=None, n_devices=8):
+    import os
+
+    e = os.environ if env is None else env
+    flags = e.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={n_devices}"
+    if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+        flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+    if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+    e["XLA_FLAGS"] = flags
+    return e

@@ -292,15 +292,9 @@ def _child_env(kind):
     if kind == "cpu_mesh":
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        # the collective-call watchdog defaults (warn 20s / TERMINATE 40s)
-        # are sized for real multi-host hangs; on a 1-core host emulating 8
-        # devices, a heavy per-device program legitimately takes minutes to
-        # reach an all-reduce — the folded GPT-1.3B step was SIGABRT'd by
-        # exactly this watchdog
-        env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + \
-            " --xla_force_host_platform_device_count=8" + \
-            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600" + \
-            " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+        import _cpu_mesh_flags
+
+        _cpu_mesh_flags.apply(env)
     return env
 
 

@@ -59,6 +59,52 @@ def _wrap_like(x, out):
     return Tensor(out) if isinstance(x, Tensor) else out
 
 
+def _promote_subf32_reduce(dt) -> bool:
+    """True when a sub-f32 sum-reduce must run in f32: ONLY on the CPU
+    backend, whose AllReducePromotion pass CHECK-fails cloning the
+    copy-rooted reduction region jax emits for bf16 psums
+    (``hlo_instruction.cc`` "Invalid binary instruction opcode copy",
+    jaxlib 0.9) — SIGABRTing compilation of bf16 pipeline schedules on
+    emulated meshes. On TPU the native-dtype reduce is kept: promoting
+    there would double collective wire bytes on the gradient hot path."""
+    if dt not in (jnp.bfloat16, jnp.float16):
+        return False
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True
+
+
+def psum_f32safe(v, ax):
+    """``lax.psum`` with sub-f32 floats promoted to f32 for the reduce
+    where required (see :func:`_promote_subf32_reduce`)."""
+    dt = v.dtype
+    if _promote_subf32_reduce(dt):
+        return lax.psum(v.astype(jnp.float32), ax).astype(dt)
+    return lax.psum(v, ax)
+
+
+def pmean_f32safe(v, ax):
+    """``lax.pmean`` through the same promotion (pmean lowers to
+    psum / axis-size, hitting the same XLA CPU pass)."""
+    dt = v.dtype
+    if _promote_subf32_reduce(dt):
+        return lax.pmean(v.astype(jnp.float32), ax).astype(dt)
+    return lax.pmean(v, ax)
+
+
+def psum_scatter_f32safe(v, ax, scatter_dimension=0, tiled=True):
+    """``lax.psum_scatter`` through the same promotion (same pass, same
+    copy-rooted bf16 reduction region, confirmed same SIGABRT)."""
+    dt = v.dtype
+    if _promote_subf32_reduce(dt):
+        return lax.psum_scatter(
+            v.astype(jnp.float32), ax, scatter_dimension=scatter_dimension,
+            tiled=tiled).astype(dt)
+    return lax.psum_scatter(v, ax, scatter_dimension=scatter_dimension,
+                            tiled=tiled)
+
+
 # ---------------------------------------------------------------- all_reduce
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
     g = _resolve_group(group)
@@ -66,13 +112,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=T
     if _in_trace(v):
         ax = _axes(g)
         if op == ReduceOp.SUM:
-            out = lax.psum(v, ax)
+            out = psum_f32safe(v, ax)
         elif op == ReduceOp.MAX:
             out = lax.pmax(v, ax)
         elif op == ReduceOp.MIN:
             out = lax.pmin(v, ax)
         elif op == ReduceOp.AVG:
-            out = lax.pmean(v, ax)
+            out = pmean_f32safe(v, ax)
         else:
             # PROD: gather shards and multiply directly. The log-sum-exp
             # trick is NaN-gradient at v=0 and numerically poor; PROD
@@ -162,7 +208,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
     else:
         v = raw(tensor)
     if _in_trace(v):
-        out = lax.psum_scatter(v, _axes(g), scatter_dimension=0, tiled=True)
+        out = psum_scatter_f32safe(v, _axes(g), scatter_dimension=0, tiled=True)
     else:
         n = g.nranks
         idx = max(g.rank, 0)

@@ -41,6 +41,7 @@ from ....framework.core import Tensor
 from ....framework.op import defop, raw
 from ....nn.layer import Layer, Parameter
 from ... import mesh as _mesh
+from ...collective import psum_f32safe as _psum_f32safe
 
 
 class LayerDesc:
@@ -340,7 +341,8 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
 
         _, out_buf = lax.fori_loop(0, M + S - 1, step, (state, out_buf))
         # only the last stage holds real outputs; replicate across pp
-        out_buf = lax.psum(
+        # (f32-safe: bf16 psum crashes XLA CPU's AllReducePromotion)
+        out_buf = _psum_f32safe(
             jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)), "pp"
         )
         return out_buf
@@ -400,13 +402,28 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
             return h_next, out_
 
         _, out_buf = lax.fori_loop(0, n_steps, step, (h0, out_buf))
-        out_buf = lax.psum(
+        out_buf = _psum_f32safe(
             jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)), "pp"
         )
         return out_buf
 
     if V > 1:
         spmd_fn = spmd_fn_interleaved
+
+    # On the CPU backend, sub-f32 i/o crosses the shard_map boundary as
+    # f32: the replicated input's cotangent is a jax-inserted psum at this
+    # boundary, and XLA CPU's AllReducePromotion CHECK-fails on the
+    # copy-rooted reduction region jax emits for bf16 psums (see
+    # collective._promote_subf32_reduce). The converts fuse; compute
+    # inside stays in the model dtype; TPU keeps native-dtype i/o.
+    from ...collective import _promote_subf32_reduce
+
+    promote = _promote_subf32_reduce(x.dtype)
+    inner_fn = spmd_fn
+    if promote:
+        def spmd_fn(local_stacked, xm_all):  # noqa: F811
+            return inner_fn(
+                local_stacked, xm_all.astype(x.dtype)).astype(jnp.float32)
 
     mapped = jax.shard_map(
         spmd_fn,
@@ -419,7 +436,9 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
     # jit wrapper: the partial-manual shard_map eager impl path is broken in
     # current jax (nested unmatch uses the full axis set); the traced path is
     # fine, and under an outer jit this inlines.
-    out = jax.jit(mapped)(tuple(stacked_vals), xm)
+    out = jax.jit(mapped)(
+        tuple(stacked_vals), xm.astype(jnp.float32) if promote else xm)
+    out = out.astype(x.dtype)
     return out.reshape((B,) + out.shape[2:])
 
 

@@ -6,19 +6,15 @@ subprocesses + Gloo, we simulate an 8-chip slice with
 --xla_force_host_platform_device_count on the CPU PJRT backend.
 """
 import os
+import sys
 
 # Must happen before any jax backend initialization.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    flags += " --xla_force_host_platform_device_count=8"
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
-    # the default 40s collective watchdog misfires when 1 host core
-    # emulates 8 devices under load (see bench_configs._child_env)
-    flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
-              " --xla_cpu_collective_call_terminate_timeout_seconds=7200")
-os.environ["XLA_FLAGS"] = flags
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _cpu_mesh_flags  # noqa: E402  (jax-free; shared flag defaults)
+
+_cpu_mesh_flags.apply()
 
 import jax  # noqa: E402
 

@@ -1,0 +1,38 @@
+"""bf16 pipeline-parallel regression: the circular schedule must compile
+and train in bf16 on the CPU mesh.
+
+Guards the XLA CPU AllReducePromotion CHECK-failure ("Invalid binary
+instruction opcode copy"): jax emits bf16 psum reduction regions rooted in
+a copy, which that pass cannot clone — every explicit psum and the
+shard_map-boundary i/o now route sub-f32 floats through f32 (see
+collective.psum_f32safe and _pipeline_forward). This was the blocker for
+the GPT-6.7B pp x sharding artifact (VERDICT r3 #2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+
+def test_bf16_pp2_sharding4_trains():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(dp_degree=1, mp_degree=1, pp_degree=2)
+    s.hybrid_configs["sharding_degree"] = 4
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=4,
+        num_attention_heads=4, max_position_embeddings=128,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg).bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+    step = fleet.DistTrainStep(model, lambda m, i, l: m(i, labels=l), opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (2, 64)).astype(np.int32))
+    l1 = float(step(ids, ids))
+    l2 = float(step(ids, ids))
+    assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
