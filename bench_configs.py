@@ -214,11 +214,13 @@ def run_gpt_6p7b_ppsharding():
     shard fits in RAM; one step, tiny batch — this validates the pp x
     sharding program, not throughput.
 
-    NOTE: the full 32-layer compile exceeds 80 minutes on a 1-core host
-    (XLA CPU backend; measured round 3) — BENCH_67B_LAYERS can shrink the
-    stack while keeping the true 6.7B layer geometry (hidden 4096, 32
-    heads, ffn 16384); the gpt_6p7b_ppsharding_lite config records the
-    8-layer variant."""
+    NOTE: the full 32-layer run is OOM-killed on this box (round 4,
+    125GB host RAM: 8 emulated devices each hold their own buffer copies,
+    so the one-host footprint is ~8x a real per-chip footprint) —
+    BENCH_67B_LAYERS shrinks the stack while keeping the true 6.7B layer
+    geometry (hidden 4096, 32 heads, ffn 16384). The committed artifact
+    uses 16 layers (3.4B params, ~117GB peak); gpt_6p7b_ppsharding_lite
+    records the 8-layer variant."""
     import numpy as np
 
     import paddle_tpu as paddle
