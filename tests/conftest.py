@@ -23,6 +23,24 @@ try:
 except Exception:
     pass
 
+try:
+    # persistent XLA compile cache: the suite is compile-bound on this box
+    # and most programs are identical run-over-run (CI reuse; cold run pays
+    # once). NOTE: the env var JAX_COMPILATION_CACHE_DIR alone is ignored
+    # by this jax version — the config update is load-bearing.
+    import tempfile
+
+    # per-user dir (same rationale as utils/cpp_extension.py: a fixed
+    # world-shared /tmp path breaks multi-user boxes and invites poisoning)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get(
+                          "JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(tempfile.gettempdir(),
+                                       f"paddle_tpu_test_jaxcache_{os.getuid()}")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 

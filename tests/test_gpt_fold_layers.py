@@ -6,12 +6,11 @@ amortizes per-layer cost through fused program passes; the TPU-native
 answer is the jax scan-over-layers idiom (BENCH weak #5: GPT-1.3B CPU-mesh
 compile 1093s unrolled)."""
 import numpy as np
-import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
 
-pytestmark = pytest.mark.fast
+# not in the fast tier: three full-model compiles (~50s on this box)
 
 
 def _mk(fold):
