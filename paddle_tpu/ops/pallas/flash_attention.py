@@ -53,6 +53,37 @@ DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
+def _env_block(name, default):
+    import os
+
+    try:
+        v = int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+    # Mosaic needs the block's second-to-last dim divisible by 8; zero or
+    # negative values would divide-by-zero in the pad math
+    return _ceil8(v)
+
+
+def _blocks_fwd():
+    """Forward block sizes; env-tunable (PADDLE_TPU_FLASH_BLOCK_Q/K) for
+    on-chip sweeps. Read at TRACE time: a changed env var does not retrace
+    an already-compiled shape — sweep in fresh processes."""
+    bq = _env_block("PADDLE_TPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q)
+    bk = _env_block("PADDLE_TPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K)
+    return bq, bk
+
+
+def _blocks_bwd():
+    """Backward block sizes; default to the forward's, separately tunable
+    (PADDLE_TPU_FLASH_BWD_BLOCK_Q/K) — the bwd kernel's working set is
+    ~2.5x the fwd's per tile, so its optimum can sit one size lower."""
+    fq, fk = _blocks_fwd()
+    bq = _env_block("PADDLE_TPU_FLASH_BWD_BLOCK_Q", fq)
+    bk = _env_block("PADDLE_TPU_FLASH_BWD_BLOCK_K", fk)
+    return bq, bk
+
+
 def _ceil8(n):
     return max(8, (n + 7) // 8 * 8)
 
@@ -414,8 +445,12 @@ def _fa_backward(q, k, v, bias, o, lse, do, causal, scale, n_heads,
     bias_tk = bias.shape[2] if bias is not None else 1
     if bias is not None and (pad_q or pad_k):
         bias = _pad_bias(bias, pad_q, pad_k)
-    if lse.shape[1] != tqp:
+    if lse.shape[1] < tqp:
         lse = jnp.pad(lse, ((0, 0), (0, tqp - lse.shape[1])))
+    elif lse.shape[1] > tqp:
+        # residual lse is padded to the FORWARD block grid, which can be
+        # wider than the backward's when bwd blocks are tuned smaller
+        lse = lse[:, :tqp]
     # per-row stats enter the kernels with a trailing singleton dim (see
     # the forward's lse out_spec for the Mosaic tiling rule)
     lse = lse[:, :, None]
@@ -564,7 +599,7 @@ def _fa(q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b, bias_h,
         bias_grad, interpret):
     o, _ = _fa_forward(
         q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b, bias_h,
-        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+        *_blocks_fwd(), interpret,
     )
     return o
 
@@ -573,7 +608,7 @@ def _fa_fwd(q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b,
             bias_h, bias_grad, interpret):
     o, lse = _fa_forward(
         q, k, v, bias, causal, scale, n_heads, n_kv_heads, bias_b, bias_h,
-        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+        *_blocks_fwd(), interpret,
     )
     return o, (q, k, v, bias, o, lse)
 
@@ -583,8 +618,7 @@ def _fa_bwd(causal, scale, n_heads, n_kv_heads, bias_b, bias_h, bias_grad,
     q, k, v, bias, o, lse = res
     dq, dk, dv, dbias = _fa_backward(
         q, k, v, bias, o, lse, do, causal, scale, n_heads, n_kv_heads,
-        bias_b, bias_h, bias_grad, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-        interpret,
+        bias_b, bias_h, bias_grad, *_blocks_bwd(), interpret,
     )
     if bias is None:
         dbias = None

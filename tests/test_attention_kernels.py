@@ -48,6 +48,29 @@ def test_flash_attention_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+def test_flash_attention_grads_mismatched_bwd_blocks(monkeypatch):
+    """Backward blocks tuned SMALLER than the forward's (the sweep's shape):
+    the forward-grid-padded lse residual must be re-sliced to the backward
+    grid, incl. a sequence length that is a multiple of neither block."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_K", "64")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BWD_BLOCK_Q", "32")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BWD_BLOCK_K", "32")
+    q, k, v = _rand(1, 100, 2, 16, seed=7)  # 100: not a multiple of 64 or 32
+
+    def f_pl(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).mean()
+
+    def f_ref(q, k, v):
+        return (_sdpa_reference(q, k, v, None, 0.0, True, None) ** 2).mean()
+
+    g_pl = jax.grad(f_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_flash_attention_bias_and_mask():
     q, k, v = _rand(2, 96, 2, 16, seed=3)
     rng = np.random.default_rng(4)
