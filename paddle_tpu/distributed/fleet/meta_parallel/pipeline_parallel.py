@@ -194,7 +194,7 @@ class SpmdPipeline(Layer):
             self._stacked_bufs.append(sb)
 
     # -- functional application of the template with given leaf values -------
-    def _apply_block(self, leaf_vals, x):
+    def _apply_block(self, leaf_vals, x, *extra):
         tmpl = self._template_holder[0]
         nb = len(self._tbuffers)
         p_vals = leaf_vals[: len(leaf_vals) - nb] if nb else leaf_vals
@@ -206,7 +206,7 @@ class SpmdPipeline(Layer):
                 p._value = v
             for b, v in zip(self._tbuffers, b_vals):
                 b._value = v
-            out = tmpl(Tensor(x))
+            out = tmpl(Tensor(x), *extra)
             return raw(out)
         finally:
             for p, v in zip(self._tparams, originals):
@@ -214,11 +214,21 @@ class SpmdPipeline(Layer):
             for b, v in zip(self._tbuffers, orig_bufs):
                 b._value = v
 
-    def forward(self, x):
+    def forward(self, x, *extra):
+        """``extra`` — per-call tensors every block receives unchanged (an
+        encoder's attention mask). Supported on the layer-fold (scan) path
+        only; the micro-batch pipeline schedules take a single tensor.
+
+        ``x`` and ``extra`` pass into the defop UN-unwrapped: the defop
+        records Tensor leaves as differentiable tape inputs, so the eager
+        tape edge back to the embeddings (or a differentiable mask) stays
+        intact — a pre-emptive ``raw()`` here silently severed it."""
         return _pipeline_forward(
-            raw(x) if isinstance(x, Tensor) else x,
+            x,
             *[p for p in self._stacked],
             *[b for b in self._stacked_bufs],
+            *extra,
+            n_extra=len(extra),
             pipe=self,
         )
 
@@ -247,6 +257,27 @@ class SpmdPipeline(Layer):
         return {"steps": steps, "step_cost": cost, "total_cost": total,
                 "ideal_cost": float(M), "bubble_fraction": 1.0 - M / total,
                 "M": M}
+
+
+def fold_or_list(blocks, fold: bool, recompute: bool = False):
+    """Model-zoo construction helper: the layer-fold stack (ONE lax.scan
+    over layer-stacked params — compile O(1) in depth) when ``fold``, else
+    a plain LayerList. One definition for GPT/Llama/BERT/ERNIE."""
+    if fold and len(blocks) > 1:
+        return SpmdPipeline(blocks, num_stages=1, recompute_block=recompute)
+    from ....nn.layer import LayerList
+
+    return LayerList(blocks)
+
+
+def run_stack(stack, x, *extra):
+    """Apply a fold_or_list stack: scans the folded form, loops the list.
+    ``extra`` (e.g. an encoder's attention mask) goes to every block."""
+    if isinstance(stack, SpmdPipeline):
+        return stack(x, *extra)
+    for blk in stack:
+        x = blk(x, *extra) if extra else blk(x)
+    return x
 
 
 def _uses_scan_fallback(num_stages: int) -> bool:
@@ -283,12 +314,17 @@ def _choose_microbatches(batch: int, requested: int, warn: bool = True) -> int:
 
 
 @defop(name="spmd_pipeline")
-def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
+def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     m = _mesh.get_global_mesh()
     S = pipe.num_stages
     block = pipe._apply_block
     if pipe.recompute_block:
         block = jax.checkpoint(block, policy=jax.checkpoint_policies.dots_saveable)
+
+    if n_extra:
+        stacked_vals, extra = stacked_vals[:-n_extra], stacked_vals[-n_extra:]
+    else:
+        extra = ()
 
     if _uses_scan_fallback(S):
         # layer-stacked scan (the idiomatic big-model pattern: one block
@@ -301,10 +337,17 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline):
             ordered = tuple(stacked_vals)
 
         def body(h, leaves):
-            return block(leaves, h), None
+            return block(leaves, h, *extra), None
 
         h, _ = lax.scan(body, x, ordered)
         return h
+
+    if extra:
+        raise NotImplementedError(
+            "SpmdPipeline: extra per-call args (attention masks, ...) are "
+            "supported on the layer-fold path (num_stages=1) only; the "
+            "micro-batch pipeline schedules move a single tensor between "
+            "stages — fold the mask into the block input or its buffers")
 
     # ---- circular micro-batch schedule over the pp axis --------------------
     V = pipe.num_virtual_stages

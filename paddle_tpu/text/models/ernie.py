@@ -35,6 +35,7 @@ class ErnieConfig:
         pad_token_id: int = 0,
         layer_norm_eps: float = 1e-12,
         use_flash_attention: bool = True,
+        fold_layers: bool = False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -52,6 +53,8 @@ class ErnieConfig:
         self.pad_token_id = pad_token_id
         self.layer_norm_eps = layer_norm_eps
         self.use_flash_attention = use_flash_attention
+        # one lax.scan over layer-stacked params (see GPTConfig.fold_layers)
+        self.fold_layers = fold_layers
 
     @staticmethod
     def ernie3_base(**kw):
@@ -113,7 +116,13 @@ class ErnieModel(nn.Layer):
         self.config = config
         bc = _ErnieBlockConfig(config)
         self.embeddings = ErnieEmbeddings(config)
-        self.encoder = nn.LayerList([BertLayer(bc) for _ in range(config.num_hidden_layers)])
+        from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+            fold_or_list,
+        )
+
+        self.encoder = fold_or_list(
+            [BertLayer(bc) for _ in range(config.num_hidden_layers)],
+            getattr(config, "fold_layers", False))
         self.pooler = BertPooler(bc) if add_pooling_layer else None
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
@@ -126,8 +135,11 @@ class ErnieModel(nn.Layer):
             m = raw(attention_mask)
             mask = ((1.0 - m.astype(jnp.float32)) * -1e9)[:, None, None, :]
         x = self.embeddings(input_ids, token_type_ids, position_ids, task_type_ids)
-        for layer in self.encoder:
-            x = layer(x, mask)
+        from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+            run_stack,
+        )
+
+        x = run_stack(self.encoder, x, *(() if mask is None else (mask,)))
         pooled = self.pooler(x) if self.pooler is not None else None
         return x, pooled
 

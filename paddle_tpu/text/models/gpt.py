@@ -196,26 +196,23 @@ class GPTModel(nn.Layer):
             self.decoder = SpmdPipeline(
                 blocks, num_stages=pp, recompute_block=config.use_recompute
             )
-        elif getattr(config, "fold_layers", False) and len(blocks) > 1:
-            # layer-dim scan without pp: one compiled block body (see
-            # GPTConfig.fold_layers). num_stages=1 routes SpmdPipeline's
-            # scan fallback — no micro-batch schedule involved.
-            from ...distributed.fleet.meta_parallel.pipeline_parallel import SpmdPipeline
-
-            self.decoder = SpmdPipeline(
-                blocks, num_stages=1, recompute_block=config.use_recompute
-            )
         else:
-            self.decoder = nn.LayerList(blocks)
+            from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+                fold_or_list,
+            )
+
+            self.decoder = fold_or_list(
+                blocks, getattr(config, "fold_layers", False),
+                recompute=config.use_recompute)
         self.final_layernorm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None):
+        from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+            run_stack,
+        )
+
         x = self.embeddings(input_ids, position_ids)
-        if isinstance(self.decoder, nn.LayerList):
-            for blk in self.decoder:
-                x = blk(x)
-        else:
-            x = self.decoder(x)
+        x = run_stack(self.decoder, x)
         return self.final_layernorm(x)
 
 

@@ -215,12 +215,11 @@ class LlamaModel(nn.Layer):
             )
         elif getattr(config, "fold_layers", False) and len(blocks) > 1:
             from ...distributed.fleet.meta_parallel.pipeline_parallel import (
-                SpmdPipeline,
+                fold_or_list,
             )
 
-            self.layers = SpmdPipeline(
-                blocks, num_stages=1, recompute_block=config.use_recompute
-            )
+            self.layers = fold_or_list(
+                blocks, True, recompute=config.use_recompute)
         else:
             if pp > 1:
                 import warnings
