@@ -18,14 +18,22 @@ pass rewrites the source of a ``to_static`` function so that
 - ``return`` inside a converted branch is folded into the conversion
   (the branch helper's return value IS the function return).
 
+- ``break``/``continue`` in a convertible loop — bare, or as the sole
+  body of a plain ``if`` — are rewritten away (the reference's
+  break_continue_transformer): continues gate the rest of the body on
+  the (possibly tensor) condition, breaks set a carried stop flag that
+  also gates the loop test, so ``while True: ... if c: break`` compiles
+  to ``lax.while_loop``.
+
 The conversion is attempted lazily, the first time tracing a function hits
 a host-sync point (``TraceHostSyncError``); anything the transformer cannot
-prove safe (break/continue crossing a converted boundary, attribute stores
-inside branches, yield/global/nonlocal, returns inside loops that must
-lower to lax) keeps the ORIGINAL statement, so the behavior degrades to
-the existing guard: trace again, and if the untouched statement still
-host-syncs, fall back to eager with a warning — exactly the reference's
-dygraph fallback, but now a last resort instead of the only answer.
+prove safe (break/continue buried deeper than the supported shapes,
+attribute stores inside branches, yield/global/nonlocal, returns inside
+loops that must lower to lax) keeps the ORIGINAL statement, so the
+behavior degrades to the existing guard: trace again, and if the untouched
+statement still host-syncs, fall back to eager with a warning — exactly
+the reference's dygraph fallback, but now a last resort instead of the
+only answer.
 
 Functions CALLED from a converted function are themselves converted:
 every call site is rewritten to route through ``convert_call`` (the
@@ -842,12 +850,91 @@ class _FunctionConverter:
         return not (f.hazard or f.attr_store or f.returns or f.raises
                     or f.breaks_unbound or node.orelse)
 
+    # -- break / continue elimination (reference: dy2static
+    #    break_continue_transformer) --
+    def _rewrite_bc(self, stmts, brk, cnames):
+        """Rewrite top-level ``break``/``continue`` in a loop-body statement
+        list — bare, or as the SOLE body of a plain ``if`` — by gating the
+        remainder of the body on the (possibly tensor) condition; breaks
+        additionally set the carried ``brk`` flag. The generated condition
+        temps are appended to ``cnames`` (the caller pre-initializes them
+        at the top of the body so a gating ``if`` never carries an
+        UNDEFINED out of one branch). Returns (new_stmts, uses_break) or
+        None for unsupported forms. Nested loops own their breaks."""
+        out, uses = [], False
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_parse_stmt(f"{brk} = True"))
+                return out, True
+            if isinstance(s, ast.Continue):
+                return out, uses
+            if isinstance(s, (ast.For, ast.While)):
+                out.append(s)  # inner loop owns its breaks
+                continue
+            if isinstance(s, ast.If) and len(s.body) == 1 and not s.orelse \
+                    and isinstance(s.body[0], (ast.Break, ast.Continue)):
+                rest = self._rewrite_bc(stmts[i + 1:], brk, cnames)
+                if rest is None:
+                    return None
+                rest_stmts, rest_uses = rest
+                cname = self._fresh("bcc")
+                cnames.append(cname)
+                out.append(ast.Assign(
+                    targets=[ast.Name(id=cname, ctx=ast.Store())],
+                    value=s.test))
+                is_break = isinstance(s.body[0], ast.Break)
+                if is_break:
+                    out.append(_parse_stmt(f"{brk} = {brk} or {cname}"))
+                if rest_stmts:
+                    out.append(ast.If(
+                        test=ast.UnaryOp(
+                            op=ast.Not(),
+                            operand=ast.Name(id=cname, ctx=ast.Load())),
+                        body=rest_stmts, orelse=[]))
+                return out, (is_break or rest_uses)
+            if _facts([s]).breaks_unbound:
+                return None  # break/continue buried deeper: unsupported
+            out.append(s)
+        return out, False
+
+    def _debreak_loop(self, st):
+        """If the ONLY conversion blocker of a loop is eliminable
+        break/continue, return (new_body, uses_break, brk_name); else
+        None."""
+        f = _facts(st.body)
+        if not f.breaks_unbound or f.hazard or f.attr_store or f.returns \
+                or f.raises or st.orelse:
+            return None
+        brk = self._fresh("brk")
+        cnames: list = []
+        res = self._rewrite_bc(list(st.body), brk, cnames)
+        if res is None:
+            return None
+        new_body, uses_break = res
+        inits = [_parse_stmt(f"{c} = False") for c in cnames]
+        return inits + new_body, uses_break, brk
+
     def _convert_while(self, st, fn_tail):
+        pre = []
+        deb = self._debreak_loop(st)
+        if deb is not None:
+            new_body, uses_break, brk = deb
+            test = st.test
+            if uses_break:
+                pre.append(ast.fix_missing_locations(
+                    ast.copy_location(_parse_stmt(f"{brk} = False"), st)))
+                test = ast.BoolOp(op=ast.And(), values=[
+                    ast.UnaryOp(op=ast.Not(),
+                                operand=ast.Name(id=brk, ctx=ast.Load())),
+                    test])
+            st = ast.copy_location(
+                ast.While(test=test, body=new_body, orelse=[]), st)
+            ast.fix_missing_locations(st)
         if not self._loop_convertible(st):
             st.test = self._expr_value(st.test)
             st.body = self._block(st.body, fn_tail=False)
             st.orelse = self._block(st.orelse, fn_tail=False)
-            return [ast.fix_missing_locations(st)]
+            return pre + [ast.fix_missing_locations(st)]
         body_assigned = _facts(st.body).assigned
         carried = self._carried_for_loop(st, body_assigned, _loaded_names(st.test))
         t_name, b_name = self._fresh("wt"), self._fresh("wb")
@@ -864,12 +951,30 @@ class _FunctionConverter:
         else:
             call = _jst_call("convert_while", f"{t_name}, {b_name}, ()")
         stmt = self._assign_call(call, None)
-        return [ast.fix_missing_locations(x) for x in (test_fn, body_fn, stmt)]
+        return pre + [ast.fix_missing_locations(x)
+                      for x in (test_fn, body_fn, stmt)]
 
     def _convert_for(self, st, fn_tail):
         # only `for <name> in range(...)` converts; anything else stays
         # Python (a concrete iterable unrolls under trace, which is the
         # jax-idiomatic outcome for static trip counts anyway)
+        pre_bc, brk, orig_st = [], None, st
+        if (isinstance(st.target, ast.Name) and isinstance(st.iter, ast.Call)
+                and isinstance(st.iter.func, ast.Name)
+                and st.iter.func.id == "range"
+                and not st.iter.keywords
+                and 1 <= len(st.iter.args) <= 3):
+            deb = self._debreak_loop(st)
+            if deb is not None:
+                new_body, uses_break, brk_name = deb
+                st = ast.copy_location(ast.For(
+                    target=st.target, iter=st.iter, body=new_body,
+                    orelse=[], type_comment=None), st)
+                ast.fix_missing_locations(st)
+                if uses_break:
+                    brk = brk_name
+                    pre_bc.append(ast.fix_missing_locations(ast.copy_location(
+                        _parse_stmt(f"{brk} = False"), st)))
         convertible = (
             self._loop_convertible(st)
             and isinstance(st.target, ast.Name)
@@ -880,6 +985,9 @@ class _FunctionConverter:
             and 1 <= len(st.iter.args) <= 3
         )
         if not convertible:
+            # fall back with the ORIGINAL statement: a plain Python for of
+            # the debroken body would not stop iterating on the brk flag
+            st = orig_st
             st.iter = self._expr_value(st.iter)
             st.body = self._block(st.body, fn_tail=False)
             st.orelse = self._block(st.orelse, fn_tail=False)
@@ -908,12 +1016,19 @@ class _FunctionConverter:
             ast.Assign(targets=[ast.Name(id=step_name, ctx=ast.Store())], value=step),
         ]
         body_assigned = _facts(st.body).assigned | {var, i_name}
+        extra = {brk} if brk else set()
         carried = sorted(set(
-            self._carried_for_loop(st, body_assigned, {i_name})) | {var, i_name})
+            self._carried_for_loop(st, body_assigned, {i_name} | extra))
+            | {var, i_name} | extra)
         t_name, b_name = self._fresh("ft"), self._fresh("fb")
-        test_fn = self._helper(t_name, carried, [ast.Return(
-            value=_parse_stmt(
-                f"{_JST}.range_cond({i_name}, {stop_name}, {step_name})").value)])
+        rc = _parse_stmt(
+            f"{_JST}.range_cond({i_name}, {stop_name}, {step_name})").value
+        if brk:
+            rc = self._expr_value(ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(),
+                            operand=ast.Name(id=brk, ctx=ast.Load())),
+                rc]))
+        test_fn = self._helper(t_name, carried, [ast.Return(value=rc)])
         set_var = _parse_stmt(f"{var} = {i_name}")
         inc = _parse_stmt(f"{i_name} = {i_name} + {step_name}")
         body_fn = self._helper(
@@ -925,7 +1040,7 @@ class _FunctionConverter:
             "convert_while", f"{t_name}, {b_name}, {self._inits_src(carried)}"))
         stmt = self._assign_call(call, None)
         return [ast.fix_missing_locations(x)
-                for x in pre + [test_fn, body_fn, stmt]]
+                for x in pre_bc + pre + [test_fn, body_fn, stmt]]
 
 
 # --------------------------------------------------------------------- #

@@ -213,13 +213,6 @@ class LlamaModel(nn.Layer):
             self.layers = SpmdPipeline(
                 blocks, num_stages=pp, recompute_block=config.use_recompute
             )
-        elif getattr(config, "fold_layers", False) and len(blocks) > 1:
-            from ...distributed.fleet.meta_parallel.pipeline_parallel import (
-                fold_or_list,
-            )
-
-            self.layers = fold_or_list(
-                blocks, True, recompute=config.use_recompute)
         else:
             if pp > 1:
                 import warnings
@@ -229,16 +222,22 @@ class LlamaModel(nn.Layer):
                     f"divisible by pp_degree={pp}: Llama decoder runs "
                     "WITHOUT pipeline partitioning"
                 )
-            self.layers = nn.LayerList(blocks)
+            from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+                fold_or_list,
+            )
+
+            self.layers = fold_or_list(
+                blocks, getattr(config, "fold_layers", False),
+                recompute=config.use_recompute)
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids):
+        from ...distributed.fleet.meta_parallel.pipeline_parallel import (
+            run_stack,
+        )
+
         x = self.embed_tokens(input_ids)
-        if isinstance(self.layers, nn.LayerList):
-            for blk in self.layers:
-                x = blk(x)
-        else:
-            x = self.layers(x)
+        x = run_stack(self.layers, x)
         return self.norm(x)
 
 
