@@ -311,6 +311,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = max(2, prefetch_factor)
         self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
@@ -347,9 +348,21 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
+    def _buffered(self, it):
+        """The reference's buffered reader: keep prefetch_factor batches
+        already CONSTRUCTED ahead of the consumer. Tensor leaves hold
+        dispatched device buffers (jnp.asarray is an async H2D on TPU), so
+        the copy of batch k+1 overlaps compute on batch k. Applied only to
+        iterators whose Tensors are built at PULL time — the threaded
+        pipeline constructs batches in its workers (H2D already issued
+        there), where extra lookahead would only pin device memory."""
+        if self.use_buffer_reader:
+            return _lookahead_batches(it, self.prefetch_factor)
+        return it
+
     def __iter__(self):
         if self.num_workers == 0:
-            yield from self._gen_batches()
+            yield from self._buffered(self._gen_batches())
             return
         if not self._iterable_mode and self.collate_fn is default_collate_fn:
             # worker PROCESSES + shared-memory transport (the reference's
@@ -359,7 +372,9 @@ class DataLoader:
             # Falls back to threads if process setup fails (e.g. unpicklable
             # dataset under a spawn-only platform).
             try:
-                yield from self._iter_multiprocess()
+                # mp transport yields numpy; Tensors are built at pull time,
+                # so the lookahead genuinely fronts the device transfer
+                yield from self._buffered(self._iter_multiprocess())
                 return
             except _MpSetupError as e:
                 import warnings
@@ -475,6 +490,27 @@ class _WorkerFailure:
         self.exc = exc
 
 
+
+
+def _lookahead_batches(it, depth):
+    """Yield from ``it`` keeping ``depth`` items pre-pulled: the next
+    batch's device transfer is issued before the current batch's compute
+    begins (jax dispatch is asynchronous)."""
+    import collections
+
+    buf = collections.deque()
+    try:
+        while len(buf) < depth:
+            buf.append(next(it))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(next(it))  # issue the NEXT H2D before yielding
+        except StopIteration:
+            pass
+        yield out
 
 
 def _wrap_np_tree(tree):

@@ -959,11 +959,15 @@ class _FunctionConverter:
         # Python (a concrete iterable unrolls under trace, which is the
         # jax-idiomatic outcome for static trip counts anyway)
         pre_bc, brk, orig_st = [], None, st
-        if (isinstance(st.target, ast.Name) and isinstance(st.iter, ast.Call)
-                and isinstance(st.iter.func, ast.Name)
-                and st.iter.func.id == "range"
-                and not st.iter.keywords
-                and 1 <= len(st.iter.args) <= 3):
+        is_range_for = (
+            isinstance(st.target, ast.Name)
+            and isinstance(st.iter, ast.Call)
+            and isinstance(st.iter.func, ast.Name)
+            and st.iter.func.id == "range"
+            and not st.iter.keywords
+            and 1 <= len(st.iter.args) <= 3
+        )
+        if is_range_for:
             deb = self._debreak_loop(st)
             if deb is not None:
                 new_body, uses_break, brk_name = deb
@@ -975,15 +979,7 @@ class _FunctionConverter:
                     brk = brk_name
                     pre_bc.append(ast.fix_missing_locations(ast.copy_location(
                         _parse_stmt(f"{brk} = False"), st)))
-        convertible = (
-            self._loop_convertible(st)
-            and isinstance(st.target, ast.Name)
-            and isinstance(st.iter, ast.Call)
-            and isinstance(st.iter.func, ast.Name)
-            and st.iter.func.id == "range"
-            and not st.iter.keywords
-            and 1 <= len(st.iter.args) <= 3
-        )
+        convertible = is_range_for and self._loop_convertible(st)
         if not convertible:
             # fall back with the ORIGINAL statement: a plain Python for of
             # the debroken body would not stop iterating on the brk flag
