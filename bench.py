@@ -400,12 +400,21 @@ def _phase_child(phase):
             from paddle_tpu.nn.functional import attention as attn_mod
 
             routed = attn_mod._pallas_backend_ok()
-            t, s, m, f, _ = _measure_config(32, 1024, max(STEPS // 2, 5), 2, peak)
+            # batch geometry is the open seq1024 MFU lever (VERDICT r3 #5):
+            # sweepable without code edits in a live-tunnel window
+            try:
+                b1024 = int(os.environ.get("BENCH_SEQ1024_BATCH", "32"))
+            except ValueError:
+                print("# BENCH_SEQ1024_BATCH unparsable; using 32",
+                      file=sys.stderr)
+                b1024 = 32
+            t, s, m, f, _ = _measure_config(
+                b1024, 1024, max(STEPS // 2, 5), 2, peak)
             print(json.dumps({
                 "tokens_per_sec": round(t, 1),
                 "step_time_ms": round(s * 1e3, 2),
                 "mfu": round(m, 4) if m else None,
-                "batch": 32, "seq": 1024, "flash_routed": bool(routed)}))
+                "batch": b1024, "seq": 1024, "flash_routed": bool(routed)}))
         elif phase.startswith("micro:"):
             print(json.dumps(_kernel_microbench(int(phase.split(":", 1)[1]))))
         else:

@@ -495,22 +495,32 @@ class _WorkerFailure:
 def _lookahead_batches(it, depth):
     """Yield from ``it`` keeping ``depth`` items pre-pulled: the next
     batch's device transfer is issued before the current batch's compute
-    begins (jax dispatch is asynchronous)."""
+    begins (jax dispatch is asynchronous). A mid-stream source error is
+    DEFERRED until the already-buffered good batches have been delivered —
+    the consumer must not lose batches it would have received unbuffered."""
     import collections
 
     buf = collections.deque()
+    pending_err = None
     try:
         while len(buf) < depth:
             buf.append(next(it))
     except StopIteration:
         pass
+    except Exception as e:  # noqa: BLE001 — re-raised after the drain
+        pending_err = e
     while buf:
         out = buf.popleft()
-        try:
-            buf.append(next(it))  # issue the NEXT H2D before yielding
-        except StopIteration:
-            pass
+        if pending_err is None:
+            try:
+                buf.append(next(it))  # issue the NEXT H2D before yielding
+            except StopIteration:
+                pass
+            except Exception as e:  # noqa: BLE001
+                pending_err = e
         yield out
+    if pending_err is not None:
+        raise pending_err
 
 
 def _wrap_np_tree(tree):
