@@ -193,6 +193,19 @@ class SpmdPipeline(Layer):
             self.register_buffer(n.replace(".", "__") + "_stacked", sb)
             self._stacked_bufs.append(sb)
 
+    # -- modes: the template is NOT a registered sublayer (its params are
+    #    absorbed into the stacked ones), so train()/eval() must be
+    #    forwarded explicitly or its dropout/batchnorm flags go stale ----
+    def train(self):
+        super().train()
+        self._template_holder[0].train()
+        return self
+
+    def eval(self):
+        super().eval()
+        self._template_holder[0].eval()
+        return self
+
     # -- functional application of the template with given leaf values -------
     def _apply_block(self, leaf_vals, x, *extra):
         tmpl = self._template_holder[0]
@@ -336,10 +349,27 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
         else:
             ordered = tuple(stacked_vals)
 
-        def body(h, leaves):
-            return block(leaves, h, *extra), None
+        # per-layer RNG keys ride the scan: the body is traced ONCE, so a
+        # plain next_key() inside the template would hand every layer the
+        # SAME dropout mask. Each layer instead derives its random ops
+        # from its own key (and remat replays them identically). Gated on
+        # training: an eval forward must not consume global RNG state.
+        if getattr(pipe._template_holder[0], "training", False):
+            from ....framework import rng as _rng
 
-        h, _ = lax.scan(body, x, ordered)
+            keys = jax.random.split(_rng.next_key(), pipe.num_layers)
+
+            def body(h, xs):
+                leaves, lk = xs[:-1], xs[-1]
+                with _rng.trace_key_scope(lk):
+                    return block(leaves, h, *extra), None
+
+            h, _ = lax.scan(body, x, (*ordered, keys))
+        else:
+            def body(h, leaves):
+                return block(leaves, h, *extra), None
+
+            h, _ = lax.scan(body, x, ordered)
         return h
 
     if extra:
@@ -348,6 +378,22 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
             "supported on the layer-fold path (num_stages=1) only; the "
             "micro-batch pipeline schedules move a single tensor between "
             "stages — fold the mask into the block input or its buffers")
+
+    tmpl = pipe._template_holder[0]
+    if getattr(tmpl, "training", False) and not getattr(
+            pipe, "_warned_sched_dropout", False):
+        if any("dropout" in type(l).__name__.lower() and getattr(l, "p", 0)
+               for l in tmpl.sublayers(include_self=True)):
+            object.__setattr__(pipe, "_warned_sched_dropout", True)
+            warnings.warn(
+                "SpmdPipeline micro-batch schedule with active dropout: the "
+                "schedule body is traced once, so dropout masks repeat "
+                "across layers and micro-batches within a step (the "
+                "layer-fold path decorrelates per layer; full per-"
+                "(layer, micro-batch) decorrelation in the pipeline "
+                "schedules is a known limit). Set dropout to 0 for exact "
+                "reference-equivalent pipeline training.",
+                stacklevel=3)
 
     # ---- circular micro-batch schedule over the pp axis --------------------
     V = pipe.num_virtual_stages

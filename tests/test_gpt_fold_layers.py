@@ -127,6 +127,53 @@ def test_ernie_fold_layers_training_parity():
     assert losses[True][-1] < losses[True][0]
 
 
+def test_fold_scan_decorrelates_dropout_across_layers():
+    """Per-layer RNG keys ride the fold scan: two stacked p=0.5 dropout
+    blocks keep ~25% of elements (independent masks), not ~50% (the shared
+    mask a once-traced body would produce)."""
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        SpmdPipeline,
+    )
+
+    class DropBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            # one (identical-valued) param so the stack has leaves to fold
+            self.scale = self.create_parameter(
+                (1,), default_initializer=nn.initializer.Constant(1.0))
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(x * self.scale)
+
+    paddle.seed(33)
+    stack = SpmdPipeline([DropBlock(), DropBlock()], num_stages=1)
+    stack.train()
+    x = paddle.ones([64, 256], dtype="float32")
+    out = np.asarray(stack(x)._value)
+    frac_nonzero = float((out != 0).mean())
+    # independent masks: 0.25 expected; shared mask: 0.5. With 16384
+    # samples the binomial std is ~0.003 — 0.35 splits them decisively.
+    assert frac_nonzero < 0.35, (
+        f"{frac_nonzero:.3f} nonzero — dropout masks are correlated "
+        "across scanned layers")
+    # and the kept values are upscaled twice (1/keep^2 = 4)
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 4.0, rtol=1e-5)
+
+    # eval(): dropout off (the hidden template must receive the mode flip)
+    # and the forward must not consume global RNG state
+    stack.eval()
+    state_before = paddle.get_rng_state() if hasattr(paddle, "get_rng_state") \
+        else None
+    out_eval = np.asarray(stack(x)._value)
+    np.testing.assert_allclose(out_eval, np.ones_like(out_eval), rtol=1e-6)
+    if state_before is not None:
+        assert paddle.get_rng_state() == state_before, \
+            "eval forward consumed global RNG state"
+
+
 def test_fold_layers_training_parity():
     from paddle_tpu.jit import TrainStep
 
