@@ -310,7 +310,8 @@ _SKIP_ROOTS = frozenset({
     "builtins", "paddle_tpu", "jax", "jaxlib", "numpy", "flax", "optax",
     "chex", "einops", "torch", "math", "cmath", "functools", "itertools",
     "operator", "typing", "collections", "abc", "copy", "random", "re",
-    "os", "sys", "warnings", "logging", "dataclasses",
+    "os", "sys", "warnings", "logging", "dataclasses", "scipy", "pandas",
+    "PIL", "json", "pickle", "threading", "queue", "transformers",
 })
 
 # Bounds runaway conversion chains (mutually recursive helpers, deep call
