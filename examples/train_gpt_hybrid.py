@@ -2,13 +2,13 @@
 
 Run on the 8-virtual-device CPU mesh (no TPU needed):
 
-    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/train_gpt_hybrid.py
+    JAX_PLATFORMS=cpu python examples/train_gpt_hybrid.py
 
-On a real TPU slice, drop the env vars — the same script uses every chip
-jax can see. The parallel plan (dp x mp x pp x ZeRO sharding) is data-size
-agnostic: fleet places parameters/optimizer state, DistTrainStep compiles
-ONE SPMD program per batch signature and XLA inserts all collectives.
+On a real TPU slice, drop the env var — the same script uses every chip
+jax can see. The parallel plan (dp x mp x pp, plus ZeRO optimizer-state
+sharding when the device count allows) is data-size agnostic: fleet
+places parameters/optimizer state, DistTrainStep compiles ONE SPMD
+program per batch signature and XLA inserts all collectives.
 """
 import os
 import sys
@@ -16,8 +16,14 @@ import sys
 # runnable straight from the repo checkout, no install needed
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":  # ad-hoc CPU runs (see README)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # emulated-mesh preamble: pin the cpu backend BEFORE jax backend init
+    # and apply the shared flags (8 virtual devices + the XLA CPU
+    # collective-watchdog relaxation) — see _cpu_mesh_flags.py
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import _cpu_mesh_flags
+
+    _cpu_mesh_flags.apply()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -35,12 +41,15 @@ def main():
     n = len(jax.devices())
     mp = 2 if n % 2 == 0 else 1
     pp = 2 if (n // mp) % 2 == 0 else 1
-    dp = n // (mp * pp)
+    sharding = 2 if (n // (mp * pp)) % 2 == 0 else 1  # ZeRO optimizer states
+    dp = n // (mp * pp * sharding)
 
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs.update(dp_degree=dp, mp_degree=mp, pp_degree=pp)
+    strategy.hybrid_configs["sharding_degree"] = sharding
     fleet.init(is_collective=True, strategy=strategy)
-    print(f"mesh: dp={dp} mp={mp} pp={pp} over {n} devices")
+    print(f"mesh: dp={dp} mp={mp} pp={pp} sharding={sharding} "
+          f"over {n} devices")
 
     paddle.seed(0)
     cfg = GPTConfig(
@@ -57,12 +66,17 @@ def main():
                                opt)
 
     rng = np.random.default_rng(0)
-    # batch must divide evenly over the dp axis (data sharding)
-    batch, seq = dp * max(4, 8 // dp), 64
+    # batch must divide evenly over the data axes (dp x sharding)
+    d = dp * sharding
+    batch, seq = d * max(4, 8 // d), 65
     for it in range(10):
-        ids = paddle.to_tensor(
-            rng.integers(0, 512, (batch, seq)).astype(np.int32))
-        loss = step(ids, ids)
+        tokens = rng.integers(0, 512, (batch, seq)).astype(np.int32)
+        # next-token objective: inputs see tokens[:-1], labels are the
+        # SHIFTED tokens[1:] (causal LM; unshifted labels would train an
+        # identity copy)
+        ids = paddle.to_tensor(tokens[:, :-1])
+        labels = paddle.to_tensor(tokens[:, 1:])
+        loss = step(ids, labels)
         print(f"step {it}: loss {float(loss):.4f}")
 
 
