@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# First-minutes-of-chip-time harvest (run when the axon tunnel is LIVE).
+#
+# Priority order matches the standing queue (VERDICT r3 #1/#5/#3):
+#   1. bench.py            — refreshes BENCH_TPU_LAST.json at HEAD (rbg PRNG
+#                            active; expected ~45% MFU vs the committed
+#                            136k/37.2%); persists the capture git SHA.
+#   2. bench_flash_sweep   — backward block-size sweep at seq1024/2048
+#                            (fresh-process env knobs) -> FLASH_SWEEP.json.
+#   3. resnet50 batch sweep — 27% MFU baseline; bf16/donation already
+#                            verified clean on CPU, the lever is batch.
+#   4. seq1024 batch sweep  — BENCH_SEQ1024_BATCH toward >=0.30 MFU.
+#
+# Each stage is budgeted; a tunnel flap mid-run leaves earlier durable
+# artifacts in place (bench.py persists before later stages run).
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 110 python -c "import jax; d=jax.devices(); print(d)" >/dev/null 2>&1
+}
+
+echo "== probing tunnel =="
+if ! probe; then
+  echo "tunnel down; aborting (nothing measured)"
+  exit 1
+fi
+
+echo "== 1/4 bench.py (durable headline refresh) =="
+timeout 3000 python bench.py | tail -1
+
+echo "== 2/4 flash backward block sweep =="
+timeout 3600 python bench_flash_sweep.py 1024 2048 | tail -8
+
+echo "== 3/4 resnet50 batch sweep =="
+for b in 256 512; do
+  echo "-- resnet50 batch $b"
+  timeout 1800 env BENCH_BATCH=$b python bench_configs.py resnet50 | tail -1
+done
+
+echo "== 4/4 seq1024 batch sweep (through the bench seq1024 phase) =="
+for b in 32 64 128; do
+  echo "-- seq1024 batch $b"
+  timeout 2400 env BENCH_SEQ1024_BATCH=$b python bench.py | tail -1
+done
+
+echo "== done; commit the refreshed artifacts =="
+git status --short | sed -n '1,10p'
