@@ -118,11 +118,22 @@ PTS_EXPORT int64_t pts_run_f32(void* handle, const float* data,
     t_last_error = "null handle";
     return -1;
   }
+  if (rank < 0 || (rank > 0 && !shape)) {
+    t_last_error = "negative input rank or null shape";
+    return -1;
+  }
+  // bound the product so the later *sizeof(float) byte count can't overflow
+  const int64_t kMaxElems = INT64_MAX / static_cast<int64_t>(sizeof(float));
+  int64_t n_in = 1;
+  for (int i = 0; i < rank; i++) {
+    if (shape[i] < 0 || (shape[i] > 0 && n_in > kMaxElems / shape[i])) {
+      t_last_error = "invalid input shape (negative or overflowing dim)";
+      return -1;
+    }
+    n_in *= shape[i];
+  }
   GilGuard gil;
   Handle* h = static_cast<Handle*>(handle);
-
-  int64_t n_in = 1;
-  for (int i = 0; i < rank; i++) n_in *= shape[i];
 
   PyObject* np = PyImport_ImportModule("numpy");
   if (!np) {
@@ -176,6 +187,15 @@ PTS_EXPORT int64_t pts_run_f32(void* handle, const float* data,
       break;
     }
     Py_ssize_t orank = PyTuple_Size(oshape);
+    if (orank > 8) {
+      // the contract hands the caller out_shape[0..*out_rank-1] over an
+      // 8-dim buffer; a deeper output must error, not leak garbage dims
+      Py_DECREF(oshape);
+      Py_DECREF(o32);
+      t_last_error = "output rank > 8 unsupported by pts_run_f32";
+      result = -2;  // error text already set; skip set_error_from_python
+      break;
+    }
     if (out_rank) *out_rank = static_cast<int>(orank);
     int64_t n_out = 1;
     for (Py_ssize_t i = 0; i < orank; i++) {
@@ -197,7 +217,8 @@ PTS_EXPORT int64_t pts_run_f32(void* handle, const float* data,
     Py_DECREF(o32);
     result = n_out;
   } while (false);
-  if (result < 0) set_error_from_python();
+  if (result == -1) set_error_from_python();
+  if (result == -2) result = -1;
   Py_XDECREF(outs);
   Py_XDECREF(in_list);
   Py_XDECREF(arr);

@@ -318,7 +318,16 @@ _SKIP_ROOTS = frozenset({
 # stacks): beyond this depth of nested CONVERTED frames, callees run
 # unconverted (tensor control flow there degrades to the eager guard).
 _MAX_CONVERT_DEPTH = 32
-_call_depth = 0
+# Thread-local: concurrent to_static traces on different threads must not
+# share the depth counter (one thread exhausting it would silently disable
+# conversion on another).
+import threading as _threading
+
+_depth_state = _threading.local()
+
+
+def _get_depth():
+    return getattr(_depth_state, "depth", 0)
 
 _ccall_cache: dict = {}  # id-keyed {raw_fn_id: (weakref, converted|False)}
 
@@ -328,12 +337,11 @@ def _depth_guard(converted):
 
     @functools.wraps(converted)
     def run(*a, **k):
-        global _call_depth
-        _call_depth += 1
+        _depth_state.depth = _get_depth() + 1
         try:
             return converted(*a, **k)
         finally:
-            _call_depth -= 1
+            _depth_state.depth -= 1
 
     return run
 
@@ -392,10 +400,9 @@ def convert_call(f):
     (``_SKIP_ROOTS``), classes (constructors), arbitrary callable objects,
     and functions defined INSIDE a converted function (their source lives
     in the transformed module and is unavailable to ``inspect``)."""
-    global _call_depth
     if not callable(f) or isinstance(f, type):
         return f
-    if _call_depth >= _MAX_CONVERT_DEPTH:
+    if _get_depth() >= _MAX_CONVERT_DEPTH:
         return f
     if isinstance(f, (types.BuiltinFunctionType, types.BuiltinMethodType)):
         return f

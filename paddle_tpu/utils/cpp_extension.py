@@ -52,19 +52,33 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
                 text = fh.read()
         except OSError:
             continue
-        for m in re.finditer(r'^\s*#\s*include\s*"([^"]+)"', text, re.M):
+        for m in re.finditer(r'^\s*#\s*include\s*([<"])([^">]+)[">]', text,
+                             re.M):
             # quoted includes resolve includer-relative first, then through
-            # any -I dirs from the flags (both must stamp the artifact)
-            for base in [os.path.dirname(os.path.abspath(path))] + inc_dirs:
-                cand = os.path.normpath(os.path.join(base, m.group(1)))
+            # any -I dirs from the flags; angle includes only through -I dirs
+            # (system headers won't resolve there and are skipped — toolchain
+            # headers don't need to stamp the artifact, project ones do)
+            bases = inc_dirs if m.group(1) == "<" else (
+                [os.path.dirname(os.path.abspath(path))] + inc_dirs)
+            for base in bases:
+                cand = os.path.normpath(os.path.join(base, m.group(2)))
                 if os.path.exists(cand):
                     if cand not in seen:
                         seen.add(cand)
                         deps.append(cand)
                         queue.append(cand)
                     break
+
+    def _mtime(d):
+        # a dep deleted between discovery and stat must not crash load();
+        # 0 still perturbs the stamp vs. the file existing
+        try:
+            return os.stat(d).st_mtime_ns
+        except OSError:
+            return 0
+
     stamp = hashlib.sha256(("\x00".join(
-        cmd_tail + [f"{d}:{os.stat(d).st_mtime_ns}" for d in sorted(deps)]
+        cmd_tail + [f"{d}:{_mtime(d)}" for d in sorted(deps)]
     )).encode()).hexdigest()[:16]
     out = os.path.join(build_dir, f"lib{name}_{stamp}.so")
     if os.path.exists(out):

@@ -219,12 +219,36 @@ def test_depth_bound_returns_original():
     def helper(x):
         return x
 
-    old = d._call_depth
-    d._call_depth = d._MAX_CONVERT_DEPTH
+    old = d._get_depth()
+    d._depth_state.depth = d._MAX_CONVERT_DEPTH
     try:
         assert d.convert_call(helper) is helper
     finally:
-        d._call_depth = old
+        d._depth_state.depth = old
+
+
+def test_depth_counter_is_thread_local():
+    import threading
+
+    from paddle_tpu.jit import dy2static as d
+
+    def helper(x):
+        return x
+
+    d._depth_state.depth = d._MAX_CONVERT_DEPTH
+    try:
+        results = {}
+
+        def probe():
+            # a fresh thread starts at depth 0: conversion must proceed
+            results["conv"] = d.convert_call(helper)
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        assert results["conv"] is not helper
+    finally:
+        d._depth_state.depth = 0
 
 
 # ----- r3 #8 guard tests: snapshot semantics ----- #
