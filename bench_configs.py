@@ -235,6 +235,12 @@ def run_gpt_6p7b_ppsharding():
     s = fleet.DistributedStrategy()
     s.hybrid_configs.update(dp_degree=1, mp_degree=1, pp_degree=2)
     s.hybrid_configs["sharding_degree"] = 4
+    # ZeRO-3 + block recompute: the r4 stage-1/no-remat configuration
+    # measured 15.88 GiB per device — over v5e's 16 GiB; stage 3 shards
+    # the bf16 params over the sharding axis (GroupSharded "p_g_os"
+    # semantics) and remat drops block activations, landing the same 16L
+    # geometry at ~6.5 GiB (tests/test_memory_analysis.py pins <= 14 GiB)
+    s.sharding_configs["stage"] = 3
     fleet.init(is_collective=True, strategy=s)
     paddle.seed(0)
     # default 16: the full 32-layer stack is OOM-killed on this box (see
@@ -242,7 +248,8 @@ def run_gpt_6p7b_ppsharding():
     layers = int(os.environ.get("BENCH_67B_LAYERS", "16"))
     cfg = GPTConfig.gpt3_6p7b(
         vocab_size=50304, hidden_dropout_prob=0.0,
-        attention_probs_dropout_prob=0.0, num_hidden_layers=layers)
+        attention_probs_dropout_prob=0.0, num_hidden_layers=layers,
+        use_recompute=True)
     model = GPTForCausalLM(cfg).bfloat16()
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
