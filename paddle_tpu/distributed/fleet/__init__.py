@@ -242,9 +242,9 @@ def shard_model_parameters(model: Layer, *, fsdp: bool = False):
     for _, p in model.named_parameters():
         spec = param_spec(p, fsdp=fsdp)
         p.dist_spec = spec
-        p._rebind(jax.device_put(raw(p), NamedSharding(m, spec)))
+        p._rebind(_mesh.global_device_put(raw(p), spec, m))
     for _, b in model.named_buffers():
-        b._rebind(jax.device_put(raw(b), NamedSharding(m, P())))
+        b._rebind(_mesh.global_device_put(raw(b), P(), m))
     return model
 
 
@@ -317,9 +317,9 @@ class HybridParallelOptimizer:
         out = {}
         for k, v in st.items():
             if hasattr(v, "shape") and tuple(v.shape) == pshape:
-                out[k] = jax.device_put(v, NamedSharding(m, spec))
+                out[k] = _mesh.global_device_put(v, spec, m)
             elif hasattr(v, "shape"):
-                out[k] = jax.device_put(v, NamedSharding(m, P()))
+                out[k] = _mesh.global_device_put(v, P(), m)
             else:
                 out[k] = v
         return out
@@ -705,7 +705,8 @@ class DistTrainStep(TrainStep):
             return batch_vals
         out = []
         for v in batch_vals:
-            out.append(jax.device_put(v, NamedSharding(m, data_spec_for(tuple(v.shape)))))
+            out.append(_mesh.global_device_put(
+                v, data_spec_for(tuple(v.shape)), m))
         return out
 
     def _jit(self, step):

@@ -205,6 +205,26 @@ def named_sharding(spec: PartitionSpec, mesh: Optional[Mesh] = None) -> NamedSha
     return NamedSharding(mesh or require_global_mesh(), spec)
 
 
+def global_device_put(value, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """Place host data onto the (possibly multi-host) global mesh.
+
+    Single-process: a plain ``jax.device_put``. Multi-process SPMD
+    (``jax.process_count() > 1``): ``device_put`` would fail on the
+    non-addressable remote devices, so build the global array from a
+    callback — every process holds the SAME full-value host copy (model
+    init and batch loading are same-seeded on each host, the reference's
+    `test_dist_base` contract) and contributes just its addressable
+    shards. This is the TPU-native stand-in for the reference's
+    per-rank scatter in `DistributedDataParallel` / data loaders.
+    """
+    m = mesh or require_global_mesh()
+    sh = NamedSharding(m, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(value, sh)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+
 def _sanitize_spec(spec: PartitionSpec, shape, mesh: Mesh) -> PartitionSpec:
     """Drop axis names from dims they don't divide evenly (correctness first:
     an indivisible dim stays replicated rather than erroring)."""

@@ -146,7 +146,21 @@ class Generator:
         return self._seed
 
 
-_default_generator = Generator(0)
+# LAZY: creating a Generator touches the XLA backend (jax.random.key), and
+# backend init must not happen at import time — multi-host workers need
+# jax.distributed.initialize() to run first (distributed/env.py).
+_default_generator = None
+_default_lock = threading.Lock()
+
+
+def _default() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        with _default_lock:
+            if _default_generator is None:
+                _default_generator = Generator(0)
+    return _default_generator
+
 
 # Trace-scoped key: when paddle_tpu.jit traces a function, it installs a key
 # here (a tracer); random ops consume splits of it instead of the global state.
@@ -154,20 +168,20 @@ _trace_state = threading.local()
 
 
 def default_generator() -> Generator:
-    return _default_generator
+    return _default()
 
 
 def seed(value: int) -> Generator:
     """Set the global random seed (paddle.seed parity)."""
-    return _default_generator.manual_seed(int(value))
+    return _default().manual_seed(int(value))
 
 
 def get_rng_state():
-    return _default_generator.get_state()
+    return _default().get_state()
 
 
 def set_rng_state(state):
-    _default_generator.set_state(state)
+    _default().set_state(state)
 
 
 @contextlib.contextmanager
@@ -195,4 +209,4 @@ def next_key(generator: Optional[Generator] = None):
         n = _trace_state.n
         _trace_state.n = n + 1
         return jax.random.fold_in(tk, n)
-    return (generator or _default_generator).next_key()
+    return (generator or _default()).next_key()
