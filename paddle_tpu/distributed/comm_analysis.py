@@ -1,0 +1,177 @@
+"""Per-step collective-traffic analysis from compiled SPMD programs.
+
+VERDICT r4 weak #5: the virtual CPU mesh proves correctness, not scaling
+— emulated collective timings are meaningless. What CAN be measured
+without hardware is the compiled program itself: every collective XLA
+emitted, its payload bytes, and which mesh axis its replica groups span.
+From those, a bandwidth model projects scaling efficiency at real chip
+counts (the per-axis byte counts are exact; only the bandwidths are
+assumptions).
+
+Reference anchor: `fleet/base/topology.py::CommunicateTopology` orders
+axes by communication locality for exactly this reason — mp on the
+fastest links, dp on the slowest (SURVEY.md §2.3 "Hybrid topology").
+Here the same design claim becomes checkable: in a multi-slice mesh the
+only cross-slice (DCN) traffic must be dp-axis gradient reduction.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_COLL_RE = re.compile(
+    r"=\s*(?:\(([^)]*)\)|(\w+)\[([\d,]*)\](?:\{[^}]*\})?)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\(", )
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _parse_groups(line: str) -> Optional[List[List[int]]]:
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        src = [int(x) for x in m.group(3).split(",")]
+        iota = np.arange(int(np.prod(src))).reshape(src)
+        if m.group(4):
+            iota = iota.transpose([int(x) for x in m.group(4).split(",")])
+        return iota.reshape(g, s).tolist()
+    m = _GROUPS_LIST_RE.search(line)
+    if m:
+        return [[int(x) for x in grp.split(",") if x.strip()]
+                for grp in re.findall(r"\{([\d,\s]*)\}", m.group(1))]
+    return None
+
+
+def _line_payload_bytes(line: str, kind: str) -> int:
+    """Payload bytes for the collective on this line. all-gather counts
+    OUTPUT bytes (the gathered result), the others count the operand-side
+    result shape — for all-reduce/permute in-shape == out-shape, for
+    reduce-scatter the true wire cost is the pre-scatter input, i.e.
+    out_bytes * group_size (handled by the traffic model, which gets the
+    group size separately)."""
+    m = _COLL_RE.search(line)
+    if not m:
+        return 0
+    if m.group(1) is not None:  # tuple shape: sum element shapes
+        total = 0
+        for dt, dims in _SHAPE_RE.findall(m.group(1)):
+            total += _shape_bytes(dt, dims)
+        return total
+    return _shape_bytes(m.group(2), m.group(3))
+
+
+def _axes_of_group(group: List[int], mesh) -> tuple:
+    """Mesh axis names along which this replica group's members vary."""
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    coords = {}
+    for dev in group:
+        pos = np.argwhere(ids == dev)
+        if len(pos) != 1:
+            return ("unknown",)
+        coords[dev] = tuple(pos[0])
+    axes = []
+    for k, name in enumerate(mesh.axis_names):
+        if len({c[k] for c in coords.values()}) > 1:
+            axes.append(name)
+    return tuple(axes) if axes else ("self",)
+
+
+def collective_traffic(hlo_text: str, mesh) -> List[Dict]:
+    """Every collective in a compiled HLO module: kind, payload bytes,
+    group size, the mesh axes the groups span, and modeled per-device
+    wire bytes (ring algorithms):
+
+      all-reduce          2 * (n-1)/n * payload
+      all-gather          (n-1)/n * payload          (payload = output)
+      reduce-scatter      (n-1)/n * payload * n      (payload = shard out)
+      collective-permute  payload
+      all-to-all          (n-1)/n * payload
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if not m or "-done" in line:
+            continue
+        kind = m.group(4)
+        payload = _line_payload_bytes(line, kind)
+        groups = _parse_groups(line)
+        n = len(groups[0]) if groups else 1
+        if n <= 1:
+            continue
+        if kind == "all-reduce":
+            wire = 2 * (n - 1) / n * payload
+        elif kind == "reduce-scatter":
+            wire = (n - 1) / n * payload * n
+        elif kind == "collective-permute":
+            wire = payload
+        else:  # all-gather / all-to-all
+            wire = (n - 1) / n * payload
+        axes = _axes_of_group(groups[0], mesh) if groups else ("unknown",)
+        out.append({
+            "kind": kind, "payload_bytes": payload, "group_size": n,
+            "axes": axes, "wire_bytes_per_device": int(wire),
+        })
+    return out
+
+
+def axis_traffic_summary(colls: List[Dict]) -> Dict[str, int]:
+    """Total modeled per-device wire bytes per mesh-axis combination."""
+    agg: Dict[str, int] = {}
+    for c in colls:
+        key = "+".join(c["axes"])
+        agg[key] = agg.get(key, 0) + c["wire_bytes_per_device"]
+    return agg
+
+
+def axis_payload_summary(colls: List[Dict]) -> Dict[str, int]:
+    """Total raw payload bytes per axis combination (pre-algorithm): what
+    a hierarchical multi-slice schedule would move across the slice cut
+    once per phase."""
+    agg: Dict[str, int] = {}
+    for c in colls:
+        key = "+".join(c["axes"])
+        agg[key] = agg.get(key, 0) + c["payload_bytes"]
+    return agg
+
+
+def slice_crossing_traffic(hlo_text: str, mesh, slice_of_device: Dict[int, int]) -> List[Dict]:
+    """Collectives whose replica groups span more than one slice — the
+    traffic that rides DCN in a multi-slice deployment. `slice_of_device`
+    maps device id -> slice id (distributed.mesh._device_slice_ids)."""
+    out = []
+    for c_line in hlo_text.splitlines():
+        m = _COLL_RE.search(c_line)
+        if not m or "-done" in c_line:
+            continue
+        groups = _parse_groups(c_line)
+        if not groups:
+            continue
+        crossing = any(
+            len({slice_of_device.get(d, 0) for d in g}) > 1 for g in groups)
+        if crossing:
+            out.append({
+                "kind": m.group(4),
+                "payload_bytes": _line_payload_bytes(c_line, m.group(4)),
+                "group_size": len(groups[0]),
+                "axes": _axes_of_group(groups[0], mesh),
+            })
+    return out

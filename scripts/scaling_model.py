@@ -1,0 +1,241 @@
+"""SCALING_MODEL.json generator (VERDICT r4 weak #5 / next-round #8).
+
+For each parallelism layout on the 8-virtual-device CPU mesh, compile the
+train step, extract every collective XLA emitted (exact per-device wire
+bytes per axis — paddle_tpu.distributed.comm_analysis), and project
+8 -> 256-chip efficiency over assumed v5e ICI/DCN bandwidths. The byte
+counts are measurements of the compiled program; ONLY the bandwidths and
+the overlap assumption are model inputs.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python scripts/scaling_model.py
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "SCALING_MODEL.json")
+
+# ---- model assumptions (everything else is measured) ---------------------
+ICI_BW_PER_CHIP = 1.6e11  # ~160 GB/s usable per v5e chip (4 ICI links)
+DCN_BW_PER_CHIP = 3.1e9   # ~25 GB/s per 8-chip host across DCN
+PEAK_BF16 = 197e12        # v5e bf16 peak FLOP/s
+ASSUMPTIONS = {
+    "ici_bw_per_chip_bytes_s": ICI_BW_PER_CHIP,
+    "dcn_bw_per_chip_bytes_s": DCN_BW_PER_CHIP,
+    "peak_bf16_flops": PEAK_BF16,
+    "overlap": "both bounds reported: none (comm fully exposed) and "
+               "full (comm hidden unless it exceeds compute)",
+    "scaling_mode": "weak scaling: dp degree grows with chips, per-device "
+                    "batch fixed, mp/pp/sep degrees fixed",
+}
+
+CONFIGS = {
+    # name: (hybrid degrees, extra strategy keys, env)
+    "dp8": ({"dp_degree": 8}, {}, {}),
+    "mp8": ({"mp_degree": 8}, {}, {}),
+    "dp2_mp4": ({"dp_degree": 2, "mp_degree": 4}, {}, {}),
+    "sharding8_z1": ({"dp_degree": 1}, {"sharding_degree": 8}, {}),
+    "dp2_pp2_mp2": ({"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}, {},
+                    {}),
+    "2slice_dp2_mp4": ({"dp_degree": 2, "mp_degree": 4}, {},
+                       {"PADDLE_TPU_NUM_SLICES": "2"}),
+}
+
+
+def run_config(name):
+    """Child process: build the step, compile, extract traffic."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import comm_analysis, fleet
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    import jax
+
+    degrees, extra, _env = CONFIGS[name]
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs.update(degrees)
+    for k, v in extra.items():
+        s.hybrid_configs[k] = v
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    # GPT-1.3B layer GEOMETRY (hidden 2048, 16 heads) at 4 layers, seq 128:
+    # per-layer comm structure identical to the full model; grads scale
+    # linearly in layer count (noted in meta for extrapolation)
+    cfg = GPTConfig(
+        vocab_size=50304, hidden_size=2048, num_hidden_layers=4,
+        num_attention_heads=16, intermediate_size=8192,
+        max_position_embeddings=256, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg).bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+    step = fleet.DistTrainStep(model,
+                               lambda m, ids, lbl: m(ids, labels=lbl), opt)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 50000, (8, 128))
+        .astype(np.int32))
+    t0 = time.perf_counter()
+    comp = step._compiled_for(ids, ids)
+    compile_s = time.perf_counter() - t0
+    hlo = comp.as_text()
+    mesh = _mesh.get_global_mesh()
+    colls = comm_analysis.collective_traffic(hlo, mesh)
+    per_axis = comm_analysis.axis_traffic_summary(colls)
+    per_axis_payload = comm_analysis.axis_payload_summary(colls)
+
+    cost = comp.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops = float(dict(cost or {}).get("flops", 0.0))
+
+    slices = _mesh._device_slice_ids(list(mesh.devices.flat), None)
+    slice_of = {d.id: s_ for d, s_ in zip(mesh.devices.flat, slices)}
+    crossing = comm_analysis.slice_crossing_traffic(hlo, mesh, slice_of)
+
+    print(json.dumps({
+        "config": name, "compile_s": round(compile_s, 1),
+        "n_collectives": len(colls),
+        "per_axis_wire_bytes_per_device": per_axis,
+        "per_axis_payload_bytes": per_axis_payload,
+        "flops_per_device_per_step": flops,
+        "cross_slice": [
+            {**c, "axes": list(c["axes"])} for c in crossing],
+    }), flush=True)
+
+
+def project(entry):
+    """8 -> N-chip efficiency under the stated assumptions.
+
+    Single-slice (a v5e slice spans up to 256 chips all-ICI): every axis
+    rides ICI; data-axis ring traffic per device is 2(n-1)/n*B and is
+    scaled from the measured degree toward its asymptote. The separate
+    multi-slice scenario (2 slices) uses the hierarchical schedule —
+    intra-slice reduce-scatter, inter-slice shard exchange, intra-slice
+    all-gather — whose per-chip DCN bytes are 2*payload/n_chips."""
+    per_axis = entry["per_axis_wire_bytes_per_device"]
+    payload = entry.get("per_axis_payload_bytes", {})
+    flops = entry["flops_per_device_per_step"]
+    compute_s = flops / PEAK_BF16
+
+    def data_axis(axes):
+        parts = axes.split("+")
+        return "dp" in parts or "sharding" in parts
+
+    data_degree = 1
+    for axes, b in per_axis.items():
+        if data_axis(axes):
+            data_degree = max(data_degree, 2)  # measured at >=2 on the mesh
+    out = {}
+    for chips in (8, 16, 64, 256):
+        ici = 0.0
+        dp_payload = 0.0
+        for axes, b in per_axis.items():
+            if axes == "self":
+                continue
+            if data_axis(axes):
+                # ring factor (n-1)/n: rescale measured degree -> scaled
+                n0 = max(data_degree, 2)
+                n1 = n0 * chips // 8
+                b = b * ((n1 - 1) / n1) / ((n0 - 1) / n0)
+                dp_payload += payload.get(axes, 0)
+            ici += b
+        comm_s = ici / ICI_BW_PER_CHIP
+        entry_c = {
+            "ici_bytes_per_chip": int(ici),
+            "compute_s_ideal": compute_s,
+            "comm_s_single_slice": comm_s,
+            "efficiency_no_overlap": round(
+                compute_s / (compute_s + comm_s), 4) if compute_s else None,
+            "efficiency_full_overlap": round(min(
+                1.0, compute_s / max(comm_s, 1e-12)), 4)
+            if compute_s else None,
+        }
+        if chips == 256 and dp_payload:
+            # 2-slice deployment: hierarchical dp all-reduce across DCN
+            dcn_per_chip = 2 * dp_payload / chips
+            dcn_s = dcn_per_chip / DCN_BW_PER_CHIP
+            entry_c["two_slice"] = {
+                "dcn_bytes_per_chip": int(dcn_per_chip),
+                "comm_s": comm_s + dcn_s,
+                "efficiency_no_overlap": round(
+                    compute_s / (compute_s + comm_s + dcn_s), 4)
+                if compute_s else None,
+            }
+        out[str(chips)] = entry_c
+    return out
+
+
+def main():
+    results = {}
+    for name in CONFIGS:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        kept = [t for t in env.get("XLA_FLAGS", "").split()
+                if not t.startswith("--xla_force_host_platform_device_count")]
+        env["XLA_FLAGS"] = " ".join(
+            kept + ["--xla_force_host_platform_device_count=8"])
+        env.update(CONFIGS[name][2])
+        env["SCALING_MODEL_CHILD"] = name
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        if not lines:
+            results[name] = {"error":
+                             f"rc={p.returncode}: {(p.stderr or '')[-300:]}"}
+            continue
+        entry = json.loads(lines[-1])
+        entry["projection"] = project(entry)
+        results[name] = entry
+        print(f"[scaling_model] {name}: "
+              f"{entry['n_collectives']} collectives, "
+              f"axes={list(entry['per_axis_wire_bytes_per_device'])}",
+              file=sys.stderr)
+    doc = {
+        "meta": {
+            "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+            "model": "GPT-1.3B layer geometry (hidden 2048, 16 heads, "
+                     "ffn 8192) at 4 layers, seq 128, batch 8, bf16; "
+                     "grad/param traffic scales linearly in layer count",
+            "assumptions": ASSUMPTIONS,
+            "method": "wire bytes parsed from the compiled SPMD HLO "
+                      "(paddle_tpu.distributed.comm_analysis); ring "
+                      "algorithm cost model per collective",
+            "note": "absolute efficiency figures are for THIS probe "
+                    "geometry (per-device batch 1-4, seq 128) and "
+                    "underestimate production configs: compute scales "
+                    "linearly with per-device batch while dp gradient "
+                    "traffic is batch-independent. The load-bearing "
+                    "results are the per-axis byte table, the mp/pp "
+                    "degree-invariance, and cross_slice == dp-gradient-"
+                    "only.",
+        },
+        "configs": results,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(json.dumps({"written": OUT,
+                      "configs": list(results)}))
+
+
+if __name__ == "__main__":
+    child = os.environ.pop("SCALING_MODEL_CHILD", None)
+    if child:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, REPO)
+        run_config(child)
+    else:
+        main()
