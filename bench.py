@@ -366,7 +366,11 @@ def _measure_config(batch, seq, steps, warmup, peak):
     from paddle_tpu import amp
 
     one_step, step, (ids, y) = _ernie_step(batch, seq)
-    for _ in range(warmup):
+    t_c0 = time.perf_counter()
+    loss = one_step()
+    float(loss._value)
+    compile_s = time.perf_counter() - t_c0  # compile + first step
+    for _ in range(max(warmup - 1, 0)):
         loss = one_step()
     float(loss._value)
     t0 = time.perf_counter()
@@ -383,7 +387,7 @@ def _measure_config(batch, seq, steps, warmup, peak):
     except Exception:
         pass
     mfu = (flops / dt / peak) if (flops and peak) else None
-    return batch * seq / dt, dt, mfu, flops, final_loss
+    return batch * seq / dt, dt, mfu, flops, final_loss, compile_s
 
 
 def _phase_child(phase):
@@ -408,12 +412,13 @@ def _phase_child(phase):
                 print("# BENCH_SEQ1024_BATCH unparsable; using 32",
                       file=sys.stderr)
                 b1024 = 32
-            t, s, m, f, _ = _measure_config(
+            t, s, m, f, _, c = _measure_config(
                 b1024, 1024, max(STEPS // 2, 5), 2, peak)
             print(json.dumps({
                 "tokens_per_sec": round(t, 1),
                 "step_time_ms": round(s * 1e3, 2),
                 "mfu": round(m, 4) if m else None,
+                "compile_s": round(c, 1),
                 "batch": b1024, "seq": 1024, "flash_routed": bool(routed)}))
         elif phase.startswith("micro:"):
             print(json.dumps(_kernel_microbench(int(phase.split(":", 1)[1]))))
@@ -561,19 +566,22 @@ def _measure(platform, backend_err):
     # would misreport the kernel as unavailable)
     flash_routed = attn_mod._pallas_backend_ok()
 
-    tok_s, step_s, mfu, flops, loss = _measure_config(BATCH, SEQ, STEPS, WARMUP, peak)
+    tok_s, step_s, mfu, flops, loss, compile_s = _measure_config(
+        BATCH, SEQ, STEPS, WARMUP, peak)
     if platform != "cpu" and "BENCH_BATCH" not in os.environ:
         # batch sweep: bigger batches amortize per-step overhead and fill
         # the MXU better; keep whichever sustains the higher throughput
         for b2 in (512,):
             _release_device_memory()
             try:
-                t2, s2, m2, f2, l2 = _measure_config(b2, SEQ, STEPS, WARMUP, peak)
+                t2, s2, m2, f2, l2, c2 = _measure_config(
+                    b2, SEQ, STEPS, WARMUP, peak)
             except Exception:
                 continue  # OOM at this batch: keep the smaller config
             if t2 > tok_s:
                 BATCH = b2
-                tok_s, step_s, mfu, flops, loss = t2, s2, m2, f2, l2
+                tok_s, step_s, mfu, flops, loss, compile_s = (
+                    t2, s2, m2, f2, l2, c2)
     if mfu is not None and mfu > 1.0:
         # physically impossible: the synchronization didn't actually fence
         # the device work. Report the failure rather than a fantasy number.
@@ -611,6 +619,7 @@ def _measure(platform, backend_err):
             round(mfu / H100_ANCHOR_MFU, 4) if mfu is not None else None
         ),
         "step_time_ms": round(step_s * 1e3, 2),
+        "compile_s": round(compile_s, 1),
         "batch": BATCH,
         "seq": SEQ,
         "flops_per_step": flops,

@@ -46,16 +46,24 @@ def _sync(x):
 
 
 def _timed_steps(step_fn, args, warmup, iters):
-    out = None
-    for _ in range(warmup):
+    """Returns (sec/step, final_loss, compile_s). compile_s is the fenced
+    first call (compile + first step), measured only when this call
+    performs the warmup — with warmup=0 the caller already compiled and
+    ran the first step itself (compile_s is None; no extra step runs)."""
+    compile_s = None
+    if warmup >= 1:
+        t0 = time.perf_counter()
         out = step_fn(*args)
-    if out is not None:
+        _sync(out)
+        compile_s = time.perf_counter() - t0
+        for _ in range(warmup - 1):
+            out = step_fn(*args)
         _sync(out)  # fence warmup so the timed loop starts clean
     t0 = time.perf_counter()
     for _ in range(iters):
         out = step_fn(*args)
     final = _sync(out)
-    return (time.perf_counter() - t0) / iters, final
+    return (time.perf_counter() - t0) / iters, final, compile_s
 
 
 def _is_tpu():
@@ -95,7 +103,7 @@ def run_resnet50():
         with amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
             return step(x, y)
 
-    dt, loss = _timed_steps(one, (), warmup, steps)
+    dt, loss, compile_s = _timed_steps(one, (), warmup, steps)
     flops = None
     try:
         with amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
@@ -107,6 +115,7 @@ def run_resnet50():
         "metric": "resnet50 images/sec (O2 bf16, 224x224, fwd+bwd+momentum)",
         "value": round(batch / dt, 1), "unit": "images/s",
         "step_time_ms": round(dt * 1e3, 2), "batch": batch,
+        "compile_s": round(compile_s, 1),
         "mfu": round(mfu, 4) if mfu else None, "loss": round(loss, 4),
     }
 
@@ -155,11 +164,12 @@ def run_bert_mlm_dp():
         with amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
             return step(ids, lbl)
 
-    dt, loss = _timed_steps(one, (), warmup, steps)
+    dt, loss, compile_s = _timed_steps(one, (), warmup, steps)
     return {
         "metric": f"bert-base MLM tokens/sec (O2 bf16, seq128, dp{ndev})",
         "value": round(batch * seq / dt, 1), "unit": "tokens/s",
         "step_time_ms": round(dt * 1e3, 2), "global_batch": batch,
+        "compile_s": round(compile_s, 1),
         "dp_degree": ndev, "loss": round(loss, 4),
     }
 
@@ -196,7 +206,7 @@ def run_gpt_1p3b_dpmp():
     t0 = time.perf_counter()
     loss0 = _sync(step(ids, ids))
     compile_s = time.perf_counter() - t0
-    dt, loss = _timed_steps(step, (ids, ids), 0, 1)
+    dt, loss, _ = _timed_steps(step, (ids, ids), 0, 1)
     return {
         "metric": "gpt3-1.3B dp2xmp4 step time (schedule sanity, CPU mesh)",
         "value": round(dt * 1e3, 1), "unit": "ms/step",
@@ -264,7 +274,7 @@ def run_gpt_6p7b_ppsharding():
     loss0 = _sync(step(ids, ids))
     compile_s = time.perf_counter() - t0
     # second step: the VERDICT done-criterion is a finite DECREASING loss
-    dt, loss1 = _timed_steps(step, (ids, ids), 0, 1)
+    dt, loss1, _ = _timed_steps(step, (ids, ids), 0, 1)
     mem = step.memory_analysis(ids, ids)
     return {
         "metric": (

@@ -13,6 +13,10 @@ import time
 
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+_kept = [t for t in os.environ.get("XLA_FLAGS", "").split()
+         if not t.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(
+    _kept + ["--xla_force_host_platform_device_count=8"])
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -20,6 +24,10 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# NOTE: intentionally mirrors bench_configs.run_gpt_6p7b_ppsharding (same
+# strategy/config/step construction) with stage/recompute as parameters and
+# a compile-only measurement — keep the two in sync when the shared setup
+# changes. Honors BENCH_67B_LAYERS like the bench harness.
 def run(stage: int, recompute: bool, layers: int = 16):
     import numpy as np
 
@@ -54,7 +62,7 @@ def run(stage: int, recompute: bool, layers: int = 16):
     out = {"stage": stage, "recompute": recompute, "layers": layers,
            "compile_s": round(compile_s, 1),
            "live_gib": round(mem["live_size_in_bytes"] / 2**30, 3)}
-    out.update({k: v for k, v in mem.items()})
+    out.update(mem)
     print(json.dumps(out), flush=True)
 
 
@@ -62,5 +70,6 @@ if __name__ == "__main__":
     stage = int(sys.argv[1]) if len(sys.argv) > 1 else 3
     rec = (sys.argv[2].lower() in ("1", "true", "yes")) \
         if len(sys.argv) > 2 else True
-    layers = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    layers = int(sys.argv[3]) if len(sys.argv) > 3 else int(
+        os.environ.get("BENCH_67B_LAYERS", "16"))
     run(stage, rec, layers)
