@@ -235,6 +235,24 @@ def convert_while(test_fn, body_fn, init):
     return tuple(out)
 
 
+def convert_cast(caster, x):
+    """``float(x)`` / ``int(x)`` / ``bool(x)`` inside converted code
+    (reference: dy2static convert_var_dtype): on a TRACED tensor the cast
+    becomes a 0-d ``astype`` so the program keeps compiling — the result
+    is a scalar tensor, which composes with arithmetic/comparisons like
+    the Python scalar would. Non-traced values (and shadowed caster
+    names) cast normally."""
+    if caster in (float, int, bool) and _is_traced(x):
+        import jax.numpy as jnp
+
+        from ..framework.core import Tensor
+
+        dt = {float: jnp.float32, int: jnp.int32, bool: jnp.bool_}[caster]
+        # reshape(()) enforces size-1, exactly like the Python cast would
+        return Tensor(jnp.reshape(jnp.asarray(_raw(x)), ()).astype(dt))
+    return caster(x)
+
+
 def range_cond(i, stop, step):
     """Continuation test for a converted ``for ... in range(...)``; honors
     the step sign on both the Python and tensor paths."""
@@ -645,6 +663,15 @@ class _ExprRewriter(ast.NodeTransformer):
         # __class__ cell) — routing it through convert_call would break it
         if isinstance(node.func, ast.Name) and node.func.id == "super":
             return node
+        # cast transform (reference: convert_var_dtype): float(x)/int(x)/
+        # bool(x) on a traced scalar becomes a 0-d astype instead of a
+        # host sync. The NAME node is passed through, so a shadowed
+        # `float` resolves to the user's binding and casts normally.
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1 and not node.keywords
+                and not isinstance(node.args[0], ast.Starred)):
+            return self._call("convert_cast", [node.func, node.args[0]])
         node.func = self._call("convert_call", [node.func])
         return node
 
@@ -846,6 +873,15 @@ class _FunctionConverter:
             return self._convert_while(st, fn_tail)
         if isinstance(st, ast.For):
             return self._convert_for(st, fn_tail)
+        if isinstance(st, ast.Assert):
+            # asserts stay Python: a traced condition host-syncs at trace
+            # time and the callable degrades to eager (XLA has no abort).
+            # Recorded so conversion_report shows WHY a model fell back.
+            self.notes.append(
+                f"assert at line {st.lineno}: asserts run as Python — a "
+                "tensor condition host-syncs and degrades the callable "
+                "to eager (XLA programs cannot abort)")
+            return [self._expr_pass(st)]
         if isinstance(st, (ast.With, ast.Try)):
             if isinstance(st, ast.Try):
                 # documented fallback: XLA control flow cannot branch on

@@ -136,3 +136,52 @@ def test_conversion_report_counts_callees():
     rep = main.conversion_report()
     assert rep["n_converted"] >= 1
     assert isinstance(rep["callees"], dict)
+
+
+def test_cast_transform_compiles():
+    """float()/int()/bool() on traced scalars become 0-d astypes
+    (reference: convert_var_dtype) instead of host syncs."""
+
+    @to_static
+    def f(x):
+        s = x.sum()
+        a = float(s) * 2.0
+        b = int(s)
+        c = bool(s > 0)
+        if (c):
+            return a + b
+        return a - b
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r = f(_ones())
+    # s=4.0: a=8.0, b=4, c True -> 12
+    assert float(r) == 12.0
+    assert not f._eager_fallback
+
+
+def test_cast_shadowed_name_untouched():
+    @to_static
+    def g(x):
+        float = lambda v: v * 10  # noqa: E731 — deliberate shadow
+        return float(x).sum()
+
+    r = g(_ones())
+    assert float(r) == 40.0
+
+
+def test_assert_records_note():
+    @to_static
+    def h(x):
+        assert x is not None
+        if (x.sum() > 0):
+            return x * 2
+        return x
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r = h(_ones())
+    assert float(r.sum()) == 8.0
+    rep = h.conversion_report()
+    entry = rep["callees"].get(rep["entry"])
+    assert entry and any("assert" in n for n in entry.get("notes", ())), entry
