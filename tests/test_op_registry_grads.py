@@ -19,7 +19,9 @@ _ALL = sorted(OP_REGISTRY)
 
 @pytest.fixture(scope="module")
 def results():
-    return classify_all()
+    # classify exactly the collection-time snapshot (_ALL): other test
+    # modules may register ad-hoc ops mid-session
+    return classify_all(_ALL)
 
 
 @pytest.mark.parametrize("name", _ALL)
