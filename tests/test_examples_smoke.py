@@ -17,6 +17,7 @@ EXAMPLES = [
     "train_static_program.py",
     "train_moe.py",
     "train_elastic_resume.py",
+    "train_long_context.py",
 ]
 
 
