@@ -94,10 +94,13 @@ def main():
     print(f"mesh: dp={ndev // sep} x sep={sep}, seq={SEQ} "
           f"(each device holds a {SEQ // sep}-token shard)")
     rng = np.random.default_rng(0)
-    data = rng.integers(0, VOCAB, (2, SEQ)).astype(np.int32)
+    # next-token objective: inputs see tokens[:-1], labels are the
+    # SHIFTED tokens[1:] (unshifted labels would train an identity copy)
+    tokens = rng.integers(0, VOCAB, (2, SEQ + 1)).astype(np.int32)
+    ids = paddle.to_tensor(tokens[:, :-1])
+    labels = paddle.to_tensor(tokens[:, 1:])
     for it in range(8):
-        ids = paddle.to_tensor(data)
-        loss = float(step(ids, ids))
+        loss = float(step(ids, labels))
         if it % 2 == 0:
             print(f"step {it} loss {loss:.4f}")
     print("final loss", loss)
