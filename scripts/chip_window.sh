@@ -32,13 +32,16 @@ timeout 3000 python bench.py | tail -1
 echo "== 2/4 flash backward block sweep =="
 timeout 3600 python bench_flash_sweep.py 1024 2048 | tail -8
 
-echo "== 3/4 resnet50 batch sweep =="
+echo "== 3/5 GPT-760M single-chip anchor (VERDICT r4 #2) =="
+timeout 2400 python bench_configs.py gpt_760m_singlechip | tail -1
+
+echo "== 4/5 resnet50 batch sweep =="
 for b in 256 512; do
   echo "-- resnet50 batch $b"
   timeout 1800 env BENCH_BATCH=$b python bench_configs.py resnet50 | tail -1
 done
 
-echo "== 4/4 seq1024 batch sweep (through the bench seq1024 phase) =="
+echo "== 5/5 seq1024 batch sweep (through the bench seq1024 phase) =="
 for b in 32 64 128; do
   echo "-- seq1024 batch $b"
   timeout 2400 env BENCH_SEQ1024_BATCH=$b python bench.py | tail -1
