@@ -123,6 +123,7 @@ class SpmdPipeline(Layer):
         num_microbatches: Optional[int] = None,
         recompute_block: bool = False,
         num_virtual_stages: int = 1,
+        recompute_granularity: str = "full",
     ):
         super().__init__()
         blocks = list(blocks)
@@ -144,6 +145,10 @@ class SpmdPipeline(Layer):
             )
         self.num_microbatches = num_microbatches
         self.recompute_block = recompute_block
+        from ..utils.recompute_helper import policy_for_granularity
+
+        policy_for_granularity(recompute_granularity)  # fail fast on typos
+        self.recompute_granularity = recompute_granularity
         # Interleaved (virtual-pp) layout: chunk c of layer range lives on
         # physical stage c % S (reference: interleaved 1F1B — SURVEY.md §2.3
         # "Pipeline parallel" / virtual-pp). Stacking order is s-major so a
@@ -214,14 +219,23 @@ class SpmdPipeline(Layer):
         b_vals = leaf_vals[len(leaf_vals) - nb:] if nb else ()
         originals = [p._value for p in self._tparams]
         orig_bufs = [b._value for b in self._tbuffers]
+        # the stack wraps this whole apply in jax.checkpoint; a block whose
+        # own forward also calls recompute() would nest and recompute the
+        # forward twice in backward — flip its flag only for this apply
+        # (never mutate the caller-owned block permanently)
+        orig_rc = getattr(tmpl, "_use_recompute", False)
         try:
             for p, v in zip(self._tparams, p_vals):
                 p._value = v
             for b, v in zip(self._tbuffers, b_vals):
                 b._value = v
+            if self.recompute_block and orig_rc:
+                tmpl._use_recompute = False
             out = tmpl(Tensor(x), *extra)
             return raw(out)
         finally:
+            if self.recompute_block and orig_rc:
+                tmpl._use_recompute = orig_rc
             for p, v in zip(self._tparams, originals):
                 p._value = v
             for b, v in zip(self._tbuffers, orig_bufs):
@@ -272,12 +286,14 @@ class SpmdPipeline(Layer):
                 "M": M}
 
 
-def fold_or_list(blocks, fold: bool, recompute: bool = False):
+def fold_or_list(blocks, fold: bool, recompute: bool = False,
+                 recompute_granularity: str = "full"):
     """Model-zoo construction helper: the layer-fold stack (ONE lax.scan
     over layer-stacked params — compile O(1) in depth) when ``fold``, else
     a plain LayerList. One definition for GPT/Llama/BERT/ERNIE."""
     if fold and len(blocks) > 1:
-        return SpmdPipeline(blocks, num_stages=1, recompute_block=recompute)
+        return SpmdPipeline(blocks, num_stages=1, recompute_block=recompute,
+                            recompute_granularity=recompute_granularity)
     from ....nn.layer import LayerList
 
     return LayerList(blocks)
@@ -332,7 +348,27 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     S = pipe.num_stages
     block = pipe._apply_block
     if pipe.recompute_block:
-        block = jax.checkpoint(block, policy=jax.checkpoint_policies.dots_saveable)
+        # "full" granularity (save block inputs only) is the only policy
+        # that scales here: any saveable intermediate is stacked across the
+        # whole layer dim by the scan below ([L, B, T, ffn] stashes OOM'd a
+        # v5e at 16 layers under dots_saveable — measured round 5).
+        from ..utils.recompute_helper import policy_for_granularity
+
+        gran = getattr(pipe, "recompute_granularity", "full")
+        # each stage's scan stacks only its own chunk of layers
+        chunk = pipe.num_layers // (
+            max(pipe.num_stages, 1) * pipe.num_virtual_stages)
+        if gran != "full" and chunk >= 8 and not getattr(
+                pipe, "_warned_gran_stack", False):
+            object.__setattr__(pipe, "_warned_gran_stack", True)
+            warnings.warn(
+                f"recompute_granularity={gran!r} with {chunk} layers "
+                "scanned per stage: saveable intermediates stack across "
+                "the scanned layer dim and can exhaust device memory "
+                "(a 16-layer GPT-760M at seq 1024 OOMs a 16 GiB chip); "
+                "use 'full' unless the per-stage stack is shallow",
+                stacklevel=3)
+        block = jax.checkpoint(block, policy=policy_for_granularity(gran))
 
     if n_extra:
         stacked_vals, extra = stacked_vals[:-n_extra], stacked_vals[-n_extra:]
@@ -542,6 +578,7 @@ class PipelineLayer(Layer):
         loss_fn: Optional[Callable] = None,
         seg_method: str = "uniform",
         recompute_interval: int = 0,
+        recompute_granularity: str = "full",
         num_virtual_pipeline_stages: Optional[int] = None,
         **kwargs,
     ):
@@ -610,6 +647,7 @@ class PipelineLayer(Layer):
                         built[lo : hi + 1],
                         num_stages=self.num_stages,
                         recompute_block=recompute_interval > 0,
+                        recompute_granularity=recompute_granularity,
                         num_virtual_stages=n_virtual,
                     )
                 )

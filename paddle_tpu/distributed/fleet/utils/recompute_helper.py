@@ -6,10 +6,18 @@ state stashing so dropout masks replay identically).
 
 TPU-native design: `jax.checkpoint` (rematerialization) — XLA re-emits the
 forward ops in the backward pass; RNG replay is free because randomness is
-explicit (counter-based keys are part of the traced inputs). The
-`dots_saveable` policy keeps matmul outputs (MXU work) and recomputes the
-cheap HBM-bound elementwise ops — the right default trade on TPU where HBM
-bandwidth, not FLOPs, is the bottleneck (SURVEY.md "HBM bandwidth").
+explicit (counter-based keys are part of the traced inputs).
+
+Granularity (the reference's ``recompute_granularity`` knob on GPT-class
+models): ``"full"`` saves only the block inputs — the memory-optimal form,
+and the only one that scales inside a layer-folded ``lax.scan`` (any
+"saveable" intermediate is stacked across ALL layers there: saving the FFN
+dot outputs of a 24-layer GPT-760M at seq 1024 batch 8 stacks to >5 GiB
+and OOMs a 16 GiB v5e — measured on chip, round 5). ``"full_attn"`` /
+``"core_attn"`` map to the ``dots_saveable`` policy — keep matmul outputs
+(MXU work), recompute the cheap elementwise tail — the closest XLA
+analogue of recomputing only the attention interior, worth it for shallow
+unfolded stacks that are compute-bound rather than memory-bound.
 """
 from __future__ import annotations
 
@@ -28,11 +36,32 @@ def _recompute_apply(vals, fn):
     return fn(*vals)
 
 
+def policy_for_granularity(granularity):
+    """Map the reference's ``recompute_granularity`` strings to XLA remat
+    policies. ``"full"`` (the reference default) -> ``None``: save only the
+    block inputs. ``"full_attn"``/``"core_attn"`` (and the TPU-native alias
+    ``"dots"``) -> ``dots_saveable``: keep matmul outputs, recompute the
+    elementwise tail."""
+    if granularity in (None, "full"):
+        return None
+    if granularity in ("full_attn", "core_attn", "dots"):
+        return jax.checkpoint_policies.dots_saveable
+    raise ValueError(
+        f"unknown recompute_granularity {granularity!r}; expected 'full', "
+        "'full_attn', 'core_attn' or 'dots'")
+
+
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              policy=None, _param_owners=None, **kwargs):
-    """Run `function(*args)` under rematerialization."""
+              policy=None, granularity="dots", _param_owners=None, **kwargs):
+    """Run `function(*args)` under rematerialization. ``policy`` (an XLA
+    checkpoint policy) wins over ``granularity`` when given.
+
+    The bare-API default stays ``"dots"`` (keep MXU outputs, recompute the
+    HBM-bound elementwise tail — the right trade for a single unfolded
+    block). Model configs pass their ``recompute_granularity`` explicitly,
+    defaulting to the reference's ``"full"``."""
     if policy is None:
-        policy = jax.checkpoint_policies.dots_saveable
+        policy = policy_for_granularity(granularity)
 
     tensor_args = [isinstance(a, Tensor) for a in args]
     # The block's parameters must be explicit differentiable inputs of the
@@ -78,7 +107,8 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     # recompute-knob kwargs belong to recompute(), not the first layer
     # (reference contract: recompute_sequential consumes them upstream)
     rc_kwargs = {k: kwargs.pop(k)
-                 for k in ("use_reentrant", "preserve_rng_state", "policy")
+                 for k in ("use_reentrant", "preserve_rng_state", "policy",
+                           "granularity")
                  if k in kwargs}
 
     def run_segment(fs, first, fn_kwargs):

@@ -57,6 +57,7 @@ class GPTConfig:
         tie_word_embeddings: bool = True,
         layer_norm_epsilon: float = 1e-5,
         fold_layers: bool = False,
+        recompute_granularity: str = "full",
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -69,6 +70,11 @@ class GPTConfig:
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
+        # recompute_granularity (reference GPT knob): "full" saves only
+        # block inputs (required for folded/stacked layers — see
+        # fleet/utils/recompute_helper.py); "full_attn"/"core_attn" keep
+        # matmul outputs (dots_saveable).
+        self.recompute_granularity = recompute_granularity
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
         self.tie_word_embeddings = tie_word_embeddings
@@ -168,6 +174,8 @@ class GPTDecoderLayer(nn.Layer):
         self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._use_recompute = config.use_recompute
+        self._recompute_granularity = getattr(
+            config, "recompute_granularity", "full")
         self._sequence_parallel = config.sequence_parallel
 
     def _block(self, x):
@@ -179,7 +187,8 @@ class GPTDecoderLayer(nn.Layer):
 
     def forward(self, x):
         if self._use_recompute:
-            return _recompute(self._block, x)
+            return _recompute(self._block, x,
+                              granularity=self._recompute_granularity)
         return self._block(x)
 
 
@@ -194,7 +203,9 @@ class GPTModel(nn.Layer):
             from ...distributed.fleet.meta_parallel.pipeline_parallel import SpmdPipeline
 
             self.decoder = SpmdPipeline(
-                blocks, num_stages=pp, recompute_block=config.use_recompute
+                blocks, num_stages=pp, recompute_block=config.use_recompute,
+                recompute_granularity=getattr(
+                    config, "recompute_granularity", "full"),
             )
         else:
             from ...distributed.fleet.meta_parallel.pipeline_parallel import (
@@ -203,7 +214,9 @@ class GPTModel(nn.Layer):
 
             self.decoder = fold_or_list(
                 blocks, getattr(config, "fold_layers", False),
-                recompute=config.use_recompute)
+                recompute=config.use_recompute,
+                recompute_granularity=getattr(
+                    config, "recompute_granularity", "full"))
         self.final_layernorm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None):

@@ -53,6 +53,7 @@ class LlamaConfig:
         use_recompute: bool = False,
         sequence_parallel: bool = False,
         fold_layers: bool = False,
+        recompute_granularity: str = "full",
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -71,6 +72,9 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.use_flash_attention = use_flash_attention
         self.use_recompute = use_recompute
+        # see GPTConfig.recompute_granularity ("full" is required for the
+        # folded/stacked layer forms; dots-saveable stacks across layers)
+        self.recompute_granularity = recompute_granularity
         self.sequence_parallel = sequence_parallel
         # one lax.scan over layer-stacked params without pp: compile time
         # O(1) in depth (see GPTConfig.fold_layers; same scan machinery)
@@ -180,6 +184,8 @@ class LlamaDecoderLayer(nn.Layer):
         )
         self.mlp = LlamaMLP(config)
         self._use_recompute = config.use_recompute
+        self._recompute_granularity = getattr(
+            config, "recompute_granularity", "full")
         self._sequence_parallel = config.sequence_parallel
 
     def _block(self, x):
@@ -191,7 +197,8 @@ class LlamaDecoderLayer(nn.Layer):
 
     def forward(self, x):
         if self._use_recompute:
-            return _recompute(self._block, x)
+            return _recompute(self._block, x,
+                              granularity=self._recompute_granularity)
         return self._block(x)
 
 
@@ -211,7 +218,9 @@ class LlamaModel(nn.Layer):
             )
 
             self.layers = SpmdPipeline(
-                blocks, num_stages=pp, recompute_block=config.use_recompute
+                blocks, num_stages=pp, recompute_block=config.use_recompute,
+                recompute_granularity=getattr(
+                    config, "recompute_granularity", "full"),
             )
         else:
             if pp > 1:
@@ -228,7 +237,9 @@ class LlamaModel(nn.Layer):
 
             self.layers = fold_or_list(
                 blocks, getattr(config, "fold_layers", False),
-                recompute=config.use_recompute)
+                recompute=config.use_recompute,
+                recompute_granularity=getattr(
+                    config, "recompute_granularity", "full"))
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids):
