@@ -296,32 +296,37 @@ def run_gpt_6p7b_ppsharding_lite():
     return run_gpt_6p7b_ppsharding()
 
 
-def run_gpt_760m_singlechip():
-    """VERDICT r4 next-round #2: a real GPT geometry on ONE chip —
-    fwd+bwd+AdamW as one program, tok/s + MFU reported with a TPU
-    platform stamp. GPT-760M (hidden 1536, 24L, 16 heads) in bf16 params
-    AND bf16 Adam moments with block recompute: ~1.5 GiB params + ~3 GiB
-    moments + remat'd activations fits a 16 GiB v5e with room for the
-    seq-1024 batch. On CPU this runs a 2-layer sanity proxy."""
+def _run_gpt_singlechip(metric_name, env_prefix, cfg_factory,
+                        default_batch):
+    """Shared single-chip GPT trainer bench: fwd+bwd+AdamW as one program,
+    bf16 params AND bf16 Adam moments, block recompute, tok/s + analytic
+    model-flops MFU with a TPU platform stamp. Env knobs (per config):
+    {PREFIX}_LAYERS / {PREFIX}_SEQ / {PREFIX}_RECOMPUTE
+    ("full"/"full_attn"/"core_attn"/"none") / {PREFIX}_BATCH (falls back
+    to the shared BENCH_BATCH). On CPU this runs a 2-layer proxy."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.text.models import GPTForCausalLM
 
     tpu = _is_tpu()
-    layers = int(os.environ.get("BENCH_760M_LAYERS", "24" if tpu else "2"))
-    batch = int(os.environ.get("BENCH_BATCH", "8" if tpu else "2"))
-    seq = int(os.environ.get("BENCH_760M_SEQ", "1024" if tpu else "128"))
+    e = lambda k, d: os.environ.get(f"{env_prefix}_{k}",
+                                    os.environ.get("BENCH_" + k, d))
+    layers = int(e("LAYERS", "24" if tpu else "2"))
+    batch = int(e("BATCH", default_batch if tpu else "2"))
+    seq = int(e("SEQ", "1024" if tpu else "128"))
+    granularity = e("RECOMPUTE", "full")
     steps, warmup = (20, 3) if tpu else (2, 1)
 
     paddle.seed(0)
-    cfg = GPTConfig(
-        vocab_size=50304, hidden_size=1536, num_hidden_layers=layers,
-        num_attention_heads=16, intermediate_size=6144,
+    cfg = cfg_factory(
+        num_hidden_layers=layers,
         max_position_embeddings=max(seq, 1024),
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        fold_layers=True, use_recompute=True)
+        fold_layers=True, use_recompute=granularity != "none",
+        recompute_granularity=(granularity if granularity != "none"
+                               else "full"))
     model = GPTForCausalLM(cfg).bfloat16()
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = paddle.optimizer.AdamW(learning_rate=2e-4,
@@ -349,18 +354,48 @@ def run_gpt_760m_singlechip():
     except Exception:
         pass
     return {
-        "metric": (f"gpt-760M-geometry ({layers}L) single-chip tokens/s "
+        "metric": (f"{metric_name} ({layers}L) single-chip tokens/s "
                    "(bf16 params+moments, remat, fwd+bwd+AdamW)"),
         "value": round(batch * seq / dt, 1), "unit": "tokens/s",
         "step_time_ms": round(dt * 1e3, 2),
         "compile_s": round(compile_s, 1) if compile_s else None,
         "n_params": n_params, "batch": batch, "seq": seq,
-        "num_layers": layers,
+        "num_layers": layers, "recompute": granularity,
         "mfu": round(mfu, 4) if mfu else None,
         "per_device_live_bytes": mem,
         "loss": round(loss, 4),
         "sanity": bool(np.isfinite(loss)),
     }
+
+
+def run_gpt_760m_singlechip():
+    """VERDICT r4 next-round #2: a real GPT geometry on ONE chip.
+    GPT-760M (hidden 1536, 24L, 16 heads): ~1.5 GiB bf16 params + ~3 GiB
+    bf16 moments + remat'd activations fits a 16 GiB v5e with room for
+    the seq-1024 batch."""
+    from paddle_tpu.text.models import GPTConfig
+
+    def factory(**kw):
+        return GPTConfig(vocab_size=50304, hidden_size=1536,
+                         num_attention_heads=16, intermediate_size=6144,
+                         **kw)
+
+    return _run_gpt_singlechip("gpt-760M-geometry", "BENCH_760M",
+                               factory, "8")
+
+
+def run_gpt_1p3b_singlechip():
+    """The full GPT-3 1.3B geometry (BASELINE config 4's model) on ONE
+    chip: bf16 params (~2.6 GiB) + bf16 Adam moments (~5.2 GiB) + full
+    block recompute leaves headroom for seq-1024 activations on a 16 GiB
+    v5e. Complements the CPU-mesh dp2xmp4 schedule sanity with a real
+    silicon datapoint for the flagship model."""
+    from paddle_tpu.text.models import GPTConfig
+
+    def factory(**kw):
+        return GPTConfig.gpt3_1p3b(vocab_size=50304, **kw)
+
+    return _run_gpt_singlechip("gpt3-1.3B", "BENCH_1P3B", factory, "4")
 
 
 CONFIGS = {
@@ -370,6 +405,7 @@ CONFIGS = {
     "gpt_6p7b_ppsharding": (run_gpt_6p7b_ppsharding, "cpu_mesh"),
     "gpt_6p7b_ppsharding_lite": (run_gpt_6p7b_ppsharding_lite, "cpu_mesh"),
     "gpt_760m_singlechip": (run_gpt_760m_singlechip, "any"),
+    "gpt_1p3b_singlechip": (run_gpt_1p3b_singlechip, "any"),
 }
 
 

@@ -70,10 +70,13 @@ class GPTConfig:
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
-        # recompute_granularity (reference GPT knob): "full" saves only
-        # block inputs (required for folded/stacked layers — see
-        # fleet/utils/recompute_helper.py); "full_attn"/"core_attn" keep
-        # matmul outputs (dots_saveable).
+        # recompute_granularity (reference GPT knob, same default): "full"
+        # saves only block inputs — the OOM-safe choice, and REQUIRED for
+        # folded/stacked layers where saved intermediates stack across the
+        # lax.scan layer dim (see fleet/utils/recompute_helper.py);
+        # "full_attn"/"core_attn" keep matmul outputs (dots_saveable) —
+        # on an UNFOLDED stack with HBM headroom they trade memory for a
+        # faster backward (no matmul re-execution) and are the better pick.
         self.recompute_granularity = recompute_granularity
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
@@ -94,8 +97,9 @@ class GPTConfig:
 
     @staticmethod
     def gpt3_1p3b(**kw):
-        return GPTConfig(hidden_size=2048, num_hidden_layers=24, num_attention_heads=16,
-                         max_position_embeddings=2048, **kw)
+        kw.setdefault("num_hidden_layers", 24)
+        kw.setdefault("max_position_embeddings", 2048)
+        return GPTConfig(hidden_size=2048, num_attention_heads=16, **kw)
 
     @staticmethod
     def gpt3_6p7b(**kw):

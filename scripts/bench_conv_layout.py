@@ -63,7 +63,9 @@ def _timed(fn, args, warmup=2, iters=10):
 
 
 def conv_ms(batch, cin, cout, k, s, hw, layout):
-    pad = k // 2
+    # odd k: symmetric SAME pad; even k (space-to-depth stem): asymmetric
+    # (lo, hi) = ((k-1)//2, k//2) so a 4x4/1 conv keeps the 112 spatial dim
+    pad_lo, pad_hi = (k - 1) // 2, k // 2
     if layout == "NCHW":
         x = jnp.asarray(np.random.default_rng(0).standard_normal(
             (batch, cin, hw, hw)), jnp.bfloat16)
@@ -79,7 +81,7 @@ def conv_ms(batch, cin, cout, k, s, hw, layout):
 
     def f(x, w):
         return jax.lax.conv_general_dilated(
-            x, w, (s, s), [(pad, pad), (pad, pad)],
+            x, w, (s, s), [(pad_lo, pad_hi), (pad_lo, pad_hi)],
             dimension_numbers=dn)
 
     g = jax.jit(jax.grad(lambda x, w: f(x, w).astype(jnp.float32).mean(),

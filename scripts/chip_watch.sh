@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe every PROBE_EVERY seconds; on the first live probe,
+# run the round-5 harvest queue (highest-value-first, each stage durable),
+# then keep watching for later windows unless STOP file exists.
+#
+# Queue rationale (VERDICT r4 standing instruction + this session's levers):
+#   1. bench.py                 — SHA-stamped headline at HEAD.
+#   2. gpt_1p3b_singlechip      — BASELINE config-4 model, first silicon run.
+#   3. gpt_760m remat sweep     — full_attn vs full, batch 16: MFU lever.
+#   4. bench_gmm_tpu.py         — grouped-matmul (MoE) kernel: first silicon run.
+#   5. bench_conv_layout.py     — ResNet NHWC question: first silicon run.
+#   6. seq1024 batch 64         — the open seq1024 MFU lever.
+set -u
+cd "$(dirname "$0")/.."
+PROBE_EVERY=${PROBE_EVERY:-180}
+STAMP=chip_watch_state
+mkdir -p "$STAMP"
+
+probe() {
+  timeout 110 python - <<'EOF' >/dev/null 2>&1
+import os
+os.environ.pop("JAX_PLATFORMS", None)
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+x = jnp.ones((128, 128), jnp.bfloat16)
+(x @ x).sum().block_until_ready()
+EOF
+}
+
+stage() {  # stage <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  if [ -e "$STAMP/$name.done" ]; then echo "== skip $name (done)"; return 0; fi
+  echo "== stage $name =="
+  if timeout "$tmo" "$@" > "$STAMP/$name.log" 2>&1; then
+    touch "$STAMP/$name.done"
+    tail -2 "$STAMP/$name.log"
+  else
+    echo "-- $name failed/timed out (rc=$?); will retry next window"
+    tail -3 "$STAMP/$name.log"
+  fi
+}
+
+while [ ! -e "$STAMP/STOP" ]; do
+  if probe; then
+    echo "== tunnel LIVE at $(date -u +%FT%TZ) =="
+    stage bench_head      3000 python bench.py
+    stage gpt1p3b_chip    3000 python bench_configs.py gpt_1p3b_singlechip
+    stage gpt760m_fullattn 2400 env BENCH_760M_RECOMPUTE=full_attn BENCH_760M_BATCH=4 \
+                               python bench_configs.py gpt_760m_singlechip
+    stage gpt760m_b16     2400 env BENCH_760M_BATCH=16 \
+                               python bench_configs.py gpt_760m_singlechip
+    stage gmm_tpu         1800 python scripts/bench_gmm_tpu.py
+    stage conv_layout     2400 python scripts/bench_conv_layout.py 256
+    stage seq1024_b64     2400 env BENCH_SEQ1024_BATCH=64 python bench.py
+    if ls "$STAMP"/*.done >/dev/null 2>&1 \
+       && [ "$(ls "$STAMP"/*.done | wc -l)" -ge 7 ]; then
+      echo "== all stages durable; watcher exiting =="
+      break
+    fi
+  fi
+  sleep "$PROBE_EVERY"
+done
+echo "== chip_watch done at $(date -u +%FT%TZ) =="
