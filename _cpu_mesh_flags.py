@@ -8,7 +8,85 @@ NO jax imports here: callers (tests/conftest.py, bench_configs.py,
 __graft_entry__.py) must apply this BEFORE any jax backend init.
 Each flag is guarded separately so a user-supplied value for one is
 never overridden by appending our default for the other.
+
+Optional flags are probed against the INSTALLED jaxlib before being
+added: XLA fatal-aborts the whole process on an unknown flag in
+XLA_FLAGS (parse_flags_from_env.cc), so passing a tuning flag this
+jaxlib build doesn't register would turn every jax init into a crash.
+The probe searches the xla_extension binary for the flag's registration
+string (no jax import, no backend init) and caches per build.
 """
+
+_probe_cache = None  # {flag_name: bool}, loaded once per process
+
+
+def _flag_probe_cache():
+    """Load (or build) the {flag: supported} cache for the installed
+    jaxlib, keyed by the xla_extension binary's path+mtime+size so a
+    jaxlib upgrade invalidates it."""
+    global _probe_cache
+    if _probe_cache is not None:
+        return _probe_cache
+    import json
+    import os
+    import tempfile
+
+    _probe_cache = {}
+    try:
+        import jaxlib  # package init only — no backend touch
+
+        so = os.path.join(os.path.dirname(jaxlib.__file__), "xla_extension.so")
+        st = os.stat(so)
+        key = f"{so}:{int(st.st_mtime)}:{st.st_size}"
+        cache_path = os.path.join(
+            tempfile.gettempdir(), f"paddle_tpu_xla_flagprobe_{os.getuid()}.json")
+        try:
+            with open(cache_path) as f:
+                doc = json.load(f)
+            if doc.get("key") == key:
+                _probe_cache = dict(doc.get("flags", {}))
+                _probe_cache["__so__"] = so
+                return _probe_cache
+        except (OSError, ValueError):
+            pass
+        _probe_cache = {"__so__": so, "__key__": key, "__cache_path__": cache_path}
+    except Exception:
+        _probe_cache = {}
+    return _probe_cache
+
+
+def _xla_flag_supported(name: str) -> bool:
+    """True iff the installed jaxlib registers --<name> (binary string
+    probe of xla_extension.so via mmap; result cached on disk)."""
+    cache = _flag_probe_cache()
+    if name in cache:
+        return cache[name]
+    so = cache.get("__so__")
+    if not so:
+        return False  # no jaxlib found: nothing will parse the flag anyway
+    import mmap
+
+    try:
+        with open(so, "rb") as f:
+            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as m:
+                found = m.find(name.encode()) != -1
+    except (OSError, ValueError):
+        return False
+    cache[name] = found
+    cache_path = cache.get("__cache_path__")
+    if cache_path:
+        import json
+        import os
+
+        flags = {k: v for k, v in cache.items() if not k.startswith("__")}
+        tmp = cache_path + f".{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"key": cache["__key__"], "flags": flags}, f)
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+    return found
 
 
 def apply(env=None, n_devices=8):
@@ -18,9 +96,13 @@ def apply(env=None, n_devices=8):
     flags = e.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         flags += f" --xla_force_host_platform_device_count={n_devices}"
-    if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+    # watchdog relaxation only where this jaxlib knows the flags — an
+    # unknown flag is a process-level fatal abort at first backend init
+    if ("xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags
+            and _xla_flag_supported("xla_cpu_collective_call_warn_stuck_timeout_seconds")):
         flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
-    if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+    if ("xla_cpu_collective_call_terminate_timeout_seconds" not in flags
+            and _xla_flag_supported("xla_cpu_collective_call_terminate_timeout_seconds")):
         flags += " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
-    e["XLA_FLAGS"] = flags
+    e["XLA_FLAGS"] = flags.strip()
     return e

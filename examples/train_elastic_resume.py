@@ -4,9 +4,11 @@ The launch CLI supervises the worker (bounded-retry relaunch on nonzero
 exit — the reference's elastic controllers' watch loop); the worker's
 ElasticManager checkpoints model+optimizer every N steps with orbax and
 resumes from the newest complete checkpoint. This script demonstrates
-the WHOLE cycle in one process tree: the first worker attempt crashes
-hard at step 7; the supervisor relaunches; the second attempt resumes
-from the last checkpoint and finishes.
+the WHOLE cycle in one process tree: the chaos harness
+(paddle_tpu.testing.chaos, armed via PADDLE_CHAOS_KILL_STEP) SIGKILLs the
+first worker attempt at step 7; the supervisor relaunches; the second
+attempt (chaos disarms itself on PADDLE_RESTART_COUNT>0) resumes from the
+last committed checkpoint and finishes. See docs/FAULT_TOLERANCE.md.
 
 Run:  JAX_PLATFORMS=cpu python examples/train_elastic_resume.py
 """
@@ -31,6 +33,7 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu.distributed.fleet.elastic import ElasticManager
 from paddle_tpu.jit import TrainStep
+from paddle_tpu.testing import chaos
 
 work = sys.argv[1]
 restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
@@ -50,12 +53,9 @@ x = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
 y = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
 
 for step in range(start, 15):
+    chaos.step_fence(step)  # SIGKILL here on attempt 0 (PADDLE_CHAOS_KILL_STEP)
     loss = float(step_fn(x, y))
     elastic.maybe_save(step, model, opt)
-    if restart == 0 and step == 7:
-        print("[worker attempt 0] simulated hard fault at step 7",
-              flush=True)
-        os._exit(17)  # no cleanup, no final checkpoint
 
 with open(os.path.join(work, "done.json"), "w") as f:
     json.dump({"attempt": restart, "resumed_from": start,
@@ -74,7 +74,10 @@ def main():
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # the launch CLI supervises: crash (rc=17) -> relaunch, budget 2
+    # arm the chaos harness: kill -9 the worker at step 7, first attempt only
+    env["PADDLE_CHAOS"] = "1"
+    env["PADDLE_CHAOS_KILL_STEP"] = "7"
+    # the launch CLI supervises: SIGKILL -> nonzero rc -> relaunch, budget 2
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
            "--max_restarts", "2", "--restart_backoff", "0.2",
            script, work]

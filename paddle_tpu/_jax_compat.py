@@ -1,0 +1,84 @@
+"""Version-compat shims over the installed jax.
+
+The repo targets a range of jax releases: `shard_map` graduated from
+`jax.experimental.shard_map` to a top-level `jax.shard_map`, renaming
+kwargs on the way (`check_rep` -> `check_vma`; manual axes went from the
+complement-form `auto=` to the direct `axis_names=`). Resolve whichever
+this install provides and translate the kwargs, so kernel code is written
+once against the modern surface. Keep every cross-version alias HERE —
+scattering hasattr probes through kernel code is how silent API drift
+creeps in.
+"""
+from __future__ import annotations
+
+import jax
+
+_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+if not _NEW_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+              axis_names=None, check_vma=None, check_rep=None, auto=None,
+              **kwargs):
+    """`jax.shard_map` with modern kwargs on every supported jax.
+
+    `axis_names` (modern) and `auto` (legacy complement) are two spellings
+    of the manual-axes set; `check_vma` (modern) and `check_rep` (legacy)
+    are two names for the same replication check. Either spelling is
+    accepted and translated to what the installed jax understands."""
+    if _NEW_SHARD_MAP:
+        if check_vma is None and check_rep is not None:
+            check_vma = check_rep
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is None and auto is not None and mesh is not None:
+            axis_names = frozenset(mesh.axis_names) - frozenset(auto)
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    if check_rep is None and check_vma is not None:
+        check_rep = check_vma
+    if check_rep is not None:
+        kwargs["check_rep"] = check_rep
+    # Legacy jax lowers every axis FULLY manual, ignoring the requested
+    # auto/axis_names split: its partial-manual path runs the body through
+    # the SPMD partitioner, which rejects the partition_id that
+    # `lax.axis_index` lowers to — and every shard_map body in this repo
+    # (pipeline schedule, ring attention) takes its rank from axis_index.
+    # Promoting auto axes to manual is semantics-preserving for those
+    # bodies: in/out specs may only name manual axes so they stay valid,
+    # and data along a promoted axis is simply replicated (the GSPMD hints
+    # the body would have used for it are dropped by
+    # `mesh.sharding_constraint` inside any manual region). Costs redundant
+    # compute along the promoted axes on old jax, never wrong answers.
+    return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+
+
+def bound_axis_names() -> frozenset:
+    """Axis names bound in the CURRENT trace (shard_map/pmap/vmap regions).
+
+    Inside such a region these axes are MANUAL: data is already rank-local,
+    so a GSPMD sharding hint naming them is at best moot and (on every jax
+    we support) a lowering error. Callers use this to strip them from
+    PartitionSpecs before `with_sharding_constraint`. Returns the empty set
+    when the introspection hook is unavailable — the conservative answer."""
+    try:
+        from jax._src import core as _core
+
+        return frozenset(_core.unsafe_get_axis_names())
+    except Exception:
+        return frozenset()
+
+
+def axis_size(axis_name):
+    """`lax.axis_size` for jax versions that predate it: a psum of the
+    literal 1 constant-folds to the static mesh-axis extent inside any
+    mapped region (the canonical pre-axis_size idiom)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

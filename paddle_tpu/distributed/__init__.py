@@ -83,11 +83,10 @@ def get_group(gid=None):
 # `shard_map` convenience re-export: the explicit-SPMD escape hatch
 # (reference analogue: writing custom collective ops).
 def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
-    import jax
-
+    from .._jax_compat import shard_map as _shard_map
     from .mesh import require_global_mesh
 
-    return jax.shard_map(
+    return _shard_map(
         f,
         mesh=mesh or require_global_mesh(),
         in_specs=in_specs,

@@ -14,6 +14,7 @@ serialization with training steps.
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
@@ -21,6 +22,12 @@ import numpy as np
 
 from ...framework.core import Tensor
 from ...framework.op import raw
+from . import manifest as _manifest
+
+#: suffix for in-flight (uncommitted) checkpoint directories; a crash at any
+#: point leaves either the old committed dir or a *.ptsave-tmp leftover —
+#: never a half-written dir under the final name
+TMP_SUFFIX = ".ptsave-tmp"
 
 
 def _to_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
@@ -30,6 +37,10 @@ def _to_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
             out[k] = raw(v)
         elif isinstance(v, dict):
             out[k] = _to_arrays(v)
+        elif isinstance(v, np.generic):
+            # orbax's StandardCheckpointHandler accepts ndarray but not
+            # numpy scalar types (np.int64 et al. fail its type check)
+            out[k] = np.asarray(v)
         else:
             out[k] = v
     return out
@@ -41,24 +52,105 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = False):
+class _AtomicCommit:
+    """Turns a finished body write under the tmp name into a committed
+    checkpoint: chaos fault point -> checksum manifest -> atomic rename ->
+    parent-dir fsync. A kill at ANY point leaves either the previous
+    committed dir or a *.ptsave-tmp leftover — never a torn final dir."""
+
+    def __init__(self, tmp: str, final: str):
+        self.tmp = tmp
+        self.final = final
+
+    def run(self):
+        from ...testing import chaos
+
+        chaos.on_commit(self.tmp, self.final)
+        _manifest.write_manifest(self.tmp)
+        if os.path.exists(self.final):
+            shutil.rmtree(self.final)
+        os.replace(self.tmp, self.final)
+        _manifest.fsync_dir(os.path.dirname(self.final))
+        chaos.after_commit(self.final)
+
+
+class PendingSave:
+    """Handle for an in-flight async save. The commit (manifest + rename)
+    happens on `wait_until_finished()` — until then the checkpoint does not
+    exist under its final name, so readers can never observe a partial
+    write. Duck-compatible with the orbax async handle the previous API
+    returned."""
+
+    def __init__(self, ckptr, commit: _AtomicCommit):
+        self._ckptr = ckptr
+        self._commit = commit
+        self.done = False
+        self.path = commit.final
+
+    @property
+    def tmp_path(self) -> str:
+        return self._commit.tmp
+
+    def wait_until_finished(self):
+        if self.done:
+            return
+        self._ckptr.wait_until_finished()
+        self._commit.run()
+        self.done = True
+        self._ckptr.close()
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    async_save: bool = False, atomic: bool = True):
     """paddle.distributed.checkpoint.save_state_dict parity (Orbax-backed).
 
     Sharded arrays are written shard-by-shard per host; replicated arrays are
-    written once. `async_save` returns immediately and flushes on the next
-    save/wait (orbax async machinery).
+    written once. `async_save` returns a PendingSave immediately; the commit
+    happens on its `wait_until_finished()`.
+
+    With `atomic` (default) the body is written under `<path>.ptsave-tmp`
+    and only renamed to `path` after a checksum manifest is in place, so a
+    kill -9 at any point never leaves a torn directory under the final name
+    (see docs/FAULT_TOLERANCE.md).
     """
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     arrays = _to_arrays(state_dict)
+    if not atomic:
+        if async_save:
+            ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+            ckptr.save(path, args=ocp.args.StandardSave(arrays), force=True)
+            return ckptr
+        with _checkpointer() as ckptr:
+            ckptr.save(path, arrays, force=True)
+        return None
+
+    tmp = path + TMP_SUFFIX
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    commit = _AtomicCommit(tmp, path)
     if async_save:
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-        ckptr.save(path, args=ocp.args.StandardSave(arrays), force=True)
-        return ckptr
+        ckptr.save(tmp, args=ocp.args.StandardSave(arrays), force=True)
+        return PendingSave(ckptr, commit)
     with _checkpointer() as ckptr:
-        ckptr.save(path, arrays, force=True)
+        ckptr.save(tmp, arrays, force=True)
+    commit.run()
     return None
+
+
+def is_complete_checkpoint(path: str) -> bool:
+    """Cheap commit check: the manifest exists and every listed file is
+    present with the recorded size. A dir failing this was torn mid-save
+    and must never be restored."""
+    return _manifest.is_complete(path)
+
+
+def verify_checkpoint(path: str, deep: bool = True):
+    """(ok, reason). `deep` re-checksums every file against the commit
+    manifest — catches silent byte corruption, not just truncation."""
+    return _manifest.verify(path, deep=deep)
 
 
 def load_state_dict(

@@ -137,11 +137,28 @@ def init_parallel_env() -> Group:
     nproc = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get("JAX_NUM_PROCESSES")
     pid = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("JAX_PROCESS_ID")
     if coord and nproc and int(nproc) > 1 and not jax._src.distributed.global_state.client:
+        # CPU backend: cross-process collectives need an explicit transport
+        # (gloo); without it every multi-process program fails at compile
+        # with "Multiprocess computations aren't implemented on the CPU
+        # backend". Must be set before backend init. TPU needs nothing —
+        # collectives ride ICI/DCN natively.
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # knob absent on this jax: keep prior behavior
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(nproc),
             process_id=int(pid or 0),
         )
+        # hung-rank detection: when launch exported a heartbeat interval,
+        # join the store-backed watchdog so a wedged peer fails the job
+        # with a diagnosis instead of stalling every collective forever
+        from ..runtime.watchdog import maybe_start_from_env
+
+        maybe_start_from_env()
     world = list(range(len(jax.devices())))
     _default_group = Group(world, axis_names=None, name="world")
     _groups.append(_default_group)

@@ -11,13 +11,31 @@ every N steps with bounded retention; on restart — same or different
 topology — `latest_step()` + `restore()` re-shards onto the live mesh and
 training continues. The etcd membership machinery has no analogue to port:
 membership is the job scheduler's concern (GKE/Borg restart the slice).
+
+Crash-safety guarantees (docs/FAULT_TOLERANCE.md):
+  * saves commit atomically (body -> checksum manifest -> rename), so a
+    kill -9 mid-save never leaves a restorable-looking torn `step_N/`;
+  * `resume()` restores the newest checkpoint that passes manifest
+    verification, skipping torn or corrupted ones with a diagnosis;
+  * retention pruning counts only committed checkpoints and never deletes
+    the newest one, whatever `max_to_keep` says;
+  * a pending async save is flushed before the next save starts and at
+    interpreter exit, so back-to-back saves cannot interleave writes.
 """
 from __future__ import annotations
 
+import atexit
 import os
-from typing import Optional
+import shutil
+import sys
+from typing import Dict, Optional
 
-from ..checkpoint import save_state_dict
+from ..checkpoint import (
+    TMP_SUFFIX,
+    is_complete_checkpoint,
+    save_state_dict,
+    verify_checkpoint,
+)
 
 
 class ElasticManager:
@@ -31,24 +49,35 @@ class ElasticManager:
     """
 
     def __init__(self, ckpt_dir: str, save_interval: int = 100, max_to_keep: int = 3,
-                 async_save: bool = False):
+                 async_save: bool = False, verify_on_resume: bool = True):
         self.ckpt_dir = os.path.abspath(ckpt_dir)
         self.save_interval = max(1, int(save_interval))
         self.max_to_keep = max_to_keep
         self.async_save = async_save
+        self.verify_on_resume = verify_on_resume
         os.makedirs(self.ckpt_dir, exist_ok=True)
         self._pending = None
+        # a pending async save left in flight at interpreter exit would
+        # silently lose the newest checkpoint (and can interleave with a
+        # final sync save); commit it on the way out
+        atexit.register(self._atexit_flush)
 
     # -- discovery ----------------------------------------------------------
-    def _step_dirs(self):
+    def _step_dirs(self) -> Dict[int, str]:
         out = {}
         for name in os.listdir(self.ckpt_dir):
             if name.startswith("step_") and name[5:].isdigit():
                 out[int(name[5:])] = os.path.join(self.ckpt_dir, name)
         return out
 
+    def _complete_steps(self) -> Dict[int, str]:
+        """Only checkpoints whose commit manifest checks out (shallow) —
+        torn dirs from a mid-save kill are invisible to discovery."""
+        return {s: p for s, p in self._step_dirs().items()
+                if is_complete_checkpoint(p)}
+
     def latest_step(self) -> Optional[int]:
-        steps = self._step_dirs()
+        steps = self._complete_steps()
         return max(steps) if steps else None
 
     # -- save/restore -------------------------------------------------------
@@ -69,17 +98,33 @@ class ElasticManager:
         self.save(step, model, optimizer, extra)
         return True
 
+    def flush(self):
+        """Commit a pending async save (manifest + atomic rename). No-op
+        when nothing is in flight."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            pending.wait_until_finished()
+            # the commit just added a checkpoint; re-apply retention so an
+            # async tail save doesn't leave max_to_keep+1 dirs behind
+            self._gc()
+
+    def _atexit_flush(self):
+        try:
+            self.flush()
+        except Exception as e:  # interpreter teardown: diagnose, don't mask exit
+            print(f"[elastic] final checkpoint flush failed: {e!r}",
+                  file=sys.stderr)
+
     def save(self, step: int, model, optimizer=None, extra=None):
         """`extra` (user payload: rng state, epoch counters, ...) goes to a
         SIDECAR checkpoint next to the canonical one — the canonical tree
         stays exactly the live model/optimizer structure, so restore targets
         never have to guess shapes for keys that exist only on disk."""
+        # a still-running async save must commit before the next write
+        # starts: two writers interleaving in one directory tree is exactly
+        # the torn state the manifest exists to rule out
+        self.flush()
         path = os.path.join(self.ckpt_dir, f"step_{step}")
-        if self._pending is not None:
-            try:
-                self._pending.wait_until_finished()
-            except Exception:
-                pass
         self._pending = save_state_dict(
             self._state(model, optimizer), path, async_save=self.async_save
         )
@@ -91,31 +136,76 @@ class ElasticManager:
         return os.path.join(self.ckpt_dir, f"extra_{step}")
 
     def _gc(self):
-        steps = sorted(self._step_dirs())
-        while len(steps) > self.max_to_keep:
-            victim = steps.pop(0)
-            import shutil
-
-            shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{victim}"), ignore_errors=True)
+        # retention counts COMMITTED checkpoints only, and even
+        # max_to_keep=0 keeps the newest one: pruning must never leave the
+        # job with no verified checkpoint to fall back to
+        complete = sorted(self._complete_steps())
+        keep = max(1, int(self.max_to_keep))
+        for victim in complete[:-keep]:
+            shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{victim}"),
+                          ignore_errors=True)
             shutil.rmtree(self._extra_dir(victim), ignore_errors=True)
+        # sweep tmp leftovers from crashed writers — never the in-flight
+        # save (matching by prefix: orbax stages the async body under
+        # `<tmp>.orbax-checkpoint-tmp-<ts>` siblings of the target)
+        active = os.path.basename(self._pending.tmp_path) if (
+            self._pending is not None and hasattr(self._pending, "tmp_path")
+        ) else None
+        for name in os.listdir(self.ckpt_dir):
+            if TMP_SUFFIX in name and not (active and name.startswith(active)):
+                shutil.rmtree(os.path.join(self.ckpt_dir, name),
+                              ignore_errors=True)
 
     def resume(self, model, optimizer=None, extra_out=None) -> int:
-        """Restore latest snapshot into the LIVE layout (re-stacking for the
-        model's pipelines, re-placing onto current shardings); returns the
-        next step index to run (0 when no checkpoint exists). If the
-        snapshot was saved with ``extra=...``, pass a dict as ``extra_out``
-        to receive that payload back."""
+        """Restore the newest VERIFIED snapshot into the LIVE layout
+        (re-stacking for the model's pipelines, re-placing onto current
+        shardings); returns the next step index to run (0 when no usable
+        checkpoint exists). Torn directories (no commit manifest) are
+        skipped with a diagnosis; a committed checkpoint failing checksum
+        verification falls back to the previous complete step. If every
+        committed checkpoint is damaged, raises instead of silently
+        training from scratch. If the snapshot was saved with ``extra=...``,
+        pass a dict as ``extra_out`` to receive that payload back."""
         from ...distributed.checkpoint import load_state_dict
         from ...distributed.checkpoint.converter import (
             apply_canonical, restore_canonical,
         )
 
-        step = self.latest_step()
-        if step is None:
-            return 0
-        path = os.path.join(self.ckpt_dir, f"step_{step}")
-        canonical = restore_canonical(path, model, optimizer)
-        apply_canonical(model, canonical, optimizer)
-        if extra_out is not None and os.path.isdir(self._extra_dir(step)):
-            extra_out.update(load_state_dict(self._extra_dir(step)))
-        return step + 1
+        self.flush()
+        all_steps = self._step_dirs()
+        complete = self._complete_steps()
+        torn = sorted(set(all_steps) - set(complete))
+        if torn:
+            print(f"[elastic] ignoring torn/incomplete checkpoint dir(s) "
+                  f"{['step_%d' % s for s in torn]} under {self.ckpt_dir} "
+                  "(no commit manifest — writer died mid-save)",
+                  file=sys.stderr)
+        failures = []
+        for step in sorted(complete, reverse=True):
+            path = complete[step]
+            if self.verify_on_resume:
+                ok, why = verify_checkpoint(path, deep=True)
+                if not ok:
+                    print(f"[elastic] skipping step_{step}: {why}; falling "
+                          "back to previous complete checkpoint",
+                          file=sys.stderr)
+                    failures.append((step, why))
+                    continue
+            try:
+                canonical = restore_canonical(path, model, optimizer)
+                apply_canonical(model, canonical, optimizer)
+            except Exception as e:
+                print(f"[elastic] restore of step_{step} failed ({e!r}); "
+                      "falling back to previous complete checkpoint",
+                      file=sys.stderr)
+                failures.append((step, repr(e)))
+                continue
+            if extra_out is not None and os.path.isdir(self._extra_dir(step)):
+                extra_out.update(load_state_dict(self._extra_dir(step)))
+            return step + 1
+        if failures:
+            raise RuntimeError(
+                "every committed checkpoint under "
+                f"{self.ckpt_dir} failed verification/restore: {failures}; "
+                "refusing to silently train from scratch")
+        return 0

@@ -550,7 +550,9 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
             return inner_fn(
                 local_stacked, xm_all.astype(x.dtype)).astype(jnp.float32)
 
-    mapped = jax.shard_map(
+    from ...._jax_compat import shard_map as _shard_map
+
+    mapped = _shard_map(
         spmd_fn,
         mesh=m,
         in_specs=(tuple(P("pp") for _ in stacked_vals), P()),

@@ -41,6 +41,15 @@ def build_parser():
                         "(reference: elastic controllers' restart budget)")
     p.add_argument("--restart_backoff", type=float, default=1.0,
                    help="base seconds between relaunches (doubles per retry, capped)")
+    p.add_argument("--rdzv_timeout", type=float,
+                   default=float(os.environ.get("PADDLE_RDZV_TIMEOUT", "300")),
+                   help="seconds to wait for all hosts at the rank-negotiation "
+                        "rendezvous before failing with a diagnosis")
+    p.add_argument("--heartbeat_interval", type=float,
+                   default=float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "0")),
+                   help="seconds between liveness beats; >0 arms the hung-rank "
+                        "watchdog in every worker (PADDLE_HEARTBEAT_MISS beats "
+                        "of silence fail the job loudly). 0 disables.")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -156,10 +165,23 @@ def launch(argv=None):
             # script runs — the asymmetric handshake guarantees no client
             # has an outstanding request by then.
             host, port = args.master.rsplit(":", 1)
-            rank, _store = negotiate_rank(f"{host}:{int(port) + 1}", nnodes)
+            try:
+                rank, _store = negotiate_rank(f"{host}:{int(port) + 1}",
+                                              nnodes, timeout=args.rdzv_timeout)
+            except TimeoutError as e:
+                raise SystemExit(
+                    f"[launch] rendezvous failed after {args.rdzv_timeout:.0f}s: "
+                    f"{e}\n[launch] every host must run the same launch command "
+                    f"with --nnodes={nnodes} and --master={args.master} "
+                    "(raise PADDLE_RDZV_TIMEOUT / --rdzv_timeout for slow "
+                    "cluster starts)") from e
             _store.close()
         os.environ["PADDLE_TRAINER_ID"] = str(rank)
         os.environ["JAX_PROCESS_ID"] = str(rank)
+    if args.heartbeat_interval > 0:
+        # workers read these in init_parallel_env (runtime.watchdog)
+        os.environ["PADDLE_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+        os.environ.setdefault("PADDLE_HEARTBEAT_MISS", "5")
     cmd = [sys.executable, args.training_script] + list(args.training_script_args)
     env = os.environ.copy()
     # the worker is a fresh interpreter: propagate the launcher's import

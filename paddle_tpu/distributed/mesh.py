@@ -256,6 +256,25 @@ def sharding_constraint(value, spec: PartitionSpec, mesh: Optional[Mesh] = None)
     if m is None or m.empty:
         return value
     spec = _sanitize_spec(spec, tuple(value.shape), m)
+    # Inside a shard_map/pmap region the bound axes are MANUAL for this
+    # trace: data is already rank-local along them, so a GSPMD hint naming
+    # them is moot — and rejected at LOWERING time (too late for a
+    # try/except here). Strip them from the spec up front.
+    from .._jax_compat import bound_axis_names
+
+    manual = bound_axis_names()
+    if manual:
+        entries = [
+            None
+            if e is not None and any(
+                n in manual for n in (e if isinstance(e, tuple) else (e,))
+            )
+            else e
+            for e in spec
+        ]
+        while entries and entries[-1] is None:
+            entries.pop()
+        spec = PartitionSpec(*entries)
     try:
         from jax import lax
 

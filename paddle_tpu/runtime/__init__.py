@@ -178,16 +178,21 @@ class TCPStore:
         host: str = "127.0.0.1",
         port: int = 0,
         is_master: bool = False,
-        timeout: float = 60.0,
+        timeout: Optional[float] = None,
     ):
-        lib = native.get_lib()
+        if timeout is None:
+            timeout = float(os.environ.get("PADDLE_STORE_TIMEOUT", "60"))
         self._py_fallback = None
-        if lib is None:
+        # PADDLE_STORE_FORCE_PY=1 and chaos store-fault injection force the
+        # Python store (where the fault hooks live) even with the native
+        # lib present
+        if not native.store_native_enabled():
             from . import py_store
 
             self._py_fallback = py_store.PyTCPStore(host, port, is_master, timeout)
             self.port = self._py_fallback.port
             return
+        lib = native.get_lib()
         self._lib = lib
         self._h = lib.pt_store_create(
             host.encode(), int(port), 1 if is_master else 0, float(timeout)
@@ -258,13 +263,31 @@ class TCPStore:
         """
         if rank == 0:
             for r in range(1, world_size):
-                self.wait(f"{ns}/arrived/{r}", timeout)
+                try:
+                    self.wait(f"{ns}/arrived/{r}", timeout)
+                except TimeoutError as e:
+                    raise TimeoutError(
+                        f"rendezvous '{ns}': rank {r} of {world_size} never "
+                        f"arrived within {timeout}s — check that rank's "
+                        "process is alive and PADDLE_MASTER matches") from e
             self.set(f"{ns}/go", b"1")
             for r in range(1, world_size):
-                self.wait(f"{ns}/ack/{r}", timeout)
+                try:
+                    self.wait(f"{ns}/ack/{r}", timeout)
+                except TimeoutError as e:
+                    raise TimeoutError(
+                        f"rendezvous '{ns}': rank {r} arrived but never "
+                        f"acknowledged within {timeout}s (it likely died "
+                        "between handshake phases)") from e
         else:
             self.set(f"{ns}/arrived/{rank}", b"1")
-            self.wait(f"{ns}/go", timeout)
+            try:
+                self.wait(f"{ns}/go", timeout)
+            except TimeoutError as e:
+                raise TimeoutError(
+                    f"rendezvous '{ns}': rank {rank} waited {timeout}s for "
+                    "the master's go signal — the master (rank 0) is down "
+                    "or still waiting on another rank") from e
             self.set(f"{ns}/ack/{rank}", b"1")
 
     def barrier(self, name: str, world_size: int, timeout: float = 60.0) -> None:

@@ -121,3 +121,20 @@ def get_lib():
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def store_native_enabled() -> bool:
+    """Whether TCPStore should use the native backend.
+
+    False when the lib is unavailable, when ``PADDLE_STORE_FORCE_PY=1``
+    (debugging / CI determinism), or when chaos store-fault injection is
+    active — the fault hooks (latency, connection drops) live in the Python
+    store, so chaos runs must exercise that path on every rank."""
+    if os.environ.get("PADDLE_STORE_FORCE_PY", "0") not in ("0", ""):
+        return False
+    if os.environ.get("PADDLE_CHAOS", "0") not in ("0", ""):
+        from ..testing import chaos
+
+        if chaos.store_faults_enabled():
+            return False
+    return available()

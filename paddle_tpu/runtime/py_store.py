@@ -1,13 +1,40 @@
 """Pure-Python TCPStore fallback (same semantics as the native store).
 
-Used only when the native runtime can't be built (no toolchain); keeps
-``paddle_tpu.distributed.launch`` rendezvous working everywhere. Protocol is
-line-oriented and private to this module (the native and Python stores don't
-interoperate — a job uses one or the other on all ranks).
+Used only when the native runtime can't be built (no toolchain) or when
+``PADDLE_STORE_FORCE_PY=1`` / chaos store-fault injection forces the Python
+path; keeps ``paddle_tpu.distributed.launch`` rendezvous working everywhere.
+Protocol is line-oriented and private to this module (the native and Python
+stores don't interoperate — a job uses one or the other on all ranks).
+
+Robustness contract (docs/FAULT_TOLERANCE.md):
+
+* every socket op runs under a DEADLINE — a dead or wedged server turns
+  into a ``TimeoutError`` naming the op and key, never an indefinite hang
+  inside ``socket.recv``;
+* connect retries with exponential backoff + jitter up to the caller's
+  timeout, so a client starting before the master's listener is up (the
+  normal launch race) converges without hammering the host;
+* idempotent ops (get/wait/check/set/del) transparently reconnect and
+  re-issue once after a dropped connection; ``add`` never auto-retries (a
+  replay would double-count a rank).
+
+Env knobs (read lazily so tests can flip them per-case):
+
+  PADDLE_STORE_OP_TIMEOUT   deadline for non-blocking ops (set/add/check/
+                            del) and the connect phase default, seconds
+                            (default 60)
+  PADDLE_STORE_RPC_SLACK    extra client-side slack on top of a blocking
+                            get/wait's server-side timeout, seconds
+                            (default 15) — the window in which a live
+                            server's "timed out" reply must arrive
+  PADDLE_STORE_RETRY_BASE   initial reconnect backoff, seconds (default 0.05)
+  PADDLE_STORE_RETRY_CAP    max per-attempt backoff, seconds (default 2.0)
 """
 from __future__ import annotations
 
+import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
@@ -15,26 +42,72 @@ import threading
 import time
 
 
-def _send_msg(sock, obj):
+def _knob(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def op_timeout() -> float:
+    return _knob("PADDLE_STORE_OP_TIMEOUT", 60.0)
+
+
+def rpc_slack() -> float:
+    return _knob("PADDLE_STORE_RPC_SLACK", 15.0)
+
+
+def _chaos():
+    """The chaos harness, or None when inert — the import itself is gated
+    so the normal path never pays for (or depends on) the testing pkg."""
+    if os.environ.get("PADDLE_CHAOS", "0") in ("0", ""):
+        return None
+    from ..testing import chaos
+
+    return chaos if chaos.store_faults_enabled() else None
+
+
+def _send_msg(sock, obj, deadline=None, what="store op"):
     data = pickle.dumps(obj)
-    sock.sendall(struct.pack("<Q", len(data)) + data)
+    payload = struct.pack("<Q", len(data)) + data
+    if deadline is not None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"PyTCPStore: deadline expired sending {what}")
+        sock.settimeout(remaining)
+    try:
+        sock.sendall(payload)
+    except socket.timeout as e:
+        raise TimeoutError(f"PyTCPStore: timed out sending {what}") from e
 
 
-def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        c = sock.recv(8 - len(hdr))
-        if not c:
-            raise ConnectionError("store connection closed")
-        hdr += c
-    (n,) = struct.unpack("<Q", hdr)
-    data = b""
-    while len(data) < n:
-        c = sock.recv(min(1 << 16, n - len(data)))
-        if not c:
-            raise ConnectionError("store connection closed")
-        data += c
-    return pickle.loads(data)
+def _recv_msg(sock, deadline=None, what="store op"):
+    """Receive one length-prefixed message, honoring `deadline`
+    (monotonic). A dead server becomes TimeoutError naming the op instead
+    of an unbounded blocking recv."""
+
+    def _read(n):
+        buf = b""
+        while len(buf) < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"PyTCPStore: timed out waiting for reply to {what}")
+                sock.settimeout(remaining)
+            try:
+                c = sock.recv(min(1 << 16, n - len(buf)))
+            except socket.timeout as e:
+                raise TimeoutError(
+                    f"PyTCPStore: timed out waiting for reply to {what}") from e
+            if not c:
+                raise ConnectionError("store connection closed")
+            buf += c
+        return buf
+
+    (n,) = struct.unpack("<Q", _read(8))
+    return pickle.loads(_read(n))
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -53,7 +126,7 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 cmd, key, arg = _recv_msg(self.request)
-            except (ConnectionError, EOFError, OSError):
+            except (ConnectionError, EOFError, OSError, TimeoutError):
                 return
             # Responses are sent OUTSIDE srv.cv: a client with a full TCP
             # buffer would otherwise block sendall while holding the global
@@ -87,12 +160,53 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = srv.kv.pop(key, None) is not None
             else:
                 return
-            _send_msg(self.request, resp)
+            try:
+                _send_msg(self.request, resp)
+            except (ConnectionError, OSError, TimeoutError):
+                return
+
+
+def _connect_with_backoff(host, port, timeout, why="store"):
+    """Dial with exponential backoff + jitter until `timeout` elapses.
+
+    The first attempts race the master's listener coming up — that's the
+    normal launch sequence, not an error — so retry quietly, but when the
+    deadline passes, say exactly who we couldn't reach and for how long."""
+    deadline = time.monotonic() + timeout
+    delay = _knob("PADDLE_STORE_RETRY_BASE", 0.05)
+    cap = _knob("PADDLE_STORE_RETRY_CAP", 2.0)
+    attempt = 0
+    last_err = None
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise ConnectionError(
+                f"PyTCPStore: cannot reach {why} at {host}:{port} after "
+                f"{attempt - 1} attempts over {timeout:.1f}s "
+                f"(last error: {last_err!r}) — is the master rank up, and "
+                "do PADDLE_MASTER/port match on every rank?")
+        try:
+            sock = socket.create_connection((host, port),
+                                            timeout=min(remaining, max(delay, 1.0)))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except OSError as e:
+            last_err = e
+            # full jitter: sleep U(0, delay), then grow the ceiling
+            time.sleep(min(random.uniform(0, delay), max(0.0, remaining)))
+            delay = min(delay * 2, cap)
 
 
 class PyTCPStore:
+    #: ops safe to re-issue after a dropped connection (`add` is excluded:
+    #: replaying an increment would double-count a rank)
+    _IDEMPOTENT = frozenset({"get", "check", "del", "set"})
+
     def __init__(self, host="127.0.0.1", port=0, is_master=False, timeout=60.0):
         self._server = None
+        self._host = host
+        self.timeout = float(timeout)
         if is_master:
             # Bind the master address specifically (not 0.0.0.0): master
             # election depends on non-owners failing this bind.
@@ -101,31 +215,61 @@ class PyTCPStore:
             threading.Thread(target=self._server.serve_forever, daemon=True).start()
         else:
             self.port = port
-        deadline = time.monotonic() + timeout
-        while True:
-            try:
-                self._sock = socket.create_connection((host, self.port), timeout=timeout)
-                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                break
-            except OSError:
-                if time.monotonic() > deadline:
-                    raise ConnectionError(f"PyTCPStore: cannot reach {host}:{self.port}")
-                time.sleep(0.1)
+        self._sock = _connect_with_backoff(host, self.port, self.timeout)
         self._lock = threading.Lock()
 
-    def _rpc(self, cmd, key, arg=None):
+    def _reconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = _connect_with_backoff(self._host, self.port, self.timeout)
+
+    def _rpc(self, cmd, key, arg=None, op_deadline=None):
+        """One request/response, under a deadline. Idempotent ops survive a
+        dropped connection by reconnecting (with backoff) and re-issuing
+        ONCE — covers both injected drops and a master that restarted its
+        listener between ops."""
+        what = f"{cmd}({key!r})"
+        if op_deadline is None:
+            op_deadline = time.monotonic() + op_timeout()
+        chaos = _chaos()
         with self._lock:
-            _send_msg(self._sock, (cmd, key, arg))
-            return _recv_msg(self._sock)
+            if chaos is not None:
+                chaos.store_latency()
+                # drops only on ops the retry path may re-issue; severing
+                # an `add` would poison the counter semantics by design
+                if cmd in self._IDEMPOTENT and chaos.store_should_drop():
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+            for retry in (False, True):
+                try:
+                    _send_msg(self._sock, (cmd, key, arg), op_deadline, what)
+                    return _recv_msg(self._sock, op_deadline, what)
+                except (ConnectionError, OSError) as e:
+                    if isinstance(e, TimeoutError):
+                        raise
+                    if retry or cmd not in self._IDEMPOTENT:
+                        raise ConnectionError(
+                            f"PyTCPStore: {what} failed ({e!r}) and is not "
+                            "retryable") from e
+                    self._reconnect()
 
     def set(self, key, value):
         data = value.encode() if isinstance(value, str) else bytes(value)
         self._rpc("set", key, data)
 
     def get(self, key, timeout=60.0):
-        v = self._rpc("get", key, float(timeout))
+        # the server blocks up to `timeout` for the key; the client allows
+        # that plus slack for the reply itself — so a DEAD server is
+        # distinguished from a key that simply never arrived
+        deadline = time.monotonic() + float(timeout) + rpc_slack()
+        v = self._rpc("get", key, float(timeout), op_deadline=deadline)
         if v is None:
-            raise TimeoutError(f"PyTCPStore.get({key!r}) timed out")
+            raise TimeoutError(f"PyTCPStore.get({key!r}) timed out after "
+                               f"{timeout}s (key never set)")
         return v
 
     def add(self, key, delta=1):
@@ -146,7 +290,7 @@ class PyTCPStore:
     def close(self):
         try:
             self._sock.close()
-        except Exception:
+        except OSError:
             pass
         if self._server is not None:
             self._server.shutdown()

@@ -1,0 +1,179 @@
+"""Heartbeat-based worker watchdog for the multiprocess SPMD path.
+
+A hung rank (deadlocked collective, wedged host callback, stuck input
+pipeline) is worse than a dead one: the job burns accelerator time forever
+with no error. Every rank runs a BEAT thread that bumps a per-rank counter
+in the coordination store; the monitor rank (rank 0 by default) runs a
+MONITOR thread that tracks when each peer's counter last changed and, once
+a peer has been silent past the miss budget, fails the job loudly with a
+diagnosis naming the stalled rank(s) — turning a silent hang into a
+restartable crash the elastic layer can recover from.
+
+Env knobs (wired by ``paddle_tpu.distributed.launch --heartbeat_interval``
+and read by ``maybe_start_from_env``):
+
+  PADDLE_HEARTBEAT_INTERVAL   seconds between beats (0/unset = disabled)
+  PADDLE_HEARTBEAT_MISS       beats a peer may miss before it is declared
+                              stalled (default 5; grace = interval * miss)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+def _default_on_stall(stalled: Dict[int, float], grace: float) -> None:
+    names = ", ".join(f"rank {r} (silent {age:.0f}s)"
+                      for r, age in sorted(stalled.items()))
+    print(
+        f"[watchdog] FATAL: {names} missed the heartbeat budget "
+        f"({grace:.0f}s) — the worker is hung (deadlocked collective or "
+        "wedged host loop), not dead. Failing the job so the supervisor "
+        "can relaunch from the last checkpoint.",
+        file=sys.stderr, flush=True)
+    # os._exit, not sys.exit: the monitor must take the process down even
+    # if the main thread is the thing that's wedged
+    os._exit(124)
+
+
+class HeartbeatWatchdog:
+    """Store-backed liveness monitor.
+
+    Every participant calls ``start()``; the ``monitor_rank`` additionally
+    watches all peers. ``stop()`` (or process exit — threads are daemons)
+    ends participation. The store must outlive the watchdog (it is the
+    launch rendezvous store, which the master rank owns)."""
+
+    def __init__(self, store, rank: int, world_size: int,
+                 interval: float = 5.0, miss: int = 5,
+                 label: str = "default", monitor_rank: int = 0,
+                 on_stall: Optional[Callable[[Dict[int, float], float], None]] = None):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.interval = float(interval)
+        self.miss = max(1, int(miss))
+        self.label = label
+        self.monitor_rank = int(monitor_rank)
+        self.on_stall = on_stall or _default_on_stall
+        self._stop = threading.Event()
+        self._threads = []
+        self._beats = 0
+
+    # -- wire format --------------------------------------------------------
+    def _key(self, rank: int) -> str:
+        return f"__hb/{self.label}/{rank}"
+
+    # -- beat side ----------------------------------------------------------
+    def _beat_loop(self):
+        while not self._stop.is_set():
+            self._beats += 1
+            try:
+                self.store.set(self._key(self.rank), str(self._beats))
+            except (ConnectionError, OSError, TimeoutError):
+                # the store died with the master; the job is coming down
+                # anyway — don't add a watchdog crash on top
+                return
+            self._stop.wait(self.interval)
+
+    # -- monitor side -------------------------------------------------------
+    def _read_peer(self, rank: int) -> Optional[bytes]:
+        try:
+            if not self.store.check(self._key(rank)):
+                return None
+            return self.store.get(self._key(rank), timeout=self.interval)
+        except (ConnectionError, OSError, TimeoutError):
+            return None
+
+    def _monitor_loop(self):
+        grace = self.interval * self.miss
+        last_value: Dict[int, Optional[bytes]] = {}
+        last_change: Dict[int, float] = {}
+        now = time.monotonic()
+        for r in range(self.world_size):
+            if r != self.rank:
+                last_value[r] = None
+                last_change[r] = now  # startup grace: clock starts now
+        while not self._stop.is_set():
+            self._stop.wait(self.interval)
+            if self._stop.is_set():
+                return
+            now = time.monotonic()
+            stalled: Dict[int, float] = {}
+            for r in last_value:
+                v = self._read_peer(r)
+                if v is not None and v != last_value[r]:
+                    last_value[r] = v
+                    last_change[r] = now
+                elif now - last_change[r] > grace:
+                    stalled[r] = now - last_change[r]
+            if stalled:
+                self.on_stall(stalled, grace)
+                return
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "HeartbeatWatchdog":
+        t = threading.Thread(target=self._beat_loop, daemon=True,
+                             name=f"hb-beat-{self.label}")
+        t.start()
+        self._threads.append(t)
+        if self.rank == self.monitor_rank and self.world_size > 1:
+            m = threading.Thread(target=self._monitor_loop, daemon=True,
+                                 name=f"hb-monitor-{self.label}")
+            m.start()
+            self._threads.append(m)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * self.interval)
+        self._threads = []
+
+
+_active: Optional[HeartbeatWatchdog] = None
+
+
+def maybe_start_from_env() -> Optional[HeartbeatWatchdog]:
+    """Start the watchdog when the launch CLI asked for one
+    (PADDLE_HEARTBEAT_INTERVAL > 0). The heartbeat store lives on the
+    rendezvous master's port + 2 (port + 1 is rank negotiation); the master
+    rank hosts it, everyone connects. Safe to call more than once."""
+    global _active
+    if _active is not None:
+        return _active
+    try:
+        interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "0"))
+    except ValueError:
+        return None
+    if interval <= 0:
+        return None
+    master = os.environ.get("PADDLE_MASTER")
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if not master or world_size < 2:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    miss = int(os.environ.get("PADDLE_HEARTBEAT_MISS", "5"))
+    host, port = master.rsplit(":", 1)
+    from . import TCPStore
+
+    store = TCPStore(host, int(port) + 2, is_master=(rank == 0),
+                     timeout=max(60.0, interval * miss))
+    _active = HeartbeatWatchdog(store, rank, world_size,
+                                interval=interval, miss=miss,
+                                label="spmd").start()
+    return _active
+
+
+def stop_active():
+    global _active
+    if _active is not None:
+        _active.stop()
+        try:
+            _active.store.close()
+        except Exception:
+            pass
+        _active = None

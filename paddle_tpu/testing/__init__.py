@@ -1,0 +1,9 @@
+"""paddle_tpu.testing — fault-injection and robustness test utilities.
+
+`paddle_tpu.testing.chaos` is the deterministic fault-injection harness
+(process kills, torn/corrupted checkpoint writes, store faults) driven by
+PADDLE_CHAOS_* env knobs; see docs/FAULT_TOLERANCE.md.
+"""
+from . import chaos
+
+__all__ = ["chaos"]

@@ -1,0 +1,241 @@
+"""Deterministic fault injection for crash-safety testing.
+
+The reference hardens its runtime against real fleet faults (killed pods,
+torn NFS writes, dead rendezvous peers); this harness injects those same
+faults ON DEMAND so the crash-safety guarantees in docs/FAULT_TOLERANCE.md
+are tested instead of hoped for. Everything is inert unless PADDLE_CHAOS=1,
+and every fault is deterministic given the seed knobs — a failing soak run
+reproduces byte-for-byte.
+
+Env knobs (all read lazily so tests can flip them per-case):
+
+  PADDLE_CHAOS=1                    master switch; nothing fires without it
+  PADDLE_CHAOS_SEED=<int>           rng seed (default 0), mixed with
+                                    PADDLE_TRAINER_ID so ranks draw
+                                    independent-but-reproducible streams
+  PADDLE_CHAOS_ONCE=0|1             faults fire only on the first launch
+                                    attempt (PADDLE_RESTART_COUNT==0);
+                                    default 1 so a relaunched worker runs
+                                    clean and the job converges
+  PADDLE_CHAOS_KILL_STEP=<k>        step_fence(k) delivers SIGKILL to self
+                                    (the `kill -9 ` mid-training fault)
+  PADDLE_CHAOS_CKPT_MODE=crash|torn|corrupt
+  PADDLE_CHAOS_CKPT_STEP=<k>        which step's save the checkpoint fault
+                                    applies to (default: every armed save)
+      crash   — SIGKILL between the checkpoint body write and its commit
+                (manifest + rename): simulates dying mid-save; only a
+                .ptsave-tmp dir is left, never a half `step_k/`
+      torn    — emulate the legacy non-atomic writer dying: the final
+                `step_k/` name appears WITHOUT a manifest and with one
+                file truncated, then SIGKILL; resume must skip it
+      corrupt — commit normally, then flip bytes in the largest data file
+                (manifest left stale): resume-time checksum verification
+                must reject it
+  PADDLE_CHAOS_STORE_DROP=<p>       per-op probability the client store
+                                    connection is dropped before send
+  PADDLE_CHAOS_STORE_LATENCY_MS=<ms>  artificial latency per store op
+
+The tear/corrupt helpers at the bottom are also callable directly from
+tests (no env needed) to manufacture damaged checkpoints.
+"""
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import signal
+import sys
+import time
+from typing import List, Optional, Tuple
+
+_rng: Optional[random.Random] = None
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = os.environ.get(name)
+    return default if v in (None, "") else v
+
+
+def enabled() -> bool:
+    return _env("PADDLE_CHAOS", "0") not in ("0", None)
+
+
+def attempt() -> int:
+    """Which launch attempt this process is (launch CLI exports
+    PADDLE_RESTART_COUNT to relaunched workers)."""
+    try:
+        return int(_env("PADDLE_RESTART_COUNT", "0"))
+    except ValueError:
+        return 0
+
+
+def armed() -> bool:
+    """Faults fire only when chaos is on AND (unless PADDLE_CHAOS_ONCE=0)
+    this is the first launch attempt — a relaunched worker must run clean
+    so kill-and-resume soaks converge."""
+    if not enabled():
+        return False
+    if _env("PADDLE_CHAOS_ONCE", "1") != "0" and attempt() != 0:
+        return False
+    return True
+
+
+def rng() -> random.Random:
+    """Per-process deterministic stream: seed mixed with the rank so every
+    rank draws an independent but reproducible fault schedule."""
+    global _rng
+    if _rng is None:
+        seed = int(_env("PADDLE_CHAOS_SEED", "0"))
+        rank = int(_env("PADDLE_TRAINER_ID", "0"))
+        _rng = random.Random((seed << 16) ^ (rank + 1))
+    return _rng
+
+
+def reset() -> None:
+    """Drop cached rng state (tests flipping env knobs mid-process)."""
+    global _rng
+    _rng = None
+
+
+def _log(msg: str) -> None:
+    print(f"[chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def _sigkill(why: str) -> None:
+    _log(f"{why} -> SIGKILL pid {os.getpid()}")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+# ---------------------------------------------------------------------------
+# Training-loop faults
+# ---------------------------------------------------------------------------
+def step_fence(step: int) -> None:
+    """Call once per training step; delivers the configured mid-training
+    `kill -9` when the step matches PADDLE_CHAOS_KILL_STEP."""
+    if not armed():
+        return
+    k = _env("PADDLE_CHAOS_KILL_STEP")
+    if k is not None and int(k) == step:
+        _sigkill(f"kill injected at train step {step}")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-commit faults (called by the atomic writer)
+# ---------------------------------------------------------------------------
+def _ckpt_mode_for(final_path: str) -> Optional[str]:
+    if not armed():
+        return None
+    mode = _env("PADDLE_CHAOS_CKPT_MODE")
+    if mode is None:
+        return None
+    want = _env("PADDLE_CHAOS_CKPT_STEP")
+    if want is not None:
+        tail = os.path.basename(os.path.normpath(final_path)).rsplit("_", 1)[-1]
+        if not (tail.isdigit() and int(tail) == int(want)):
+            return None
+    return mode
+
+
+def on_commit(tmp_path: str, final_path: str) -> None:
+    """Fault point BETWEEN the checkpoint body write and its commit
+    (manifest + atomic rename) — the window a real kill -9 tears."""
+    mode = _ckpt_mode_for(final_path)
+    if mode == "crash":
+        _sigkill(f"crash injected before commit of {final_path}")
+    elif mode == "torn":
+        # what the legacy non-atomic writer left behind: the final name
+        # exists, no commit record, one file cut short
+        if os.path.exists(final_path):
+            shutil.rmtree(final_path)
+        os.replace(tmp_path, final_path)
+        truncate_one_file(final_path)
+        _sigkill(f"torn write injected at {final_path}")
+
+
+def after_commit(final_path: str) -> None:
+    """Fault point after a successful commit: silent byte corruption."""
+    if _ckpt_mode_for(final_path) == "corrupt":
+        corrupt_checkpoint(final_path)
+        _log(f"corrupted one shard under {final_path}")
+
+
+# ---------------------------------------------------------------------------
+# Store faults (called by runtime/py_store.py)
+# ---------------------------------------------------------------------------
+def store_faults_enabled() -> bool:
+    return enabled() and (
+        _env("PADDLE_CHAOS_STORE_DROP") is not None
+        or _env("PADDLE_CHAOS_STORE_LATENCY_MS") is not None
+    )
+
+
+def store_latency() -> None:
+    ms = float(_env("PADDLE_CHAOS_STORE_LATENCY_MS", "0"))
+    if ms > 0 and armed():
+        time.sleep(ms / 1000.0)
+
+
+def store_should_drop() -> bool:
+    """Deterministically decide whether to sever the client connection
+    before this store op (the retry path must survive and re-issue)."""
+    p = float(_env("PADDLE_CHAOS_STORE_DROP", "0"))
+    return p > 0 and armed() and rng().random() < p
+
+
+# ---------------------------------------------------------------------------
+# Damage helpers — usable directly from tests, no env required
+# ---------------------------------------------------------------------------
+def _data_files(root: str) -> List[Tuple[int, str]]:
+    """(size, path) for every regular file under a checkpoint dir except
+    the commit manifest, largest first (deterministic tiebreak on path)."""
+    from ..distributed.checkpoint.manifest import MANIFEST_NAME
+
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if dirpath == root and fn == MANIFEST_NAME:
+                continue
+            full = os.path.join(dirpath, fn)
+            out.append((os.path.getsize(full), full))
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
+
+
+def truncate_one_file(root: str) -> Optional[str]:
+    """Cut the largest data file in half (a torn write)."""
+    files = _data_files(root)
+    if not files:
+        return None
+    size, path = files[0]
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    return path
+
+
+def corrupt_checkpoint(root: str, nbytes: int = 8) -> Optional[str]:
+    """Flip `nbytes` bytes in the middle of the largest data file, leaving
+    sizes (and the manifest) intact — only a checksum catches this."""
+    files = _data_files(root)
+    if not files:
+        return None
+    size, path = files[0]
+    off = max(0, size // 2 - nbytes)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(nbytes)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    return path
+
+
+def tear_checkpoint(root: str) -> None:
+    """Make a committed checkpoint look like a mid-save kill under the
+    legacy writer: commit record gone, largest file truncated."""
+    from ..distributed.checkpoint.manifest import manifest_path
+
+    try:
+        os.remove(manifest_path(root))
+    except OSError:
+        pass
+    truncate_one_file(root)

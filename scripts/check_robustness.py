@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Static robustness gate for the coordination-critical runtime layers.
+
+Scans ``paddle_tpu/runtime`` and ``paddle_tpu/distributed/launch`` and
+rejects two classes of hang/mask bugs that code review keeps re-admitting:
+
+  1. bare ``except:`` — swallows KeyboardInterrupt/SystemExit and masks the
+     very faults the crash-safety layer is supposed to surface;
+  2. unbounded ``socket.recv`` — any file that calls ``.recv(...)`` must
+     also call ``.settimeout(...)`` somewhere: a recv with no deadline on a
+     dead peer is an eternal silent hang (the failure mode the py_store
+     hardening exists to rule out).
+
+Exit status 0 = clean, 1 = violations (printed one per line as
+``path:line: message``). Runs under plain CPython — no third-party deps —
+so it can gate CI before any test spins up a backend.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = [
+    os.path.join("paddle_tpu", "runtime"),
+    os.path.join("paddle_tpu", "distributed", "launch"),
+]
+
+
+def _py_files(root):
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check_file(path: str):
+    """Yield (line, message) violations for one file."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+
+    recv_calls = []
+    has_settimeout = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield (node.lineno,
+                   "bare 'except:' — catch specific exceptions; a blanket "
+                   "handler masks faults and eats KeyboardInterrupt")
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "recv":
+                recv_calls.append(node.lineno)
+            elif node.func.attr in ("settimeout", "create_connection"):
+                # create_connection(timeout=...) also bounds the socket
+                has_settimeout = True
+    if recv_calls and not has_settimeout:
+        for line in recv_calls:
+            yield (line,
+                   "socket.recv without any settimeout in this file — an "
+                   "unbounded recv on a dead peer hangs forever; set a "
+                   "deadline (see py_store._recv_msg)")
+
+
+def main(argv=None):
+    root = (argv or sys.argv[1:] or [REPO])[0]
+    violations = []
+    for path in _py_files(root):
+        rel = os.path.relpath(path, root)
+        for line, msg in check_file(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} robustness violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,426 @@
+"""Crash-safety pipeline tests: atomic checkpoint commit, torn/corrupt
+fallback in ElasticManager.resume, bounded retention, store deadlines and
+retries, rendezvous diagnostics, hung-rank watchdog, chaos determinism.
+
+The slow end-to-end kill -9 soak lives in test_chaos_soak.py (marked
+slow+chaos); everything here is in-process and tier-1."""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import manifest
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.testing import chaos
+
+from conftest import free_port
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _make_model(seed=0):
+    paddle.seed(seed)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    return model, opt
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+    y = paddle.to_tensor(rng.standard_normal((8, 4)).astype("float32"))
+    return x, y
+
+
+def _train(model, opt, steps, mgr=None, start=0):
+    x, y = _data()
+    step_fn = TrainStep(model, lambda m, a, b: ((m(a) - b) ** 2).mean(), opt)
+    losses = []
+    for step in range(start, steps):
+        losses.append(float(step_fn(x, y)))
+        if mgr is not None:
+            mgr.maybe_save(step, model, opt)
+    return losses
+
+
+def _state_arrays(model):
+    return {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+
+
+# ---------------------------------------------------------------------------
+# manifest / atomic commit
+# ---------------------------------------------------------------------------
+class TestManifest:
+    def test_commit_roundtrip(self, tmp_path):
+        root = tmp_path / "c"
+        (root / "d").mkdir(parents=True)
+        (root / "a.bin").write_bytes(b"x" * 1000)
+        (root / "d" / "b.bin").write_bytes(b"y" * 50)
+        manifest.write_manifest(str(root))
+        assert manifest.is_complete(str(root))
+        ok, why = manifest.verify(str(root), deep=True)
+        assert ok, why
+
+    def test_truncation_detected_shallow(self, tmp_path):
+        root = tmp_path / "c"
+        root.mkdir()
+        (root / "a.bin").write_bytes(b"x" * 1000)
+        manifest.write_manifest(str(root))
+        chaos.truncate_one_file(str(root))
+        ok, why = manifest.verify(str(root), deep=False)
+        assert not ok and "size" in why
+
+    def test_corruption_detected_only_deep(self, tmp_path):
+        root = tmp_path / "c"
+        root.mkdir()
+        (root / "a.bin").write_bytes(b"x" * 1000)
+        manifest.write_manifest(str(root))
+        chaos.corrupt_checkpoint(str(root))
+        assert manifest.verify(str(root), deep=False)[0]  # sizes intact
+        ok, why = manifest.verify(str(root), deep=True)
+        assert not ok and "checksum" in why
+
+    def test_missing_manifest_is_incomplete(self, tmp_path):
+        root = tmp_path / "c"
+        root.mkdir()
+        (root / "a.bin").write_bytes(b"x")
+        assert not manifest.is_complete(str(root))
+
+    def test_save_is_atomic(self, tmp_path):
+        model, opt = _make_model()
+        path = str(tmp_path / "snap")
+        ckpt.save_state_dict(model.state_dict(), path)
+        assert ckpt.is_complete_checkpoint(path)
+        assert not any(ckpt.TMP_SUFFIX in n for n in os.listdir(tmp_path))
+
+    def test_async_save_commits_on_wait(self, tmp_path):
+        model, opt = _make_model()
+        path = str(tmp_path / "snap")
+        pending = ckpt.save_state_dict(model.state_dict(), path, async_save=True)
+        pending.wait_until_finished()
+        assert ckpt.is_complete_checkpoint(path)
+        pending.wait_until_finished()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# ElasticManager: torn/corrupt fallback, retention
+# ---------------------------------------------------------------------------
+class TestElasticResume:
+    def test_torn_dir_skipped_and_trajectory_matches(self, tmp_path):
+        """Satellite (c): a torn step_N is skipped, step_{N-1} restores, and
+        the post-resume loss trajectory matches an uninterrupted run."""
+        total = 8
+        ref_model, ref_opt = _make_model(seed=0)
+        ref_losses = _train(ref_model, ref_opt, total)
+
+        work = str(tmp_path / "ck")
+        model, opt = _make_model(seed=0)
+        mgr = ElasticManager(work, save_interval=2, max_to_keep=10)
+        crash_losses = _train(model, opt, 6, mgr=mgr)  # saves at 1,3,5
+        assert crash_losses == ref_losses[:6]
+        # the newest checkpoint (step_5) was torn by a mid-save kill
+        chaos.tear_checkpoint(os.path.join(work, "step_5"))
+
+        model2, opt2 = _make_model(seed=1)  # different init: restore must win
+        start = ElasticManager(work).resume(model2, opt2)
+        assert start == 4  # step_3 + 1, torn step_5 skipped
+        resumed = _train(model2, opt2, total, start=start)
+        np.testing.assert_array_equal(
+            np.asarray(resumed), np.asarray(ref_losses[start:]))
+
+    def test_corrupt_checkpoint_falls_back(self, tmp_path, capsys):
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=2, max_to_keep=10)
+        _train(model, opt, 6, mgr=mgr)  # saves at 1,3,5
+        chaos.corrupt_checkpoint(os.path.join(work, "step_5"))
+
+        model2, opt2 = _make_model(seed=1)
+        start = ElasticManager(work).resume(model2, opt2)
+        assert start == 4  # checksum rejects step_5, step_3 restores
+
+    def test_all_damaged_raises(self, tmp_path):
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=2, max_to_keep=10)
+        _train(model, opt, 4, mgr=mgr)  # saves at 1,3
+        chaos.corrupt_checkpoint(os.path.join(work, "step_1"))
+        chaos.corrupt_checkpoint(os.path.join(work, "step_3"))
+        model2, opt2 = _make_model(seed=1)
+        with pytest.raises(RuntimeError, match="refusing"):
+            ElasticManager(work).resume(model2, opt2)
+
+    def test_fresh_start_when_no_checkpoints(self, tmp_path):
+        model, opt = _make_model()
+        assert ElasticManager(str(tmp_path / "empty")).resume(model, opt) == 0
+
+    def test_torn_only_is_fresh_start(self, tmp_path):
+        """A job killed during its very first save has no committed state:
+        resume() must start from scratch, not raise."""
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=2, max_to_keep=10)
+        _train(model, opt, 2, mgr=mgr)  # saves at 1
+        chaos.tear_checkpoint(os.path.join(work, "step_1"))
+        model2, opt2 = _make_model(seed=1)
+        assert ElasticManager(work).resume(model2, opt2) == 0
+
+    def test_retention_bounded_and_keeps_newest(self, tmp_path):
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=1, max_to_keep=2)
+        _train(model, opt, 5, mgr=mgr)
+        assert sorted(mgr._complete_steps()) == [3, 4]
+
+    def test_max_to_keep_zero_keeps_last(self, tmp_path):
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=1, max_to_keep=0)
+        _train(model, opt, 3, mgr=mgr)
+        assert sorted(mgr._complete_steps()) == [2]
+
+    def test_retention_never_counts_torn_dirs(self, tmp_path):
+        """Torn dirs don't crowd out committed ones in the keep-count."""
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=1, max_to_keep=2)
+        _train(model, opt, 2, mgr=mgr)  # saves 0,1
+        chaos.tear_checkpoint(os.path.join(work, "step_1"))
+        _train(model, opt, 3, mgr=mgr)  # saves 0,1,2 again (0,2 fresh)
+        complete = sorted(mgr._complete_steps())
+        assert len(complete) == 2 and 2 in complete
+
+    def test_tmp_leftovers_swept(self, tmp_path):
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=1, max_to_keep=2)
+        os.makedirs(os.path.join(work, "step_9" + ckpt.TMP_SUFFIX))
+        _train(model, opt, 2, mgr=mgr)
+        assert not any(ckpt.TMP_SUFFIX in n for n in os.listdir(work))
+
+    def test_async_back_to_back_and_resume(self, tmp_path):
+        work = str(tmp_path / "ck")
+        model, opt = _make_model()
+        mgr = ElasticManager(work, save_interval=1, async_save=True, max_to_keep=2)
+        losses = _train(model, opt, 5, mgr=mgr)
+        mgr.flush()
+        assert sorted(mgr._complete_steps()) == [3, 4]
+        model2, opt2 = _make_model(seed=1)
+        assert ElasticManager(work).resume(model2, opt2) == 5
+        np.testing.assert_array_equal(
+            model2.weight.numpy(), model.weight.numpy())
+
+
+# ---------------------------------------------------------------------------
+# py_store: deadlines, backoff, retry
+# ---------------------------------------------------------------------------
+class TestStoreDeadlines:
+    def test_connect_backoff_names_endpoint(self, monkeypatch):
+        from paddle_tpu.runtime import py_store
+
+        monkeypatch.setenv("PADDLE_STORE_RETRY_BASE", "0.01")
+        port = free_port()  # nothing listening
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match=rf"{port}.*attempts"):
+            py_store.PyTCPStore("127.0.0.1", port, is_master=False, timeout=0.5)
+        assert time.monotonic() - t0 < 10
+
+    def test_dead_server_recv_times_out(self, monkeypatch):
+        """A server that accepts but never replies must become a
+        TimeoutError naming the op — not an eternal recv."""
+        from paddle_tpu.runtime import py_store
+
+        monkeypatch.setenv("PADDLE_STORE_OP_TIMEOUT", "0.5")
+        monkeypatch.setenv("PADDLE_STORE_RPC_SLACK", "0.3")
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        conns = []
+        threading.Thread(
+            target=lambda: [conns.append(srv.accept()[0]) for _ in range(4)],
+            daemon=True).start()
+        try:
+            store = py_store.PyTCPStore(
+                "127.0.0.1", srv.getsockname()[1], is_master=False, timeout=2.0)
+            with pytest.raises(TimeoutError, match=r"check\('k'\)"):
+                store.check("k")
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError, match="get"):
+                store.get("k", timeout=0.2)
+            assert time.monotonic() - t0 < 5  # 0.2s server + 0.3s slack
+        finally:
+            srv.close()
+            for c in conns:
+                c.close()
+
+    def test_get_timeout_names_key(self):
+        from paddle_tpu.runtime import py_store
+
+        store = py_store.PyTCPStore("127.0.0.1", free_port(), is_master=True,
+                                    timeout=5.0)
+        try:
+            with pytest.raises(TimeoutError, match="never_set"):
+                store.get("never_set", timeout=0.2)
+        finally:
+            store.close()
+
+    def test_idempotent_ops_survive_reconnect(self):
+        from paddle_tpu.runtime import py_store
+
+        store = py_store.PyTCPStore("127.0.0.1", free_port(), is_master=True,
+                                    timeout=5.0)
+        try:
+            store.set("k", b"v")
+            store._sock.close()  # simulate a dropped connection
+            assert store.get("k", timeout=2.0) == b"v"
+        finally:
+            store.close()
+
+    def test_chaos_drop_retried(self, monkeypatch):
+        from paddle_tpu.runtime import py_store
+
+        monkeypatch.setenv("PADDLE_CHAOS", "1")
+        monkeypatch.setenv("PADDLE_CHAOS_STORE_DROP", "1.0")
+        chaos.reset()
+        store = py_store.PyTCPStore("127.0.0.1", free_port(), is_master=True,
+                                    timeout=5.0)
+        try:
+            store.set("k", b"v")  # dropped, reconnected, re-issued
+            assert store.get("k", timeout=2.0) == b"v"
+        finally:
+            store.close()
+            chaos.reset()
+
+
+class TestHandshakeDiagnosis:
+    def test_master_names_missing_rank(self, monkeypatch):
+        from paddle_tpu.runtime import TCPStore
+
+        monkeypatch.setenv("PADDLE_STORE_FORCE_PY", "1")
+        monkeypatch.setenv("PADDLE_STORE_RPC_SLACK", "0.3")
+        store = TCPStore("127.0.0.1", free_port(), is_master=True, timeout=5.0)
+        try:
+            with pytest.raises(TimeoutError, match="rank 1 of 2 never arrived"):
+                store.asymmetric_handshake("ns", 0, 2, timeout=0.3)
+        finally:
+            store.close()
+
+    def test_client_names_master(self, monkeypatch):
+        from paddle_tpu.runtime import TCPStore
+
+        monkeypatch.setenv("PADDLE_STORE_FORCE_PY", "1")
+        monkeypatch.setenv("PADDLE_STORE_RPC_SLACK", "0.3")
+        store = TCPStore("127.0.0.1", free_port(), is_master=True, timeout=5.0)
+        try:
+            with pytest.raises(TimeoutError, match="master.*rank 0"):
+                store.asymmetric_handshake("ns", 1, 2, timeout=0.3)
+        finally:
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+class TestWatchdog:
+    def _store(self):
+        from paddle_tpu.runtime import py_store
+
+        return py_store.PyTCPStore("127.0.0.1", free_port(), is_master=True,
+                                   timeout=5.0)
+
+    def test_stalled_peer_detected(self):
+        from paddle_tpu.runtime.watchdog import HeartbeatWatchdog
+
+        store = self._store()
+        stalls = []
+        monitor = HeartbeatWatchdog(
+            store, rank=0, world_size=2, interval=0.1, miss=3,
+            on_stall=lambda s, g: stalls.append(s)).start()
+        # rank 1 beats a few times, then "hangs" (beats stop)
+        peer = HeartbeatWatchdog(store, rank=1, world_size=2, interval=0.1)
+        peer.start()
+        time.sleep(0.4)
+        peer.stop()
+        deadline = time.monotonic() + 5
+        while not stalls and time.monotonic() < deadline:
+            time.sleep(0.05)
+        monitor.stop()
+        store.close()
+        assert stalls and 1 in stalls[0]
+
+    def test_live_peers_not_flagged(self):
+        from paddle_tpu.runtime.watchdog import HeartbeatWatchdog
+
+        store = self._store()
+        stalls = []
+        monitor = HeartbeatWatchdog(
+            store, rank=0, world_size=2, interval=0.1, miss=3,
+            on_stall=lambda s, g: stalls.append(s)).start()
+        peer = HeartbeatWatchdog(store, rank=1, world_size=2, interval=0.1)
+        peer.start()
+        time.sleep(1.0)
+        assert not stalls
+        peer.stop()
+        monitor.stop()
+        store.close()
+
+    def test_env_disabled_by_default(self, monkeypatch):
+        from paddle_tpu.runtime import watchdog
+
+        monkeypatch.delenv("PADDLE_HEARTBEAT_INTERVAL", raising=False)
+        assert watchdog.maybe_start_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# chaos harness determinism
+# ---------------------------------------------------------------------------
+class TestChaosHarness:
+    def test_inert_without_master_switch(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_CHAOS", raising=False)
+        monkeypatch.setenv("PADDLE_CHAOS_KILL_STEP", "0")
+        chaos.step_fence(0)  # must NOT kill: master switch off
+        assert not chaos.enabled() and not chaos.armed()
+
+    def test_disarmed_on_relaunch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_CHAOS", "1")
+        monkeypatch.setenv("PADDLE_RESTART_COUNT", "1")
+        monkeypatch.setenv("PADDLE_CHAOS_KILL_STEP", "0")
+        chaos.step_fence(0)  # attempt 1: fault must not re-fire
+        assert chaos.enabled() and not chaos.armed()
+
+    def test_rng_deterministic_per_seed_and_rank(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_CHAOS", "1")
+        monkeypatch.setenv("PADDLE_CHAOS_SEED", "7")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        chaos.reset()
+        a = [chaos.rng().random() for _ in range(5)]
+        chaos.reset()
+        b = [chaos.rng().random() for _ in range(5)]
+        assert a == b
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        chaos.reset()
+        c = [chaos.rng().random() for _ in range(5)]
+        assert a != c  # ranks draw independent streams
+        chaos.reset()
+
+    def test_damage_helpers_no_env(self, tmp_path):
+        root = tmp_path / "c"
+        root.mkdir()
+        (root / "big.bin").write_bytes(b"z" * 4096)
+        (root / "small.bin").write_bytes(b"q" * 16)
+        manifest.write_manifest(str(root))
+        hit = chaos.corrupt_checkpoint(str(root))
+        assert hit.endswith("big.bin")  # largest data file targeted
+        assert os.path.getsize(hit) == 4096  # sizes intact
+        chaos.tear_checkpoint(str(root))
+        assert not manifest.is_complete(str(root))
+        assert os.path.getsize(str(root / "big.bin")) == 2048

@@ -1,0 +1,64 @@
+"""The static robustness gate (scripts/check_robustness.py) — both that
+the live tree is clean and that the checker actually catches what it
+claims to catch."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_robustness.py")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import check_robustness  # noqa: E402
+
+
+def test_live_tree_is_clean():
+    proc = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                          text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def _violations(tmp_path, src):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_file(str(f)))
+
+
+def test_bare_except_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        try:
+            x = 1
+        except:
+            pass
+    """)
+    assert len(v) == 1 and "bare" in v[0][1]
+
+
+def test_typed_except_allowed(tmp_path):
+    assert not _violations(tmp_path, """
+        try:
+            x = 1
+        except (OSError, ValueError):
+            pass
+    """)
+
+
+def test_unbounded_recv_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        def f(sock):
+            return sock.recv(4096)
+    """)
+    assert len(v) == 1 and "recv" in v[0][1]
+
+
+def test_recv_with_deadline_allowed(tmp_path):
+    assert not _violations(tmp_path, """
+        def f(sock):
+            sock.settimeout(5.0)
+            return sock.recv(4096)
+    """)
