@@ -13,6 +13,7 @@ total: every op in the framework is auto-parallel by construction.
 """
 from __future__ import annotations
 
+import sys
 from typing import List, Optional, Sequence, Union
 
 import jax
@@ -320,7 +321,8 @@ class Engine:
                 loss = step_fn(*batch)
                 history["loss"].append(float(np.asarray(raw(loss))))
                 if verbose and i % log_freq == 0:
-                    print(f"[Engine] epoch {epoch} step {i} loss {history['loss'][-1]:.5f}")
+                    print(f"[Engine] epoch {epoch} step {i} loss "
+                          f"{history['loss'][-1]:.5f}", file=sys.stderr)
                 if steps_per_epoch is not None and i + 1 >= steps_per_epoch:
                     break
         return history

@@ -15,11 +15,13 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from ... import observability as _obs
 from ...framework.core import Tensor
 from ...framework.op import raw
 from . import manifest as _manifest
@@ -30,26 +32,80 @@ from . import manifest as _manifest
 TMP_SUFFIX = ".ptsave-tmp"
 
 
+def _exportable(arr):
+    """Orbax-serializable view of one array. In a multiprocess runtime
+    orbax's type handler rejects fully-addressable ("host local")
+    jax.Arrays — it only accepts single-process arrays or global multihost
+    arrays — so per-rank local state is exported as numpy instead. Global
+    (cross-host sharded) arrays pass through untouched."""
+    if (jax.process_count() > 1 and isinstance(arr, jax.Array)
+            and arr.is_fully_addressable):
+        return np.asarray(arr)
+    return arr
+
+
 def _to_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
     out = {}
     for k, v in state_dict.items():
         if isinstance(v, Tensor):
-            out[k] = raw(v)
+            out[k] = _exportable(raw(v))
         elif isinstance(v, dict):
             out[k] = _to_arrays(v)
         elif isinstance(v, np.generic):
             # orbax's StandardCheckpointHandler accepts ndarray but not
             # numpy scalar types (np.int64 et al. fail its type check)
             out[k] = np.asarray(v)
+        elif isinstance(v, jax.Array):
+            out[k] = _exportable(v)
         else:
             out[k] = v
     return out
 
 
+def _mp_options():
+    """Per-process orbax multiprocessing config.
+
+    Every caller here saves its OWN (host-local) state dict to its OWN
+    path — the elastic per-rank layout — so in a multiprocess runtime each
+    rank must be its own primary with private barriers. Orbax's default
+    (primary host 0, global barriers) would never finalize rank>0's
+    checkpoint and would deadlock rank 0 unless every rank saved in
+    lockstep."""
+    import orbax.checkpoint as ocp
+
+    if jax.process_count() <= 1:
+        return ocp.options.MultiprocessingOptions()
+    me = jax.process_index()
+    return ocp.options.MultiprocessingOptions(
+        primary_host=None, active_processes={me},
+        barrier_sync_key_prefix=f"rank{me}")
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
-    return ocp.StandardCheckpointer()
+    return ocp.StandardCheckpointer(multiprocessing_options=_mp_options())
+
+
+def _dir_bytes(root: str) -> int:
+    total = 0
+    for dirpath, _, filenames in os.walk(root):
+        for fn in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+    return total
+
+
+def _record_save(path: str, seconds: float) -> None:
+    if not _obs.enabled():
+        return  # skip the directory walk entirely when telemetry is off
+    nbytes = _dir_bytes(path)
+    _obs.observe("checkpoint_save_seconds", seconds)
+    _obs.inc("checkpoint_save_bytes_total", nbytes)
+    _obs.event("checkpoint_save", path=path, seconds=round(seconds, 6),
+               bytes=nbytes)
 
 
 class _AtomicCommit:
@@ -81,9 +137,10 @@ class PendingSave:
     write. Duck-compatible with the orbax async handle the previous API
     returned."""
 
-    def __init__(self, ckptr, commit: _AtomicCommit):
+    def __init__(self, ckptr, commit: _AtomicCommit, t0: Optional[float] = None):
         self._ckptr = ckptr
         self._commit = commit
+        self._t0 = t0
         self.done = False
         self.path = commit.final
 
@@ -98,6 +155,10 @@ class PendingSave:
         self._commit.run()
         self.done = True
         self._ckptr.close()
+        if self._t0 is not None:
+            # async duration = save() call through commit: the window the
+            # checkpoint was in flight, which is what overlap tuning needs
+            _record_save(self.path, time.perf_counter() - self._t0)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -115,15 +176,20 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     """
     import orbax.checkpoint as ocp
 
+    t0 = time.perf_counter()
     path = os.path.abspath(path)
     arrays = _to_arrays(state_dict)
     if not atomic:
         if async_save:
-            ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+            # legacy raw-orbax handle: no commit hook to time against
+            ckptr = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler(),
+                multiprocessing_options=_mp_options())
             ckptr.save(path, args=ocp.args.StandardSave(arrays), force=True)
             return ckptr
         with _checkpointer() as ckptr:
             ckptr.save(path, arrays, force=True)
+        _record_save(path, time.perf_counter() - t0)
         return None
 
     tmp = path + TMP_SUFFIX
@@ -131,12 +197,15 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         shutil.rmtree(tmp)
     commit = _AtomicCommit(tmp, path)
     if async_save:
-        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        ckptr = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler(),
+            multiprocessing_options=_mp_options())
         ckptr.save(tmp, args=ocp.args.StandardSave(arrays), force=True)
-        return PendingSave(ckptr, commit)
+        return PendingSave(ckptr, commit, t0=t0)
     with _checkpointer() as ckptr:
         ckptr.save(tmp, arrays, force=True)
     commit.run()
+    _record_save(path, time.perf_counter() - t0)
     return None
 
 
@@ -168,10 +237,13 @@ def load_state_dict(
     """
     import orbax.checkpoint as ocp
 
+    t0 = time.perf_counter()
     path = os.path.abspath(path)
     if state_dict is None:
         with _checkpointer() as ckptr:
-            return ckptr.restore(path)
+            out = ckptr.restore(path)
+        _record_restore(path, time.perf_counter() - t0)
+        return out
 
     arrays = _to_arrays(state_dict)
     target = jax.tree_util.tree_map(
@@ -184,8 +256,20 @@ def load_state_dict(
         restored = ckptr.restore(path, target)
     for k, v in state_dict.items():
         if isinstance(v, Tensor) and k in restored:
-            v._rebind(restored[k])
+            r = restored[k]
+            if not isinstance(r, jax.Array):
+                # multiprocess local state round-trips through numpy (see
+                # _exportable); re-place it on the live tensor's devices
+                r = jax.device_put(np.asarray(r),
+                                   getattr(raw(v), "sharding", None))
+            v._rebind(r)
+    _record_restore(path, time.perf_counter() - t0)
     return state_dict
+
+
+def _record_restore(path: str, seconds: float) -> None:
+    _obs.observe("checkpoint_restore_seconds", seconds)
+    _obs.event("checkpoint_restore", path=path, seconds=round(seconds, 6))
 
 
 save = save_state_dict

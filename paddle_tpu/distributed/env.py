@@ -26,6 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import observability as _obs
 from . import mesh as _mesh
 
 
@@ -159,6 +160,16 @@ def init_parallel_env() -> Group:
         from ..runtime.watchdog import maybe_start_from_env
 
         maybe_start_from_env()
+        if _obs.enabled():
+            _obs.event("init_parallel_env", coordinator=coord,
+                       world_size=int(nproc), process_id=int(pid or 0),
+                       local_devices=jax.local_device_count())
+            # ranks that never call fleet_sync themselves still contribute
+            # to fleet_metrics.json on a clean exit
+            import atexit
+
+            from ..observability.fleet import fleet_sync_atexit
+            atexit.register(fleet_sync_atexit)
     world = list(range(len(jax.devices())))
     _default_group = Group(world, axis_names=None, name="world")
     _groups.append(_default_group)

@@ -30,6 +30,7 @@ import shutil
 import sys
 from typing import Dict, Optional
 
+from ... import observability as _obs
 from ..checkpoint import (
     TMP_SUFFIX,
     is_complete_checkpoint,
@@ -190,6 +191,7 @@ class ElasticManager:
                           "back to previous complete checkpoint",
                           file=sys.stderr)
                     failures.append((step, why))
+                    _obs.inc("elastic_resume_fallback_total")
                     continue
             try:
                 canonical = restore_canonical(path, model, optimizer)
@@ -199,9 +201,13 @@ class ElasticManager:
                       "falling back to previous complete checkpoint",
                       file=sys.stderr)
                 failures.append((step, repr(e)))
+                _obs.inc("elastic_resume_fallback_total")
                 continue
             if extra_out is not None and os.path.isdir(self._extra_dir(step)):
                 extra_out.update(load_state_dict(self._extra_dir(step)))
+            _obs.inc("elastic_resume_total")
+            _obs.event("elastic_resume", step=step, next_step=step + 1,
+                       torn=torn, fallbacks=len(failures), path=path)
             return step + 1
         if failures:
             raise RuntimeError(

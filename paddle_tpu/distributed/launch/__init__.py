@@ -23,6 +23,8 @@ import subprocess
 import sys
 import time
 
+from ... import observability as _obs
+
 
 def build_parser():
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
@@ -126,6 +128,9 @@ def _supervise(cmd, env, max_restarts: int, backoff: float) -> int:
                 return 128 - rc if rc < 0 else rc
             attempt += 1
             delay = min(backoff * (2 ** (attempt - 1)), 30.0)
+            _obs.inc("elastic_relaunch_total")
+            _obs.event("worker_relaunch", rc=rc, attempt=attempt,
+                       max_restarts=max_restarts, backoff=round(delay, 3))
             print(
                 f"[launch] worker exited rc={rc}; relaunching "
                 f"({attempt}/{max_restarts}) in {delay:.1f}s — training "

@@ -11,6 +11,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
+
 
 class Callback:
     def __init__(self):
@@ -240,6 +242,68 @@ class VisualDL(Callback):
         self._step += 1
 
 
+class TelemetryLogger(Callback):
+    """Feeds per-step training telemetry into ``paddle_tpu.observability``:
+    tokens/sec and estimated MFU gauges plus one ``train_step`` JSONL event
+    per batch. Auto-appended by ``config_callbacks`` and a no-op (one env
+    lookup per batch) unless ``PADDLE_TPU_TELEMETRY_DIR`` is set.
+
+    MFU uses ``logs["step_flops"]`` (XLA cost analysis, supplied by
+    ``Model.fit``) against ``PADDLE_TPU_PEAK_FLOPS`` (the accelerator's
+    peak FLOP/s); without the env var only the achieved-FLOP/s gauge is
+    exported.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._t0 = None
+
+    def on_train_begin(self, logs=None):
+        _obs.event("train_run", phase="begin",
+                   epochs=self.params.get("epochs"),
+                   steps=self.params.get("steps"))
+
+    def on_train_end(self, logs=None):
+        _obs.event("train_run", phase="end")
+        _obs.flush()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter() if _obs.enabled() else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        self._t0 = None
+        logs = logs or {}
+        fields = {"step": int(step), "seconds": round(dt, 6)}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple, np.ndarray)):
+            loss = loss[0] if len(loss) else None
+        try:
+            fields["loss"] = float(loss)
+        except (TypeError, ValueError):
+            pass
+        bs = logs.get("batch_size")
+        if bs:
+            tps = float(bs) / dt
+            _obs.set_gauge("train_tokens_per_second", tps)
+            fields["tokens_per_second"] = round(tps, 3)
+        flops = logs.get("step_flops")
+        if flops:
+            fps = float(flops) / dt
+            _obs.set_gauge("train_flops_per_second", fps)
+            try:
+                peak = float(os.environ.get("PADDLE_TPU_PEAK_FLOPS", "0") or 0)
+            except ValueError:
+                peak = 0.0
+            if peak > 0:
+                mfu = fps / peak
+                _obs.set_gauge("train_mfu", mfu)
+                fields["mfu"] = round(mfu, 6)
+        _obs.event("train_step", **fields)
+
+
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, log_freq=2, verbose=2, save_freq=1, save_dir=None,
                      metrics=None, mode="train"):
@@ -251,6 +315,8 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks = cbks + [LRScheduler()]
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(c, TelemetryLogger) for c in cbks):
+        cbks = cbks + [TelemetryLogger()]
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({

@@ -12,6 +12,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from . import observability as _obs
 from .framework.core import Tensor, no_grad
 from .framework.op import raw
 from .hapi import callbacks as _cb
@@ -30,6 +31,7 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self._train_step = None
+        self._step_flops = None  # None = not probed, False = unavailable
         self.stop_training = False
 
     # ------------------------------------------------------------- prepare --
@@ -154,6 +156,9 @@ class Model:
                 losses = self.train_batch(xs, ys)
                 logs["loss"] = losses[0]
                 logs["batch_size"] = (raw(xs[0]).shape[0] if xs else batch_size)
+                flops = self._probe_step_flops(xs, ys)
+                if flops:
+                    logs["step_flops"] = flops
                 cbks.on_batch_end("train", step, logs)
                 step_count += 1
                 if num_iters is not None and step_count >= num_iters:
@@ -164,6 +169,19 @@ class Model:
             cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
         return self
+
+    def _probe_step_flops(self, xs, ys):
+        """FLOPs of one compiled train step (XLA cost analysis), probed once
+        after the first batch and only when telemetry is on — feeds the MFU
+        gauge in callbacks.TelemetryLogger."""
+        if self._step_flops is None and _obs.enabled() \
+                and self._train_step is not None:
+            try:
+                cost = self._train_step.cost_analysis(*xs, *ys)
+                self._step_flops = float(cost.get("flops", 0.0)) or False
+            except Exception:
+                self._step_flops = False
+        return self._step_flops or None
 
     def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)) and len(batch) >= 2:

@@ -16,12 +16,14 @@ DyGraph — §3.1 step 5 — disappears; XLA schedules the whole step).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..framework import rng as _rng
 from ..framework.core import Tensor, TraceHostSyncError, no_grad
 from ..framework.op import raw
@@ -205,12 +207,21 @@ class TracedLayer:
             len(state_vals),
         )
         entry = self._cache.get(key)
+        miss_t0 = None
         if entry is None:
+            # cache miss = an XLA (re)compile; the jit wrapper is lazy, so
+            # the timer must span the first jitted call below too
+            miss_t0 = time.perf_counter()
             entry = self._compile(treedef, arr_idx, tensor_flags, static_part, state, is_buffer)
             self._cache[key] = entry
         jitted, out_tree_box = entry
         rng_key = _rng.next_key()
         outs_flat, new_state = jitted(state_vals, arr_vals, rng_key)
+        if miss_t0 is not None:
+            _obs.record_compile(
+                "to_static", time.perf_counter() - miss_t0,
+                signature=f"{getattr(self._fn, '__qualname__', self._fn)} "
+                          f"cache_size={len(self._cache)}")
         for t, v, buf in zip(state, new_state, is_buffer):
             t._value = v
         out_tree = out_tree_box[0]
@@ -333,6 +344,7 @@ class TrainStep:
         extraction, cache get-or-compile, rng draw, and the write-back of
         params/buffers/optimizer states. Returns the jitted fn's first
         output (loss scalar or per-step losses)."""
+        t0 = time.perf_counter()
         params = self._params
         buffers = self._buffers + self._extra_params
         p_vals = [p._value for p in params]
@@ -340,7 +352,8 @@ class TrainStep:
         opt_states = self._opt.functional_states()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         jitted = self._cache.get(key)
-        if jitted is None:
+        miss = jitted is None
+        if miss:
             jitted = build()
             self._cache[key] = jitted
         rng_key = _rng.next_key()
@@ -351,6 +364,14 @@ class TrainStep:
         for b, v in zip(buffers, new_b):
             b._value = v
         self._opt.load_functional_states(new_st)
+        dt = time.perf_counter() - t0
+        if miss:
+            # compile steps are tracked separately so they don't pollute
+            # the steady-state step-time distribution
+            _obs.record_compile("train_step", dt,
+                                signature=f"{type(self).__name__} {key!r}")
+        else:
+            _obs.observe("train_step_seconds", dt)
         return out
 
     def _place_batch(self, batch_vals):

@@ -1,0 +1,102 @@
+"""Registered metric names and event kinds — the telemetry vocabulary.
+
+Every metric recorded from the coordination-critical layers
+(``paddle_tpu/runtime``, ``paddle_tpu/distributed``, ``paddle_tpu/testing``)
+MUST be declared here; ``scripts/check_observability.py`` enforces it
+statically (literal names only, kind must match the recording call). The
+point is grep-ability: an operator reading a dashboard can find every
+call site of a metric by its registered name, and two subsystems cannot
+accidentally export the same name with different meanings.
+
+Naming convention:
+  * lowercase snake_case (``metrics.NAME_RE``);
+  * counters end in ``_total`` (or ``_bytes_total`` for byte counts);
+  * histograms/gauges carry their unit as a suffix (``_seconds``,
+    ``_bytes``);
+  * the exporter prefixes everything with ``paddle_tpu_`` — names here are
+    unprefixed.
+
+This module is imported by ``scripts/check_observability.py`` directly from
+its file path, so it must stay dependency-free (stdlib only, no package
+imports).
+"""
+
+#: name -> (kind, help). Kind is one of counter | gauge | histogram.
+METRICS = {
+    # -- XLA compilation (jit cache misses) ---------------------------------
+    "xla_compile_total": (
+        "counter",
+        "XLA compilations = jit cache misses (labels: where)"),
+    "xla_compile_seconds": (
+        "histogram",
+        "Wall time of each cache-miss step: trace + compile + first run"),
+    # -- training loop ------------------------------------------------------
+    "train_step_seconds": (
+        "histogram", "Per-step wall time measured at the train-step dispatch"),
+    "train_tokens_per_second": (
+        "gauge", "Input elements consumed per second (last step)"),
+    "train_flops_per_second": (
+        "gauge", "Achieved FLOP/s from XLA cost analysis (last step)"),
+    "train_mfu": (
+        "gauge",
+        "Estimated model FLOPs utilization vs PADDLE_TPU_PEAK_FLOPS"),
+    # -- checkpointing ------------------------------------------------------
+    "checkpoint_save_seconds": (
+        "histogram", "Checkpoint save wall time, body write through commit"),
+    "checkpoint_save_bytes_total": (
+        "counter", "Total bytes committed to checkpoints"),
+    "checkpoint_restore_seconds": (
+        "histogram", "Checkpoint restore wall time"),
+    # -- coordination store -------------------------------------------------
+    "store_op_seconds": (
+        "histogram", "py_store client op latency (labels: op)"),
+    "store_op_retry_total": (
+        "counter", "Idempotent store ops re-issued after a dropped "
+                   "connection (labels: op)"),
+    "store_reconnect_total": (
+        "counter", "Client store reconnects (backoff dials)"),
+    "store_connect_attempts_total": (
+        "counter", "Failed store connect attempts during backoff"),
+    # -- watchdog / liveness ------------------------------------------------
+    "heartbeat_age_seconds": (
+        "gauge", "Seconds since a rank's heartbeat last advanced "
+                 "(labels: rank)"),
+    "watchdog_poll_age_seconds": (
+        "histogram", "Observed heartbeat ages per watchdog poll "
+                     "(labels: rank)"),
+    "heartbeat_beats_total": (
+        "counter", "Heartbeats published by this rank"),
+    # -- elastic / relaunch -------------------------------------------------
+    "elastic_relaunch_total": (
+        "counter", "Worker relaunches by the launch supervisor"),
+    "elastic_resume_total": (
+        "counter", "Successful ElasticManager.resume restores"),
+    "elastic_resume_fallback_total": (
+        "counter", "Checkpoints skipped during resume (torn/corrupt/failed)"),
+    # -- chaos --------------------------------------------------------------
+    "chaos_fault_total": (
+        "counter", "Faults injected by the chaos harness (labels: fault)"),
+}
+
+#: JSONL event kinds (the `kind` field of every event log record).
+EVENTS = {
+    "xla_compile",        # a jit cache miss compiled a new executable
+    "train_step",         # one training step (hapi TelemetryLogger)
+    "train_run",          # fit() begin/end
+    "checkpoint_save",    # a checkpoint commit (path, seconds, bytes)
+    "checkpoint_restore",  # a checkpoint restore
+    "elastic_resume",     # ElasticManager.resume decision (step, fallbacks)
+    "worker_relaunch",    # launch supervisor relaunched a dead worker
+    "watchdog_start",     # heartbeat watchdog came up on this rank
+    "rank_stalled",       # watchdog diagnosed a silent rank
+    "chaos_fault",        # the chaos harness injected a fault
+    "store_connect_failed",  # store dial exhausted its backoff budget
+    "init_parallel_env",  # multiprocess runtime bootstrap
+    "fleet_aggregate",    # rank 0 merged fleet snapshots
+}
+
+
+def metric_kind(name: str):
+    """Declared kind for a registered name, or None."""
+    entry = METRICS.get(name)
+    return entry[0] if entry else None

@@ -1,0 +1,223 @@
+"""Fleet-wide metric aggregation over the coordination store.
+
+Every rank publishes its registry snapshot as JSON under a well-known store
+key; rank 0 collects all of them, computes per-metric min/max/mean across
+ranks, flags stragglers, and writes one ``fleet_metrics.json`` under the
+telemetry dir — the first place cross-rank skew ("which rank is lagging?")
+becomes visible without attaching a debugger to every host.
+
+The store is the same TCPStore family the launch rendezvous uses; the
+telemetry instance lives on the rendezvous master's port + 3 (port + 1 is
+rank negotiation, + 2 the heartbeat watchdog), hosted by rank 0. A store
+handed in explicitly (e.g. an application's own) is used as-is and never
+closed here.
+
+``fleet_sync`` is tolerant by design: a rank that died before publishing
+shows up in ``missing_ranks`` instead of failing the merge, and peers that
+cannot reach rank 0 (it may already have exited) log and return rather
+than raise — telemetry must never take down a job that was otherwise
+finishing cleanly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+#: mean step-time above fleet mean by this fraction flags a straggler
+STRAGGLER_THRESHOLD = 1.2
+
+#: histograms compared rank-to-rank for straggler diagnosis
+_STRAGGLER_METRICS = ("train_step_seconds",)
+
+_store = None  # cached telemetry store (rank 0 hosts; binding twice fails)
+_synced = False
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# merge (pure; unit-testable without processes)
+# ---------------------------------------------------------------------------
+def _scalar_views(metric_name: str, data: dict):
+    """(label_str, scalar) pairs used for cross-rank aggregation: counter/
+    gauge values directly, histogram means."""
+    if data["type"] in ("counter", "gauge"):
+        return list(data.get("values", {}).items())
+    return [(ls, s["mean"]) for ls, s in data.get("series", {}).items()]
+
+
+def merge_snapshots(snaps: Dict[int, dict], world_size: int) -> dict:
+    """Merge per-rank snapshots (as returned by ``observability.snapshot``)
+    into the fleet_metrics document. Pure function — no store, no files."""
+    aggregate: dict = {}
+    for r, snap in sorted(snaps.items()):
+        for name, data in snap.get("metrics", {}).items():
+            for label_str, value in _scalar_views(name, data):
+                slot = aggregate.setdefault(name, {}).setdefault(
+                    label_str, {"per_rank": {}})
+                slot["per_rank"][str(r)] = value
+    for name, by_label in aggregate.items():
+        for label_str, slot in by_label.items():
+            vals = slot["per_rank"]
+            nums = {r: v for r, v in vals.items()
+                    if isinstance(v, (int, float))}
+            if not nums:
+                continue
+            lo_r = min(nums, key=nums.get)
+            hi_r = max(nums, key=nums.get)
+            slot.update(
+                min=nums[lo_r], max=nums[hi_r],
+                mean=sum(nums.values()) / len(nums),
+                min_rank=int(lo_r), max_rank=int(hi_r))
+
+    stragglers = []
+    for name in _STRAGGLER_METRICS:
+        for label_str, slot in aggregate.get(name, {}).items():
+            mean = slot.get("mean")
+            if mean is None or mean <= 0 or len(slot["per_rank"]) < 2:
+                continue
+            for r, v in slot["per_rank"].items():
+                if v > mean * STRAGGLER_THRESHOLD:
+                    stragglers.append({
+                        "rank": int(r), "metric": name, "labels": label_str,
+                        "mean_seconds": v, "fleet_mean_seconds": mean,
+                        "slowdown": v / mean})
+    stragglers.sort(key=lambda s: -s["slowdown"])
+
+    return {
+        "schema": 1,
+        "ts": round(time.time(), 6),
+        "world_size": int(world_size),
+        "missing_ranks": sorted(set(range(world_size)) -
+                                {int(r) for r in snaps}),
+        "stragglers": stragglers,
+        "aggregate": aggregate,
+        "ranks": {str(r): snap for r, snap in sorted(snaps.items())},
+    }
+
+
+def _write_fleet_metrics(doc: dict) -> str:
+    from . import telemetry_dir
+
+    d = telemetry_dir()
+    path = os.path.join(d, "fleet_metrics.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(d, exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# store plumbing
+# ---------------------------------------------------------------------------
+def _default_store(rank: int, timeout: float):
+    """The dedicated telemetry store (master port + 3), cached per process."""
+    global _store
+    if _store is not None:
+        return _store
+    master = os.environ.get("PADDLE_MASTER")
+    if not master:
+        return None
+    host, port = master.rsplit(":", 1)
+    from ..runtime import TCPStore
+
+    _store = TCPStore(host, int(port) + 3, is_master=(rank == 0),
+                      timeout=timeout)
+    return _store
+
+
+def fleet_sync(store=None, rank: Optional[int] = None,
+               world_size: Optional[int] = None, timeout: float = 60.0,
+               label: str = "default") -> Optional[str]:
+    """Publish this rank's snapshot; rank 0 merges and writes
+    ``fleet_metrics.json``. Returns the written path on rank 0 (and in
+    single-process runs), else None. No-op when telemetry is off.
+
+    Call near the end of training on EVERY rank (or rely on the atexit hook
+    ``init_parallel_env`` installs). Rank 0 waits up to ``timeout`` for each
+    peer's snapshot; absent peers land in ``missing_ranks``. Peers wait for
+    rank 0's done-marker so the file is committed before any rank returns.
+    """
+    global _synced
+    from . import enabled, event, flush, snapshot
+
+    if not enabled():
+        return None
+    if rank is None:
+        rank = _env_int("PADDLE_TRAINER_ID", 0)
+    if world_size is None:
+        world_size = _env_int("PADDLE_TRAINERS_NUM", 1)
+    flush()  # the per-rank prom textfile rides along with every sync
+    local = snapshot()
+    if world_size < 2:
+        path = _write_fleet_metrics(merge_snapshots({rank: local}, 1))
+        _synced = True
+        return path
+
+    if store is None:
+        try:
+            store = _default_store(rank, timeout)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            print(f"[telemetry] rank {rank}: fleet store unreachable ({e!r});"
+                  " skipping fleet aggregation", file=sys.stderr)
+            return None
+        if store is None:
+            return None
+    try:
+        store.set(f"__telemetry/{label}/snap/{rank}",
+                  json.dumps(local).encode())
+        path = None
+        if rank == 0:
+            snaps = {0: local} if rank == 0 else {}
+            for r in range(world_size):
+                if r == rank:
+                    continue
+                try:
+                    raw = store.get(f"__telemetry/{label}/snap/{r}", timeout)
+                    snaps[r] = json.loads(raw)
+                except (TimeoutError, ConnectionError, OSError,
+                        ValueError) as e:
+                    print(f"[telemetry] rank {r} never published a snapshot "
+                          f"({e!r}); aggregating without it",
+                          file=sys.stderr)
+            doc = merge_snapshots(snaps, world_size)
+            path = _write_fleet_metrics(doc)
+            event("fleet_aggregate", ranks=sorted(snaps),
+                  missing=doc["missing_ranks"],
+                  stragglers=len(doc["stragglers"]), path=path)
+            store.set(f"__telemetry/{label}/done", b"1")
+        else:
+            try:
+                store.wait(f"__telemetry/{label}/done", timeout)
+            except (TimeoutError, ConnectionError, OSError):
+                pass  # rank 0 died or is slow; our snapshot is published
+        _synced = True
+        return path
+    except (ConnectionError, OSError, TimeoutError) as e:
+        print(f"[telemetry] rank {rank}: fleet sync failed ({e!r})",
+              file=sys.stderr)
+        return None
+
+
+def fleet_sync_atexit() -> None:
+    """Best-effort exit-time sync (installed by init_parallel_env when
+    telemetry is on); skipped when an explicit fleet_sync already ran."""
+    if _synced:
+        return
+    timeout = float(os.environ.get("PADDLE_TPU_TELEMETRY_SYNC_TIMEOUT",
+                                   "20") or 20)
+    try:
+        fleet_sync(timeout=timeout)
+    except Exception as e:  # exit path: diagnose, never mask the exit code
+        print(f"[telemetry] exit-time fleet sync failed: {e!r}",
+              file=sys.stderr)

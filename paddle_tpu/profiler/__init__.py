@@ -16,10 +16,11 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import json
 import os
 import time
 from enum import Enum
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable, List, Optional, Union
 
 import jax
 
@@ -63,12 +64,56 @@ def make_scheduler(*, closed: int = 0, ready: int = 0, record: int = 1, repeat: 
     return scheduler
 
 
+class SortedKeys(Enum):
+    """paddle.profiler.SortedKeys parity (host-timer subset)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    Calls = 2
+    Name = 3
+
+
+#: string aliases accepted anywhere a SortedKeys is (paddle passes enums;
+#: ad-hoc scripts pass strings)
+_SORT_ALIASES = {
+    "total": SortedKeys.CPUTotal,
+    "avg": SortedKeys.CPUAvg,
+    "count": SortedKeys.Calls,
+    "calls": SortedKeys.Calls,
+    "name": SortedKeys.Name,
+}
+
+
+def _resolve_sort(sorted_by) -> SortedKeys:
+    if sorted_by is None:
+        return SortedKeys.CPUTotal
+    if isinstance(sorted_by, SortedKeys):
+        return sorted_by
+    key = _SORT_ALIASES.get(str(sorted_by).lower())
+    if key is None:
+        raise ValueError(
+            f"summary(sorted_by={sorted_by!r}): expected a SortedKeys or one "
+            f"of {sorted(_SORT_ALIASES)}")
+    return key
+
+
+_TIME_UNITS = {"s": 1.0, "ms": 1e3, "us": 1e6}
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready factory: keep the XPlane/trace files under dir_name."""
+    """on_trace_ready factory: keep the XPlane/trace files under dir_name;
+    `worker_name` prefixes the host-trace file (``{worker}_host_trace.json``)
+    so multi-worker runs exporting into a shared dir don't clobber each
+    other. The config is also applied at Profiler construction (via the
+    attribute below) — the host trace is written during ``_stop_trace``,
+    BEFORE the on_trace_ready callback fires."""
 
     def handler(prof):
         prof._export_dir = dir_name
+        if worker_name:
+            prof._worker_name = worker_name
 
+    handler._export_config = (dir_name, worker_name)
     return handler
 
 
@@ -120,6 +165,14 @@ class RecordEvent:
 _host_events = collections.defaultdict(lambda: [0, 0.0])  # name -> [count, secs]
 
 
+def reset_host_events() -> None:
+    """Clear the process-global RecordEvent aggregator. The aggregator is
+    deliberately process-wide (mirrors the reference's global host tracer),
+    so back-to-back Profiler runs — and test cases — must reset it between
+    runs or the second summary() reports the first run's counts too."""
+    _host_events.clear()
+
+
 class Profiler:
     def __init__(
         self,
@@ -142,16 +195,35 @@ class Profiler:
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
         self._export_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._worker_name = None
+        # export_chrome_tracing carries its config on the handler: apply it
+        # NOW, not at stop() — the host trace file is written in
+        # _stop_trace, before the on_trace_ready callback runs
+        cfg = getattr(on_trace_ready, "_export_config", None)
+        if cfg is not None:
+            self._export_dir = cfg[0]
+            self._worker_name = cfg[1]
         self._step = 0
         self._tracing = False
         self._step_times = []
         self._last_step_t = None
+        #: every scheduler state as applied, in order — step 0's state first
+        #: (tests pin the sequence against make_scheduler's)
+        self._state_history: List[ProfilerState] = []
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         self._last_step_t = time.perf_counter()
-        if not self._timer_only and self._scheduler is None:
+        if self._timer_only:
+            return self
+        if self._scheduler is None:
             self._start_trace()
+        else:
+            # the scheduler's step-0 state applies to the FIRST step, which
+            # runs between start() and the first step() call — consulting
+            # only inside step() (post-increment) skipped it entirely and
+            # shifted skip_first by one
+            self._apply_state(self._scheduler(self._step))
         return self
 
     def stop(self):
@@ -168,12 +240,15 @@ class Profiler:
         self._last_step_t = now
         self._step += 1
         if self._scheduler is not None and not self._timer_only:
-            state = self._scheduler(self._step)
-            if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
-                if not self._tracing:
-                    self._start_trace()
-            elif self._tracing:
-                self._stop_trace()
+            self._apply_state(self._scheduler(self._step))
+
+    def _apply_state(self, state: ProfilerState):
+        self._state_history.append(state)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if not self._tracing:
+                self._start_trace()
+        elif self._tracing:
+            self._stop_trace()
 
     def _start_trace(self):
         _runtime.trace_start()
@@ -198,10 +273,10 @@ class Profiler:
         # files (reference: chrometracing_logger.cc output).
         events = _runtime.trace_export()
         if events:
-            import json
-
+            fname = (f"{self._worker_name}_host_trace.json"
+                     if self._worker_name else "host_trace.json")
             os.makedirs(self._export_dir, exist_ok=True)
-            with open(os.path.join(self._export_dir, "host_trace.json"), "w") as f:
+            with open(os.path.join(self._export_dir, fname), "w") as f:
                 json.dump({"traceEvents": events}, f)
 
     def __enter__(self):
@@ -213,17 +288,39 @@ class Profiler:
 
     # -- reporting ----------------------------------------------------------
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        """Host-timer report. `sorted_by` orders the event table
+        (SortedKeys or "total"/"avg"/"count"/"name"; default total time,
+        descending); `time_unit` is one of "s"/"ms"/"us"."""
+        unit = str(time_unit).lower()
+        if unit not in _TIME_UNITS:
+            raise ValueError(
+                f"summary(time_unit={time_unit!r}): expected one of "
+                f"{sorted(_TIME_UNITS)}")
+        scale = _TIME_UNITS[unit]
+        key = _resolve_sort(sorted_by)
         lines = ["-- paddle_tpu profiler summary " + "-" * 30]
         if self._step_times:
             ts = self._step_times
             lines.append(
-                f"steps: {len(ts)}  avg: {sum(ts) / len(ts) * 1e3:.2f} ms  "
-                f"min: {min(ts) * 1e3:.2f} ms  max: {max(ts) * 1e3:.2f} ms"
+                f"steps: {len(ts)}  avg: {sum(ts) / len(ts) * scale:.2f} {unit}  "
+                f"min: {min(ts) * scale:.2f} {unit}  max: {max(ts) * scale:.2f} {unit}"
             )
         if _host_events:
-            lines.append(f"{'event':40s} {'count':>8s} {'total ms':>12s}")
-            for name, (cnt, secs) in sorted(_host_events.items(), key=lambda kv: -kv[1][1]):
-                lines.append(f"{name:40s} {cnt:8d} {secs * 1e3:12.2f}")
+            items = list(_host_events.items())
+            if key is SortedKeys.Name:
+                items.sort(key=lambda kv: kv[0])
+            elif key is SortedKeys.Calls:
+                items.sort(key=lambda kv: (-kv[1][0], kv[0]))
+            elif key is SortedKeys.CPUAvg:
+                items.sort(key=lambda kv: (-kv[1][1] / max(kv[1][0], 1), kv[0]))
+            else:
+                items.sort(key=lambda kv: (-kv[1][1], kv[0]))
+            lines.append(f"{'event':40s} {'count':>8s} "
+                         f"{'total ' + unit:>12s} {'avg ' + unit:>12s}")
+            for name, (cnt, secs) in items:
+                lines.append(
+                    f"{name:40s} {cnt:8d} {secs * scale:12.2f} "
+                    f"{secs * scale / max(cnt, 1):12.2f}")
         if self._tracing or os.path.isdir(self._export_dir):
             lines.append(f"device trace (XPlane): {self._export_dir}")
         out = "\n".join(lines)
@@ -249,4 +346,66 @@ def stop_profiler(*a, **k):
     jax.profiler.stop_trace()
 
 
-load_profiler_result = None  # chrome-trace reload: covered by TensorBoard/xprof
+class ProfilerResult:
+    """Programmatic view of an exported host chrome trace
+    (``host_trace.json`` / ``{worker}_host_trace.json``).
+
+    The device-side XPlane files stay in TensorBoard/xprof territory; this
+    covers the host RecordEvent timeline — enough for tests and scripted
+    assertions ("did my_region run 5 times and stay under 2ms?")."""
+
+    def __init__(self, path: str, events: List[dict]):
+        self.path = path
+        #: raw chrome-trace event dicts (name/ph/ts/dur in microseconds)
+        self.events = events
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def names(self) -> List[str]:
+        return sorted({e.get("name") for e in self.events if e.get("name")})
+
+    def _named(self, name: str) -> List[dict]:
+        return [e for e in self.events if e.get("name") == name]
+
+    def count(self, name: str) -> int:
+        return len(self._named(name))
+
+    def total_duration(self, name: str) -> float:
+        """Summed duration of complete ("ph": "X") events, microseconds."""
+        return float(sum(e.get("dur", 0) for e in self._named(name)
+                         if e.get("ph", "X") == "X"))
+
+    def time_range(self) -> Optional[tuple]:
+        """(first_ts, last_end_ts) over all events, microseconds."""
+        spans = [(e["ts"], e["ts"] + e.get("dur", 0))
+                 for e in self.events if "ts" in e]
+        if not spans:
+            return None
+        return min(s for s, _ in spans), max(e for _, e in spans)
+
+
+def load_profiler_result(file_path: str) -> ProfilerResult:
+    """Reload an exported host trace for programmatic assertions
+    (paddle.profiler.load_profiler_result parity, host-trace scope).
+
+    Accepts the JSON file itself or the export directory — in a directory,
+    ``host_trace.json`` is preferred, else the lexicographically first
+    ``*_host_trace.json`` (worker-named exports)."""
+    path = file_path
+    if os.path.isdir(path):
+        default = os.path.join(path, "host_trace.json")
+        if os.path.isfile(default):
+            path = default
+        else:
+            named = sorted(n for n in os.listdir(path)
+                           if n.endswith("_host_trace.json"))
+            if not named:
+                raise FileNotFoundError(
+                    f"load_profiler_result({file_path!r}): no "
+                    "host_trace.json or *_host_trace.json in directory")
+            path = os.path.join(file_path, named[0])
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    return ProfilerResult(path, list(events))

@@ -41,6 +41,8 @@ import struct
 import threading
 import time
 
+from .. import observability as _obs
+
 
 def _knob(name: str, default: float) -> float:
     v = os.environ.get(name)
@@ -181,6 +183,9 @@ def _connect_with_backoff(host, port, timeout, why="store"):
         attempt += 1
         remaining = deadline - time.monotonic()
         if remaining <= 0:
+            _obs.event("store_connect_failed", host=host, port=port, why=why,
+                       attempts=attempt - 1, timeout=timeout,
+                       last_error=repr(last_err))
             raise ConnectionError(
                 f"PyTCPStore: cannot reach {why} at {host}:{port} after "
                 f"{attempt - 1} attempts over {timeout:.1f}s "
@@ -193,6 +198,7 @@ def _connect_with_backoff(host, port, timeout, why="store"):
             return sock
         except OSError as e:
             last_err = e
+            _obs.inc("store_connect_attempts_total")
             # full jitter: sleep U(0, delay), then grow the ceiling
             time.sleep(min(random.uniform(0, delay), max(0.0, remaining)))
             delay = min(delay * 2, cap)
@@ -223,6 +229,7 @@ class PyTCPStore:
             self._sock.close()
         except OSError:
             pass
+        _obs.inc("store_reconnect_total")
         self._sock = _connect_with_backoff(self._host, self.port, self.timeout)
 
     def _rpc(self, cmd, key, arg=None, op_deadline=None):
@@ -234,6 +241,7 @@ class PyTCPStore:
         if op_deadline is None:
             op_deadline = time.monotonic() + op_timeout()
         chaos = _chaos()
+        t0 = time.perf_counter()
         with self._lock:
             if chaos is not None:
                 chaos.store_latency()
@@ -247,7 +255,10 @@ class PyTCPStore:
             for retry in (False, True):
                 try:
                     _send_msg(self._sock, (cmd, key, arg), op_deadline, what)
-                    return _recv_msg(self._sock, op_deadline, what)
+                    resp = _recv_msg(self._sock, op_deadline, what)
+                    _obs.observe("store_op_seconds",
+                                 time.perf_counter() - t0, op=cmd)
+                    return resp
                 except (ConnectionError, OSError) as e:
                     if isinstance(e, TimeoutError):
                         raise
@@ -255,6 +266,7 @@ class PyTCPStore:
                         raise ConnectionError(
                             f"PyTCPStore: {what} failed ({e!r}) and is not "
                             "retryable") from e
+                    _obs.inc("store_op_retry_total", op=cmd)
                     self._reconnect()
 
     def set(self, key, value):

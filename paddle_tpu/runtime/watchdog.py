@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from .. import observability as _obs
+
 
 def _default_on_stall(stalled: Dict[int, float], grace: float) -> None:
     names = ", ".join(f"rank {r} (silent {age:.0f}s)"
@@ -69,6 +71,7 @@ class HeartbeatWatchdog:
 
     # -- beat side ----------------------------------------------------------
     def _beat_loop(self):
+        last_beat = time.monotonic()
         while not self._stop.is_set():
             self._beats += 1
             try:
@@ -77,6 +80,16 @@ class HeartbeatWatchdog:
                 # the store died with the master; the job is coming down
                 # anyway — don't add a watchdog crash on top
                 return
+            now = time.monotonic()
+            # self-observed age: every rank exports its own liveness series
+            # (the monitor only sees PEERS, and only runs on one rank)
+            _obs.inc("heartbeat_beats_total")
+            _obs.set_gauge("heartbeat_age_seconds", now - last_beat,
+                           rank=self.rank)
+            _obs.observe("watchdog_poll_age_seconds", now - last_beat,
+                         rank=self.rank)
+            _obs.flush()  # keep the prom textfile live while training runs
+            last_beat = now
             self._stop.wait(self.interval)
 
     # -- monitor side -------------------------------------------------------
@@ -110,12 +123,25 @@ class HeartbeatWatchdog:
                     last_change[r] = now
                 elif now - last_change[r] > grace:
                     stalled[r] = now - last_change[r]
+                age = now - last_change[r]
+                _obs.set_gauge("heartbeat_age_seconds", age, rank=r)
+                _obs.observe("watchdog_poll_age_seconds", age, rank=r)
             if stalled:
+                # diagnosis + final export BEFORE on_stall: the default
+                # handler os._exit()s, which skips atexit hooks
+                _obs.event("rank_stalled",
+                           stalled={str(r): round(a, 3)
+                                    for r, a in stalled.items()},
+                           grace=grace, monitor_rank=self.rank)
+                _obs.flush()
                 self.on_stall(stalled, grace)
                 return
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "HeartbeatWatchdog":
+        _obs.event("watchdog_start", interval=self.interval, miss=self.miss,
+                   world_size=self.world_size, label=self.label,
+                   monitor=(self.rank == self.monitor_rank))
         t = threading.Thread(target=self._beat_loop, daemon=True,
                              name=f"hb-beat-{self.label}")
         t.start()

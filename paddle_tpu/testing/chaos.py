@@ -48,6 +48,8 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
+from .. import observability as _obs
+
 _rng: Optional[random.Random] = None
 
 
@@ -101,6 +103,15 @@ def _log(msg: str) -> None:
     print(f"[chaos] {msg}", file=sys.stderr, flush=True)
 
 
+def _fault(fault: str, **fields) -> None:
+    """Record an injected fault in the telemetry stream (counter + JSONL
+    event), so soak runs yield an auditable fault-vs-recovery timeline.
+    The event write is unbuffered append — it survives the SIGKILL that
+    usually follows."""
+    _obs.inc("chaos_fault_total", fault=fault)
+    _obs.event("chaos_fault", fault=fault, attempt=attempt(), **fields)
+
+
 def _sigkill(why: str) -> None:
     _log(f"{why} -> SIGKILL pid {os.getpid()}")
     os.kill(os.getpid(), signal.SIGKILL)
@@ -116,6 +127,7 @@ def step_fence(step: int) -> None:
         return
     k = _env("PADDLE_CHAOS_KILL_STEP")
     if k is not None and int(k) == step:
+        _fault("kill_step", step=step)
         _sigkill(f"kill injected at train step {step}")
 
 
@@ -141,6 +153,7 @@ def on_commit(tmp_path: str, final_path: str) -> None:
     (manifest + atomic rename) — the window a real kill -9 tears."""
     mode = _ckpt_mode_for(final_path)
     if mode == "crash":
+        _fault("ckpt_crash", path=final_path)
         _sigkill(f"crash injected before commit of {final_path}")
     elif mode == "torn":
         # what the legacy non-atomic writer left behind: the final name
@@ -149,6 +162,7 @@ def on_commit(tmp_path: str, final_path: str) -> None:
             shutil.rmtree(final_path)
         os.replace(tmp_path, final_path)
         truncate_one_file(final_path)
+        _fault("ckpt_torn", path=final_path)
         _sigkill(f"torn write injected at {final_path}")
 
 
@@ -156,6 +170,7 @@ def after_commit(final_path: str) -> None:
     """Fault point after a successful commit: silent byte corruption."""
     if _ckpt_mode_for(final_path) == "corrupt":
         corrupt_checkpoint(final_path)
+        _fault("ckpt_corrupt", path=final_path)
         _log(f"corrupted one shard under {final_path}")
 
 
@@ -179,7 +194,10 @@ def store_should_drop() -> bool:
     """Deterministically decide whether to sever the client connection
     before this store op (the retry path must survive and re-issue)."""
     p = float(_env("PADDLE_CHAOS_STORE_DROP", "0"))
-    return p > 0 and armed() and rng().random() < p
+    drop = p > 0 and armed() and rng().random() < p
+    if drop:
+        _fault("store_drop")
+    return drop
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Static observability gate for the coordination-critical layers.
+
+Scans ``paddle_tpu/runtime``, ``paddle_tpu/distributed``,
+``paddle_tpu/testing`` and ``paddle_tpu/observability`` and rejects two
+classes of telemetry rot:
+
+  1. bare ``print(...)`` (no ``file=`` keyword) — stdout belongs to the
+     user's program; runtime/distributed diagnostics must go to stderr
+     (``print(..., file=sys.stderr)``) or, better, through
+     ``paddle_tpu.observability.event``;
+  2. unregistered or mistyped metric names — every recording call through
+     the observability facade (``_obs.inc/set_gauge/observe/event``) must
+     pass a STRING-LITERAL first argument that is declared in
+     ``paddle_tpu/observability/catalog.py`` with a matching kind
+     (inc→counter, set_gauge→gauge, observe→histogram, event→EVENTS).
+     Literal names keep every dashboard series grep-able to its call
+     sites; the kind check stops two subsystems from exporting one name
+     with two meanings.
+
+Exit status 0 = clean, 1 = violations (printed one per line as
+``path:line: message``). Runs under plain CPython — the catalog is loaded
+straight from its file path, so no paddle_tpu (or jax) import happens.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = [
+    os.path.join("paddle_tpu", "runtime"),
+    os.path.join("paddle_tpu", "distributed"),
+    os.path.join("paddle_tpu", "testing"),
+    os.path.join("paddle_tpu", "observability"),
+]
+
+#: module aliases the facade is imported under at instrumented call sites
+OBS_ALIASES = {"_obs", "obs", "observability"}
+
+#: facade recorder -> required catalog kind (None = EVENTS set)
+RECORDERS = {
+    "inc": "counter",
+    "set_gauge": "gauge",
+    "observe": "histogram",
+    "event": None,
+}
+
+
+def _load_catalog(root):
+    """Load observability/catalog.py from its FILE PATH — importing the
+    paddle_tpu package would pull jax into a linter."""
+    path = os.path.join(root, "paddle_tpu", "observability", "catalog.py")
+    spec = importlib.util.spec_from_file_location("_obs_catalog", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _py_files(root):
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check_file(path: str, catalog):
+    """Yield (line, message) violations for one file. `catalog` is the
+    loaded catalog module (METRICS dict + EVENTS set)."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # rule 1: bare print to stdout
+        if isinstance(func, ast.Name) and func.id == "print":
+            if not any(kw.arg == "file" for kw in node.keywords):
+                yield (node.lineno,
+                       "bare print() — runtime/distributed layers must not "
+                       "write to stdout; use print(..., file=sys.stderr) or "
+                       "observability.event(...)")
+            continue
+        # rule 2: facade recorders take registered literal names
+        if not (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in OBS_ALIASES
+                and func.attr in RECORDERS):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            yield (node.lineno,
+                   f"{func.value.id}.{func.attr}(...) with a non-literal "
+                   "name — metric/event names must be string literals so "
+                   "every series is grep-able to its call sites")
+            continue
+        name = first.value
+        kind = RECORDERS[func.attr]
+        if kind is None:
+            if name not in catalog.EVENTS:
+                yield (node.lineno,
+                       f"event kind {name!r} is not registered in "
+                       "observability/catalog.py EVENTS")
+        else:
+            declared = catalog.METRICS.get(name)
+            if declared is None:
+                yield (node.lineno,
+                       f"metric {name!r} is not registered in "
+                       "observability/catalog.py METRICS")
+            elif declared[0] != kind:
+                yield (node.lineno,
+                       f"metric {name!r} is declared as a {declared[0]} but "
+                       f"recorded via .{func.attr} (needs a {kind})")
+
+
+def main(argv=None):
+    root = (argv or sys.argv[1:] or [REPO])[0]
+    catalog = _load_catalog(root if os.path.isdir(
+        os.path.join(root, "paddle_tpu")) else REPO)
+    violations = []
+    for path in _py_files(root):
+        rel = os.path.relpath(path, root)
+        for line, msg in check_file(path, catalog):
+            violations.append(f"{rel}:{line}: {msg}")
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} observability violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

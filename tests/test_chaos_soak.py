@@ -11,6 +11,7 @@ is exact, not approximate.
 Marked slow+chaos: each case boots ~2 fresh interpreters; run with
     pytest tests/test_chaos_soak.py --runslow
 """
+import json
 import os
 import subprocess
 import sys
@@ -127,6 +128,42 @@ def test_kill_during_save_never_restores_damage(tmp_path, mode):
         if name.startswith("step_"):
             ok, why = manifest.verify(os.path.join(ckpt, name), deep=True)
             assert ok, f"{name} left damaged but discoverable: {why}"
+
+
+def test_chaos_faults_land_in_telemetry(tmp_path):
+    """S4 of docs/OBSERVABILITY.md: with telemetry on, an injected kill
+    leaves an auditable ``chaos_fault`` event in the victim's JSONL — the
+    unbuffered append survives the SIGKILL that follows it — and the
+    supervisor's ``worker_relaunch`` + the resumed worker's
+    ``elastic_resume`` land after it, yielding the fault-vs-recovery
+    timeline."""
+    tdir = tmp_path / "telemetry"
+    ref, _, _ = _run(tmp_path, "tel_ref")
+    got, _, proc = _run(
+        tmp_path, "tel",
+        chaos_env={
+            "PADDLE_CHAOS": "1",
+            "PADDLE_CHAOS_SEED": "7",
+            "PADDLE_CHAOS_KILL_STEP": "4",
+            "PADDLE_TPU_TELEMETRY_DIR": str(tdir),
+        })
+    assert "SIGKILL" in proc.stderr
+    _assert_bitwise_equal(got, ref)
+
+    lines = (tdir / "events_rank0.jsonl").read_text().splitlines()
+    evs = [json.loads(l) for l in lines if l.strip()]
+    kinds = [e["kind"] for e in evs]
+    fault_i = kinds.index("chaos_fault")
+    relaunch_i = kinds.index("worker_relaunch")
+    assert fault_i < relaunch_i, kinds
+    fault = evs[fault_i]
+    assert fault["fault"] == "kill_step" and fault["step"] == 4
+    assert fault["attempt"] == 0
+    assert evs[relaunch_i]["attempt"] == 1
+    assert "elastic_resume" in kinds[relaunch_i:], kinds
+    # fault accounting survives into the event stream even though the
+    # process was killed before any flush could write the textfile
+    assert any(e["kind"] == "chaos_fault" for e in evs)
 
 
 def test_corrupt_checkpoint_never_restored(tmp_path):

@@ -1,0 +1,95 @@
+"""The static observability gate (scripts/check_observability.py) — both
+that the live tree is clean and that the checker actually catches what it
+claims to catch (mirrors test_robustness_check.py)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_observability.py")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import check_observability  # noqa: E402
+
+CATALOG = check_observability._load_catalog(REPO)
+
+
+def test_live_tree_is_clean():
+    proc = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                          text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def _violations(tmp_path, src):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_observability.check_file(str(f), CATALOG))
+
+
+def test_bare_print_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        def f():
+            print("debugging")
+    """)
+    assert len(v) == 1 and "stdout" in v[0][1]
+
+
+def test_stderr_print_allowed(tmp_path):
+    assert not _violations(tmp_path, """
+        import sys
+        def f():
+            print("diagnosis", file=sys.stderr)
+    """)
+
+
+def test_nonliteral_metric_name_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f(name):
+            _obs.inc(name)
+    """)
+    assert len(v) == 1 and "non-literal" in v[0][1]
+
+
+def test_unregistered_metric_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f():
+            _obs.inc("made_up_metric_total")
+    """)
+    assert len(v) == 1 and "not registered" in v[0][1]
+
+
+def test_kind_mismatch_rejected(tmp_path):
+    # train_step_seconds is declared as a histogram; .inc needs a counter
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f():
+            _obs.inc("train_step_seconds")
+    """)
+    assert len(v) == 1 and "declared as a histogram" in v[0][1]
+
+
+def test_unregistered_event_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f():
+            _obs.event("made_up_kind", x=1)
+    """)
+    assert len(v) == 1 and "EVENTS" in v[0][1]
+
+
+def test_registered_literals_allowed(tmp_path):
+    assert not _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f(dt):
+            _obs.inc("store_reconnect_total")
+            _obs.set_gauge("heartbeat_age_seconds", dt, rank=0)
+            _obs.observe("store_op_seconds", dt, op="get")
+            _obs.event("rank_stalled", rank=3)
+    """)
