@@ -311,22 +311,29 @@ def _run_gpt_singlechip(metric_name, env_prefix, cfg_factory,
     from paddle_tpu.text.models import GPTForCausalLM
 
     tpu = _is_tpu()
-    e = lambda k, d: os.environ.get(f"{env_prefix}_{k}",
-                                    os.environ.get("BENCH_" + k, d))
+    # per-config knobs only; the ONE shared fallback is BENCH_BATCH (a
+    # global BENCH_LAYERS/SEQ/RECOMPUTE leaking into every config would
+    # silently change which geometry a named bench measures)
+    e = lambda k, d: os.environ.get(f"{env_prefix}_{k}", d)
     layers = int(e("LAYERS", "24" if tpu else "2"))
-    batch = int(e("BATCH", default_batch if tpu else "2"))
+    batch = int(e("BATCH", os.environ.get(
+        "BENCH_BATCH", default_batch if tpu else "2")))
     seq = int(e("SEQ", "1024" if tpu else "128"))
     granularity = e("RECOMPUTE", "full")
     steps, warmup = (20, 3) if tpu else (2, 1)
 
     paddle.seed(0)
-    cfg = cfg_factory(
+    cfg_kw = dict(
         num_hidden_layers=layers,
-        max_position_embeddings=max(seq, 1024),
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
         fold_layers=True, use_recompute=granularity != "none",
         recompute_granularity=(granularity if granularity != "none"
                                else "full"))
+    # the factory owns max_position_embeddings (the named geometries say
+    # 2048); only grow it when the benched sequence wouldn't fit
+    cfg = cfg_factory(**cfg_kw)
+    if seq > cfg.max_position_embeddings:
+        cfg = cfg_factory(max_position_embeddings=seq, **cfg_kw)
     model = GPTForCausalLM(cfg).bfloat16()
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = paddle.optimizer.AdamW(learning_rate=2e-4,

@@ -105,6 +105,21 @@ def psum_scatter_f32safe(v, ax, scatter_dimension=0, tiled=True):
                             tiled=tiled)
 
 
+def psum_quantized(v, ax, wire_dtype="bf16"):
+    """Reduced-precision all-reduce: each contributor's value passes
+    through the wire dtype (bf16 round-trip, or int8 with a per-call
+    absmax scale) and the accumulation runs in f32. On emulated CPU
+    meshes this SIMULATES the wire — the compiled HLO still moves f32
+    bytes — but the numerics match a real reduced-precision exchange
+    with per-contributor quantization. ``distributed.grad_comm`` is the
+    production caller (its buckets inline the same two steps); exposed
+    here as the single audited primitive for tests and benches."""
+    from .grad_comm import quantize_roundtrip
+
+    q = quantize_roundtrip(v.astype(jnp.float32), wire_dtype)
+    return lax.psum(q, ax).astype(v.dtype)
+
+
 # ---------------------------------------------------------------- all_reduce
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
     g = _resolve_group(group)

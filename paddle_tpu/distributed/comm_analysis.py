@@ -61,6 +61,24 @@ def _parse_groups(line: str) -> Optional[List[List[int]]]:
     return None
 
 
+def _line_payload(line: str) -> tuple:
+    """(payload_bytes, wire_dtype) for the collective on this line. The
+    dtype is what actually crosses the wire — a bf16/s8 operand means the
+    exchange moves half/quarter the f32 bytes (grad_comm's reduced-
+    precision collectives show up here). Tuple shapes sum elements and
+    report the first element's dtype."""
+    m = _COLL_RE.search(line)
+    if not m:
+        return 0, None
+    if m.group(1) is not None:  # tuple shape: sum element shapes
+        total, dtype = 0, None
+        for dt, dims in _SHAPE_RE.findall(m.group(1)):
+            total += _shape_bytes(dt, dims)
+            dtype = dtype or dt
+        return total, dtype
+    return _shape_bytes(m.group(2), m.group(3)), m.group(2)
+
+
 def _line_payload_bytes(line: str, kind: str) -> int:
     """Payload bytes for the collective on this line. all-gather counts
     OUTPUT bytes (the gathered result), the others count the operand-side
@@ -68,15 +86,7 @@ def _line_payload_bytes(line: str, kind: str) -> int:
     reduce-scatter the true wire cost is the pre-scatter input, i.e.
     out_bytes * group_size (handled by the traffic model, which gets the
     group size separately)."""
-    m = _COLL_RE.search(line)
-    if not m:
-        return 0
-    if m.group(1) is not None:  # tuple shape: sum element shapes
-        total = 0
-        for dt, dims in _SHAPE_RE.findall(m.group(1)):
-            total += _shape_bytes(dt, dims)
-        return total
-    return _shape_bytes(m.group(2), m.group(3))
+    return _line_payload(line)[0]
 
 
 def _axes_of_group(group: List[int], mesh) -> tuple:
@@ -112,7 +122,7 @@ def collective_traffic(hlo_text: str, mesh) -> List[Dict]:
         if not m or "-done" in line:
             continue
         kind = m.group(4)
-        payload = _line_payload_bytes(line, kind)
+        payload, dtype = _line_payload(line)
         groups = _parse_groups(line)
         n = len(groups[0]) if groups else 1
         if n <= 1:
@@ -129,8 +139,45 @@ def collective_traffic(hlo_text: str, mesh) -> List[Dict]:
         out.append({
             "kind": kind, "payload_bytes": payload, "group_size": n,
             "axes": axes, "wire_bytes_per_device": int(wire),
+            "wire_dtype": dtype,
         })
     return out
+
+
+_GRAD_EXCHANGE_KINDS = ("all-reduce", "reduce-scatter")
+
+
+def bucket_traffic(colls: List[Dict],
+                   data_axes: tuple = ("dp", "sharding")) -> Dict:
+    """Attribute the gradient exchange to its fusion buckets.
+
+    A "bucket" is one reduction collective (all-reduce or reduce-scatter)
+    whose replica groups span only data axes — exactly what grad_comm
+    emits one of per fusion buffer (an unbucketed program shows one per
+    parameter instead, which is the regression this report exists to
+    catch). Returns per-bucket records plus the aggregate wire payload and
+    its f32-equivalent, so reduced-precision wires are visible as
+    ``payload_bytes < payload_bytes_f32`` (quantized_fraction > 0)."""
+    data = set(data_axes)
+    buckets = []
+    for c in colls:
+        axes = set(c["axes"]) - {"self"}
+        if c["kind"] in _GRAD_EXCHANGE_KINDS and axes and axes <= data:
+            buckets.append(c)
+    payload = sum(c["payload_bytes"] for c in buckets)
+    itemsize = {c["wire_dtype"]: _DTYPE_BYTES.get(c["wire_dtype"] or "f32", 4)
+                for c in buckets}
+    payload_f32 = sum(
+        c["payload_bytes"] * 4 // itemsize[c["wire_dtype"]] for c in buckets)
+    return {
+        "buckets": buckets,
+        "n_buckets": len(buckets),
+        "payload_bytes": payload,
+        "payload_bytes_f32": payload_f32,
+        "quantized_fraction": (
+            1.0 - payload / payload_f32 if payload_f32 else 0.0),
+        "per_axis": axis_payload_summary(buckets),
+    }
 
 
 def axis_traffic_summary(colls: List[Dict]) -> Dict[str, int]:

@@ -298,6 +298,12 @@ class HybridParallelOptimizer:
         self._shard_states = (
             self._hcg is not None and self._hcg.get_sharding_parallel_world_size() > 1
         )
+        # error-feedback residuals for quantized gradient exchange; set by
+        # DistTrainStep when the explicit grad_comm path is active. They
+        # ride the functional-state pytree (trailing entry) so the compiled
+        # step threads them, but are NOT serialized: a restore restarts
+        # quantization with a zero residual.
+        self._grad_comm_residuals = None
 
     def __getattr__(self, name):
         return getattr(self._inner_opt, name)
@@ -329,13 +335,36 @@ class HybridParallelOptimizer:
         for i, p in enumerate(opt._parameter_list):
             if opt._accumulators[i] is None:
                 opt._accumulators[i] = self._state_sharding(p, opt._init_state(p))
-        return list(opt._accumulators)
+        out = list(opt._accumulators)
+        if self._grad_comm_residuals is not None:
+            from .. import grad_comm as _grad_comm
+
+            out.append({_grad_comm.RESIDUAL_KEY: dict(self._grad_comm_residuals)})
+        return out
+
+    def _strip_residuals(self, states):
+        from .. import grad_comm as _grad_comm
+
+        states = list(states)
+        if (states and isinstance(states[-1], dict)
+                and _grad_comm.RESIDUAL_KEY in states[-1]):
+            tail = states.pop()
+            if self._grad_comm_residuals is not None:
+                self._grad_comm_residuals = dict(tail[_grad_comm.RESIDUAL_KEY])
+        return states
 
     def load_functional_states(self, states):
-        self._inner_opt.load_functional_states(states)
+        self._inner_opt.load_functional_states(self._strip_residuals(states))
 
     def functional_step(self, param_vals, grad_vals, states, lr):
-        return self._inner_opt.functional_step(param_vals, grad_vals, states, lr)
+        return self._inner_opt.functional_step(
+            param_vals, grad_vals, self._strip_residuals(states), lr)
+
+    def functional_update(self, param_vals, grad_vals, states, lr):
+        """Clip-free per-param update on whatever layout the caller hands in
+        (the explicit grad_comm path calls this with SHARD-shaped params,
+        gradients and states after its own shard-local clip)."""
+        return self._inner_opt.functional_update(param_vals, grad_vals, states, lr)
 
     def step(self):
         self._inner_opt.step()
@@ -698,6 +727,106 @@ class DistTrainStep(TrainStep):
         if not isinstance(optimizer, HybridParallelOptimizer):
             optimizer = HybridParallelOptimizer(optimizer)
         super().__init__(model, loss_fn, optimizer, donate=donate)
+        self._grad_comm_cfg = None
+        self._grad_comm_plan = None
+        self._plan_grad_comm()
+
+    def _plan_grad_comm(self):
+        """Decide at construction whether the explicit bucketed/quantized
+        data-parallel exchange replaces the GSPMD-derived one for this step
+        (decided here, before the first functional_states() call, so the
+        error-feedback residual entry is part of the state pytree from the
+        start). Falls back to GSPMD whenever the mesh has model axes, any
+        param is committed non-replicated (ZeRO-3: the pipeline/GSPMD path
+        owns it), the optimizer chain merges gradients or keeps master
+        weights, or the grad clip has no shard-local form."""
+        from .. import grad_comm as _grad_comm
+
+        m = _mesh.get_global_mesh()
+        if m is None or m.size == 1:
+            return
+        cfg = _grad_comm.resolve_config(self._strategy_of())
+        if not cfg.enable:
+            return
+        opt = self._opt
+        if isinstance(opt._inner_opt, GradientMergeOptimizer):
+            return
+        if getattr(opt, "_use_master_weights", False):
+            return
+        if not _grad_comm.clip_supported(getattr(opt, "_grad_clip", None)):
+            return
+        for t in (*self._params, *self._buffers, *self._extra_params):
+            sh = getattr(raw(t), "sharding", None)
+            if isinstance(sh, NamedSharding) and tuple(sh.spec):
+                return
+        S = m.shape.get("sharding", 1)
+        state_dims = []
+        for p in self._params:
+            k = None
+            if opt._shard_states and S > 1:
+                ext = _extend_with_axis(
+                    param_spec(p), tuple(raw(p).shape), "sharding", S)
+                k = _grad_comm.sharded_dim(ext, "sharding")
+            state_dims.append(k)
+        plan = _grad_comm.plan_dp_exchange(
+            cfg, m,
+            [tuple(raw(p).shape) for p in self._params],
+            [jnp.dtype(raw(p).dtype).itemsize for p in self._params],
+            [p.trainable for p in self._params],
+            state_dims)
+        if plan is None:
+            return
+        self._grad_comm_cfg = cfg
+        self._grad_comm_plan = plan
+        if cfg.quantized and cfg.error_feedback:
+            self._opt._grad_comm_residuals = _grad_comm.init_residuals(
+                cfg, plan, m)
+        lays = tuple(plan.zero_layouts) + tuple(plan.tail_layouts)
+        _grad_comm.record_build_stats(
+            plan.n_buckets, plan.bytes_f32, plan.bytes_wire)
+        _grad_comm.record_overlap_ratio(lays[0].total * 4, plan.bytes_f32)
+
+    def _strategy_of(self):
+        return self._opt._strategy
+
+    def _build_step(self):
+        plan = self._grad_comm_plan
+        if plan is None:
+            return super()._build_step()
+        from .. import grad_comm as _grad_comm
+
+        m = _mesh.get_global_mesh()
+        cfg = self._grad_comm_cfg
+        changed = []
+        loss_of = self._make_loss_of(changed)
+        states = self._opt.functional_states()
+        if (states and isinstance(states[-1], dict)
+                and _grad_comm.RESIDUAL_KEY in states[-1]):
+            states = states[:-1]
+
+        def _spec(v):
+            sh = getattr(v, "sharding", None)
+            return sh.spec if isinstance(sh, NamedSharding) else P()
+
+        state_specs = jax.tree_util.tree_map(_spec, list(states))
+        return _grad_comm.build_explicit_dp_step(
+            cfg, plan, m,
+            loss_of=loss_of, opt=self._opt,
+            trainable=[p.trainable for p in self._params],
+            state_specs_tree=state_specs,
+            batch_spec_fn=data_spec_for,
+            buffer_changed_cell=changed,
+            use_residuals=self._opt._grad_comm_residuals is not None)
+
+    def _dispatch(self, key, build, batch_vals):
+        out = super()._dispatch(key, build, batch_vals)
+        plan = self._grad_comm_plan
+        if plan is not None:
+            from .. import grad_comm as _grad_comm
+
+            steps = key[2] if key and key[0] == "multi" else 1
+            _grad_comm.record_step_bytes(plan.bytes_wire * steps)
+        return out
 
     def _place_batch(self, batch_vals):
         m = _mesh.get_global_mesh()

@@ -347,6 +347,7 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     m = _mesh.get_global_mesh()
     S = pipe.num_stages
     block = pipe._apply_block
+    ckpt_policy = None
     if pipe.recompute_block:
         # "full" granularity (save block inputs only) is the only policy
         # that scales here: any saveable intermediate is stacked across the
@@ -368,7 +369,8 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
                 "(a 16-layer GPT-760M at seq 1024 OOMs a 16 GiB chip); "
                 "use 'full' unless the per-stage stack is shallow",
                 stacklevel=3)
-        block = jax.checkpoint(block, policy=policy_for_granularity(gran))
+        ckpt_policy = policy_for_granularity(gran)
+        block = jax.checkpoint(block, policy=ckpt_policy)
 
     if n_extra:
         stacked_vals, extra = stacked_vals[:-n_extra], stacked_vals[-n_extra:]
@@ -436,18 +438,151 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     B = x.shape[0]
     M = _choose_microbatches(B, pipe.num_microbatches or S)
     mb = B // M
-    xm = x.reshape((M, mb) + x.shape[1:])
+
+    from ... import grad_comm as _grad_comm
+
+    cfg = _grad_comm.resolve_config()
+    n_params = len(pipe._stacked)
+    leaf_specs = [getattr(sp, "dist_spec", None) or P()
+                  for sp in (*pipe._stacked, *pipe._stacked_bufs)]
+
+    # Batch-shard the schedule over the data axes: micro-batch rows (dim 1
+    # of [M, mb, ...]) split across dp/sharding so per-device FLOPs track
+    # the per-device batch instead of the global batch (the region used to
+    # enter replicated and every device recomputed the full batch). Rows
+    # are laid out so device d's slice is exactly the dim-0 shard the batch
+    # already has outside the region: x row j*M + t -> xm[t, j].
+    bs_axes = ()
+    if cfg.pipeline_batch_shard:
+        cand = _grad_comm.data_axes(m)
+        gd = int(np.prod([m.shape[a] for a in cand])) if cand else 1
+        if cand and gd > 1 and mb % gd == 0:
+            bs_axes = cand
+    if bs_axes:
+        xm = x.reshape((mb, M) + x.shape[1:]).swapaxes(0, 1)
+        data_spec = P(None, bs_axes if len(bs_axes) > 1 else bs_axes[0])
+    else:
+        xm = x.reshape((M, mb) + x.shape[1:])
+        data_spec = P()
+
+    # ZeRO-3 leaves stay sharded INSIDE the region: in_spec keeps the
+    # committed `sharding` dim, a per-layer tiled all_gather inside the
+    # (re-materialised) block reassembles the full layer, and its autodiff
+    # transpose is the psum_scatter that hands the update sharded
+    # gradients. Only the current layer is ever full per device.
+    S_sh = m.shape.get("sharding", 1)
+    sharded_idx = []
+    if cfg.zero_update and S_sh > 1:
+        for i in range(n_params):
+            k = _grad_comm.sharded_dim(leaf_specs[i], "sharding")
+            if k is not None and k > 0:
+                sharded_idx.append(i)
+    z_set = frozenset(sharded_idx)
+    z_layout = None
+    if sharded_idx:
+        z_layout = _grad_comm.make_shard_layout(
+            sharded_idx,
+            [tuple(stacked_vals[i].shape[1:]) for i in sharded_idx],
+            [_grad_comm.sharded_dim(leaf_specs[i], "sharding") - 1
+             for i in sharded_idx],
+            S_sh)
+
+    # Non-sharded PARAM leaves ride per-dtype fusion buckets: one flattened
+    # (L, sum_i s_i) tensor per bucket enters at P("pp"), so the boundary
+    # gradient all-reduce over the unmentioned data axes is ONE collective
+    # per bucket instead of one per leaf (backward/comm overlap: earlier
+    # buckets' reductions overlap later layers' backward compute).
+    bucket_layouts = []
+    if cfg.enable:
+        by_dtype = {}
+        for i in range(n_params):
+            if i in z_set:
+                continue
+            by_dtype.setdefault(str(jnp.dtype(stacked_vals[i].dtype)),
+                                []).append(i)
+        for _, idxs in sorted(by_dtype.items()):
+            shapes = [tuple(stacked_vals[i].shape) for i in idxs]
+            its = [jnp.dtype(stacked_vals[i].dtype).itemsize for i in idxs]
+            bucket_layouts.extend(_grad_comm.make_layouts(
+                shapes, its, cfg.bucket_bytes, lead_dims=1, indices=idxs))
+    bucketed = frozenset(i for lay in bucket_layouts for i in lay.indices)
+
+    # region inputs: pass-through leaves first, then the packed buckets
+    pass_idx = [i for i in range(len(stacked_vals)) if i not in bucketed]
+    region_vals, region_specs = [], []
+    for i in pass_idx:
+        if i in z_set:
+            ent = [None] * stacked_vals[i].ndim
+            ent[0] = "pp"
+            ent[_grad_comm.sharded_dim(leaf_specs[i], "sharding")] = "sharding"
+            region_specs.append(P(*ent))
+        else:
+            region_specs.append(P("pp"))
+        region_vals.append(stacked_vals[i])
+    for lay in bucket_layouts:
+        region_vals.append(
+            _grad_comm.pack_bucket(stacked_vals, lay, lead_dims=1))
+        region_specs.append(P("pp"))
+
+    if bucket_layouts or z_layout is not None:
+        L_layers = pipe.num_layers
+        elems = L_layers * (sum(l.total for l in bucket_layouts)
+                            + (z_layout.total if z_layout is not None else 0))
+        wire_it = cfg.wire_itemsize if cfg.quantized else 4
+        _grad_comm.record_build_stats(
+            len(bucket_layouts) + (1 if z_layout is not None else 0),
+            elems * 4, elems * wire_it)
+        if bucket_layouts:
+            _grad_comm.record_overlap_ratio(
+                L_layers * bucket_layouts[0].total * 4, elems * 4)
+
+    def _leaves_of(region):
+        """Rebuild the per-leaf local list from pass-through + buckets; the
+        wire_cast makes each bucket's boundary cotangent a quantized
+        payload (f32-accumulated by the promoted psum)."""
+        leaves = [None] * len(stacked_vals)
+        for pos, i in enumerate(pass_idx):
+            leaves[i] = region[pos]
+        for b, lay in enumerate(bucket_layouts):
+            bkt = region[len(pass_idx) + b]
+            if cfg.quantized:
+                bkt = _grad_comm.wire_cast(bkt, cfg.wire_dtype)
+            for i, v in _grad_comm.unpack_bucket(bkt, lay, lead_dims=1):
+                leaves[i] = v
+        return tuple(leaves)
+
+    def _prep_layer(leaves):
+        """Gather the ZeRO-sharded leaves of ONE layer (inside remat, so
+        residuals stay sharded slices)."""
+        if z_layout is None:
+            return leaves
+        out = list(leaves)
+        for i, full in _grad_comm.gather_leaves(
+                [leaves[i] for i in z_layout.indices], z_layout, "sharding",
+                wire_dtype=cfg.wire_dtype if cfg.quantized else None):
+            out[i] = full
+        return tuple(out)
+
+    if z_layout is None:
+        sched_block = block
+    else:
+        def _gathered_block(leaves, h):
+            return pipe._apply_block(_prep_layer(leaves), h)
+
+        sched_block = (jax.checkpoint(_gathered_block, policy=ckpt_policy)
+                       if pipe.recompute_block else _gathered_block)
 
     def stage_apply(local_leaves, h):
         def body(h, leaves):
-            return block(leaves, h), None
+            return sched_block(leaves, h), None
 
         h, _ = lax.scan(body, h, local_leaves)
         return h
 
-    def spmd_fn(local_stacked, xm_all):
+    def spmd_fn(region, xm_all):
+        local_stacked = _leaves_of(region)
         stage = lax.axis_index("pp")
-        state = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        state = jnp.zeros(xm_all.shape[1:], xm_all.dtype)
         out_buf = jnp.zeros_like(xm_all)
 
         def step(t, carry):
@@ -472,7 +607,7 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
         )
         return out_buf
 
-    def spmd_fn_interleaved(local_stacked, xm_all):
+    def spmd_fn_interleaved(region, xm_all):
         """PHASED interleaved (virtual-pp) schedule: stage s holds V chunks
         (global chunk v*S + s); per step each stage applies exactly ONE chunk
         (1/V of its layers) to one in-flight micro-batch and hands it on with
@@ -485,6 +620,7 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
         shrinks by V, exactly the interleaved-1F1B payoff (reference:
         fleet/meta_parallel interleaved 1F1B; see schedule_info()).
         """
+        local_stacked = _leaves_of(region)
         stage = lax.axis_index("pp")
         L_chunk = pipe.num_layers // (S * V)
         # local slot v = global chunk v*S + s (s-major stacking, see __init__)
@@ -493,7 +629,7 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
         )
         groups = -(-M // S)
         n_steps = groups * S * V + S - 1
-        h0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        h0 = jnp.zeros(xm_all.shape[1:], xm_all.dtype)
         out_buf = jnp.zeros_like(xm_all)
 
         def step(t, carry):
@@ -546,26 +682,31 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     promote = _promote_subf32_reduce(x.dtype)
     inner_fn = spmd_fn
     if promote:
-        def spmd_fn(local_stacked, xm_all):  # noqa: F811
+        def spmd_fn(region, xm_all):  # noqa: F811
             return inner_fn(
-                local_stacked, xm_all.astype(x.dtype)).astype(jnp.float32)
+                region, xm_all.astype(x.dtype)).astype(jnp.float32)
 
     from ...._jax_compat import shard_map as _shard_map
 
+    region_axes = frozenset({"pp"}) | frozenset(bs_axes) | (
+        frozenset({"sharding"}) if z_layout is not None else frozenset())
     mapped = _shard_map(
         spmd_fn,
         mesh=m,
-        in_specs=(tuple(P("pp") for _ in stacked_vals), P()),
-        out_specs=P(),
-        axis_names=frozenset({"pp"}),
+        in_specs=(tuple(region_specs), data_spec),
+        out_specs=data_spec,
+        axis_names=region_axes,
         check_vma=False,
     )
     # jit wrapper: the partial-manual shard_map eager impl path is broken in
     # current jax (nested unmatch uses the full axis set); the traced path is
     # fine, and under an outer jit this inlines.
     out = jax.jit(mapped)(
-        tuple(stacked_vals), xm.astype(jnp.float32) if promote else xm)
+        tuple(region_vals), xm.astype(jnp.float32) if promote else xm)
     out = out.astype(x.dtype)
+    if bs_axes:
+        # inverse of the row interleave: out[t, j] is batch row j*M + t
+        out = out.swapaxes(0, 1)
     return out.reshape((B,) + out.shape[2:])
 
 

@@ -82,6 +82,19 @@ class DistributedStrategy:
         self.adaptive_localsgd = False
         self.fuse_all_reduce_ops = True  # no-op: XLA fuses
         self.fuse_grad_size_in_MB = 32
+        # explicit gradient-communication layer (distributed/grad_comm.py):
+        # bucketed, overlap-friendly collectives with optional reduced-
+        # precision wire (bf16/int8 + error feedback) and ZeRO weight-update
+        # sharding. Off by default — the GSPMD-derived exchange remains the
+        # baseline; PADDLE_TPU_GRAD_COMM overrides these knobs per run.
+        # bucket_mb is deliberately ABSENT here: unset, the bucket size
+        # defaults to fuse_grad_size_in_MB (the reference's fused-allreduce
+        # buffer knob) so tuned ports keep their comm granularity.
+        self.grad_comm = False
+        self.grad_comm_configs: _SubConfig = _SubConfig(
+            wire_dtype="f32", error_feedback=False,
+            zero_update=True, pipeline_batch_shard=True,
+        )
         self.nccl_comm_num = 1
         self.find_unused_parameters = False
         self.without_graph_optimization = False

@@ -36,6 +36,15 @@ def group_sharded_parallel(
     shard_model_parameters(model, fsdp=(stage == 3))
     if not isinstance(optimizer, HybridParallelOptimizer):
         optimizer = HybridParallelOptimizer(optimizer)
+    # the reference fuses gradient comm into `buffer_max_size`-byte buffers
+    # (GroupShardedStage2 _comm_buffer_size); carry that granularity onto
+    # the explicit grad-comm bucket size so a ported script that tuned it
+    # keeps its comm pattern when it opts into strategy.grad_comm
+    from ..fleet import fleet_strategy
+
+    strat = fleet_strategy()
+    if strat is not None and buffer_max_size:
+        strat.grad_comm_configs["bucket_mb"] = float(buffer_max_size) / 2 ** 20
     return model, optimizer, scaler
 
 

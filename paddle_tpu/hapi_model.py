@@ -109,6 +109,29 @@ class Model:
         out = self.network(*inputs)
         return [out.numpy() if isinstance(out, Tensor) else out]
 
+    def comm_traffic(self, *batch):
+        """Collective-traffic report of the compiled train step for this
+        batch signature (distributed.comm_analysis): every collective XLA
+        emitted with payload/axes, the per-axis wire summary, and the
+        gradient-exchange bucket attribution — ``grad_exchange`` shows how
+        many fusion buckets the exchange compiled to and what fraction of
+        f32 bytes the wire dtype removed (grad_comm). Multi-device only."""
+        from .distributed import comm_analysis as _ca
+        from .distributed import mesh as _mesh
+
+        m = _mesh.get_global_mesh()
+        if self._train_step is None or m is None or m.size == 1:
+            raise RuntimeError(
+                "comm_traffic needs prepare(optimizer, loss) and a "
+                "multi-device mesh")
+        hlo = self._train_step._compiled_for(*batch).as_text()
+        colls = _ca.collective_traffic(hlo, m)
+        return {
+            "collectives": colls,
+            "per_axis": _ca.axis_traffic_summary(colls),
+            "grad_exchange": _ca.bucket_traffic(colls),
+        }
+
     # ------------------------------------------------------------------ fit --
     def fit(
         self,

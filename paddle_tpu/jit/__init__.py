@@ -514,10 +514,15 @@ class TrainStep:
     def _compile(self):
         return self._jit(self._build_step())
 
-    def _build_step(self):
-        model, loss_fn, opt = self._model, self._loss_fn, self._opt
+    def _make_loss_of(self, changed_cell=None):
+        """The pure (train_vals, (b_vals, batch, key)) -> (loss, new_b)
+        closure shared by every step builder. ``changed_cell`` (a list)
+        receives, at trace time, one tuple of per-buffer "was mutated"
+        flags — identity comparison during tracing is a static fact, and
+        distributed builders use it to decide which buffers need a
+        cross-replica mean without burning collectives on constants."""
+        model, loss_fn = self._model, self._loss_fn
         params, buffers = self._params, self._buffers + self._extra_params
-        trainable = [p.trainable for p in params]
 
         def loss_of(train_vals, fixed):
             b_vals, batch_vals, rng_key = fixed
@@ -538,7 +543,17 @@ class TrainStep:
                         p._value = v
                     for b, v in zip(buffers, orig_b):
                         b._value = v
+            if changed_cell is not None:
+                changed_cell[:] = [tuple(
+                    nv is not v for nv, v in zip(new_b, b_vals))]
             return loss_val, new_b
+
+        return loss_of
+
+    def _build_step(self):
+        opt = self._opt
+        trainable = [p.trainable for p in self._params]
+        loss_of = self._make_loss_of()
 
         def step(p_vals, b_vals, opt_states, batch_vals, lr, rng_key):
             (loss_val, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(

@@ -73,6 +73,19 @@ METRICS = {
         "counter", "Successful ElasticManager.resume restores"),
     "elastic_resume_fallback_total": (
         "counter", "Checkpoints skipped during resume (torn/corrupt/failed)"),
+    # -- gradient communication (distributed/grad_comm.py) ------------------
+    "grad_comm_bytes_total": (
+        "counter", "Gradient-exchange payload bytes at the wire dtype, "
+                   "accumulated per executed step"),
+    "grad_comm_buckets": (
+        "gauge", "Fusion buckets in the compiled gradient exchange "
+                 "(one collective each; 0/absent = unbucketed GSPMD path)"),
+    "grad_comm_quantized_fraction": (
+        "gauge", "Fraction of f32 gradient bytes removed by the reduced-"
+                 "precision wire (0.0 = f32, 0.5 = bf16, 0.75 = int8)"),
+    "grad_comm_overlap_ratio": (
+        "gauge", "Share of exchanged bytes outside the last-issued bucket "
+                 "— the part that can overlap remaining backward compute"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),

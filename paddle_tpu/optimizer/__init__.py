@@ -196,6 +196,13 @@ class Optimizer:
         if self._grad_clip is not None:
             clipped = self._grad_clip(list(zip(param_vals, grad_vals)))
             grad_vals = [g for _, g in clipped]
+        return self.functional_update(param_vals, grad_vals, states, lr)
+
+    def functional_update(self, param_vals, grad_vals, states, lr):
+        """functional_step minus grad clip: the raw per-parameter rule.
+        Distributed callers that clip on a different data layout (e.g. the
+        ZeRO shard-local update in fleet, where the global norm is a scalar
+        psum over shard blocks) clip first, then call this directly."""
         new_ps, new_sts = [], []
         for p, pv, g, st in zip(self._parameter_list, param_vals, grad_vals, states):
             if g is None or not p.trainable:

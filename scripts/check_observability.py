@@ -48,6 +48,14 @@ RECORDERS = {
     "event": None,
 }
 
+#: metric-name prefix -> sole file allowed to record it. Serieses with an
+#: owner stay single-writer: grad_comm_* numbers describe the compiled
+#: gradient exchange, and a second writer (a bench script, a model) would
+#: silently turn them into a mixed-meaning series.
+OWNED_PREFIXES = {
+    "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
+}
+
 
 def _load_catalog(root):
     """Load observability/catalog.py from its FILE PATH — importing the
@@ -70,9 +78,10 @@ def _py_files(root):
                     yield os.path.join(dirpath, fn)
 
 
-def check_file(path: str, catalog):
+def check_file(path: str, catalog, rel: str = None):
     """Yield (line, message) violations for one file. `catalog` is the
-    loaded catalog module (METRICS dict + EVENTS set)."""
+    loaded catalog module (METRICS dict + EVENTS set); `rel` is the
+    repo-relative path (ownership rule)."""
     with open(path, "rb") as f:
         src = f.read()
     tree = ast.parse(src, filename=path)
@@ -120,6 +129,12 @@ def check_file(path: str, catalog):
                 yield (node.lineno,
                        f"metric {name!r} is declared as a {declared[0]} but "
                        f"recorded via .{func.attr} (needs a {kind})")
+        # rule 3: owned metric families are single-writer
+        for prefix, owner in OWNED_PREFIXES.items():
+            if name.startswith(prefix) and rel is not None and rel != owner:
+                yield (node.lineno,
+                       f"metric {name!r} may only be recorded from {owner} "
+                       f"(the {prefix}* family is single-writer)")
 
 
 def main(argv=None):
@@ -129,7 +144,7 @@ def main(argv=None):
     violations = []
     for path in _py_files(root):
         rel = os.path.relpath(path, root)
-        for line, msg in check_file(path, catalog):
+        for line, msg in check_file(path, catalog, rel):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

@@ -27,15 +27,33 @@ x = jnp.ones((128, 128), jnp.bfloat16)
 EOF
 }
 
+# A stage that fails MAX_RETRIES windows in a row is parked as .gave_up so
+# one broken bench can't burn every future tunnel window (or hold the
+# watcher open forever); rm the marker to re-arm it.
+MAX_RETRIES=${MAX_RETRIES:-3}
+
 stage() {  # stage <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
   if [ -e "$STAMP/$name.done" ]; then echo "== skip $name (done)"; return 0; fi
+  if [ -e "$STAMP/$name.gave_up" ]; then
+    echo "== skip $name (gave up after $MAX_RETRIES failures)"; return 0
+  fi
   echo "== stage $name =="
   if timeout "$tmo" "$@" > "$STAMP/$name.log" 2>&1; then
     touch "$STAMP/$name.done"
+    rm -f "$STAMP/$name.fails"
     tail -2 "$STAMP/$name.log"
   else
-    echo "-- $name failed/timed out (rc=$?); will retry next window"
+    local rc=$?
+    local fails=$(( $(cat "$STAMP/$name.fails" 2>/dev/null || echo 0) + 1 ))
+    echo "$fails" > "$STAMP/$name.fails"
+    if [ "$fails" -ge "$MAX_RETRIES" ]; then
+      touch "$STAMP/$name.gave_up"
+      echo "-- $name failed (rc=$rc) $fails/$MAX_RETRIES times; giving up" \
+           "(rm $STAMP/$name.gave_up to re-arm)"
+    else
+      echo "-- $name failed/timed out (rc=$rc); retry $fails/$MAX_RETRIES next window"
+    fi
     tail -3 "$STAMP/$name.log"
   fi
 }
@@ -52,9 +70,9 @@ while [ ! -e "$STAMP/STOP" ]; do
     stage gmm_tpu         1800 python scripts/bench_gmm_tpu.py
     stage conv_layout     2400 python scripts/bench_conv_layout.py 256
     stage seq1024_b64     2400 env BENCH_SEQ1024_BATCH=64 python bench.py
-    if ls "$STAMP"/*.done >/dev/null 2>&1 \
-       && [ "$(ls "$STAMP"/*.done | wc -l)" -ge 7 ]; then
-      echo "== all stages durable; watcher exiting =="
+    settled=$(ls "$STAMP"/*.done "$STAMP"/*.gave_up 2>/dev/null | wc -l)
+    if [ "$settled" -ge 7 ]; then
+      echo "== all stages settled (done or gave up); watcher exiting =="
       break
     fi
   fi

@@ -84,6 +84,28 @@ def test_unregistered_event_rejected(tmp_path):
     assert len(v) == 1 and "EVENTS" in v[0][1]
 
 
+_OWNED_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.set_gauge("grad_comm_buckets", 3.0)
+"""
+
+
+def test_owned_metric_from_wrong_file_rejected(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_OWNED_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "comm_analysis.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+
+
+def test_owned_metric_from_owner_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_OWNED_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "grad_comm.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
 def test_registered_literals_allowed(tmp_path):
     assert not _violations(tmp_path, """
         from paddle_tpu import observability as _obs
