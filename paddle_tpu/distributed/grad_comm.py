@@ -273,6 +273,26 @@ def unpack_bucket(bucket, layout: BucketLayout, *, lead_dims: int = 0):
 
 
 # ------------------------------------------------------- wire quantization --
+def quantize_absmax(v, axis=None):
+    """Symmetric int8 quantization with an absmax scale over ``axis``
+    (``None`` = one scale for the whole array, the gradient-wire shape;
+    the serving KV cache passes the head_dim axis for per-head scales).
+    Returns ``(q_int8, scale_f32)`` with ``scale`` keeping reduced dims."""
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(v.astype(jnp.float32)), axis=axis, keepdims=True)
+        / _INT8_LEVELS,
+        jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(jnp.round(v.astype(jnp.float32) / scale),
+                 -_INT8_LEVELS, _INT8_LEVELS)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_absmax(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_absmax` (scale broadcasts over the
+    reduced axes it kept)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def quantize_roundtrip(v, wire_dtype: str):
     """Project ``v`` onto what the wire dtype can represent (f32-safe
     accumulation semantics: the payload is quantized once, the reduction
@@ -281,11 +301,8 @@ def quantize_roundtrip(v, wire_dtype: str):
     if wire_dtype == "bf16":
         return v.astype(jnp.bfloat16).astype(v.dtype)
     if wire_dtype == "int8":
-        scale = jnp.maximum(jnp.max(jnp.abs(v)) / _INT8_LEVELS,
-                            jnp.finfo(jnp.float32).tiny)
-        q = jnp.round(v / scale)
-        q = jnp.clip(q, -_INT8_LEVELS, _INT8_LEVELS)
-        return (q * scale).astype(v.dtype)
+        q, scale = quantize_absmax(v)
+        return dequantize_absmax(q, scale, v.dtype)
     return v
 
 

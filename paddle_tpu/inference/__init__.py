@@ -83,6 +83,26 @@ class Config:
     def tensorrt_engine_enabled(self):
         return self._tensorrt
 
+    # --- serving decode engine (inference/engine.py, docs/SERVING.md) ---
+    def enable_decode_engine(self, num_slots: int = 8, max_length: int = 512,
+                             kv_dtype: str = "f32", **kw):
+        """Record decode-engine settings; `enable_decode_engine(model,
+        config)` (module level) builds the engine from them and attaches
+        it, after which text.generation.generate()/generate_padded() route
+        through the KV-cached continuous-batching loop."""
+        self._engine_kwargs = dict(
+            num_slots=num_slots, max_length=max_length, kv_dtype=kv_dtype,
+            **kw)
+
+    def decode_engine_enabled(self) -> bool:
+        return getattr(self, "_engine_kwargs", None) is not None
+
+    def decode_engine_config(self):
+        """EngineConfig built from enable_decode_engine() settings."""
+        from .engine import EngineConfig
+
+        return EngineConfig(**getattr(self, "_engine_kwargs", {}) or {})
+
     def summary(self):
         return (
             f"Config(prefix={self._prefix}, device={self._device or 'default'}, "
@@ -101,6 +121,9 @@ class _IOHandle:
         self.name = name
         self._value = None
         self._shape = None
+        #: bumped on every copy_from_cpu — Predictor.run only device_puts
+        #: handles whose version moved since the last call
+        self._version = 0
 
     def reshape(self, shape):
         self._shape = tuple(shape)
@@ -110,8 +133,11 @@ class _IOHandle:
         if self._shape is not None and tuple(arr.shape) != self._shape:
             arr = arr.reshape(self._shape)
         self._value = arr
+        self._version += 1
 
     def copy_to_cpu(self) -> np.ndarray:
+        # outputs stay device-resident until someone actually asks for the
+        # host copy (np.asarray on a jax array is the D2H transfer)
         return np.asarray(self._value)
 
     def shape(self):
@@ -133,12 +159,30 @@ class Predictor:
         }
         self._outputs: Dict[str, _IOHandle] = {}
         self._output_names: List[str] = []
+        #: name -> (handle version, device-resident array). Params already
+        #: live on device inside the TranslatedLayer; this closes the other
+        #: half of the loop so repeated run() calls with unchanged inputs
+        #: do zero H2D transfers.
+        self._dev_inputs: Dict[str, tuple] = {}
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
 
     def get_input_handle(self, name) -> _IOHandle:
         return self._inputs[name]
+
+    def _device_input(self, name):
+        """The handle's value as a device array, re-transferred only when
+        copy_from_cpu bumped its version since the previous run()."""
+        import jax
+
+        h = self._inputs[name]
+        ver, arr = self._dev_inputs.get(name, (None, None))
+        if ver != h._version:
+            v = h._value
+            arr = v if isinstance(v, jax.Array) else jax.device_put(v)
+            self._dev_inputs[name] = (h._version, arr)
+        return arr
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. Either stage inputs via handles then run(), or pass a list
@@ -154,7 +198,8 @@ class Predictor:
         missing = [n for n in self._input_names if self._inputs[n]._value is None]
         if missing:
             raise RuntimeError(f"inputs not set: {missing}")
-        out = self._layer.forward(*[self._inputs[n]._value for n in self._input_names])
+        out = self._layer.forward(
+            *[self._device_input(n) for n in self._input_names])
         import jax
 
         leaves = jax.tree_util.tree_leaves(
@@ -164,7 +209,8 @@ class Predictor:
         self._outputs = {}
         for n, leaf in zip(self._output_names, leaves):
             h = _IOHandle(n)
-            h._value = np.asarray(leaf._value if hasattr(leaf, "_value") else leaf)
+            # keep the DEVICE array; copy_to_cpu does the host transfer
+            h._value = leaf._value if hasattr(leaf, "_value") else leaf
             self._outputs[n] = h
         if inputs is not None:
             return [self._outputs[n].copy_to_cpu() for n in self._output_names]
@@ -179,6 +225,32 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def enable_decode_engine(model, config: Optional[Config] = None, **kw):
+    """Attach a KV-cached continuous-batching decode engine to a live
+    causal LM (a model exposing ``decode_adapter()``: GPTForCausalLM,
+    LlamaForCausalLM). After this, ``text.generation.generate`` /
+    ``generate_padded`` route through the engine automatically; the
+    engine is also returned for direct ``submit()``/``step()``/``run()``
+    driving. Settings come from ``config.enable_decode_engine(...)`` when
+    a Config is given, else from keyword args (EngineConfig fields).
+
+    See docs/SERVING.md."""
+    from .engine import DecodeEngine
+
+    if config is not None and config.decode_engine_enabled():
+        engine = DecodeEngine(model, config.decode_engine_config())
+    else:
+        engine = DecodeEngine(model, **kw)
+    model._decode_engine = engine
+    return engine
+
+
+def disable_decode_engine(model):
+    """Detach the engine; generation falls back to the legacy loops."""
+    if getattr(model, "_decode_engine", None) is not None:
+        model._decode_engine = None
 
 
 class PredictorPool:

@@ -1,0 +1,604 @@
+"""KV-cached decode engine with continuous batching — the serving path.
+
+Reference capability: Paddle Inference's generation serving stack (fused
+attention-with-cache kernels updating an in-place ``cache_kv`` per layer)
+and PaddleNLP's ``llm/predictor.py`` batched serving loop. TPU-native
+design (the static-shape serving discipline on XLA):
+
+* **Static shapes only.** Two compiled program families serve every
+  request mix: one prefill per power-of-two prompt bucket (batch 1,
+  written into a slot) and ONE single-token decode step over all
+  ``num_slots`` slots. Nothing recompiles per request, per length, or
+  per step; a 3-bucket workload compiles <= 4 XLA programs
+  (tests/test_decode_engine.py gates this).
+* **Slot-indexed KV cache.** ``[L, S, Hkv, T_max, D]`` stacked buffers
+  live on device and are donated back to XLA on every compiled step
+  (TPU/GPU backends), so the cache updates in place instead of copying.
+* **Continuous batching.** A pure-Python scheduler admits waiting
+  requests into free slots and evicts finished ones BETWEEN compiled
+  steps: short requests never wait for long ones and decode occupancy
+  stays high. Slot reuse cannot leak a previous request's KV — decode
+  attention masks positions > the slot's own ``cache_position``, and
+  every position <= it has been freshly written by the current request.
+* **On-device sampling.** greedy/temperature/top-k/top-p run inside the
+  decode program via ``jax.random`` with per-slot keys folded by target
+  position (so a request's sample stream does not depend on which other
+  requests it was batched with); the per-token host transfer is one
+  int32 per slot, never a logits matrix.
+* **Optional int8 KV.** ``kv_dtype="int8"`` stores the cache at one byte
+  per element with per-(layer, slot, head, position) absmax scales via
+  grad_comm's quantize/dequantize helpers — the reduced-precision-with-
+  absmax-scales discipline the gradient wire already uses, applied to
+  the dominant serving memory consumer.
+
+Models plug in through ``model.decode_adapter()`` (text/models/gpt.py,
+llama.py): the engine owns the residual stream, the cache, and the
+sampler; the adapter exposes embed / per-layer norm+qkv+out-proj+mlp /
+final-norm / logits hooks plus cache geometry. See docs/SERVING.md.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+from ..distributed.grad_comm import dequantize_absmax, quantize_absmax
+from ..framework.core import Tensor, no_grad
+from ..framework.op import raw
+from ..nn import functional as F
+
+__all__ = [
+    "DecodeEngine",
+    "EngineConfig",
+    "SamplingParams",
+    "pow2_bucket",
+]
+
+KV_DTYPES = ("f32", "bf16", "int8")
+
+
+def pow2_bucket(n: int, lo: int = 16, hi: Optional[int] = None) -> int:
+    """Smallest power-of-two >= n (floored at `lo`, capped at `hi`)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi) if hi is not None else b
+
+
+@dataclass
+class EngineConfig:
+    """Engine geometry + cache policy (see docs/SERVING.md for tuning)."""
+
+    num_slots: int = 8
+    max_length: int = 512
+    kv_dtype: str = "f32"  # f32 | bf16 | int8
+    #: explicit prompt buckets; None = powers of two from min_bucket up to
+    #: max_length. Only buckets a prompt actually lands in get compiled.
+    prompt_buckets: Optional[Tuple[int, ...]] = None
+    min_bucket: int = 16
+    #: None = donate cache buffers on tpu/gpu only (CPU XLA cannot alias
+    #: them and would warn on every step)
+    donate: Optional[bool] = None
+    #: base seed for requests that don't carry their own
+    seed: int = 0
+
+    def resolved_buckets(self) -> List[int]:
+        if self.prompt_buckets:
+            bs = sorted({min(int(b), self.max_length)
+                         for b in self.prompt_buckets})
+        else:
+            bs, b = [], self.min_bucket
+            while b < self.max_length:
+                bs.append(b)
+                b *= 2
+            bs.append(min(b, self.max_length))
+        return bs
+
+
+@dataclass
+class SamplingParams:
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: Optional[int] = None
+
+    def fields(self):
+        """(temperature, top_k, top_p, greedy) in device form."""
+        greedy = (not self.do_sample) or self.temperature <= 0.0
+        return (max(float(self.temperature), 1e-6), int(self.top_k),
+                float(self.top_p), bool(greedy))
+
+
+@dataclass
+class Request:
+    req_id: int
+    prompt: np.ndarray
+    params: SamplingParams
+    key_np: np.ndarray
+    tokens: List[int] = field(default_factory=list)
+    status: str = "waiting"  # waiting | running | done
+    slot: int = -1
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+
+
+# ---------------------------------------------------------------------------
+# cache plumbing (pure jnp; traced inside the engine's compiled programs)
+# ---------------------------------------------------------------------------
+
+
+def _prefill_write(cache, scales, layer, slot, kv, int8):
+    """Write a whole prompt block kv [1, TB, Hkv, D] into (layer, slot)."""
+    blk = jnp.swapaxes(kv[0], 0, 1)  # [Hkv, TB, D]
+    if int8:
+        q, scale = quantize_absmax(blk, axis=-1)  # scale [Hkv, TB, 1]
+        cache = jax.lax.dynamic_update_slice(
+            cache, q[None, None], (layer, slot, 0, 0, 0))
+        scales = jax.lax.dynamic_update_slice(
+            scales, scale[..., 0][None, None], (layer, slot, 0, 0))
+        return cache, scales
+    cache = jax.lax.dynamic_update_slice(
+        cache, blk[None, None].astype(cache.dtype), (layer, slot, 0, 0, 0))
+    return cache, scales
+
+
+def _decode_write(cache, scales, layer, kv, positions, int8):
+    """Write one token kv [S, 1, Hkv, D] at per-slot `positions` [S]."""
+    x = kv[:, 0]  # [S, Hkv, D]
+    if int8:
+        q, scale = quantize_absmax(x, axis=-1)  # q [S,Hkv,D], scale [S,Hkv,1]
+
+        def put(c, qs, p):  # c [Hkv, T, D]
+            return jax.lax.dynamic_update_slice(c, qs[:, None, :], (0, p, 0))
+
+        def put_scale(c, ss, p):  # c [Hkv, T]
+            return jax.lax.dynamic_update_slice(c, ss, (0, p))
+
+        cache = cache.at[layer].set(jax.vmap(put)(cache[layer], q, positions))
+        scales = scales.at[layer].set(
+            jax.vmap(put_scale)(scales[layer], scale, positions))
+        return cache, scales
+
+    def put(c, xs, p):
+        return jax.lax.dynamic_update_slice(
+            c, xs[:, None, :].astype(c.dtype), (0, p, 0))
+
+    cache = cache.at[layer].set(jax.vmap(put)(cache[layer], x, positions))
+    return cache, scales
+
+
+def _layer_kv(cache, scales, layer, int8):
+    """One layer's [S, Hkv, T, D] view, dequantized when int8."""
+    lay = cache[layer]
+    if int8:
+        return dequantize_absmax(lay, scales[layer][..., None])
+    return lay
+
+
+def _sample_tokens(logits, keys, temperature, top_k, top_p, greedy):
+    """On-device sampling for N rows: logits [N, V] f32, keys [N, ks],
+    temperature/top_p f32 [N], top_k i32 [N], greedy bool [N]. Per-row
+    keys keep every request's sample stream independent of co-scheduling.
+    top_k <= 0 and top_p >= 1.0 disable their filters."""
+    v = logits.shape[-1]
+    x = logits / temperature[:, None]
+    sorted_x = jnp.sort(x, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sorted_x, (jnp.clip(top_k, 1, v) - 1)[:, None], axis=-1)
+    x = jnp.where((top_k[:, None] > 0) & (x < kth), -jnp.inf, x)
+    probs = jax.nn.softmax(x, axis=-1)
+    sp = jnp.sort(probs, axis=-1)[:, ::-1]
+    keep = (jnp.cumsum(sp, axis=-1) - sp) < top_p[:, None]
+    thr = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
+    x = jnp.where((top_p[:, None] < 1.0) & (probs < thr), -jnp.inf, x)
+    sampled = jax.vmap(lambda xr, kr: jax.random.categorical(kr, xr))(x, keys)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
+class DecodeEngine:
+    """Continuous-batching serving engine over a decoder-only LM.
+
+    Usage::
+
+        eng = DecodeEngine(model, num_slots=8, max_length=512)
+        rid = eng.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+        eng.run()                     # or step() from your own loop
+        out = eng.result(rid)         # np.ndarray prompt + generated
+
+    or the batch front end ``eng.generate_batch(ids, ...)`` which
+    ``text.generation.generate`` rides on.
+    """
+
+    def __init__(self, model, config: Optional[EngineConfig] = None,
+                 **overrides):
+        self.config = config or EngineConfig(**overrides)
+        cfg = self.config
+        if cfg.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {cfg.kv_dtype!r}")
+        self.model = model
+        model.eval()
+        self.adapter = model.decode_adapter()
+        ad = self.adapter
+        if cfg.max_length > ad.max_positions:
+            raise ValueError(
+                f"max_length={cfg.max_length} exceeds the model's "
+                f"max_positions={ad.max_positions}")
+        self.buckets = cfg.resolved_buckets()
+        self._int8 = cfg.kv_dtype == "int8"
+        store = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                 "int8": jnp.int8}[cfg.kv_dtype]
+        shape = (ad.num_layers, cfg.num_slots, ad.num_kv_heads,
+                 cfg.max_length, ad.head_dim)
+        self._kc = jnp.zeros(shape, store)
+        self._vc = jnp.zeros(shape, store)
+        if self._int8:
+            self._ksc = jnp.ones(shape[:-1], jnp.float32)
+            self._vsc = jnp.ones(shape[:-1], jnp.float32)
+        else:
+            self._ksc = self._vsc = None
+        # stable state ordering for the compiled-call state swap (the
+        # TracedLayer idiom): dedup'd params first, then buffers
+        self._state, seen = [], set()
+        for _, p in model.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                self._state.append(p)
+        for _, b in model.named_buffers():
+            if id(b) not in seen:
+                seen.add(id(b))
+                self._state.append(b)
+        donate = cfg.donate
+        if donate is None:
+            donate = jax.default_backend() in ("tpu", "gpu")
+        self._donate = bool(donate)
+        self._prefill_jit: Dict[int, object] = {}
+        self._decode_jit = None
+        self._compiled = set()
+        self.compile_count = 0
+        self.total_tokens = 0
+        self.decode_steps = 0
+        self._base_key = jax.random.PRNGKey(cfg.seed)
+        self._zero_key = np.asarray(self._base_key)
+        self._waiting: deque = deque()
+        self._running: Dict[int, Request] = {}
+        self._free = list(range(cfg.num_slots))[::-1]  # pop() -> slot 0
+        self._requests: Dict[int, Request] = {}
+        self._next_id = 0
+
+    # -- scheduler ----------------------------------------------------------
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               **kw) -> int:
+        """Queue one request; returns its id. `prompt` is a 1-D int array
+        (Tensor/np/list); keyword args build a SamplingParams."""
+        if params is None:
+            params = SamplingParams(**kw)
+        ids = np.asarray(raw(prompt), dtype=np.int32).reshape(-1)
+        t0 = int(ids.shape[0])
+        if t0 < 1:
+            raise ValueError("empty prompt")
+        if t0 > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {t0} exceeds the largest prompt bucket "
+                f"{self.buckets[-1]}")
+        if t0 + params.max_new_tokens > self.config.max_length:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({params.max_new_tokens}) "
+                f"exceeds max_length={self.config.max_length}")
+        rid = self._next_id
+        self._next_id += 1
+        if params.seed is not None:
+            key = jax.random.PRNGKey(params.seed)
+        else:
+            key = jax.random.fold_in(self._base_key, rid)
+        req = Request(req_id=rid, prompt=ids, params=params,
+                      key_np=np.asarray(key),
+                      submit_time=time.perf_counter())
+        self._requests[rid] = req
+        self._waiting.append(req)
+        _obs.inc("serving_requests_total")
+        _obs.set_gauge("serving_queue_depth", float(len(self._waiting)))
+        return rid
+
+    def step(self) -> bool:
+        """Admit waiting requests into free slots (one compiled prefill
+        each), then run ONE compiled decode step over every occupied slot.
+        Returns False when the engine is fully idle."""
+        self._admit()
+        if not self._running:
+            return bool(self._waiting)
+        cfg = self.config
+        s = cfg.num_slots
+        tokens = np.zeros(s, np.int32)
+        positions = np.zeros(s, np.int32)
+        temp = np.ones(s, np.float32)
+        top_k = np.zeros(s, np.int32)
+        top_p = np.ones(s, np.float32)
+        greedy = np.ones(s, bool)
+        keys = np.broadcast_to(self._zero_key, (s,) + self._zero_key.shape)
+        keys = np.array(keys)
+        for slot, req in self._running.items():
+            tokens[slot] = req.tokens[-1]
+            positions[slot] = len(req.prompt) + len(req.tokens) - 1
+            t_, k_, p_, g_ = req.params.fields()
+            temp[slot], top_k[slot], top_p[slot], greedy[slot] = t_, k_, p_, g_
+            keys[slot] = req.key_np
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        t0 = time.perf_counter()
+        out = self._run_counted(
+            "decode", self._decode_jit,
+            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy))
+        self._kc, self._vc, self._ksc, self._vsc, nxt, logits = out
+        nxt_host = np.asarray(nxt)  # the per-token host transfer: [S] int32
+        _obs.observe("serving_decode_step_seconds",
+                     time.perf_counter() - t0)
+        self.decode_steps += 1
+        self._last_logits = logits
+        active = list(self._running.items())
+        for slot, req in active:
+            self.total_tokens += 1
+            self._append_token(req, int(nxt_host[slot]))
+        _obs.inc("serving_tokens_total", len(active))
+        self._update_gauges()
+        return True
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive step() until every submitted request finished; returns
+        {req_id: prompt + generated} for requests completed in this
+        drain."""
+        t0 = time.perf_counter()
+        before = self.total_tokens
+        finished = [r.req_id for r in self._requests.values()
+                    if r.status == "done"]
+        seen_done = set(finished)
+        while self._waiting or self._running:
+            self.step()
+        emitted = self.total_tokens - before
+        dt = max(time.perf_counter() - t0, 1e-9)
+        if emitted:
+            _obs.set_gauge("serving_tokens_per_second", emitted / dt)
+        return {rid: self.result(rid) for rid, r in self._requests.items()
+                if r.status == "done" and rid not in seen_done}
+
+    def result(self, rid: int) -> np.ndarray:
+        req = self._requests[rid]
+        if req.status != "done":
+            raise RuntimeError(f"request {rid} is {req.status}, not done")
+        return np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+
+    def generate_batch(self, input_ids, max_new_tokens: int = 32,
+                       do_sample: bool = False, top_k: int = 0,
+                       top_p: float = 1.0, temperature: float = 1.0,
+                       eos_token_id=None, pad_token_id=None, seed=None):
+        """Batch front end with text.generation.generate semantics: every
+        row becomes a request, rows that finish early are padded with
+        pad_token_id (else eos, else 0). Returns a Tensor [B, T0 + n]."""
+        ids = np.asarray(raw(input_ids))
+        b, t0 = ids.shape
+        rids = [
+            self.submit(ids[i], SamplingParams(
+                max_new_tokens=max_new_tokens, do_sample=do_sample,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id,
+                seed=None if seed is None else seed * 1000003 + i))
+            for i in range(b)
+        ]
+        self.run()
+        reqs = [self._requests[r] for r in rids]
+        width = max(len(r.tokens) for r in reqs)
+        filler = pad_token_id if pad_token_id is not None else (
+            eos_token_id if eos_token_id is not None else 0)
+        out = np.full((b, t0 + width), filler, dtype=ids.dtype)
+        out[:, :t0] = ids
+        for i, r in enumerate(reqs):
+            out[i, t0:t0 + len(r.tokens)] = r.tokens
+        return Tensor(jnp.asarray(out))
+
+    def stats(self) -> dict:
+        return {
+            "compile_count": self.compile_count,
+            "compiled": sorted(self._compiled),
+            "buckets": list(self.buckets),
+            "decode_steps": self.decode_steps,
+            "total_tokens": self.total_tokens,
+            "running": len(self._running),
+            "waiting": len(self._waiting),
+        }
+
+    # -- internals ----------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"no prompt bucket holds length {n}")
+
+    def _state_vals(self):
+        return [t._value for t in self._state]
+
+    def _admit(self):
+        while self._free and self._waiting:
+            req = self._waiting.popleft()
+            self._prefill(req, self._free.pop())
+        _obs.set_gauge("serving_queue_depth", float(len(self._waiting)))
+        self._update_gauges()
+
+    def _prefill(self, req: Request, slot: int):
+        tb = self._bucket_for(len(req.prompt))
+        fn = self._prefill_jit.get(tb)
+        if fn is None:
+            fn = self._build_prefill(tb)
+            self._prefill_jit[tb] = fn
+        ids = np.zeros((1, tb), np.int32)
+        ids[0, :len(req.prompt)] = req.prompt
+        t_, k_, p_, g_ = req.params.fields()
+        out = self._run_counted(
+            f"prefill_b{tb}", fn,
+            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
+            jnp.asarray(ids), np.int32(len(req.prompt)), np.int32(slot),
+            jnp.asarray(req.key_np), np.float32(t_), np.int32(k_),
+            np.float32(p_), np.asarray(g_))
+        self._kc, self._vc, self._ksc, self._vsc, nxt, logits = out
+        token = int(nxt)
+        now = time.perf_counter()
+        req.first_token_time = now
+        _obs.observe("serving_ttft_seconds", now - req.submit_time)
+        req.slot = slot
+        req.status = "running"
+        self._running[slot] = req
+        self.total_tokens += 1
+        _obs.inc("serving_tokens_total")
+        self._append_token(req, token)
+
+    def _append_token(self, req: Request, token: int):
+        req.tokens.append(token)
+        p = req.params
+        if len(req.tokens) >= p.max_new_tokens or (
+                p.eos_token_id is not None and token == p.eos_token_id):
+            self._finish(req)
+
+    def _finish(self, req: Request):
+        req.status = "done"
+        if req.slot >= 0:
+            del self._running[req.slot]
+            self._free.append(req.slot)
+            req.slot = -1
+        ttft = (None if req.first_token_time is None
+                else req.first_token_time - req.submit_time)
+        _obs.event("serving_request_done", req_id=req.req_id,
+                   prompt_tokens=int(len(req.prompt)),
+                   generated_tokens=len(req.tokens), ttft_seconds=ttft)
+
+    def _update_gauges(self):
+        cfg = self.config
+        used = sum(len(r.prompt) + len(r.tokens)
+                   for r in self._running.values())
+        _obs.set_gauge("serving_batch_occupancy",
+                       len(self._running) / float(cfg.num_slots))
+        _obs.set_gauge("serving_kv_cache_utilization",
+                       used / float(cfg.num_slots * cfg.max_length))
+
+    def _run_counted(self, name, fn, *args):
+        first = name not in self._compiled
+        t0 = time.perf_counter() if first else 0.0
+        out = fn(*args)
+        if first:
+            jax.block_until_ready(out[-2])
+            dt = time.perf_counter() - t0
+            self._compiled.add(name)
+            self.compile_count += 1
+            _obs.inc("serving_engine_compile_total")
+            _obs.record_compile("decode_engine", dt, signature=name)
+        return out
+
+    # -- compiled programs --------------------------------------------------
+    #
+    # Both programs take the model state EXPLICITLY (param/buffer values are
+    # swapped into the live tensors around the traced body and restored —
+    # the jit.TracedLayer idiom), so parameters stay jit arguments rather
+    # than baked-in constants, and the KV cache flows through as donated
+    # inputs/outputs.
+
+    def _build_prefill(self, tb: int):
+        ad, state, int8 = self.adapter, self._state, self._int8
+        layers = ad.num_layers
+        group = ad.num_heads // ad.num_kv_heads
+
+        def pure(state_vals, kc, vc, ksc, vsc, ids, true_len, slot, key,
+                 temp, top_k, top_p, greedy):
+            originals = [t._value for t in state]
+            try:
+                for t_, v_ in zip(state, state_vals):
+                    t_._value = v_
+                with no_grad():
+                    positions = jnp.arange(tb, dtype=jnp.int32)
+                    x = ad.embed(Tensor(ids), positions)
+                    for l in range(layers):
+                        h = ad.pre_attn(l, x)
+                        q, k, v = ad.qkv(l, h, positions)
+                        kc, ksc = _prefill_write(kc, ksc, l, slot, raw(k),
+                                                 int8)
+                        vc, vsc = _prefill_write(vc, vsc, l, slot, raw(v),
+                                                 int8)
+                        if group > 1:
+                            k = Tensor(jnp.repeat(raw(k), group, axis=2))
+                            v = Tensor(jnp.repeat(raw(v), group, axis=2))
+                        o = F.scaled_dot_product_attention(
+                            q, k, v, is_causal=True, training=False)
+                        x = x + ad.attn_out(l, o)
+                        x = x + ad.mlp(l, x)
+                    x = ad.final_norm(x)
+                    # right-pad positions >= true_len are inert under the
+                    # causal mask; the real last-token logits sit at
+                    # true_len - 1
+                    last = jax.lax.dynamic_slice_in_dim(
+                        raw(x), true_len - 1, 1, 1)
+                    logits = raw(ad.logits(Tensor(last)))[:, 0].astype(
+                        jnp.float32)
+            finally:
+                for t_, v_ in zip(state, originals):
+                    t_._value = v_
+            # sample stream keyed by DESTINATION position: token landing at
+            # position true_len uses fold_in(key, true_len), matching what
+            # the decode step would use — scheduling-invariant
+            step_key = jax.random.fold_in(key, true_len)
+            nxt = _sample_tokens(logits, step_key[None], temp[None],
+                                 top_k[None], top_p[None], greedy[None])
+            return kc, vc, ksc, vsc, nxt[0], logits[0]
+
+        donate = (1, 2, 3, 4) if self._donate else ()
+        return jax.jit(pure, donate_argnums=donate)
+
+    def _build_decode(self):
+        ad, state, int8 = self.adapter, self._state, self._int8
+        layers = ad.num_layers
+
+        def pure(state_vals, kc, vc, ksc, vsc, tokens, positions, keys,
+                 temp, top_k, top_p, greedy):
+            originals = [t._value for t in state]
+            try:
+                for t_, v_ in zip(state, state_vals):
+                    t_._value = v_
+                with no_grad():
+                    pos2 = positions[:, None]  # [S, 1]
+                    x = ad.embed(Tensor(tokens[:, None]), pos2)
+                    for l in range(layers):
+                        h = ad.pre_attn(l, x)
+                        q, k, v = ad.qkv(l, h, pos2)
+                        kc, ksc = _decode_write(kc, ksc, l, raw(k),
+                                                positions, int8)
+                        vc, vsc = _decode_write(vc, vsc, l, raw(v),
+                                                positions, int8)
+                        o = F.decode_attention(
+                            q, _layer_kv(kc, ksc, l, int8),
+                            _layer_kv(vc, vsc, l, int8), positions)
+                        x = x + ad.attn_out(l, o)
+                        x = x + ad.mlp(l, x)
+                    x = ad.final_norm(x)
+                    logits = raw(ad.logits(x))[:, 0].astype(jnp.float32)
+            finally:
+                for t_, v_ in zip(state, originals):
+                    t_._value = v_
+            step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
+            nxt = _sample_tokens(logits, step_keys, temp, top_k, top_p,
+                                 greedy)
+            return kc, vc, ksc, vsc, nxt, logits
+
+        donate = (1, 2, 3, 4) if self._donate else ()
+        return jax.jit(pure, donate_argnums=donate)

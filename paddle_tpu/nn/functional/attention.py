@@ -168,6 +168,42 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
+@defop(amp="white", name="decode_attention_op")
+def _decode_attention_op(q, ck, cv, cache_position, scale):
+    """Single-token decode attention against a static slot-indexed cache.
+
+    q: [S, 1, H, D] (one new token per slot); ck/cv: [S, Hkv, T, D]
+    (one layer's slice of the serving engine's [L, S, Hkv, T, D] cache);
+    cache_position: [S] int — the position the current token was written
+    at, so keys at positions > cache_position[s] (stale slot garbage or
+    other requests' leftovers) are masked out per slot. GQA-native: query
+    heads are grouped onto their kv head, no head replication in HBM.
+    """
+    s_, _, h, d = q.shape
+    hkv, t = ck.shape[1], ck.shape[2]
+    group = h // hkv
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q[:, 0].astype(jnp.float32).reshape(s_, hkv, group, d)
+    logits = jnp.einsum("shgd,shtd->shgt", qf, ck.astype(jnp.float32)) * sc
+    mask = jnp.arange(t)[None, None, None, :] \
+        <= cache_position[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("shgt,shtd->shgd", probs, cv.astype(jnp.float32))
+    return out.reshape(s_, 1, h, d).astype(q.dtype)
+
+
+def decode_attention(query, cache_k, cache_v, cache_position, scale=None,
+                     name=None):
+    """One-step KV-cached attention for serving decode (the decode-shape
+    companion of :func:`scaled_dot_product_attention`; see
+    docs/SERVING.md). Shapes: ``query`` [S, 1, H, D]; ``cache_k/v``
+    [S, Hkv, T_max, D]; ``cache_position`` [S] int32 (per-slot position of
+    the token being decoded)."""
+    return _decode_attention_op(query, cache_k, cache_v, cache_position,
+                                scale)
+
+
 @defop(name="sparse_attention_op")
 def _sparse_attention(q, k, v, offset, columns, key_padding_mask, attn_mask):
     # q/k/v: [B, H, T, D] (paddle sparse_attention layout); CSR pattern

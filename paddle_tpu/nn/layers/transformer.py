@@ -62,7 +62,12 @@ class MultiHeadAttention(Layer):
         b, t = x.shape[0], x.shape[1]
         return x.reshape([b, t, self.num_heads, self.head_dim])
 
-    def gen_cache(self, key, value=None, type=None):
+    def gen_cache(self, key, value=None, type=None, max_length=None):
+        """`max_length` preallocates a STATIC-shape incremental cache
+        [B, max_length, H, D]: pair it with `forward(cache_position=...)`
+        so every decode step reuses one compiled program (the serving
+        shape discipline; legacy `max_length=None` keeps the concat-grow
+        cache)."""
         if type == MultiHeadAttention.StaticCache or (value is not None and type is None):
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value if value is not None else key))
@@ -70,11 +75,46 @@ class MultiHeadAttention(Layer):
         b = raw(key).shape[0]
         import paddle_tpu as P
 
-        k = P.zeros([b, 0, self.num_heads, self.head_dim], "float32")
-        v = P.zeros([b, 0, self.num_heads, self.head_dim], "float32")
+        t = max_length if max_length is not None else 0
+        k = P.zeros([b, t, self.num_heads, self.head_dim], "float32")
+        v = P.zeros([b, t, self.num_heads, self.head_dim], "float32")
         return MultiHeadAttention.Cache(k, v)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def _forward_static_cache(self, q, k, v, cache, cache_position):
+        """Write k/v [B, t, H, D] into the preallocated cache at
+        `cache_position` and attend over positions <= cache_position +
+        t - 1. t == 1 routes through the fused decode-shape attention
+        (F.decode_attention); prompt blocks (t > 1) run masked SDPA over
+        the full buffer. Inference-only: the cache update bypasses the
+        autograd tape."""
+        import jax
+
+        b, t = q.shape[0], q.shape[1]
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            raw(cache.k), raw(k).astype(raw(cache.k).dtype),
+            cache_position, 1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            raw(cache.v), raw(v).astype(raw(cache.v).dtype),
+            cache_position, 1)
+        cache = MultiHeadAttention.Cache(Tensor(ck), Tensor(cv))
+        tmax = ck.shape[1]
+        if t == 1:
+            positions = jnp.full((b,), cache_position, jnp.int32)
+            out = F.decode_attention(
+                q, jnp.swapaxes(ck, 1, 2), jnp.swapaxes(cv, 1, 2),
+                positions)
+        else:
+            mask = (jnp.arange(tmax)[None, :]
+                    <= cache_position + jnp.arange(t)[:, None])
+            out = F.scaled_dot_product_attention(
+                q, Tensor(ck), Tensor(cv),
+                attn_mask=Tensor(mask[None, None]), dropout_p=self.dropout,
+                is_causal=False, training=self.training,
+            )
+        return out, cache
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
+                cache_position=None):
         key = query if key is None else key
         value = query if value is None else value
         q = self._split(self.q_proj(query))
@@ -84,6 +124,13 @@ class MultiHeadAttention(Layer):
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value))
             if isinstance(cache, MultiHeadAttention.Cache):
+                if cache_position is not None:
+                    out, cache = self._forward_static_cache(
+                        q, k, v, cache, cache_position)
+                    b, t = out.shape[0], out.shape[1]
+                    out = self.out_proj(out.reshape([b, t, self.embed_dim]))
+                    return ((out, None, cache) if self.need_weights
+                            else (out, cache))
                 from ...tensor.manipulation import concat
 
                 k = concat([cache.k, k], axis=1)
@@ -193,14 +240,17 @@ class TransformerDecoderLayer(Layer):
         self.dropout3 = Dropout(dropout)
         self.activation = getattr(F, activation)
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None,
+                cache_position=None):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
         if cache is None:
             tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
         else:
-            tgt, incr_cache = self.self_attn(tgt, tgt, tgt, tgt_mask, cache[0])
+            tgt, incr_cache = self.self_attn(
+                tgt, tgt, tgt, tgt_mask, cache[0],
+                cache_position=cache_position)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
@@ -225,8 +275,9 @@ class TransformerDecoderLayer(Layer):
             tgt = self.norm3(tgt)
         return tgt if cache is None else (tgt, (incr_cache, cache[1]))
 
-    def gen_cache(self, memory):
-        incr = self.self_attn.gen_cache(memory, type=MultiHeadAttention.Cache)
+    def gen_cache(self, memory, max_length=None):
+        incr = self.self_attn.gen_cache(memory, type=MultiHeadAttention.Cache,
+                                        max_length=max_length)
         static = self.cross_attn.gen_cache(memory, memory, type=MultiHeadAttention.StaticCache)
         return incr, static
 
@@ -240,21 +291,23 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None,
+                cache_position=None):
         output = tgt
         new_caches = []
         for i, mod in enumerate(self.layers):
             if cache is None:
                 output = mod(output, memory, tgt_mask, memory_mask)
             else:
-                output, new_cache = mod(output, memory, tgt_mask, memory_mask, cache[i])
+                output, new_cache = mod(output, memory, tgt_mask, memory_mask,
+                                        cache[i], cache_position=cache_position)
                 new_caches.append(new_cache)
         if self.norm is not None:
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
 
-    def gen_cache(self, memory, do_zip=False):
-        cache = [l.gen_cache(memory) for l in self.layers]
+    def gen_cache(self, memory, do_zip=False, max_length=None):
+        cache = [l.gen_cache(memory, max_length=max_length) for l in self.layers]
         return list(zip(*cache)) if do_zip else cache
 
 

@@ -33,6 +33,31 @@ def _without_grad(fn):
     return wrapper
 
 
+def _pow2_bucket(n: int, lo: int = 16) -> int:
+    """Smallest power-of-two >= n (floored at `lo`) — the shared length-
+    bucketing rule (inference.engine uses the same for prompt buckets)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _engine_for(model, use_engine, prompt_len: int, total_len: int):
+    """The attached decode engine (inference.enable_decode_engine) when it
+    can serve this call, else None. `use_engine=False` forces the legacy
+    loop; `use_engine=None` auto-selects. A request the engine cannot hold
+    (prompt beyond its largest bucket, or total length beyond its cache)
+    silently falls back to the legacy loop rather than failing."""
+    if use_engine is False:
+        return None
+    eng = getattr(model, "_decode_engine", None)
+    if eng is None:
+        return None
+    if total_len > eng.config.max_length or prompt_len > eng.buckets[-1]:
+        return None
+    return eng
+
+
 def _check_length(model, needed: int):
     """Out-of-range position embeddings clamp SILENTLY under XLA gather —
     raise up front instead of returning corrupted tokens."""
@@ -97,6 +122,7 @@ def generate(
     eos_token_id: Optional[int] = None,
     pad_token_id: Optional[int] = None,
     seed: Optional[int] = None,
+    use_engine: Optional[bool] = None,
 ):
     """Decode continuations for a batch of prompts.
 
@@ -105,22 +131,53 @@ def generate(
         labels (GPTForCausalLM / LlamaForCausalLM or compatible).
       input_ids: [B, T0] prompt tokens (Tensor or array).
       do_sample: False = greedy; True = top-k / nucleus sampling.
+      use_engine: None auto-routes through the KV-cached decode engine
+        when one is attached (inference.enable_decode_engine, see
+        docs/SERVING.md); False forces the legacy loop. Engine sampling
+        runs on device with per-request streams, so sampled outputs for
+        a given `seed` differ between the two paths (greedy is
+        identical).
     Returns [B, T0 + n] token ids (numpy), n <= max_new_tokens (stops early
     when every sequence has emitted eos).
+
+    The legacy fallback right-pads the growing sequence to power-of-two
+    length buckets (padding is inert under the causal mask), so one call
+    compiles O(log max_new_tokens) programs instead of one per emitted
+    token.
     """
     was_training = getattr(model, "training", False)
     if hasattr(model, "eval"):
         model.eval()
     try:
         ids = np.asarray(raw(input_ids))
+        b, t0 = ids.shape
+        total = t0 + max_new_tokens
+        eng = _engine_for(model, use_engine, t0, total)
+        if eng is not None:
+            out = eng.generate_batch(
+                ids, max_new_tokens=max_new_tokens, do_sample=do_sample,
+                top_k=top_k, top_p=top_p, temperature=temperature,
+                eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+                seed=seed)
+            return np.asarray(raw(out))
         rng = np.random.default_rng(seed)
-        b = ids.shape[0]
         done = np.zeros(b, bool)
         filler = pad_token_id if pad_token_id is not None else eos_token_id
-        _check_length(model, ids.shape[1] + max_new_tokens)
+        _check_length(model, total)
+        # any valid id works as bucket padding: padded positions sit to the
+        # RIGHT of every position we read, and causal attention never looks
+        # forward
+        bucket_fill = filler if filler is not None else 0
         for _ in range(max_new_tokens):
-            logits = model(Tensor(ids))
-            last = np.asarray(raw(logits))[:, -1, :]  # [B, V]
+            cur = ids.shape[1]
+            tb = min(_pow2_bucket(cur), total)
+            if tb > cur:
+                pad = np.full((b, tb - cur), bucket_fill, ids.dtype)
+                feed = np.concatenate([ids, pad], axis=1)
+            else:
+                feed = ids
+            logits = model(Tensor(feed))
+            last = np.asarray(raw(logits))[:, cur - 1, :]  # [B, V]
             nxt = _next_tokens(last, do_sample, top_k, top_p, temperature, rng)
             if eos_token_id is not None:
                 nxt = np.where(done, filler, nxt)
@@ -141,10 +198,14 @@ def generate_padded(
     max_length: int,
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
+    use_engine: Optional[bool] = None,
 ):
     """Greedy decode with ONE fixed shape: the sequence is right-padded to
     `max_length` so every step re-runs the same compiled program (the
-    TPU serving discipline — no per-length recompilation)."""
+    TPU serving discipline — no per-length recompilation). When a decode
+    engine is attached (inference.enable_decode_engine) the call routes
+    through its KV-cached continuous-batching loop instead — same greedy
+    tokens, O(1) work per emitted token rather than a full forward."""
     was_training = getattr(model, "training", False)
     if hasattr(model, "eval"):
         model.eval()
@@ -155,6 +216,12 @@ def generate_padded(
             raise ValueError(
                 f"prompt length {t0} already >= max_length {max_length}"
             )
+        eng = _engine_for(model, use_engine, t0, max_length)
+        if eng is not None:
+            out = eng.generate_batch(
+                ids, max_new_tokens=max_length - t0,
+                eos_token_id=eos_token_id, pad_token_id=pad_token_id)
+            return np.asarray(raw(out))
         _check_length(model, max_length)
         buf = np.full((b, max_length), pad_token_id, ids.dtype)
         buf[:, :t0] = ids

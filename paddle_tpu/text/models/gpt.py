@@ -357,3 +357,65 @@ def _gpt_generate(self, input_ids, max_new_tokens: int = 32,
 
 
 GPTForCausalLM.generate = _gpt_generate
+
+
+# ---------------------------------------------------------------------------
+# Serving decode-engine adapter (inference/engine.py). The engine owns the
+# residual stream and the slot-indexed KV cache; the adapter exposes the
+# per-layer hooks (norm / qkv / out-proj / mlp) plus the geometry the engine
+# needs to size its [L, S, Hkv, Tmax, D] cache. One engine loop then serves
+# every decoder-only model family.
+# ---------------------------------------------------------------------------
+
+
+class _GPTDecodeAdapter:
+    def __init__(self, lm: "GPTForCausalLM"):
+        if not isinstance(lm.gpt.decoder, nn.LayerList):
+            raise NotImplementedError(
+                "the decode engine requires the non-pipelined, unfolded "
+                "decoder (pp_degree=1, fold_layers=False)"
+            )
+        cfg = lm.config
+        self.lm = lm
+        self.blocks = list(lm.gpt.decoder)
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.max_positions = cfg.max_position_embeddings
+
+    def embed(self, input_ids, positions):
+        """input_ids Tensor [B, T]; positions int array [T] or [B, T]."""
+        import jax.numpy as jnp
+
+        return self.lm.gpt.embeddings(
+            input_ids, Tensor(jnp.asarray(positions)))
+
+    def pre_attn(self, layer, x):
+        return self.blocks[layer].ln_1(x)
+
+    def qkv(self, layer, h, positions):
+        return _gpt_qkv(self.blocks[layer].attn, h)
+
+    def attn_out(self, layer, o):
+        attn = self.blocks[layer].attn
+        b, t = o.shape[0], o.shape[1]
+        return attn.out_proj(
+            o.reshape([b, t, attn.num_heads * attn.head_dim]))
+
+    def mlp(self, layer, x):
+        blk = self.blocks[layer]
+        return blk.mlp(blk.ln_2(x))
+
+    def final_norm(self, x):
+        return self.lm.gpt.final_layernorm(x)
+
+    def logits(self, hidden):
+        return self.lm._logits(hidden)
+
+
+def _gpt_decode_adapter(self):
+    return _GPTDecodeAdapter(self)
+
+
+GPTForCausalLM.decode_adapter = _gpt_decode_adapter

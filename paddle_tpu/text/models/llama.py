@@ -308,6 +308,27 @@ def _apply_rope_at(x, cos, sin, pos):
     ).astype(x.dtype)
 
 
+@defop(name="rope_positions")
+def _apply_rope_positions(x, cos, sin, positions):
+    """Rotate [B, T, H, D] at explicit ABSOLUTE positions — the serving
+    engine's form of rope: `positions` is an int array [T] (shared across
+    the batch, prefill) or [B, T] (per-slot decode), gathered from the
+    cos/sin cache instead of sliced, so per-slot decode positions stay a
+    single compiled program."""
+    import jax.numpy as jnp
+
+    d2 = x.shape[-1] // 2
+    pos = jnp.asarray(positions)
+    c = jnp.take(cos, pos, axis=0)[..., None, :]  # [(B,) T, 1, D/2]
+    s = jnp.take(sin, pos, axis=0)[..., None, :]
+    if pos.ndim == 1:
+        c, s = c[None], s[None]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
 @defop(name="cache_write")
 def _cache_write(cache, kv, pos):
     """cache [B, Tmax, Hkv, D] <- kv [B, T, Hkv, D] at [pos : pos+T]."""
@@ -425,3 +446,67 @@ def _llama_generate(self, input_ids, max_new_tokens: int = 32,
 
 
 LlamaForCausalLM.generate = _llama_generate
+
+
+# ---------------------------------------------------------------------------
+# Serving decode-engine adapter (inference/engine.py; see the GPT twin in
+# gpt.py for the contract). Rope is applied inside qkv() at the engine's
+# explicit positions so prefill buckets and per-slot decode share one code
+# path.
+# ---------------------------------------------------------------------------
+
+
+class _LlamaDecodeAdapter:
+    def __init__(self, lm: "LlamaForCausalLM"):
+        if not isinstance(lm.llama.layers, nn.LayerList):
+            raise NotImplementedError(
+                "the decode engine requires the non-pipelined, unfolded "
+                "decoder (pp_degree=1, fold_layers=False)"
+            )
+        cfg = lm.config
+        self.lm = lm
+        self.blocks = list(lm.llama.layers)
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.max_positions = cfg.max_position_embeddings
+
+    def embed(self, input_ids, positions):
+        return self.lm.llama.embed_tokens(input_ids)
+
+    def pre_attn(self, layer, x):
+        return self.blocks[layer].input_layernorm(x)
+
+    def qkv(self, layer, h, positions):
+        attn = self.blocks[layer].self_attn
+        b, t = h.shape[0], h.shape[1]
+        q = attn.q_proj(h).reshape([b, t, attn.num_heads, attn.head_dim])
+        k = attn.k_proj(h).reshape([b, t, attn.num_kv_heads, attn.head_dim])
+        v = attn.v_proj(h).reshape([b, t, attn.num_kv_heads, attn.head_dim])
+        q = _apply_rope_positions(q, attn.rope_cos, attn.rope_sin, positions)
+        k = _apply_rope_positions(k, attn.rope_cos, attn.rope_sin, positions)
+        return q, k, v
+
+    def attn_out(self, layer, o):
+        attn = self.blocks[layer].self_attn
+        b, t = o.shape[0], o.shape[1]
+        return attn.o_proj(
+            o.reshape([b, t, attn.num_heads * attn.head_dim]))
+
+    def mlp(self, layer, x):
+        blk = self.blocks[layer]
+        return blk.mlp(blk.post_attention_layernorm(x))
+
+    def final_norm(self, x):
+        return self.lm.llama.norm(x)
+
+    def logits(self, hidden):
+        return self.lm._logits(hidden)
+
+
+def _llama_decode_adapter(self):
+    return _LlamaDecodeAdapter(self)
+
+
+LlamaForCausalLM.decode_adapter = _llama_decode_adapter

@@ -35,6 +35,7 @@ SCAN_DIRS = [
     os.path.join("paddle_tpu", "distributed"),
     os.path.join("paddle_tpu", "testing"),
     os.path.join("paddle_tpu", "observability"),
+    os.path.join("paddle_tpu", "inference"),
 ]
 
 #: module aliases the facade is imported under at instrumented call sites
@@ -54,6 +55,7 @@ RECORDERS = {
 #: silently turn them into a mixed-meaning series.
 OWNED_PREFIXES = {
     "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
+    "serving_": os.path.join("paddle_tpu", "inference", "engine.py"),
 }
 
 

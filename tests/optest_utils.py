@@ -68,6 +68,9 @@ def _key():
 SKIP = {
     # --- gradients intentionally not defined / not meaningful -----------
     "nextafter": "no JAX differentiation rule (piecewise-constant ULP step)",
+    "frexp": "no vjp registered for the mantissa/exponent decomposition, "
+             "and central differences straddle binade boundaries where the "
+             "mantissa jumps by 2x (numeric oracle invalid)",
     "quantized_matmul": "int8 operands; dequantized output has no grad path",
     "weight_only_linear": "int8/int4 weights; grad path covered by "
                           "test_nn_quant.py",
@@ -334,6 +337,12 @@ OVERRIDES = {
         [_f((2, 4, 2, 8)), _f((4, 4)), _f((4, 4))], {}),
     "rope_at": lambda: (
         [_f((2, 1, 2, 8)), _f((16, 4)), _f((16, 4)), 3], {}),
+    "rope_positions": lambda: (
+        [_f((2, 3, 2, 8)), _f((16, 4)), _f((16, 4)),
+         np.array([3, 0, 7], np.int32)], {}),
+    "decode_attention_op": lambda: (
+        [_f((2, 1, 4, 8)), _f((2, 2, 8, 8)), _f((2, 2, 8, 8)),
+         np.array([3, 5], np.int32), 0.35], {}),
     # ---- dropout family: deterministic given a fixed PRNG key ----------
     "dropout_op": lambda: ([_f((3, 4)), _key(), 0.4, "upscale_in_train"],
                            {}),

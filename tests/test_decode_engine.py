@@ -1,0 +1,253 @@
+"""KV-cached decode engine + serving path (docs/SERVING.md).
+
+Gates the four serving promises: engine greedy decode is BIT-EQUAL to
+the naive full-forward loops, continuous batching keeps its invariants
+(mid-flight join, EOS eviction, slot reuse without KV leakage), int8 KV
+stays within tolerance of f32, and a mixed-length workload compiles at
+most ``buckets_used + 1`` programs.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.inference as inference
+from paddle_tpu.inference.engine import (DecodeEngine, EngineConfig,
+                                         SamplingParams, pow2_bucket)
+from paddle_tpu.text import generation
+from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.fleet.topology import (
+        get_hybrid_communicate_group, set_hybrid_communicate_group)
+
+    # serving is single-process here: shield the model build from any
+    # hybrid-parallel group / pp-sliced global mesh a fleet test left
+    # behind in this interpreter (mp-degree vocab splits, SpmdPipeline
+    # decoder folding)
+    prev = get_hybrid_communicate_group()
+    prev_mesh = _mesh.get_global_mesh()
+    set_hybrid_communicate_group(None)
+    _mesh.set_global_mesh(None)
+    try:
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        m.eval()
+        yield m
+        inference.disable_decode_engine(m)
+    finally:
+        set_hybrid_communicate_group(prev)
+        _mesh.set_global_mesh(prev_mesh)
+
+
+@pytest.fixture(autouse=True)
+def _detach_engine(model):
+    yield
+    inference.disable_decode_engine(model)
+
+
+def _prompts(b, t, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, VOCAB, (b, t), dtype=np.int64)
+
+
+def test_pow2_bucket():
+    assert [pow2_bucket(n) for n in (1, 16, 17, 33, 100)] == [
+        16, 16, 32, 64, 128]
+    assert pow2_bucket(100, hi=48) == 48
+    assert EngineConfig(max_length=100).resolved_buckets() == [16, 32, 64, 100]
+
+
+def test_engine_greedy_bit_equal_generate(model):
+    ids = _prompts(3, 7)
+    ref = generation.generate(model, ids, max_new_tokens=12,
+                              use_engine=False)
+    inference.enable_decode_engine(model, num_slots=4, max_length=64)
+    out = generation.generate(model, ids, max_new_tokens=12)
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_engine_greedy_bit_equal_generate_padded(model):
+    ids = _prompts(2, 9, seed=3)
+    ref = generation.generate_padded(model, ids, max_length=24,
+                                     use_engine=False)
+    inference.enable_decode_engine(model, num_slots=2, max_length=64)
+    out = generation.generate_padded(model, ids, max_length=24)
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_generate_bucketing_matches_fixed_shape(model):
+    # the legacy loop's pow2 right-pad buckets must not change tokens
+    ids = _prompts(2, 5, seed=5)
+    a = generation.generate(model, ids, max_new_tokens=11, use_engine=False)
+    b = generation.generate_padded(model, ids, max_length=16,
+                                   use_engine=False)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_join_mid_flight_and_slot_reuse(model):
+    # 3 requests on 2 slots: the third joins only after a slot frees,
+    # and its tokens must equal a solo run (slot reuse leaks no KV).
+    eng = inference.enable_decode_engine(model, num_slots=2, max_length=64)
+    ids = _prompts(3, 6, seed=11)
+    r0 = eng.submit(ids[0], SamplingParams(max_new_tokens=10))
+    r1 = eng.submit(ids[1], SamplingParams(max_new_tokens=3))
+    r2 = eng.submit(ids[2], SamplingParams(max_new_tokens=5))
+    eng.step()  # admits r0/r1 only — both slots busy, r2 waits
+    assert eng.stats()["running"] == 2 and eng.stats()["waiting"] == 1
+    assert eng._requests[r2].status == "waiting"
+    while eng._requests[r1].status != "done":
+        eng.step()
+    eng.step()  # r1's slot is free; r2 joins while r0 still decodes
+    assert eng._requests[r2].status in ("running", "done")
+    assert eng._requests[r0].status == "running"
+    eng.run()
+    got = {r: eng.result(r) for r in (r0, r1, r2)}
+    assert [len(got[r]) for r in (r0, r1, r2)] == [16, 9, 11]
+
+    solo = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+    for i, r in enumerate((r0, r1, r2)):
+        sid = solo.submit(ids[i], SamplingParams(
+            max_new_tokens=[10, 3, 5][i]))
+        solo.run()
+        np.testing.assert_array_equal(solo.result(sid), got[r])
+
+
+def test_eos_evicts_and_frees_slot(model):
+    eng = inference.enable_decode_engine(model, num_slots=2, max_length=64)
+    ids = _prompts(1, 6, seed=2)[0]
+    rid = eng.submit(ids, SamplingParams(max_new_tokens=20))
+    eng.run()
+    free_run = eng.result(rid)
+    eos = int(free_run[len(ids) + 2])  # third generated token
+    rid2 = eng.submit(ids, SamplingParams(max_new_tokens=20,
+                                          eos_token_id=eos))
+    eng.run()
+    out = eng.result(rid2)
+    # stopped at (and including) the FIRST eos in the greedy stream,
+    # short of max_new_tokens
+    first = len(ids) + int(np.argmax(free_run[len(ids):] == eos))
+    assert len(out) == first + 1 and out[-1] == eos
+    assert len(out) < len(free_run)
+    np.testing.assert_array_equal(out, free_run[:len(out)])
+    assert eng.stats()["running"] == 0 and len(eng._free) == 2
+
+
+def test_int8_kv_close_to_f32(model):
+    ids = _prompts(2, 8, seed=9)
+    f32 = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+    q = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64,
+                                         kv_dtype="int8"))
+    a = np.asarray(f32.generate_batch(ids, max_new_tokens=12)._value)
+    b = np.asarray(q.generate_batch(ids, max_new_tokens=12)._value)
+    agree = (a == b).mean()
+    assert agree >= 0.9, f"int8 KV diverged from f32: {agree:.0%} agreement"
+
+
+def test_compile_count_gate(model):
+    # mixed workload over 3 buckets compiles <= buckets_used + 1 programs
+    eng = inference.enable_decode_engine(
+        model, num_slots=4, max_length=128)
+    assert eng.buckets == [16, 32, 64, 128]
+    for t0 in (5, 20, 40, 10, 25):  # buckets 16, 32, 64, 16, 32
+        eng.submit(_prompts(1, t0, seed=t0)[0],
+                   SamplingParams(max_new_tokens=4))
+    eng.run()
+    assert eng.stats()["compile_count"] <= 3 + 1
+    before = eng.stats()["compile_count"]
+    eng.submit(_prompts(1, 12, seed=99)[0], SamplingParams(max_new_tokens=4))
+    eng.run()  # same bucket (16) — nothing new compiles
+    assert eng.stats()["compile_count"] == before
+
+
+def test_sampling_is_scheduling_invariant(model):
+    ids = _prompts(4, 6, seed=21)
+    p = SamplingParams(max_new_tokens=8, do_sample=True, temperature=0.8,
+                      top_k=12, top_p=0.95, seed=123)
+    solo = DecodeEngine(model, EngineConfig(num_slots=1, max_length=64))
+    rid = solo.submit(ids[0], p)
+    solo.run()
+    alone = solo.result(rid)
+
+    # same request, different slot count, batched with other traffic
+    busy = DecodeEngine(model, EngineConfig(num_slots=4, max_length=64))
+    others = [busy.submit(ids[i], SamplingParams(max_new_tokens=5))
+              for i in (1, 2, 3)]
+    rid2 = busy.submit(ids[0], p)
+    busy.run()
+    np.testing.assert_array_equal(alone, busy.result(rid2))
+    assert all(busy._requests[r].status == "done" for r in others)
+
+
+def test_submit_validation(model):
+    eng = DecodeEngine(model, EngineConfig(num_slots=1, max_length=32))
+    with pytest.raises(ValueError):
+        eng.submit(np.array([], np.int32))
+    with pytest.raises(ValueError):
+        eng.submit(_prompts(1, 40, seed=1)[0])  # exceeds largest bucket
+    with pytest.raises(ValueError):
+        eng.submit(_prompts(1, 8, seed=1)[0],
+                   SamplingParams(max_new_tokens=30))  # overflows ring
+
+
+def test_transformer_static_cache_matches_concat_grow():
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.core import Tensor
+    from paddle_tpu.framework.op import raw
+    from paddle_tpu.nn.layers.transformer import (TransformerDecoder,
+                                                  TransformerDecoderLayer)
+
+    import paddle_tpu as paddle
+
+    paddle.seed(3)
+    B, T, E, H = 2, 5, 16, 4
+    dec = TransformerDecoder(
+        TransformerDecoderLayer(E, H, 32, dropout=0.0), 2)
+    dec.eval()
+    rng = np.random.default_rng(0)
+    x = Tensor(jnp.asarray(rng.standard_normal((B, T, E)), jnp.float32))
+    mem = Tensor(jnp.asarray(rng.standard_normal((B, 3, E)), jnp.float32))
+    legacy = dec.gen_cache(mem)
+    static = dec.gen_cache(mem, max_length=8)
+    assert raw(static[0][0].k).shape == (B, 8, H, E // H)
+    for t in range(T):
+        xt = Tensor(raw(x)[:, t:t + 1])
+        ol, legacy = dec(xt, mem, cache=legacy)
+        os_, static = dec(xt, mem, cache=static, cache_position=t)
+        np.testing.assert_allclose(np.asarray(raw(ol)),
+                                   np.asarray(raw(os_)),
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_throughput_soak(model):
+    """Sustained mixed traffic: 24 random-size requests through 4 slots.
+
+    Everything must drain, token budgets must be exact, and the program
+    count must stay at buckets_used + 1 no matter the arrival order."""
+    rng = np.random.default_rng(0)
+    eng = inference.enable_decode_engine(model, num_slots=4, max_length=128)
+    want = {}
+    for i in range(24):
+        t0 = int(rng.integers(3, 60))
+        n = int(rng.integers(1, 16))
+        rid = eng.submit(_prompts(1, t0, seed=i)[0],
+                         SamplingParams(max_new_tokens=n,
+                                        do_sample=bool(i % 2), seed=i))
+        want[rid] = t0 + n
+        if i % 5 == 4:
+            eng.step()  # interleave arrivals with decode progress
+    eng.run()
+    for rid, total in want.items():
+        assert len(eng.result(rid)) == total
+    used = {b for b in eng.stats()["compiled"] if b != "decode"}
+    assert eng.stats()["compile_count"] <= len(used) + 1

@@ -79,6 +79,37 @@ def test_predictor_handles(tmp_path):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
+def test_predictor_inputs_stay_device_resident(tmp_path):
+    # run() re-device_puts an input only when copy_from_cpu bumped its
+    # version; unchanged handles reuse the cached device array, and
+    # output handles hold device arrays until copy_to_cpu is asked.
+    import jax
+
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "devres")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 16], "float32", "input")])
+    from paddle_tpu import inference
+
+    predictor = inference.create_predictor(inference.Config(prefix))
+    h = predictor.get_input_handle("input")
+    x = np.random.RandomState(2).randn(2, 16).astype("float32")
+    h.copy_from_cpu(x)
+    predictor.run()
+    dev1 = predictor._dev_inputs["input"][1]
+    assert isinstance(dev1, jax.Array)
+    predictor.run()  # no copy_from_cpu between runs
+    assert predictor._dev_inputs["input"][1] is dev1
+    h.copy_from_cpu(x + 1.0)
+    predictor.run()
+    assert predictor._dev_inputs["input"][1] is not dev1
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    assert isinstance(out_h._value, jax.Array)
+    np.testing.assert_allclose(
+        out_h.copy_to_cpu(), _np(net(paddle.to_tensor(x + 1.0))),
+        rtol=1e-5, atol=1e-5)
+
+
 def test_predictor_positional_run(tmp_path):
     net = SmallNet()
     net.eval()

@@ -106,6 +106,33 @@ def test_owned_metric_from_owner_allowed(tmp_path):
     assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
 
 
+_SERVING_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.set_gauge("serving_queue_depth", 2.0)
+"""
+
+
+def test_serving_metric_from_wrong_file_rejected(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_SERVING_SRC))
+    rel = os.path.join("scripts", "bench_serving.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+
+
+def test_serving_metric_from_engine_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_SERVING_SRC))
+    rel = os.path.join("paddle_tpu", "inference", "engine.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_inference_dir_is_scanned():
+    assert os.path.join("paddle_tpu", "inference") in check_observability.SCAN_DIRS
+    assert "serving_" in check_observability.OWNED_PREFIXES
+
+
 def test_registered_literals_allowed(tmp_path):
     assert not _violations(tmp_path, """
         from paddle_tpu import observability as _obs
