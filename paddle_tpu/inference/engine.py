@@ -1,45 +1,55 @@
-"""KV-cached decode engine with continuous batching — the serving path.
+"""Paged-KV decode engine: continuous batching, prefix sharing, speculation.
 
 Reference capability: Paddle Inference's generation serving stack (fused
 attention-with-cache kernels updating an in-place ``cache_kv`` per layer)
-and PaddleNLP's ``llm/predictor.py`` batched serving loop. TPU-native
-design (the static-shape serving discipline on XLA):
+and PaddleNLP's ``llm/predictor.py`` batched serving loop, extended with
+the vLLM-style block-granular cache discipline. TPU-native design (the
+static-shape serving discipline on XLA):
 
-* **Static shapes only.** Two compiled program families serve every
-  request mix: one prefill per power-of-two prompt bucket (batch 1,
-  written into a slot) and ONE single-token decode step over all
-  ``num_slots`` slots. Nothing recompiles per request, per length, or
-  per step; a 3-bucket workload compiles <= 4 XLA programs
-  (tests/test_decode_engine.py gates this).
-* **Slot-indexed KV cache.** ``[L, S, Hkv, T_max, D]`` stacked buffers
-  live on device and are donated back to XLA on every compiled step
-  (TPU/GPU backends), so the cache updates in place instead of copying.
-* **Continuous batching.** A pure-Python scheduler admits waiting
-  requests into free slots and evicts finished ones BETWEEN compiled
-  steps: short requests never wait for long ones and decode occupancy
-  stays high. Slot reuse cannot leak a previous request's KV — decode
-  attention masks positions > the slot's own ``cache_position``, and
-  every position <= it has been freshly written by the current request.
-* **On-device sampling.** greedy/temperature/top-k/top-p run inside the
-  decode program via ``jax.random`` with per-slot keys folded by target
-  position (so a request's sample stream does not depend on which other
-  requests it was batched with); the per-token host transfer is one
-  int32 per slot, never a logits matrix.
-* **Optional int8 KV.** ``kv_dtype="int8"`` stores the cache at one byte
-  per element with per-(layer, slot, head, position) absmax scales via
-  grad_comm's quantize/dequantize helpers — the reduced-precision-with-
-  absmax-scales discipline the gradient wire already uses, applied to
-  the dominant serving memory consumer.
+* **Static shapes only.** Three compiled program families serve every
+  request mix: one prefill per power-of-two *tail* bucket (batch 1,
+  written through a page table), ONE single-token decode step over all
+  ``num_slots`` slots, and (when ``speculate_k > 0``) ONE multi-token
+  verify step. Nothing recompiles per request, per length, or per step.
+* **Paged KV cache.** The cache is a page pool
+  ``[L, num_pages, Hkv, page_size, D]`` plus a per-slot page table
+  ``[S, max_pages]`` (host-maintained int32). Page 0 is a reserved trash
+  page; a free-list allocator hands out the rest. A request holds only
+  ``ceil(total_len / page_size)`` pages instead of a full ``max_length``
+  ring, so short requests stop stranding HBM and the pool can serve far
+  more concurrent requests per GB (``scripts/bench_serving.py`` churn
+  scenario). ``F.paged_attention`` gathers K/V through the table; int8
+  scales are paged identically.
+* **Prefix caching.** Full prompt blocks are chain-hashed
+  (``h_j = H(h_{j-1} || tokens_j)``) and registered in a bounded-LRU
+  page registry with refcounts. A new prompt whose leading blocks hit
+  the registry shares those pages (incref, never rewritten — decode and
+  tail writes only touch pages past ``cached_len``, which is the
+  copy-on-write discipline) and prefills ONLY the unique tail: an
+  80 %-shared-prefix workload skips 80 % of its prefill FLOPs.
+* **Speculative / multi-token decode.** A host-side prompt-lookup
+  (n-gram) draft proposes ``k`` tokens per slot; one compiled verify
+  program scores current + k draft tokens in a single target-model pass
+  and per-position target tokens are accepted while they agree with the
+  draft, emitting up to ``k + 1`` tokens per step. Acceptance compares
+  against the SAME position-keyed sample streams the decode step uses
+  (``fold_in(request_key, position)``), so greedy output stays bit-equal
+  and sampled streams stay scheduling-invariant with speculation on or
+  off.
+* **Continuous batching / on-device sampling / int8 KV** as before
+  (PR 5): pure-Python scheduler admits into free slots between compiled
+  steps, one int32 per slot per step host transfer (``k+1`` for verify),
+  absmax-scaled int8 via grad_comm's quantize/dequantize helpers.
 
 Models plug in through ``model.decode_adapter()`` (text/models/gpt.py,
-llama.py): the engine owns the residual stream, the cache, and the
-sampler; the adapter exposes embed / per-layer norm+qkv+out-proj+mlp /
-final-norm / logits hooks plus cache geometry. See docs/SERVING.md.
+llama.py). See docs/SERVING.md for the page-table invariants and the
+accept/reject rule.
 """
 from __future__ import annotations
 
+import hashlib
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -56,11 +66,17 @@ from ..nn import functional as F
 __all__ = [
     "DecodeEngine",
     "EngineConfig",
+    "PagePool",
+    "PrefixRegistry",
     "SamplingParams",
     "pow2_bucket",
 ]
 
 KV_DTYPES = ("f32", "bf16", "int8")
+
+#: the reserved all-garbage page every unallocated page-table entry (and
+#: every masked scatter) points at; never handed out by the allocator
+TRASH_PAGE = 0
 
 
 def pow2_bucket(n: int, lo: int = 16, hi: Optional[int] = None) -> int:
@@ -79,9 +95,39 @@ class EngineConfig:
     max_length: int = 512
     kv_dtype: str = "f32"  # f32 | bf16 | int8
     #: explicit prompt buckets; None = powers of two from min_bucket up to
-    #: max_length. Only buckets a prompt actually lands in get compiled.
+    #: max_length. Only buckets a prompt tail actually lands in get
+    #: compiled.
     prompt_buckets: Optional[Tuple[int, ...]] = None
     min_bucket: int = 16
+    #: KV page size in tokens. A request holds ceil(total/page_size)
+    #: pages; prefix sharing works at full-page granularity.
+    page_size: int = 16
+    #: total pages in the pool INCLUDING the reserved trash page 0.
+    #: None = 1 + num_slots * ceil(max_length / page_size) (the same
+    #: capacity the PR 5 contiguous cache reserved); set it lower to
+    #: overcommit — admission blocks when the free list runs dry.
+    num_pages: Optional[int] = None
+    #: hash full prompt blocks and share hit pages across requests
+    prefix_cache: bool = True
+    #: bounded LRU capacity of the prefix registry, in blocks.
+    #: None = num_pages (every page could be registered).
+    prefix_registry_blocks: Optional[int] = None
+    #: draft tokens per speculative step; 0 disables speculation
+    speculate_k: int = 0
+    #: longest n-gram the prompt-lookup draft matches on
+    ngram: int = 3
+    #: self-tuning speculation: track EMAs of decode/verify step wall time
+    #: and draft acceptance, and only run the verify program when its
+    #: expected tokens/s beats plain decode (verify is ~free on memory-
+    #: bound TPU decode, ~(k+1)x on compute-bound CPU). Acceptance is
+    #: timing-INDEPENDENT, so output stays bit-equal either way — the
+    #: gate only changes how many tokens one step emits. False = always
+    #: speculate when a draft exists (deterministic step pattern, what
+    #: the bit-equality tests pin).
+    spec_adaptive: bool = True
+    #: while speculation is suppressed, re-probe with one verify step
+    #: every this many decode steps (acceptance drifts with the workload)
+    spec_probe_every: int = 32
     #: None = donate cache buffers on tpu/gpu only (CPU XLA cannot alias
     #: them and would warn on every step)
     donate: Optional[bool] = None
@@ -99,6 +145,16 @@ class EngineConfig:
                 b *= 2
             bs.append(min(b, self.max_length))
         return bs
+
+    @property
+    def max_pages(self) -> int:
+        """Page-table width: pages a max_length request spans."""
+        return -(-self.max_length // self.page_size)
+
+    def resolved_num_pages(self) -> int:
+        if self.num_pages is not None:
+            return int(self.num_pages)
+        return 1 + self.num_slots * self.max_pages
 
 
 @dataclass
@@ -129,6 +185,148 @@ class Request:
     slot: int = -1
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
+    #: every page id this request holds a reference on (shared prefix
+    #: pages first, then private pages), in virtual-sequence order
+    page_ids: List[int] = field(default_factory=list)
+    #: tokens served from the prefix registry (multiple of page_size)
+    cached_len: int = 0
+
+
+# ---------------------------------------------------------------------------
+# host-side page accounting: free-list allocator + prefix registry
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Free-list page allocator with refcounts.
+
+    Page ``TRASH_PAGE`` (0) is reserved and never allocated. A page is
+    free iff its refcount is 0; ``alloc`` hands it out at refcount 1,
+    sharing increfs, and the last ``decref`` returns it to the free
+    list — so the invariant ``available() + pages_referenced == num_pages
+    - 1`` holds at every step and a double-allocation is structurally
+    impossible (allocated pages are not on the free list).
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (trash page + 1)")
+        self.num_pages = int(num_pages)
+        # pop() hands out low page ids first
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._ref = np.zeros(self.num_pages, np.int64)
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def shared_pages(self) -> int:
+        """Pages currently referenced by more than one owner."""
+        return int((self._ref[1:] >= 2).sum())
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages at refcount 1, or None (never partial)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def incref(self, page: int):
+        if page == TRASH_PAGE or self._ref[page] <= 0:
+            raise ValueError(f"incref of unallocated page {page}")
+        self._ref[page] += 1
+
+    def decref(self, page: int):
+        if self._ref[page] <= 0:
+            raise ValueError(f"decref of free page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+
+class PrefixRegistry:
+    """Bounded LRU of full prompt blocks: chain hash -> page id.
+
+    Each registered page carries one registry reference, so pages stay
+    resident (and shareable) after their request finishes until LRU
+    capacity or an explicit ``evict_unused`` reclaims them. Entries whose
+    page is still used by a running request can drop OUT of the registry
+    (no longer discoverable) without freeing the page — the refcount
+    keeps it alive until the request finishes.
+    """
+
+    def __init__(self, pool: PagePool, capacity: int):
+        self.pool = pool
+        self.capacity = int(capacity)
+        self._lru: "OrderedDict[bytes, int]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self):
+        return len(self._lru)
+
+    @staticmethod
+    def block_keys(prompt: np.ndarray, page_size: int) -> List[bytes]:
+        """Chain hashes of the prompt's FULL blocks: block j's key folds
+        in its parent's key, so equal keys imply equal whole prefixes,
+        not just equal blocks."""
+        keys, parent = [], b"paddle_tpu/prefix"
+        t0 = int(prompt.shape[0])
+        for j in range(t0 // page_size):
+            blk = np.ascontiguousarray(
+                prompt[j * page_size:(j + 1) * page_size], dtype=np.int64)
+            parent = hashlib.blake2b(
+                parent + blk.tobytes(), digest_size=16).digest()
+            keys.append(parent)
+        return keys
+
+    def lookup_chain(self, keys: List[bytes]) -> List[int]:
+        """Pages for the longest registered prefix of `keys`, each
+        increfed for the caller (release with pool.decref)."""
+        pages = []
+        for key in keys:
+            page = self._lru.get(key)
+            if page is None:
+                self.misses += 1
+                break
+            self._lru.move_to_end(key)
+            self.pool.incref(page)
+            pages.append(page)
+            self.hits += 1
+        return pages
+
+    def register(self, key: bytes, page: int):
+        if key in self._lru:
+            self._lru.move_to_end(key)
+            return
+        self.pool.incref(page)
+        self._lru[key] = page
+        while len(self._lru) > self.capacity:
+            _, old = self._lru.popitem(last=False)
+            self.pool.decref(old)
+
+    def evict_unused(self, want: int) -> int:
+        """Drop up to `want` LRU entries whose page only the registry
+        still references (freeing the page); returns pages freed."""
+        freed = 0
+        for key in list(self._lru):
+            if freed >= want:
+                break
+            page = self._lru[key]
+            if self.pool.refcount(page) == 1:
+                del self._lru[key]
+                self.pool.decref(page)
+                freed += 1
+        return freed
+
+    def clear(self):
+        for page in self._lru.values():
+            self.pool.decref(page)
+        self._lru.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -136,48 +334,51 @@ class Request:
 # ---------------------------------------------------------------------------
 
 
-def _prefill_write(cache, scales, layer, slot, kv, int8):
-    """Write a whole prompt block kv [1, TB, Hkv, D] into (layer, slot)."""
-    blk = jnp.swapaxes(kv[0], 0, 1)  # [Hkv, TB, D]
+def _block_page_write(cache, scales, layer, kv, row, cached_len, true_len,
+                      int8, page_size):
+    """Write a prompt tail kv [1, TB, Hkv, D] (positions cached_len ...
+    cached_len + TB - 1) into the pages ``row[cached_len//P + j]``.
+    Pages holding padding only (entirely >= true_len) are redirected to
+    the trash page so a padded tail bucket can never scribble past the
+    request's allocation."""
+    x = kv[0]  # [TB, Hkv, D]
+    tb, hkv, d = x.shape
+    p = page_size
+    nb = -(-tb // p)
+    if nb * p != tb:
+        x = jnp.pad(x, ((0, nb * p - tb), (0, 0), (0, 0)))
+    blk = jnp.swapaxes(x.reshape(nb, p, hkv, d), 1, 2)  # [nb, Hkv, P, D]
+    mp = row.shape[0]
+    g = cached_len // p + jnp.arange(nb)
+    need = (true_len + p - 1) // p  # pages with any real prompt content
+    idx = jnp.where(g < need, row[jnp.minimum(g, mp - 1)], TRASH_PAGE)
     if int8:
-        q, scale = quantize_absmax(blk, axis=-1)  # scale [Hkv, TB, 1]
-        cache = jax.lax.dynamic_update_slice(
-            cache, q[None, None], (layer, slot, 0, 0, 0))
-        scales = jax.lax.dynamic_update_slice(
-            scales, scale[..., 0][None, None], (layer, slot, 0, 0))
+        q, scale = quantize_absmax(blk, axis=-1)  # scale [nb, Hkv, P, 1]
+        cache = cache.at[layer, idx].set(q.astype(cache.dtype))
+        scales = scales.at[layer, idx].set(scale[..., 0])
         return cache, scales
-    cache = jax.lax.dynamic_update_slice(
-        cache, blk[None, None].astype(cache.dtype), (layer, slot, 0, 0, 0))
+    cache = cache.at[layer, idx].set(blk.astype(cache.dtype))
     return cache, scales
 
 
-def _decode_write(cache, scales, layer, kv, positions, int8):
-    """Write one token kv [S, 1, Hkv, D] at per-slot `positions` [S]."""
-    x = kv[:, 0]  # [S, Hkv, D]
+def _token_page_write(cache, scales, layer, kv, tables, positions, int8,
+                      page_size):
+    """Write kv [S, T, Hkv, D] at absolute positions [S, T] through the
+    page tables [S, MP] (decode T=1, verify T=k+1). Inactive slots carry
+    zeroed table rows, so their writes land on the trash page."""
+    pg = jnp.take_along_axis(tables, positions // page_size, axis=1)
+    off = positions % page_size
     if int8:
-        q, scale = quantize_absmax(x, axis=-1)  # q [S,Hkv,D], scale [S,Hkv,1]
-
-        def put(c, qs, p):  # c [Hkv, T, D]
-            return jax.lax.dynamic_update_slice(c, qs[:, None, :], (0, p, 0))
-
-        def put_scale(c, ss, p):  # c [Hkv, T]
-            return jax.lax.dynamic_update_slice(c, ss, (0, p))
-
-        cache = cache.at[layer].set(jax.vmap(put)(cache[layer], q, positions))
-        scales = scales.at[layer].set(
-            jax.vmap(put_scale)(scales[layer], scale, positions))
+        q, scale = quantize_absmax(kv, axis=-1)  # scale [S, T, Hkv, 1]
+        cache = cache.at[layer, pg, :, off, :].set(q.astype(cache.dtype))
+        scales = scales.at[layer, pg, :, off].set(scale[..., 0])
         return cache, scales
-
-    def put(c, xs, p):
-        return jax.lax.dynamic_update_slice(
-            c, xs[:, None, :].astype(c.dtype), (0, p, 0))
-
-    cache = cache.at[layer].set(jax.vmap(put)(cache[layer], x, positions))
+    cache = cache.at[layer, pg, :, off, :].set(kv.astype(cache.dtype))
     return cache, scales
 
 
 def _layer_kv(cache, scales, layer, int8):
-    """One layer's [S, Hkv, T, D] view, dequantized when int8."""
+    """One layer's [N, Hkv, P, D] pool view, dequantized when int8."""
     lay = cache[layer]
     if int8:
         return dequantize_absmax(lay, scales[layer][..., None])
@@ -210,7 +411,8 @@ class DecodeEngine:
 
     Usage::
 
-        eng = DecodeEngine(model, num_slots=8, max_length=512)
+        eng = DecodeEngine(model, num_slots=8, max_length=512,
+                           speculate_k=4)
         rid = eng.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
         eng.run()                     # or step() from your own loop
         out = eng.result(rid)         # np.ndarray prompt + generated
@@ -226,6 +428,8 @@ class DecodeEngine:
         if cfg.kv_dtype not in KV_DTYPES:
             raise ValueError(
                 f"kv_dtype must be one of {KV_DTYPES}, got {cfg.kv_dtype!r}")
+        if cfg.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {cfg.page_size}")
         self.model = model
         model.eval()
         self.adapter = model.decode_adapter()
@@ -234,12 +438,19 @@ class DecodeEngine:
             raise ValueError(
                 f"max_length={cfg.max_length} exceeds the model's "
                 f"max_positions={ad.max_positions}")
+        if cfg.speculate_k and not getattr(ad, "multi_token_positions",
+                                           False):
+            raise ValueError(
+                "speculate_k > 0 needs an adapter accepting [S, T] "
+                "positions (multi_token_positions=True)")
         self.buckets = cfg.resolved_buckets()
         self._int8 = cfg.kv_dtype == "int8"
         store = {"f32": jnp.float32, "bf16": jnp.bfloat16,
                  "int8": jnp.int8}[cfg.kv_dtype]
-        shape = (ad.num_layers, cfg.num_slots, ad.num_kv_heads,
-                 cfg.max_length, ad.head_dim)
+        self._mp = cfg.max_pages
+        self._num_pages = cfg.resolved_num_pages()
+        shape = (ad.num_layers, self._num_pages, ad.num_kv_heads,
+                 cfg.page_size, ad.head_dim)
         self._kc = jnp.zeros(shape, store)
         self._vc = jnp.zeros(shape, store)
         if self._int8:
@@ -247,6 +458,15 @@ class DecodeEngine:
             self._vsc = jnp.ones(shape[:-1], jnp.float32)
         else:
             self._ksc = self._vsc = None
+        self.pool = PagePool(self._num_pages)
+        cap = (cfg.prefix_registry_blocks
+               if cfg.prefix_registry_blocks is not None
+               else self._num_pages)
+        self.registry = (PrefixRegistry(self.pool, cap)
+                         if cfg.prefix_cache else None)
+        #: per-slot page tables, uploaded to every decode/verify step;
+        #: freed slots are zeroed so their writes/gathers hit trash
+        self._tables = np.zeros((cfg.num_slots, self._mp), np.int32)
         # stable state ordering for the compiled-call state swap (the
         # TracedLayer idiom): dedup'd params first, then buffers
         self._state, seen = [], set()
@@ -264,10 +484,21 @@ class DecodeEngine:
         self._donate = bool(donate)
         self._prefill_jit: Dict[int, object] = {}
         self._decode_jit = None
+        self._verify_jit = None
         self._compiled = set()
         self.compile_count = 0
         self.total_tokens = 0
         self.decode_steps = 0
+        self.verify_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._t_decode_ema = None
+        self._t_verify_ema = None
+        self._tok_verify_ema = None
+        self._steps_since_probe = 0
+        self.prefix_hit_tokens = 0
+        self.peak_pages_in_use = 0
+        self.peak_running = 0
         self._base_key = jax.random.PRNGKey(cfg.seed)
         self._zero_key = np.asarray(self._base_key)
         self._waiting: deque = deque()
@@ -296,6 +527,12 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({t0}) + max_new_tokens ({params.max_new_tokens}) "
                 f"exceeds max_length={self.config.max_length}")
+        total_pages = -(-(t0 + params.max_new_tokens)
+                        // self.config.page_size)
+        if total_pages > self._num_pages - 1:
+            raise ValueError(
+                f"request needs {total_pages} KV pages but the pool only "
+                f"has {self._num_pages - 1}")
         rid = self._next_id
         self._next_id += 1
         if params.seed is not None:
@@ -312,12 +549,50 @@ class DecodeEngine:
         return rid
 
     def step(self) -> bool:
-        """Admit waiting requests into free slots (one compiled prefill
-        each), then run ONE compiled decode step over every occupied slot.
-        Returns False when the engine is fully idle."""
+        """Admit waiting requests into free slots (one compiled tail
+        prefill each), then advance every occupied slot: ONE compiled
+        decode step, or — when speculation is on and a prompt-lookup
+        draft exists — ONE compiled verify step emitting up to
+        ``speculate_k + 1`` tokens per slot. Returns False when the
+        engine is fully idle."""
         self._admit()
         if not self._running:
             return bool(self._waiting)
+        k = self.config.speculate_k
+        if k > 0 and self._spec_worthwhile(k):
+            drafts, any_real = self._collect_drafts(k)
+            if any_real and self._verify_headroom(k):
+                self._step_verify(drafts, k)
+                return True
+        self._step_decode()
+        return True
+
+    def _spec_worthwhile(self, k: int) -> bool:
+        """Adaptive gate: speculate when the measured step-time and
+        acceptance EMAs predict verify emits more tokens/s than decode
+        (always True with spec_adaptive=False). With no verify estimate
+        yet — or a stale one — probe."""
+        if not self.config.spec_adaptive:
+            return True
+        if self._t_decode_ema is None:
+            return False  # measure the decode baseline first
+        if self._t_verify_ema is None:
+            return True
+        if self._steps_since_probe >= self.config.spec_probe_every:
+            return True
+        if self._tok_verify_ema is None:
+            return True
+        # measured tokens/s comparison: one decode step yields exactly 1
+        # token per slot; a verify step yields what acceptance actually
+        # delivered (fallback-draft slots and budget truncation included)
+        return (self._tok_verify_ema * self._t_decode_ema
+                > self._t_verify_ema)
+
+    @staticmethod
+    def _ema(prev, x, alpha=0.3):
+        return x if prev is None else (1 - alpha) * prev + alpha * x
+
+    def _step_decode(self):
         cfg = self.config
         s = cfg.num_slots
         tokens = np.zeros(s, np.int32)
@@ -336,17 +611,22 @@ class DecodeEngine:
             keys[slot] = req.key_np
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
+        warm = "decode" in self._compiled
         t0 = time.perf_counter()
         out = self._run_counted(
             "decode", self._decode_jit,
             self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(keys),
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._tables), jnp.asarray(keys),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(greedy))
         self._kc, self._vc, self._ksc, self._vsc, nxt, logits = out
         nxt_host = np.asarray(nxt)  # the per-token host transfer: [S] int32
-        _obs.observe("serving_decode_step_seconds",
-                     time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _obs.observe("serving_decode_step_seconds", dt)
+        if warm:  # a compile-laden first step would poison the estimate
+            self._t_decode_ema = self._ema(self._t_decode_ema, dt)
+        self._steps_since_probe += 1
         self.decode_steps += 1
         self._last_logits = logits
         active = list(self._running.items())
@@ -355,7 +635,100 @@ class DecodeEngine:
             self._append_token(req, int(nxt_host[slot]))
         _obs.inc("serving_tokens_total", len(active))
         self._update_gauges()
-        return True
+
+    def _step_verify(self, drafts: Dict[int, np.ndarray], k: int):
+        """One multi-token speculative step: score cur + k drafts in a
+        single target pass; accept target tokens while the draft agrees
+        (position-keyed streams, so acceptance never changes WHAT is
+        sampled — only how many tokens one step emits)."""
+        cfg = self.config
+        s, k1 = cfg.num_slots, k + 1
+        tokens = np.zeros((s, k1), np.int32)
+        positions = np.zeros(s, np.int32)
+        temp = np.ones(s, np.float32)
+        top_k = np.zeros(s, np.int32)
+        top_p = np.ones(s, np.float32)
+        greedy = np.ones(s, bool)
+        keys = np.array(np.broadcast_to(
+            self._zero_key, (s,) + self._zero_key.shape))
+        for slot, req in self._running.items():
+            tokens[slot, 0] = req.tokens[-1]
+            tokens[slot, 1:] = drafts[slot]
+            positions[slot] = len(req.prompt) + len(req.tokens) - 1
+            t_, k_, p_, g_ = req.params.fields()
+            temp[slot], top_k[slot], top_p[slot], greedy[slot] = t_, k_, p_, g_
+            keys[slot] = req.key_np
+        if self._verify_jit is None:
+            self._verify_jit = self._build_verify(k1)
+        warm = f"verify_k{k}" in self._compiled
+        t0 = time.perf_counter()
+        out = self._run_counted(
+            f"verify_k{k}", self._verify_jit,
+            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._tables), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy))
+        self._kc, self._vc, self._ksc, self._vsc, targets, logits = out
+        targets_host = np.asarray(targets)  # [S, k+1] int32
+        dt = time.perf_counter() - t0
+        _obs.observe("serving_decode_step_seconds", dt)
+        if warm:
+            self._t_verify_ema = self._ema(self._t_verify_ema, dt)
+        self._steps_since_probe = 0
+        self.decode_steps += 1
+        self.verify_steps += 1
+        self._last_logits = logits
+        emitted = 0
+        active_slots = len(self._running)
+        for slot, req in list(self._running.items()):
+            tgt = targets_host[slot]
+            m = 0
+            while m < k and int(drafts[slot][m]) == int(tgt[m]):
+                m += 1
+            self.spec_proposed += k
+            self.spec_accepted += m
+            for tok in tgt[:m + 1]:
+                if req.status != "running":
+                    break  # budget/eos hit mid-emission
+                self.total_tokens += 1
+                emitted += 1
+                self._append_token(req, int(tok))
+        if active_slots:
+            self._tok_verify_ema = self._ema(
+                self._tok_verify_ema, emitted / active_slots)
+        _obs.inc("serving_tokens_total", emitted)
+        _obs.set_gauge("serving_spec_accept_ratio",
+                       self.spec_accepted / max(self.spec_proposed, 1))
+        self._update_gauges()
+
+    def _collect_drafts(self, k: int):
+        """Prompt-lookup drafts per running slot; slots with no n-gram
+        recurrence fall back to repeating their last token (still a
+        legitimate draft — acceptance decides)."""
+        from ..text.generation import prompt_lookup_draft
+
+        drafts: Dict[int, np.ndarray] = {}
+        any_real = False
+        for slot, req in self._running.items():
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            d = prompt_lookup_draft(ctx, k, max_ngram=self.config.ngram)
+            if d is not None:
+                any_real = True
+            else:
+                d = np.full(k, req.tokens[-1], np.int32)
+            drafts[slot] = d
+        return drafts, any_real
+
+    def _verify_headroom(self, k: int) -> bool:
+        """The verify step writes KV at positions p .. p+k; require them
+        all inside the cache for every running slot (else this round
+        falls back to the single-token decode program)."""
+        limit = self.config.max_length - 1
+        return all(
+            len(r.prompt) + len(r.tokens) - 1 + k <= limit
+            for r in self._running.values())
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive step() until every submitted request finished; returns
@@ -410,15 +783,34 @@ class DecodeEngine:
             out[i, t0:t0 + len(r.tokens)] = r.tokens
         return Tensor(jnp.asarray(out))
 
+    def release_prefix_cache(self):
+        """Drop every registry reference (running requests keep theirs);
+        afterwards a drained engine holds zero pages."""
+        if self.registry is not None:
+            self.registry.clear()
+        self._update_gauges()
+
     def stats(self) -> dict:
         return {
             "compile_count": self.compile_count,
             "compiled": sorted(self._compiled),
             "buckets": list(self.buckets),
             "decode_steps": self.decode_steps,
+            "verify_steps": self.verify_steps,
             "total_tokens": self.total_tokens,
             "running": len(self._running),
             "waiting": len(self._waiting),
+            "page_size": self.config.page_size,
+            "num_pages": self._num_pages,
+            "pages_free": self.pool.available(),
+            "pages_shared": self.pool.shared_pages(),
+            "peak_pages_in_use": self.peak_pages_in_use,
+            "peak_running": self.peak_running,
+            "prefix_blocks_registered": (
+                len(self.registry) if self.registry is not None else 0),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
         }
 
     # -- internals ----------------------------------------------------------
@@ -434,26 +826,78 @@ class DecodeEngine:
 
     def _admit(self):
         while self._free and self._waiting:
-            req = self._waiting.popleft()
-            self._prefill(req, self._free.pop())
+            if not self._try_prefill(self._waiting[0], self._free[-1]):
+                break  # head request can't get pages yet; keep FIFO order
+            self._waiting.popleft()
+            self._free.pop()
         _obs.set_gauge("serving_queue_depth", float(len(self._waiting)))
         self._update_gauges()
 
-    def _prefill(self, req: Request, slot: int):
-        tb = self._bucket_for(len(req.prompt))
+    def _try_prefill(self, req: Request, slot: int) -> bool:
+        """Reserve pages (sharing registry hits), run the tail prefill,
+        register the request's own full prompt blocks. False = not enough
+        free pages even after evicting unused registry entries."""
+        cfg = self.config
+        p = cfg.page_size
+        t0 = int(req.prompt.shape[0])
+        total_pages = -(-(t0 + req.params.max_new_tokens) // p)
+        keys: List[bytes] = []
+        shared: List[int] = []
+        if self.registry is not None:
+            keys = PrefixRegistry.block_keys(req.prompt, p)
+            # never share ALL of the prompt: the prefill needs >= 1 tail
+            # token to produce the first logits (the last block is
+            # recomputed instead — copy-on-write by recompute)
+            shareable = min(len(keys), (t0 - 1) // p)
+            shared = self.registry.lookup_chain(keys[:shareable])
+        need = total_pages - len(shared)
+        if self.pool.available() < need and self.registry is not None:
+            self.registry.evict_unused(need - self.pool.available())
+        pages = self.pool.alloc(need)
+        if pages is None:
+            for pg in shared:  # retry next round with a fresh lookup
+                self.pool.decref(pg)
+            return False
+        cached_len = len(shared) * p
+        row = np.zeros(self._mp, np.int32)
+        row[:len(shared)] = shared
+        row[len(shared):total_pages] = pages
+        self._tables[slot] = row
+        req.page_ids = shared + pages
+        req.cached_len = cached_len
+        self.prefix_hit_tokens += cached_len
+        if cached_len:
+            _obs.inc("serving_prefix_hit_tokens", cached_len)
+        # register BEFORE the prefill runs: the prefill can finish the
+        # request outright (1-token budget / instant EOS), and _finish
+        # drops the request's page refs — the registry's +1 must already
+        # be in place so the blocks survive. No reader can race ahead of
+        # the KV write: the next admission only happens after this
+        # prefill has executed.
+        if self.registry is not None:
+            for j in range(len(shared), t0 // p):
+                self.registry.register(keys[j], int(row[j]))
+        self._prefill(req, slot, row, cached_len)
+        return True
+
+    def _prefill(self, req: Request, slot: int, row: np.ndarray,
+                 cached_len: int):
+        t0 = int(req.prompt.shape[0])
+        tail = req.prompt[cached_len:]
+        tb = self._bucket_for(len(tail))
         fn = self._prefill_jit.get(tb)
         if fn is None:
             fn = self._build_prefill(tb)
             self._prefill_jit[tb] = fn
         ids = np.zeros((1, tb), np.int32)
-        ids[0, :len(req.prompt)] = req.prompt
+        ids[0, :len(tail)] = tail
         t_, k_, p_, g_ = req.params.fields()
         out = self._run_counted(
             f"prefill_b{tb}", fn,
             self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
-            jnp.asarray(ids), np.int32(len(req.prompt)), np.int32(slot),
-            jnp.asarray(req.key_np), np.float32(t_), np.int32(k_),
-            np.float32(p_), np.asarray(g_))
+            jnp.asarray(ids), np.int32(cached_len), np.int32(t0),
+            jnp.asarray(row), jnp.asarray(req.key_np), np.float32(t_),
+            np.int32(k_), np.float32(p_), np.asarray(g_))
         self._kc, self._vc, self._ksc, self._vsc, nxt, logits = out
         token = int(nxt)
         now = time.perf_counter()
@@ -477,8 +921,12 @@ class DecodeEngine:
         req.status = "done"
         if req.slot >= 0:
             del self._running[req.slot]
+            self._tables[req.slot] = 0
             self._free.append(req.slot)
             req.slot = -1
+        for page in req.page_ids:
+            self.pool.decref(page)
+        req.page_ids = []
         ttft = (None if req.first_token_time is None
                 else req.first_token_time - req.submit_time)
         _obs.event("serving_request_done", req_id=req.req_id,
@@ -486,13 +934,19 @@ class DecodeEngine:
                    generated_tokens=len(req.tokens), ttft_seconds=ttft)
 
     def _update_gauges(self):
-        cfg = self.config
         used = sum(len(r.prompt) + len(r.tokens)
                    for r in self._running.values())
+        in_use = self._num_pages - 1 - self.pool.available()
+        self.peak_pages_in_use = max(self.peak_pages_in_use, in_use)
+        self.peak_running = max(self.peak_running, len(self._running))
         _obs.set_gauge("serving_batch_occupancy",
-                       len(self._running) / float(cfg.num_slots))
+                       len(self._running) / float(self.config.num_slots))
         _obs.set_gauge("serving_kv_cache_utilization",
-                       used / float(cfg.num_slots * cfg.max_length))
+                       used / float((self._num_pages - 1)
+                                    * self.config.page_size))
+        _obs.set_gauge("serving_kv_pages_free", float(self.pool.available()))
+        _obs.set_gauge("serving_kv_pages_shared",
+                       float(self.pool.shared_pages()))
 
     def _run_counted(self, name, fn, *args):
         first = name not in self._compiled
@@ -509,46 +963,48 @@ class DecodeEngine:
 
     # -- compiled programs --------------------------------------------------
     #
-    # Both programs take the model state EXPLICITLY (param/buffer values are
+    # All programs take the model state EXPLICITLY (param/buffer values are
     # swapped into the live tensors around the traced body and restored —
     # the jit.TracedLayer idiom), so parameters stay jit arguments rather
-    # than baked-in constants, and the KV cache flows through as donated
-    # inputs/outputs.
+    # than baked-in constants, and the paged KV pool flows through as
+    # donated inputs/outputs. Page tables arrive as plain int32 arguments.
 
     def _build_prefill(self, tb: int):
         ad, state, int8 = self.adapter, self._state, self._int8
         layers = ad.num_layers
-        group = ad.num_heads // ad.num_kv_heads
+        psz = self.config.page_size
 
-        def pure(state_vals, kc, vc, ksc, vsc, ids, true_len, slot, key,
-                 temp, top_k, top_p, greedy):
+        def pure(state_vals, kc, vc, ksc, vsc, ids, cached_len, true_len,
+                 row, key, temp, top_k, top_p, greedy):
             originals = [t._value for t in state]
             try:
                 for t_, v_ in zip(state, state_vals):
                     t_._value = v_
                 with no_grad():
-                    positions = jnp.arange(tb, dtype=jnp.int32)
+                    positions = cached_len + jnp.arange(tb, dtype=jnp.int32)
+                    start = jnp.reshape(cached_len, (1,)).astype(jnp.int32)
+                    table = row[None]  # [1, MP]
                     x = ad.embed(Tensor(ids), positions)
                     for l in range(layers):
                         h = ad.pre_attn(l, x)
                         q, k, v = ad.qkv(l, h, positions)
-                        kc, ksc = _prefill_write(kc, ksc, l, slot, raw(k),
-                                                 int8)
-                        vc, vsc = _prefill_write(vc, vsc, l, slot, raw(v),
-                                                 int8)
-                        if group > 1:
-                            k = Tensor(jnp.repeat(raw(k), group, axis=2))
-                            v = Tensor(jnp.repeat(raw(v), group, axis=2))
-                        o = F.scaled_dot_product_attention(
-                            q, k, v, is_causal=True, training=False)
+                        kc, ksc = _block_page_write(
+                            kc, ksc, l, raw(k), row, cached_len, true_len,
+                            int8, psz)
+                        vc, vsc = _block_page_write(
+                            vc, vsc, l, raw(v), row, cached_len, true_len,
+                            int8, psz)
+                        o = F.paged_attention(
+                            q, _layer_kv(kc, ksc, l, int8),
+                            _layer_kv(vc, vsc, l, int8), table, start)
                         x = x + ad.attn_out(l, o)
                         x = x + ad.mlp(l, x)
                     x = ad.final_norm(x)
                     # right-pad positions >= true_len are inert under the
-                    # causal mask; the real last-token logits sit at
-                    # true_len - 1
+                    # position mask; the real last-token logits sit at
+                    # tail offset true_len - 1 - cached_len
                     last = jax.lax.dynamic_slice_in_dim(
-                        raw(x), true_len - 1, 1, 1)
+                        raw(x), true_len - 1 - cached_len, 1, 1)
                     logits = raw(ad.logits(Tensor(last)))[:, 0].astype(
                         jnp.float32)
             finally:
@@ -568,9 +1024,10 @@ class DecodeEngine:
     def _build_decode(self):
         ad, state, int8 = self.adapter, self._state, self._int8
         layers = ad.num_layers
+        psz = self.config.page_size
 
-        def pure(state_vals, kc, vc, ksc, vsc, tokens, positions, keys,
-                 temp, top_k, top_p, greedy):
+        def pure(state_vals, kc, vc, ksc, vsc, tokens, positions, tables,
+                 keys, temp, top_k, top_p, greedy):
             originals = [t._value for t in state]
             try:
                 for t_, v_ in zip(state, state_vals):
@@ -581,13 +1038,13 @@ class DecodeEngine:
                     for l in range(layers):
                         h = ad.pre_attn(l, x)
                         q, k, v = ad.qkv(l, h, pos2)
-                        kc, ksc = _decode_write(kc, ksc, l, raw(k),
-                                                positions, int8)
-                        vc, vsc = _decode_write(vc, vsc, l, raw(v),
-                                                positions, int8)
-                        o = F.decode_attention(
+                        kc, ksc = _token_page_write(
+                            kc, ksc, l, raw(k), tables, pos2, int8, psz)
+                        vc, vsc = _token_page_write(
+                            vc, vsc, l, raw(v), tables, pos2, int8, psz)
+                        o = F.paged_attention(
                             q, _layer_kv(kc, ksc, l, int8),
-                            _layer_kv(vc, vsc, l, int8), positions)
+                            _layer_kv(vc, vsc, l, int8), tables, positions)
                         x = x + ad.attn_out(l, o)
                         x = x + ad.mlp(l, x)
                     x = ad.final_norm(x)
@@ -599,6 +1056,54 @@ class DecodeEngine:
             nxt = _sample_tokens(logits, step_keys, temp, top_k, top_p,
                                  greedy)
             return kc, vc, ksc, vsc, nxt, logits
+
+        donate = (1, 2, 3, 4) if self._donate else ()
+        return jax.jit(pure, donate_argnums=donate)
+
+    def _build_verify(self, k1: int):
+        """The speculative companion of the decode program: k1 = k + 1
+        tokens per slot in one pass, per-position sampling on the SAME
+        position-keyed streams."""
+        ad, state, int8 = self.adapter, self._state, self._int8
+        layers = ad.num_layers
+        psz = self.config.page_size
+
+        def pure(state_vals, kc, vc, ksc, vsc, tokens, positions, tables,
+                 keys, temp, top_k, top_p, greedy):
+            s = tokens.shape[0]
+            originals = [t._value for t in state]
+            try:
+                for t_, v_ in zip(state, state_vals):
+                    t_._value = v_
+                with no_grad():
+                    pos2 = positions[:, None] + jnp.arange(
+                        k1, dtype=jnp.int32)[None, :]  # [S, k1]
+                    x = ad.embed(Tensor(tokens), pos2)
+                    for l in range(layers):
+                        h = ad.pre_attn(l, x)
+                        q, k, v = ad.qkv(l, h, pos2)
+                        kc, ksc = _token_page_write(
+                            kc, ksc, l, raw(k), tables, pos2, int8, psz)
+                        vc, vsc = _token_page_write(
+                            vc, vsc, l, raw(v), tables, pos2, int8, psz)
+                        o = F.paged_attention(
+                            q, _layer_kv(kc, ksc, l, int8),
+                            _layer_kv(vc, vsc, l, int8), tables, positions)
+                        x = x + ad.attn_out(l, o)
+                        x = x + ad.mlp(l, x)
+                    x = ad.final_norm(x)
+                    logits = raw(ad.logits(x)).astype(jnp.float32)  # [S,k1,V]
+            finally:
+                for t_, v_ in zip(state, originals):
+                    t_._value = v_
+            step_keys = jax.vmap(jax.vmap(
+                jax.random.fold_in, in_axes=(None, 0)))(keys, pos2 + 1)
+            flat = logits.reshape(s * k1, -1)
+            rep = lambda a: jnp.repeat(a, k1, axis=0)
+            targets = _sample_tokens(
+                flat, step_keys.reshape(s * k1, -1), rep(temp), rep(top_k),
+                rep(top_p), rep(greedy)).reshape(s, k1)
+            return kc, vc, ksc, vsc, targets, logits
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)

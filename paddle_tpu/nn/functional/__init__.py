@@ -8,6 +8,7 @@ from . import flash_attention as _flash_attention_module  # noqa: F401
 from .attention import (  # noqa: F401
     decode_attention,
     flash_attention,
+    paged_attention,
     scaled_dot_product_attention,
     sparse_attention,
 )

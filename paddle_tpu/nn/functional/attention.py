@@ -204,6 +204,58 @@ def decode_attention(query, cache_k, cache_v, cache_position, scale=None,
                                 scale)
 
 
+@defop(amp="white", name="paged_attention_op")
+def _paged_attention_op(q, pk, pv, page_table, start_position, scale):
+    """KV-cached attention through a block/page-granular cache.
+
+    q: [S, T, H, D] — T new tokens per slot (T=1 decode, T=k+1 speculative
+    verify, T=bucket tail prefill with S=1); pk/pv: [N, Hkv, P, D] — ONE
+    layer's slice of the engine's [L, N, Hkv, P, D] page pool;
+    page_table: [S, MP] int32 — per-slot page ids in sequence order, so
+    virtual key position j lives in page page_table[s, j // P] at offset
+    j % P (unallocated entries point at the reserved trash page 0 and are
+    masked); start_position: [S] int — query row i of slot s sits at
+    global position start_position[s] + i and attends to key positions
+    <= its own (causal over the virtual sequence). GQA-native: query
+    heads are grouped onto their kv head, no head replication in HBM.
+    """
+    s_, t, h, d = q.shape
+    hkv, p = pk.shape[1], pk.shape[2]
+    mp = page_table.shape[1]
+    group = h // hkv
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def gather(pool):
+        g = pool[page_table]                   # [S, MP, Hkv, P, D]
+        g = jnp.swapaxes(g, 1, 2)              # [S, Hkv, MP, P, D]
+        return g.reshape(s_, hkv, mp * p, d)
+
+    k = gather(pk).astype(jnp.float32)
+    v = gather(pv).astype(jnp.float32)
+    qf = q.astype(jnp.float32).reshape(s_, t, hkv, group, d)
+    logits = jnp.einsum("sthgd,shkd->shgtk", qf, k) * sc
+    qpos = start_position[:, None] + jnp.arange(t)[None, :]       # [S, T]
+    mask = jnp.arange(mp * p)[None, None, :] <= qpos[:, :, None]  # [S, T, K]
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("shgtk,shkd->sthgd", probs, v)
+    return out.reshape(s_, t, h, d).astype(q.dtype)
+
+
+def paged_attention(query, pool_k, pool_v, page_table, start_position,
+                    scale=None, name=None):
+    """Multi-token KV-cached attention against a paged cache (the
+    page-granular companion of :func:`decode_attention`; see
+    docs/SERVING.md §paged cache). ``query`` [S, T, H, D]; ``pool_k/v``
+    [N, Hkv, page_size, D]; ``page_table`` [S, max_pages] int32;
+    ``start_position`` [S] int32 (global position of each slot's first
+    query row). Serves the decode step (T=1), the speculative verify
+    step (T=k+1), and the prefix-cached tail prefill (S=1, T=bucket)
+    with ONE op."""
+    return _paged_attention_op(query, pool_k, pool_v, page_table,
+                               start_position, scale)
+
+
 @defop(name="sparse_attention_op")
 def _sparse_attention(q, k, v, offset, columns, key_padding_mask, attn_mask):
     # q/k/v: [B, H, T, D] (paddle sparse_attention layout); CSR pattern

@@ -42,6 +42,19 @@ class MultiHeadAttention(Layer):
         def __init__(self, k, v):
             self.k, self.v = k, v
 
+    class PagedCache:
+        """Block/page-granular incremental cache (the layer-level mirror
+        of the serving engine's paged KV pool, docs/SERVING.md): K/V live
+        in a page pool [N, H, page_size, D] and each batch row owns a row
+        of ``page_table`` [B, max_pages] mapping virtual position
+        ``j`` -> page ``page_table[b, j // page_size]`` offset
+        ``j % page_size``. Page 0 is the reserved trash page."""
+
+        def __init__(self, k, v, page_table, page_size):
+            self.k, self.v = k, v
+            self.page_table = page_table
+            self.page_size = page_size
+
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -62,12 +75,16 @@ class MultiHeadAttention(Layer):
         b, t = x.shape[0], x.shape[1]
         return x.reshape([b, t, self.num_heads, self.head_dim])
 
-    def gen_cache(self, key, value=None, type=None, max_length=None):
+    def gen_cache(self, key, value=None, type=None, max_length=None,
+                  page_size=None):
         """`max_length` preallocates a STATIC-shape incremental cache
         [B, max_length, H, D]: pair it with `forward(cache_position=...)`
         so every decode step reuses one compiled program (the serving
         shape discipline; legacy `max_length=None` keeps the concat-grow
-        cache)."""
+        cache). `page_size` additionally switches to the PAGED layout
+        (PagedCache): K/V live in a page pool and are addressed through a
+        per-row page table, the same block-granular discipline the decode
+        engine uses for prefix sharing (docs/SERVING.md)."""
         if type == MultiHeadAttention.StaticCache or (value is not None and type is None):
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value if value is not None else key))
@@ -75,6 +92,18 @@ class MultiHeadAttention(Layer):
         b = raw(key).shape[0]
         import paddle_tpu as P
 
+        if page_size is not None:
+            if max_length is None:
+                raise ValueError("a paged cache needs max_length")
+            mp = -(-max_length // page_size)  # ceil
+            # identity allocation at the layer level: row b owns pages
+            # [1 + b*mp, 1 + (b+1)*mp); page 0 stays the trash page
+            num_pages = 1 + b * mp
+            pool = [num_pages, self.num_heads, page_size, self.head_dim]
+            table = jnp.arange(b * mp, dtype=jnp.int32).reshape(b, mp) + 1
+            return MultiHeadAttention.PagedCache(
+                P.zeros(pool, "float32"), P.zeros(pool, "float32"),
+                Tensor(table), page_size)
         t = max_length if max_length is not None else 0
         k = P.zeros([b, t, self.num_heads, self.head_dim], "float32")
         v = P.zeros([b, t, self.num_heads, self.head_dim], "float32")
@@ -113,6 +142,29 @@ class MultiHeadAttention(Layer):
             )
         return out, cache
 
+    def _forward_paged_cache(self, q, k, v, cache, cache_position):
+        """Write k/v [B, t, H, D] through the page table at positions
+        ``cache_position .. cache_position + t - 1`` and attend over the
+        virtual sequence via F.paged_attention. Inference-only, like the
+        contiguous static-cache path."""
+        import jax.numpy as jnp
+
+        b, t = q.shape[0], q.shape[1]
+        p = cache.page_size
+        table = raw(cache.page_table)
+        pos = cache_position + jnp.arange(t, dtype=jnp.int32)      # [t]
+        pg = jnp.take_along_axis(table, (pos[None, :] // p), axis=1)  # [B,t]
+        off = jnp.broadcast_to(pos[None, :] % p, (b, t))
+        ck = raw(cache.k).at[pg, :, off, :].set(
+            raw(k).astype(raw(cache.k).dtype))
+        cv = raw(cache.v).at[pg, :, off, :].set(
+            raw(v).astype(raw(cache.v).dtype))
+        cache = MultiHeadAttention.PagedCache(
+            Tensor(ck), Tensor(cv), cache.page_table, p)
+        start = jnp.full((b,), cache_position, jnp.int32)
+        out = F.paged_attention(q, ck, cv, table, start)
+        return out, cache
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
                 cache_position=None):
         key = query if key is None else key
@@ -123,6 +175,16 @@ class MultiHeadAttention(Layer):
         else:
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value))
+            if isinstance(cache, MultiHeadAttention.PagedCache):
+                if cache_position is None:
+                    raise ValueError(
+                        "a PagedCache requires forward(cache_position=...)")
+                out, cache = self._forward_paged_cache(
+                    q, k, v, cache, cache_position)
+                b, t = out.shape[0], out.shape[1]
+                out = self.out_proj(out.reshape([b, t, self.embed_dim]))
+                return ((out, None, cache) if self.need_weights
+                        else (out, cache))
             if isinstance(cache, MultiHeadAttention.Cache):
                 if cache_position is not None:
                     out, cache = self._forward_static_cache(
@@ -275,9 +337,10 @@ class TransformerDecoderLayer(Layer):
             tgt = self.norm3(tgt)
         return tgt if cache is None else (tgt, (incr_cache, cache[1]))
 
-    def gen_cache(self, memory, max_length=None):
+    def gen_cache(self, memory, max_length=None, page_size=None):
         incr = self.self_attn.gen_cache(memory, type=MultiHeadAttention.Cache,
-                                        max_length=max_length)
+                                        max_length=max_length,
+                                        page_size=page_size)
         static = self.cross_attn.gen_cache(memory, memory, type=MultiHeadAttention.StaticCache)
         return incr, static
 
@@ -306,8 +369,10 @@ class TransformerDecoder(Layer):
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
 
-    def gen_cache(self, memory, do_zip=False, max_length=None):
-        cache = [l.gen_cache(memory, max_length=max_length) for l in self.layers]
+    def gen_cache(self, memory, do_zip=False, max_length=None,
+                  page_size=None):
+        cache = [l.gen_cache(memory, max_length=max_length,
+                             page_size=page_size) for l in self.layers]
         return list(zip(*cache)) if do_zip else cache
 
 

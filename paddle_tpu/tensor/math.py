@@ -671,10 +671,30 @@ def renorm(x, p, axis, max_norm, name=None):
     return _renorm(x, p=float(p), axis=int(axis), max_norm=float(max_norm))
 
 
+@jax.custom_vjp
+def _frexp_impl(x):
+    return jnp.frexp(x)
+
+
+def _frexp_fwd(x):
+    m, e = jnp.frexp(x)
+    return (m, e), e
+
+
+def _frexp_bwd(e, cot):
+    # x = m * 2**e with e locally constant, so dm/dx = 2**-e almost
+    # everywhere (binade boundaries have measure zero); the integer
+    # exponent output carries no gradient (its cotangent is float0)
+    gm = cot[0]
+    return (gm * jnp.exp2(-e.astype(gm.dtype)),)
+
+
+_frexp_impl.defvjp(_frexp_fwd, _frexp_bwd)
+
+
 @defop
 def frexp(x, name=None):
-    m, e = jnp.frexp(x)
-    return m, e
+    return _frexp_impl(x)
 
 
 @defop

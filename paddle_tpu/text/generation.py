@@ -42,6 +42,35 @@ def _pow2_bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def prompt_lookup_draft(context, k: int, max_ngram: int = 3):
+    """Prompt-lookup decoding draft (model-free speculation): find the
+    most recent earlier occurrence of the context's trailing n-gram
+    (longest n <= `max_ngram` first) and propose the k tokens that
+    followed it. Returns an int32 [k] array, or None when no n-gram of
+    the context's tail recurs — the caller decides the fallback. Pure
+    host-side numpy: drafting is control flow, only verification burns
+    accelerator FLOPs (inference.engine's verify program).
+    """
+    ctx = np.asarray(context).reshape(-1)
+    t = int(ctx.shape[0])
+    for n in range(min(max_ngram, t - 1), 0, -1):
+        tail = ctx[t - n:]
+        # scan candidate starts right-to-left: the most recent match is
+        # the best predictor of what follows
+        for s in range(t - n - 1, -1, -1):
+            if not np.array_equal(ctx[s:s + n], tail):
+                continue
+            follow = ctx[s + n:s + n + k]
+            if follow.shape[0] == 0:
+                continue
+            draft = np.empty(k, np.int32)
+            draft[:follow.shape[0]] = follow
+            # short match: pad by repeating the last drafted token
+            draft[follow.shape[0]:] = follow[-1]
+            return draft
+    return None
+
+
 def _engine_for(model, use_engine, prompt_len: int, total_len: int):
     """The attached decode engine (inference.enable_decode_engine) when it
     can serve this call, else None. `use_engine=False` forces the legacy

@@ -383,6 +383,11 @@ class _GPTDecodeAdapter:
         self.num_kv_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.max_positions = cfg.max_position_embeddings
+        # positions may arrive [B, T] with a DIFFERENT offset per row
+        # (the engine's speculative verify step); both the learned
+        # position table and rope gather per-element, so [B, T] is
+        # first-class here
+        self.multi_token_positions = True
 
     def embed(self, input_ids, positions):
         """input_ids Tensor [B, T]; positions int array [T] or [B, T]."""

@@ -68,9 +68,6 @@ def _key():
 SKIP = {
     # --- gradients intentionally not defined / not meaningful -----------
     "nextafter": "no JAX differentiation rule (piecewise-constant ULP step)",
-    "frexp": "no vjp registered for the mantissa/exponent decomposition, "
-             "and central differences straddle binade boundaries where the "
-             "mantissa jumps by 2x (numeric oracle invalid)",
     "quantized_matmul": "int8 operands; dequantized output has no grad path",
     "weight_only_linear": "int8/int4 weights; grad path covered by "
                           "test_nn_quant.py",
@@ -140,6 +137,7 @@ OVERRIDES = {
     # domain-tailored inputs that replace former skip-table entries: well
     # inside each op's smooth region so f32 central differences are valid
     "matrix_power": lambda: ([_spd(3) * 0.5, 2], {}),
+    "frexp": lambda: ([_f((3, 4), lo=2.2, hi=3.8)], {}),
     "householder_product": lambda: ([_f((4, 2)) * 0.1, _f((2,)) * 0.1],
                                     {}),
     "multigammaln": lambda: ([_f((3, 4)) + 3.0, 2], {}),
@@ -343,6 +341,11 @@ OVERRIDES = {
     "decode_attention_op": lambda: (
         [_f((2, 1, 4, 8)), _f((2, 2, 8, 8)), _f((2, 2, 8, 8)),
          np.array([3, 5], np.int32), 0.35], {}),
+    # tiny shapes on purpose: numeric grad cost scales with element count
+    "paged_attention_op": lambda: (
+        [_f((1, 1, 2, 4)), _f((3, 1, 4, 4)), _f((3, 1, 4, 4)),
+         np.array([[1, 2]], np.int32),
+         np.array([5], np.int32), 0.35], {}),
     # ---- dropout family: deterministic given a fixed PRNG key ----------
     "dropout_op": lambda: ([_f((3, 4)), _key(), 0.4, "upscale_in_train"],
                            {}),

@@ -319,7 +319,7 @@ def test_flash_attn_unpadded_segment_masked():
                 got[s:e], np.asarray(ref)[0], rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.fast
+@pytest.mark.slow
 def test_flash_attn_unpadded_decode_and_padding():
     """Bottom-right causal alignment for q-len != k-len (decode-style) and
     finite grads with padding tokens beyond cu_seqlens[-1]."""

@@ -207,6 +207,7 @@ def test_ptq_calibration_and_convert():
 # ---------------------------------------------------------------------------
 # fused layers
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_fused_transformer_encoder_layer():
     paddle.seed(0)
     from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer

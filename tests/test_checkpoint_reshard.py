@@ -72,6 +72,7 @@ def _switch_run(cfg_a, cfg_b, ckpt_dir, stage_a=1, stage_b=1):
     return losses
 
 
+@pytest.mark.slow
 def test_hybrid_to_sharding8_continuity(tmp_path, baseline):
     losses = _switch_run(HYBRID, ZERO8, str(tmp_path / "a"), stage_b=3)
     np.testing.assert_allclose(
@@ -80,6 +81,7 @@ def test_hybrid_to_sharding8_continuity(tmp_path, baseline):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_sharding8_to_hybrid_continuity(tmp_path, baseline):
     losses = _switch_run(ZERO8, HYBRID, str(tmp_path / "b"), stage_a=3)
     np.testing.assert_allclose(

@@ -63,6 +63,7 @@ SCRIPT = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_kill_midtraining_resumes_from_checkpoint(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(SCRIPT)

@@ -5,6 +5,7 @@ Reference capability: compile-time scaling of deep stacks — the reference
 amortizes per-layer cost through fused program passes; the TPU-native
 answer is the jax scan-over-layers idiom (BENCH weak #5: GPT-1.3B CPU-mesh
 compile 1093s unrolled)."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -78,6 +79,7 @@ def test_bert_fold_layers_parity_with_mask():
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bert_fold_eager_backward_reaches_embeddings():
     """EAGER-mode backward through a folded encoder: the tape edge from
     the scan back to the embeddings must survive (regression: a raw()
@@ -101,6 +103,7 @@ def test_bert_fold_eager_backward_reaches_embeddings():
     assert float(np.abs(np.asarray(g._value)).sum()) > 0
 
 
+@pytest.mark.slow
 def test_ernie_fold_layers_training_parity():
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.text.models import (
@@ -174,6 +177,7 @@ def test_fold_scan_decorrelates_dropout_across_layers():
             "eval forward consumed global RNG state"
 
 
+@pytest.mark.slow
 def test_fold_layers_training_parity():
     from paddle_tpu.jit import TrainStep
 

@@ -259,6 +259,7 @@ def _baseline(monkeypatch, dp, sh, clip_norm=None):
     return _BASELINES[key]
 
 
+@pytest.mark.slow
 def test_explicit_f32_matches_gspmd_zero_path(monkeypatch):
     base = _baseline(monkeypatch, 4, 2)
     step, losses, ids = _run(monkeypatch, "f32", 4, 2)

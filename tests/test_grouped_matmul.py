@@ -126,6 +126,7 @@ def test_dropless_moe_matches_dense_routing():
     )
 
 
+@pytest.mark.slow
 def test_dropless_moe_trains():
     from paddle_tpu import incubate, nn
 

@@ -27,7 +27,8 @@ from conftest import free_port as _free_port
 
 
 @pytest.mark.parametrize(
-    "nnodes", [pytest.param(2, marks=pytest.mark.fast), 4])
+    "nnodes", [pytest.param(2, marks=pytest.mark.fast),
+               pytest.param(4, marks=pytest.mark.slow)])
 def test_rank_negotiation_subprocesses(nnodes):
     master = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")

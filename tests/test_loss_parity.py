@@ -103,10 +103,12 @@ def test_sharding2_loss_parity(baseline):
     _assert_parity(_run({"sharding_degree": 2}), baseline, "sharding2")
 
 
+@pytest.mark.slow
 def test_sharding8_loss_parity(baseline):
     _assert_parity(_run({"sharding_degree": 8}), baseline, "sharding8")
 
 
+@pytest.mark.slow
 def test_hybrid_dp_mp_pp_loss_parity(baseline):
     _assert_parity(
         _run({"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}),
@@ -203,6 +205,7 @@ def _run_moe(degrees):
     return [float(step(ids, ids)) for ids in _data()]
 
 
+@pytest.mark.slow
 def test_ep_sharding8_loss_parity():
     """MoE with the expert dim sharded over 8 devices matches 1-device."""
     base = _run_moe({})

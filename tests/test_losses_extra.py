@@ -107,6 +107,7 @@ def test_adaptive_log_softmax_matches_torch():
     np.testing.assert_allclose(float(loss._value), want_loss.item(), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_rnnt_loss_matches_bruteforce():
     """Exact check vs full alignment enumeration (the reference tests
     warp-transducer the same way at toy sizes)."""

@@ -37,6 +37,7 @@ def _gpt_step(degrees, stage=1):
     return step, ids
 
 
+@pytest.mark.slow
 def test_zero_sharding_shrinks_per_device_state():
     """Per-device state bytes must shrink stage-by-stage — ZeRO falling out
     of pjit placement, measured from the compiled per-device program:
@@ -147,6 +148,7 @@ def test_device_memory_stats_surface():
         assert v is None or (isinstance(v, int) and v >= 0)
 
 
+@pytest.mark.slow
 def test_6p7b_geometry_fits_v5e_with_headroom():
     """VERDICT r4 #3: the flagship pp2 x sharding4 16-layer TRUE-6.7B
     geometry (hidden 4096, 32 heads, ffn 16384) must compile to <= 14 GiB

@@ -39,6 +39,7 @@ def test_moe_gradients_flow():
     assert float(np.abs(moe.w_in.grad.numpy()).sum()) > 0
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_sharding():
     s = fleet.DistributedStrategy()
     s.hybrid_configs.update(dp_degree=2, sharding_degree=4)

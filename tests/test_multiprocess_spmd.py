@@ -51,6 +51,7 @@ def _launch_pair(port, timeout=600):
     return outs
 
 
+@pytest.mark.slow
 def test_two_process_spmd_matches_single_process():
     port = _free_port()
     outs = _launch_pair(port)

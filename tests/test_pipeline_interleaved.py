@@ -49,6 +49,7 @@ def test_interleaved_matches_v1_schedule():
     np.testing.assert_allclose(_np(out_v2), _np(out_v1), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_interleaved_training_decreases_loss():
     _init(pp=2)
     blocks = _blocks(4, seed=2)

@@ -15,6 +15,7 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
 
 
+@pytest.mark.slow
 def test_bf16_pp2_sharding4_trains():
     s = fleet.DistributedStrategy()
     s.hybrid_configs.update(dp_degree=1, mp_degree=1, pp_degree=2)

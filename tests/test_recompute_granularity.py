@@ -92,6 +92,7 @@ def _gpt_losses(fold, use_recompute, granularity, steps=4):
     return [float(step(ids, lbl)) for _ in range(steps)]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("fold", [False, True], ids=["unfolded", "folded"])
 def test_granularity_trajectory_parity(fold):
     base = _gpt_losses(fold, use_recompute=False, granularity="full")
@@ -103,6 +104,7 @@ def test_granularity_trajectory_parity(fold):
                                rtol=2e-6)
 
 
+@pytest.mark.slow
 def test_pp_schedule_granularity_parity():
     """recompute_block under the pp2 micro-batch schedule: both
     granularities match the schedule's own no-recompute trajectory."""

@@ -52,7 +52,8 @@ from conftest import free_port as _free_port
 
 
 @pytest.mark.parametrize(
-    "world", [pytest.param(2, marks=pytest.mark.fast), 3])
+    "world", [pytest.param(2, marks=pytest.mark.fast),
+              pytest.param(3, marks=pytest.mark.slow)])
 def test_rpc_roundtrip_subprocesses(world):
     master = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")

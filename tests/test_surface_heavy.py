@@ -13,6 +13,7 @@ def _np(t):
     return np.asarray(t._value if hasattr(t, "_value") else t)
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_forward_and_cache():
     from paddle_tpu.incubate.nn import FusedMultiTransformer
 
@@ -93,6 +94,7 @@ def test_adaptive_log_softmax_layer():
     assert layer.head_weight.grad is not None
 
 
+@pytest.mark.slow
 def test_nadam_matches_torch():
     torch = pytest.importorskip("torch")
     w0 = np.array([3.0, -2.0, 1.5], np.float32)
@@ -117,6 +119,7 @@ def test_nadam_matches_torch():
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_rprop_matches_torch():
     torch = pytest.importorskip("torch")
     w0 = np.array([3.0, -2.0, 1.5], np.float32)
@@ -327,6 +330,7 @@ def test_incubate_functional_tail():
     assert np.isfinite(_np(lyr(x, y))).all()
 
 
+@pytest.mark.slow
 def test_beam_search_decoder():
     """nn.BeamSearchDecoder + dynamic_decode: beam_size=1 equals a greedy
     argmax rollout of the same cell; wider beams contain the greedy path's

@@ -13,6 +13,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "telemetry_worker.py")
 
@@ -23,6 +25,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_run_exports_fleet_telemetry(tmp_path):
     tdir = tmp_path / "telemetry"
     port = _free_port()

@@ -60,6 +60,7 @@ def test_gpt_train_step_decreases():
     assert float(l) < float(l0)
 
 
+@pytest.mark.slow
 def test_gpt_3d_parallel_training():
     s = fleet.DistributedStrategy()
     s.hybrid_configs.update(dp_degree=2, mp_degree=2, pp_degree=2)
@@ -136,6 +137,7 @@ def test_bert_attention_mask_effect():
     assert np.abs(full[0, :4] - masked[0, :4]).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_ernie_finetune_decreases():
     """ERNIE-3.0 fine-tune (sequence classification) — the BASELINE workload."""
     paddle.seed(0)
@@ -155,6 +157,7 @@ def test_ernie_finetune_decreases():
     assert float(l) < float(l0)
 
 
+@pytest.mark.slow
 def test_llama_forward_and_gqa_training():
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
@@ -265,6 +268,7 @@ def test_generation_greedy_and_sampling():
     np.testing.assert_array_equal(outp, out)
 
 
+@pytest.mark.slow
 def test_beam_search_beats_or_ties_greedy_logprob():
     from paddle_tpu.text import beam_search, generate
     from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
@@ -324,6 +328,7 @@ def test_incubate_rms_and_rope_functionals():
     np.testing.assert_allclose(qr2.numpy(), ref_q.numpy(), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_kv_cache_generate_matches_full_recompute():
     """model.generate (prefill + one-token cached decode steps) must produce
     exactly the tokens of the full-prefix-recompute path."""
@@ -349,6 +354,7 @@ def test_llama_kv_cache_generate_matches_full_recompute():
     np.testing.assert_array_equal(s1, s2)
 
 
+@pytest.mark.slow
 def test_gpt_kv_cache_generate_matches_full_recompute():
     from paddle_tpu.text import generate
     from paddle_tpu.text.models import GPTConfig, GPTForCausalLM

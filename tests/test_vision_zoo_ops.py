@@ -26,7 +26,8 @@ def _fwd(model, hw=64):
 @pytest.mark.parametrize(
     "ctor,kwargs,hw",
     [
-        (models.alexnet, dict(num_classes=10), 64),
+        pytest.param(models.alexnet, dict(num_classes=10), 64,
+                     marks=pytest.mark.slow),
     ],
 )
 def test_model_forward_shapes(ctor, kwargs, hw):
