@@ -114,15 +114,16 @@ class _AtomicCommit:
     parent-dir fsync. A kill at ANY point leaves either the previous
     committed dir or a *.ptsave-tmp leftover — never a torn final dir."""
 
-    def __init__(self, tmp: str, final: str):
+    def __init__(self, tmp: str, final: str, meta: Optional[dict] = None):
         self.tmp = tmp
         self.final = final
+        self.meta = meta
 
     def run(self):
         from ...testing import chaos
 
         chaos.on_commit(self.tmp, self.final)
-        _manifest.write_manifest(self.tmp)
+        _manifest.write_manifest(self.tmp, meta=self.meta)
         if os.path.exists(self.final):
             shutil.rmtree(self.final)
         os.replace(self.tmp, self.final)
@@ -195,7 +196,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     tmp = path + TMP_SUFFIX
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
-    commit = _AtomicCommit(tmp, path)
+    commit = _AtomicCommit(tmp, path, meta=_layout_meta(arrays))
     if async_save:
         ckptr = ocp.AsyncCheckpointer(
             ocp.StandardCheckpointHandler(),
@@ -207,6 +208,15 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     commit.run()
     _record_save(path, time.perf_counter() - t0)
     return None
+
+
+def _layout_meta(arrays: Dict[str, Any]) -> Optional[dict]:
+    """Manifest meta carrying the source mesh + per-leaf PartitionSpec —
+    what restore-anywhere plans against (see distributed/reshard.py)."""
+    from ..reshard import LAYOUT_KEY, record_layouts
+
+    rec = record_layouts(arrays)
+    return {LAYOUT_KEY: rec} if rec else None
 
 
 def is_complete_checkpoint(path: str) -> bool:
@@ -252,8 +262,18 @@ def load_state_dict(
         else v,
         arrays,
     )
-    with _checkpointer() as ckptr:
-        restored = ckptr.restore(path, target)
+    try:
+        with _checkpointer() as ckptr:
+            _check_saved_shapes(ckptr, path, target)
+            restored = ckptr.restore(path, target)
+    except (ValueError, TypeError, KeyError) as e:
+        from ..reshard import legacy_error, read_layout_record
+
+        if read_layout_record(path) is None:
+            # pre-layout-record checkpoint failing to land on the live
+            # placements: say so, instead of the deep jax/orbax mismatch
+            raise legacy_error(path, e) from e
+        raise
     for k, v in state_dict.items():
         if isinstance(v, Tensor) and k in restored:
             r = restored[k]
@@ -265,6 +285,43 @@ def load_state_dict(
             v._rebind(r)
     _record_restore(path, time.perf_counter() - t0)
     return state_dict
+
+
+def _shape_mismatches(saved, target, prefix=""):
+    out = []
+    for k, v in saved.items():
+        key = f"{prefix}{k}"
+        t = target.get(k) if isinstance(target, dict) else None
+        if t is None:
+            continue
+        if isinstance(v, dict) and isinstance(t, dict):
+            out.extend(_shape_mismatches(v, t, key + "/"))
+        else:
+            ss = getattr(v, "shape", None)
+            ts = getattr(t, "shape", None)
+            if ss is not None and ts is not None and tuple(ss) != tuple(ts):
+                out.append(f"{key}: saved {tuple(ss)} vs target {tuple(ts)}")
+    return out
+
+
+def _check_saved_shapes(ckptr, path: str, target) -> None:
+    """Reject global-shape drift BEFORE orbax reads: tensorstore silently
+    zero-fills the out-of-range region when the requested global shape
+    exceeds the saved one (observed on this orbax), which corrupts a
+    restore instead of failing it. Typical trigger: a legacy per-rank
+    export (shard-local shapes) restored onto a full-shape target."""
+    try:
+        saved = ckptr.metadata(path)
+    except Exception:
+        return  # metadata unavailable: let restore surface its own error
+    if not isinstance(saved, dict) or not isinstance(target, dict):
+        return
+    bad = _shape_mismatches(saved, target)
+    if bad:
+        raise ValueError(
+            "checkpoint leaf shapes do not match the restore target: "
+            + "; ".join(bad[:3])
+            + (f" (+{len(bad) - 3} more)" if len(bad) > 3 else ""))
 
 
 def _record_restore(path: str, seconds: float) -> None:

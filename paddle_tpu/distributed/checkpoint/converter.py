@@ -138,26 +138,82 @@ def restore_canonical(path, model, optimizer=None) -> Dict[str, Any]:
     orbax rather than resuming silently on fresh inits. (User payloads that
     exist only on disk — ElasticManager's ``extra`` — live in a sidecar
     checkpoint precisely so this target never has to guess their shapes.)
+
+    Restore-anywhere (distributed/reshard.py): when the checkpoint's
+    manifest carries a layout record, each leaf is read onto a
+    memory-bounded READ spec on the live mesh (the source shard granularity
+    re-expressed with target axes — every device reads ~its source-local
+    bytes) and the planned slice/all-to-all/gather steps carry it to the
+    live placement. A restore failure on a checkpoint WITHOUT a layout
+    record raises the clear legacy-format diagnosis instead of a shape
+    mismatch deep in jax/orbax.
     """
-    import orbax.checkpoint as ocp
+    import time as _time
 
     from . import _checkpointer
+    from ..reshard import (apply_steps, legacy_error, plan_restore_spec,
+                           plan_same_mesh, read_layout_record,
+                           record_plan_metrics)
 
     live = canonical_state_dict(model, optimizer, abstract=True)
+    rec = read_layout_record(path)
+    rec_mesh, rec_leaves = rec if rec else (None, {})
+    t0 = _time.perf_counter()
+    pending = {}  # key -> (plan, live mesh): collective steps after the read
+    amb_mesh = next(
+        (sh.mesh for v in live.values()
+         if (sh := getattr(_as_value(v), "sharding", None)) is not None
+         and getattr(sh, "mesh", None) is not None), None)
 
-    def to_target(v):
+    def to_target(k, v):
         if isinstance(v, jax.ShapeDtypeStruct):
             return v  # exploded per-layer entry: restored unsharded, then
             #           restacked onto the live sharding by apply_canonical
         v = _as_value(v)
-        if hasattr(v, "shape") and hasattr(v, "dtype"):
-            return jax.ShapeDtypeStruct(
-                v.shape, v.dtype, sharding=getattr(v, "sharding", None))
-        return v
+        if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+            return v
+        sh = getattr(v, "sharding", None)
+        lay = rec_leaves.get(k)
+        if (rec_mesh is not None and lay is not None
+                and getattr(sh, "mesh", None) is not None
+                and getattr(sh, "spec", None) is not None
+                and tuple(lay.shape) == tuple(v.shape)):
+            read = plan_restore_spec(lay, rec_mesh, sh.mesh, sh.spec)
+            sizes = {n: int(sh.mesh.shape[n]) for n in sh.mesh.axis_names}
+            plan = plan_same_mesh(v.shape, v.dtype, read, sh.spec, sizes,
+                                  key=k)
+            if plan.steps:
+                pending[k] = (plan, sh.mesh)
+                return jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=type(sh)(sh.mesh, read))
+        if (getattr(sh, "mesh", None) is None and amb_mesh is not None
+                and isinstance(v, jax.Array)):
+            # un-meshed device leaf (fresh scalar accumulator) in a meshed
+            # model: restore it replicated on the ambient mesh — restoring
+            # committed to its current single device would hand the next
+            # jitted step arrays on conflicting device sets
+            sh = jax.sharding.NamedSharding(amb_mesh,
+                                            jax.sharding.PartitionSpec())
+        return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=sh)
 
-    target = {k: to_target(v) for k, v in live.items()}
-    with _checkpointer() as ckptr:
-        return ckptr.restore(path, target)
+    target = {k: to_target(k, v) for k, v in live.items()}
+    try:
+        with _checkpointer() as ckptr:
+            restored = ckptr.restore(path, target)
+    except (ValueError, TypeError, KeyError) as e:
+        if rec is None:
+            raise legacy_error(path, e) from e
+        raise
+    if pending:
+        fence = 0
+        for k, (plan, mesh) in pending.items():
+            restored[k] = apply_steps(restored[k], plan, mesh,
+                                      fence_base=fence)
+            fence += len(plan.steps)
+        record_plan_metrics([p for p, _ in pending.values()], what="restore",
+                            seconds=_time.perf_counter() - t0)
+    return restored
 
 
 class _StackPieces:

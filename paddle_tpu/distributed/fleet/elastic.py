@@ -157,6 +157,45 @@ class ElasticManager:
                 shutil.rmtree(os.path.join(self.ckpt_dir, name),
                               ignore_errors=True)
 
+    # -- live resize --------------------------------------------------------
+    def capture(self, model, optimizer=None) -> Dict:
+        """Live canonical snapshot (arrays keep their CURRENT placements) —
+        the source side of a live resize: capture on the old topology,
+        rebuild model/optimizer on the new one, then ``live_resize``."""
+        return self._state(model, optimizer)
+
+    def live_resize(self, step: int, src_state: Dict, model,
+                    optimizer=None) -> int:
+        """Resume at ``step + 1`` on the CURRENT (resized) topology by
+        resharding a captured live state via collectives — no disk
+        round-trip. ``src_state`` is ``capture()``'s snapshot from before
+        the fleet change; the rebuilt model/optimizer provide the target
+        placements. Any failure (missing leaves — the survivors cannot
+        host the state —, shape drift, a wedged collective) degrades to
+        ``resume()`` from the newest verified checkpoint instead of
+        crashing (graceful degradation; the fallback is telemetry-visible
+        as ``reshard_fallback_total{why="disk_restore"}``)."""
+        from ...distributed.checkpoint.converter import (
+            apply_canonical, canonical_state_dict,
+        )
+        from ..reshard import record_fallback, reshard_state
+
+        dst_state = canonical_state_dict(model, optimizer)
+        try:
+            moved = reshard_state(src_state, dst_state, what="live")
+            apply_canonical(model, moved, optimizer)
+        except (KeyError, ValueError, TimeoutError, RuntimeError) as e:
+            print(f"[elastic] live resize at step {step} failed ({e!r}); "
+                  "falling back to checkpoint restore", file=sys.stderr)
+            record_fallback("disk_restore", step=step, error=repr(e))
+            nxt = self.resume(model, optimizer)
+            _obs.event("elastic_resize", step=step, outcome="disk_restore",
+                       next_step=nxt)
+            return nxt
+        _obs.event("elastic_resize", step=step, outcome="live",
+                   next_step=step + 1, leaves=len(dst_state))
+        return step + 1
+
     def resume(self, model, optimizer=None, extra_out=None) -> int:
         """Restore the newest VERIFIED snapshot into the LIVE layout
         (re-stacking for the model's pipelines, re-placing onto current
@@ -215,3 +254,37 @@ class ElasticManager:
                 f"{self.ckpt_dir} failed verification/restore: {failures}; "
                 "refusing to silently train from scratch")
         return 0
+
+
+# ---------------------------------------------------------------------------
+# store-signaled fleet resize (the scale-event channel)
+# ---------------------------------------------------------------------------
+_RESIZE_KEY = "paddle_tpu/elastic/resize"
+
+
+def request_resize(store, world_size: int) -> None:
+    """Publish a fleet-resize request on the coordination store (bounded
+    py_store op — deadlines/backoff per docs/FAULT_TOLERANCE.md). Workers
+    polling ``poll_resize`` pick it up at their next step fence."""
+    store.set(_RESIZE_KEY, str(int(world_size)))
+
+
+def poll_resize(store) -> Optional[int]:
+    """Non-blocking check for a pending resize request: the requested new
+    world size, or None. The key stays set until ``clear_resize`` so late
+    pollers (or a worker relaunched mid-resize) still observe it."""
+    try:
+        if not store.check(_RESIZE_KEY):
+            return None
+        v = store.get(_RESIZE_KEY)
+        return int(v.decode() if isinstance(v, bytes) else v)
+    except (TimeoutError, ValueError):
+        return None
+
+
+def clear_resize(store) -> None:
+    """Acknowledge a completed resize (coordinator-side)."""
+    try:
+        store.delete_key(_RESIZE_KEY)
+    except TimeoutError:
+        pass

@@ -184,15 +184,19 @@ def _lrn(x, size, alpha, beta, k):
 
 
 @defop(name="spectral_norm_weight")
-def spectral_norm_weight(weight, u, dim=0, power_iters=1, eps=1e-12):
+def spectral_norm_weight(weight, u, v=None, dim=0, power_iters=1, eps=1e-12):
     """Spectral normalization: weight / sigma_max(weight), sigma estimated by
-    power iteration warm-started from the persistent vector `u`.
+    power iteration warm-started from the persistent vectors `u` (and `v`).
 
     Reference capability: ``paddle/phi/kernels/spectral_norm_kernel`` family
     (exposed via ``python/paddle/nn/utils/spectral_norm_hook.py``). The
     iteration runs under stop_gradient (gradients flow only through the
     final `w / sigma`, the standard SN-GAN formulation). Returns
-    (normalized_weight, new_u).
+    (normalized_weight, new_u, new_v).
+
+    ``power_iters=0`` with both vectors provided folds with the STORED
+    (u, v) — no iteration — so ``remove_spectral_norm`` reproduces the last
+    forward's sigma bit-exactly (the reference's do_power_iteration=False).
     """
     import jax
 
@@ -206,9 +210,12 @@ def spectral_norm_weight(weight, u, dim=0, power_iters=1, eps=1e-12):
 
     u_c = jax.lax.stop_gradient(jnp.asarray(u))
     w_c = jax.lax.stop_gradient(mat)
-    v_c = None
-    for _ in range(max(int(power_iters), 1)):
-        v_c = _l2(w_c.T @ u_c)
-        u_c = _l2(w_c @ v_c)
+    if int(power_iters) <= 0 and v is not None:
+        v_c = jax.lax.stop_gradient(jnp.asarray(v))
+    else:
+        v_c = None
+        for _ in range(max(int(power_iters), 1)):
+            v_c = _l2(w_c.T @ u_c)
+            u_c = _l2(w_c @ v_c)
     sigma = jnp.einsum("i,ij,j->", u_c, mat, v_c)
-    return weight / sigma, u_c
+    return weight / sigma, u_c, v_c

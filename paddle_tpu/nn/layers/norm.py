@@ -192,17 +192,25 @@ class SpectralNorm(Layer):
         self._power_iters = power_iters
         self._epsilon = epsilon
         h = int(weight_shape[self._axis])
+        rest = 1
+        for i, d in enumerate(weight_shape):
+            if i != self._axis:
+                rest *= int(d)
         rng = np.random.default_rng(0)
         u0 = rng.standard_normal(h).astype(dtype)
         u0 /= np.linalg.norm(u0) + epsilon
+        v0 = rng.standard_normal(rest).astype(dtype)
+        v0 /= np.linalg.norm(v0) + epsilon
         self.register_buffer("weight_u", _T(jnp.asarray(u0)))
+        self.register_buffer("weight_v", _T(jnp.asarray(v0)))
 
     def forward(self, weight):
         from ...framework.op import raw as _raw
 
-        w, new_u = F.spectral_norm_weight(
-            weight, self.weight_u, dim=self._axis,
+        w, new_u, new_v = F.spectral_norm_weight(
+            weight, self.weight_u, self.weight_v, dim=self._axis,
             power_iters=self._power_iters, eps=self._epsilon,
         )
         self.weight_u._rebind(_raw(new_u))
+        self.weight_v._rebind(_raw(new_v))
         return w

@@ -138,10 +138,27 @@ class _SpectralNormHook:
 
         orig = getattr(layer, self.name + "_orig")
         u = getattr(layer, self.name + "_u")
-        w, new_u = spectral_norm_weight(
-            orig, u, dim=self.dim, power_iters=self.n, eps=self.eps
+        v = getattr(layer, self.name + "_v")
+        w, new_u, new_v = spectral_norm_weight(
+            orig, u, v, dim=self.dim, power_iters=self.n, eps=self.eps
         )
         u._rebind(raw(new_u))
+        v._rebind(raw(new_v))
+        return w
+
+    def fold_weight(self, layer):
+        """W / sigma with the STORED (u, v) — zero power iterations, so the
+        fold reproduces the last forward's sigma bit-exactly (advancing the
+        iteration here made remove_spectral_norm() drift ~3e-5 off the live
+        weight)."""
+        from ..functional import spectral_norm_weight
+
+        orig = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        v = getattr(layer, self.name + "_v")
+        w, _, _ = spectral_norm_weight(
+            orig, u, v, dim=self.dim, power_iters=0, eps=self.eps
+        )
         return w
 
     def __call__(self, layer, inputs):
@@ -166,11 +183,19 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
     rng = np.random.default_rng(0)
     u0 = rng.standard_normal(h).astype("float32")
     u0 /= np.linalg.norm(u0) + eps
+    # v warm-starts at one half-iteration from u0 so a power_iters=0 fold is
+    # well-defined from the start; any later forward overwrites both buffers
+    nd = len(w.shape)
+    perm = (dim,) + tuple(i for i in range(nd) if i != dim)
+    mat0 = np.transpose(np.asarray(raw(w)), perm).reshape(h, -1)
+    v0 = (mat0.T @ u0).astype("float32")
+    v0 /= np.linalg.norm(v0) + eps
     del layer._parameters[name]
     layer.add_parameter(name + "_orig", Parameter(raw(w), trainable=w.trainable,
                                                   name=f"{name}_orig"))
     u = Tensor(jnp.asarray(u0))
     layer.register_buffer(name + "_u", u)
+    layer.register_buffer(name + "_v", Tensor(jnp.asarray(v0)))
     object.__setattr__(layer, name, hook.compute_weight(layer))
     remover = layer.register_forward_pre_hook(hook)
     if not hasattr(layer, "_weight_norm_hooks"):
@@ -189,12 +214,14 @@ def remove_spectral_norm(layer, name="weight"):
     if not isinstance(hook, _SpectralNormHook):
         raise ValueError(f"spectral_norm was not applied to {name!r}")
     hook, remover = hooks.pop(name)
-    w = hook.compute_weight(layer)
+    w = hook.fold_weight(layer)  # stored (u, v): bit-exact vs last forward
     remover.remove()
     orig = layer._parameters.pop(name + "_orig")
     layer._buffers.pop(name + "_u", None)
+    layer._buffers.pop(name + "_v", None)
     object.__setattr__(layer, name + "_orig", None)
     object.__setattr__(layer, name + "_u", None)
+    object.__setattr__(layer, name + "_v", None)
     layer.add_parameter(name, Parameter(raw(w), trainable=orig.trainable,
                                         name=name))
     return layer

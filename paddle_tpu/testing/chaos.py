@@ -34,6 +34,11 @@ Env knobs (all read lazily so tests can flip them per-case):
   PADDLE_CHAOS_STORE_DROP=<p>       per-op probability the client store
                                     connection is dropped before send
   PADDLE_CHAOS_STORE_LATENCY_MS=<ms>  artificial latency per store op
+  PADDLE_CHAOS_RESHARD_MODE=kill|latency
+  PADDLE_CHAOS_RESHARD_AT=<k>       which reshard fence the fault fires at
+                                    (fences count planned collective steps
+                                    across a reshard; default 0 = first)
+  PADDLE_CHAOS_RESHARD_LATENCY_MS=<ms>  sleep injected by the latency mode
 
 The tear/corrupt helpers at the bottom are also callable directly from
 tests (no env needed) to manufacture damaged checkpoints.
@@ -129,6 +134,38 @@ def step_fence(step: int) -> None:
     if k is not None and int(k) == step:
         _fault("kill_step", step=step)
         _sigkill(f"kill injected at train step {step}")
+
+
+# ---------------------------------------------------------------------------
+# Reshard faults (called by distributed/reshard.py between planned steps)
+# ---------------------------------------------------------------------------
+def reshard_fence(index: int, what: str) -> None:
+    """Fault point between planned reshard collective steps. ``index``
+    counts fences across one reshard (leaf boundaries and per-step), so
+    PADDLE_CHAOS_RESHARD_AT can target "mid-reshard" precisely: some
+    leaves already moved, others not — the window a real preemption tears.
+
+    kill    — SIGKILL at the matching fence; recovery must come from the
+              newest verified checkpoint, never the half-moved state.
+    latency — sleep PADDLE_CHAOS_RESHARD_LATENCY_MS at the matching fence,
+              exercising the reshard deadline watchdog.
+    """
+    if not armed():
+        return
+    mode = _env("PADDLE_CHAOS_RESHARD_MODE")
+    if mode is None:
+        return
+    at = int(_env("PADDLE_CHAOS_RESHARD_AT", "0"))
+    if index != at:
+        return
+    if mode == "kill":
+        _fault("reshard_kill", index=index, what=what)
+        _sigkill(f"kill injected at reshard fence {index} ({what})")
+    elif mode == "latency":
+        ms = float(_env("PADDLE_CHAOS_RESHARD_LATENCY_MS", "0"))
+        _fault("reshard_latency", index=index, what=what, ms=ms)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,7 @@ RECORDERS = {
 OWNED_PREFIXES = {
     "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
     "serving_": os.path.join("paddle_tpu", "inference", "engine.py"),
+    "reshard_": os.path.join("paddle_tpu", "distributed", "reshard.py"),
 }
 
 

@@ -9,7 +9,13 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
   2. unbounded ``socket.recv`` — any file that calls ``.recv(...)`` must
      also call ``.settimeout(...)`` somewhere: a recv with no deadline on a
      dead peer is an eternal silent hang (the failure mode the py_store
-     hardening exists to rule out).
+     hardening exists to rule out);
+  3. unguarded reshard collectives — in ``paddle_tpu/distributed/reshard.py``
+     every collective/transfer call site (``_constrain``, the jitted-
+     identity step executor, and ``jax.device_put``) must sit lexically
+     inside a ``with deadline_guard(...)`` block: a collective with a dead
+     peer never returns, and the guard is what turns that into a diagnosed
+     ``reshard_stall`` instead of a silent fleet-wide hang.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -26,6 +32,15 @@ SCAN_DIRS = [
     os.path.join("paddle_tpu", "runtime"),
     os.path.join("paddle_tpu", "distributed", "launch"),
 ]
+
+#: files whose collective call sites must run under deadline_guard
+GUARDED_FILES = [
+    os.path.join("paddle_tpu", "distributed", "reshard.py"),
+]
+
+#: call names that ARE collectives/transfers in the guarded files:
+#: bare-name calls and attribute calls (obj.<name>) both match
+GUARDED_CALLS = {"_constrain", "device_put"}
 
 
 def _py_files(root):
@@ -64,12 +79,68 @@ def check_file(path: str):
                    "deadline (see py_store._recv_msg)")
 
 
+def _is_deadline_guard_with(node: ast.With) -> bool:
+    """True when one of the with-items' context expr is a deadline_guard(...)
+    call (bare name or attribute access)."""
+    for item in node.items:
+        ctx = item.context_expr
+        if not isinstance(ctx, ast.Call):
+            continue
+        f = ctx.func
+        if isinstance(f, ast.Name) and f.id == "deadline_guard":
+            return True
+        if isinstance(f, ast.Attribute) and f.attr == "deadline_guard":
+            return True
+    return False
+
+
+def check_guarded_collectives(path: str):
+    """Yield (line, message) for collective call sites in a guarded file
+    that are not lexically inside a ``with deadline_guard(...)``."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    parent = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None)
+        if name not in GUARDED_CALLS:
+            continue
+        # the executor's own body (`def _constrain`) holds the cached jit
+        # call, not a collective launch; skip call sites inside it
+        anc, guarded, in_definition = node, False, False
+        while anc in parent:
+            anc = parent[anc]
+            if isinstance(anc, ast.With) and _is_deadline_guard_with(anc):
+                guarded = True
+            if (isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and anc.name in GUARDED_CALLS):
+                in_definition = True
+        if not guarded and not in_definition:
+            yield (node.lineno,
+                   f"collective call {name!r} outside any `with "
+                   "deadline_guard(...)` — a wedged peer makes this hang "
+                   "forever with no diagnosis (rule 3, reshard path)")
+
+
 def main(argv=None):
     root = (argv or sys.argv[1:] or [REPO])[0]
     violations = []
     for path in _py_files(root):
         rel = os.path.relpath(path, root)
         for line, msg in check_file(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for rel in GUARDED_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        for line, msg in check_guarded_collectives(path):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

@@ -88,3 +88,26 @@ def test_sharding8_to_hybrid_continuity(tmp_path, baseline):
         losses, baseline, rtol=5e-3, atol=1e-5,
         err_msg="sharding8(stage3) -> dp2xmp2xpp2 resume diverged")
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_dp_mp_to_pure_dp_continuity(tmp_path, baseline):
+    """Restore-anywhere acceptance: a dpxmp checkpoint resumes on a pure-dp
+    fleet with a degree-independent loss trajectory."""
+    losses = _switch_run({"mp_degree": 2}, {}, str(tmp_path / "c"))
+    np.testing.assert_allclose(
+        losses, baseline, rtol=5e-3, atol=1e-5,
+        err_msg="dp4xmp2 -> dp8 resume diverged")
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_dp_pp_to_dp_mp_continuity(tmp_path, baseline):
+    """dpxpp checkpoint resumed under dpxmp: neither config saw the other's
+    mesh, the trajectory must not notice."""
+    losses = _switch_run({"pp_degree": 2}, {"mp_degree": 2},
+                         str(tmp_path / "d"))
+    np.testing.assert_allclose(
+        losses, baseline, rtol=5e-3, atol=1e-5,
+        err_msg="dp4xpp2 -> dp4xmp2 resume diverged")
+    assert losses[-1] < losses[0]

