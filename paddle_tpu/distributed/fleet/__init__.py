@@ -784,6 +784,10 @@ class DistTrainStep(TrainStep):
         lays = tuple(plan.zero_layouts) + tuple(plan.tail_layouts)
         _grad_comm.record_build_stats(
             plan.n_buckets, plan.bytes_f32, plan.bytes_wire)
+        # with in-backward tail issue (plan.overlap_tail) only the LAST-
+        # finalizing bucket — the earliest parameters' — can't hide behind
+        # remaining backward compute; the post-backward path has the same
+        # shape (bucket 0 is still the last to finish), so one formula
         _grad_comm.record_overlap_ratio(lays[0].total * 4, plan.bytes_f32)
 
     def _strategy_of(self):

@@ -27,8 +27,10 @@ the schedule lives *inside one compiled program*:
 from __future__ import annotations
 
 import functools
+import os
 import warnings
 import re
+from dataclasses import dataclass, replace
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -37,11 +39,92 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .... import observability as _obs
 from ....framework.core import Tensor
 from ....framework.op import defop, raw
 from ....nn.layer import Layer, Parameter
 from ... import mesh as _mesh
 from ...collective import psum_f32safe as _psum_f32safe
+
+
+# ------------------------------------------------- schedule configuration --
+PP_SCHEDULES = ("gpipe", "1f1b", "zero_bubble")
+
+_PP_TRUE = {"1", "on", "true", "yes"}
+
+
+@dataclass(frozen=True)
+class PpScheduleConfig:
+    """Resolved pipeline-schedule knobs (docs/PIPELINE.md).
+
+    ``schedule`` picks how the compiled program orders micro-batch work:
+    ``gpipe`` (all-forward-then-derived-backward, the historical default),
+    ``1f1b`` (explicitly scheduled backward ring, reverse tick order), or
+    ``zero_bubble`` (1f1b with backward split into input-grad ring ticks +
+    deferred bulk weight-grad). ``virtual_pp_degree`` is the interleaving
+    factor V: chunk c of the layer stack lives on physical stage c % S and
+    the flush bubble shrinks by V.
+    """
+
+    schedule: str = "gpipe"
+    virtual_pp_degree: int = 1
+
+
+def _strategy_pp_config(strategy) -> PpScheduleConfig:
+    cfg = PpScheduleConfig()
+    if strategy is None:
+        return cfg
+    sub = dict(getattr(strategy, "pipeline_configs", {}) or {})
+    sched = str(sub.get("schedule", cfg.schedule)).strip().lower()
+    if sched not in PP_SCHEDULES:
+        raise ValueError(
+            f"pipeline_configs.schedule={sched!r} not in {PP_SCHEDULES}")
+    v = max(int(sub.get("virtual_pp_degree", cfg.virtual_pp_degree)), 1)
+    return PpScheduleConfig(schedule=sched, virtual_pp_degree=v)
+
+
+def resolve_pp_schedule(strategy=None) -> PpScheduleConfig:
+    """Strategy knobs overridden by ``PADDLE_TPU_PP_SCHEDULE``.
+
+    Env grammar (case-insensitive), mirroring PADDLE_TPU_GRAD_COMM:
+      ``gpipe`` / ``1f1b`` / ``zero_bubble``   bare schedule tokens
+      comma list of ``k=v``                    ``schedule=1f1b,virtual=2``
+                                               (``vpp`` / ``virtual_pp_degree``
+                                               are aliases of ``virtual``)
+      bare tokens compose with k=v ones:       ``zero_bubble,virtual=2``
+    """
+    if strategy is None:
+        from ... import fleet as _fleet
+
+        strategy = _fleet.fleet_strategy()
+    cfg = _strategy_pp_config(strategy)
+    raw_env = os.environ.get("PADDLE_TPU_PP_SCHEDULE", "").strip().lower()
+    if not raw_env:
+        return cfg
+    for part in raw_env.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            if part in PP_SCHEDULES:
+                cfg = replace(cfg, schedule=part)
+            else:
+                raise ValueError(
+                    f"PADDLE_TPU_PP_SCHEDULE: bad token {part!r} "
+                    f"(want k=v or a schedule from {PP_SCHEDULES})")
+            continue
+        k, v = (s.strip() for s in part.split("=", 1))
+        if k == "schedule":
+            if v not in PP_SCHEDULES:
+                raise ValueError(
+                    f"PADDLE_TPU_PP_SCHEDULE schedule={v!r} not in "
+                    f"{PP_SCHEDULES}")
+            cfg = replace(cfg, schedule=v)
+        elif k in ("virtual", "vpp", "virtual_pp_degree"):
+            cfg = replace(cfg, virtual_pp_degree=max(int(v), 1))
+        else:
+            raise ValueError(f"PADDLE_TPU_PP_SCHEDULE: unknown key {k!r}")
+    return cfg
 
 
 class LayerDesc:
@@ -122,8 +205,9 @@ class SpmdPipeline(Layer):
         num_stages: Optional[int] = None,
         num_microbatches: Optional[int] = None,
         recompute_block: bool = False,
-        num_virtual_stages: int = 1,
+        num_virtual_stages: Optional[int] = None,
         recompute_granularity: str = "full",
+        schedule: Optional[str] = None,
     ):
         super().__init__()
         blocks = list(blocks)
@@ -136,6 +220,24 @@ class SpmdPipeline(Layer):
         self.num_layers = len(blocks)
         m = _mesh.get_global_mesh()
         self.num_stages = num_stages or _mesh.mesh_axis_size("pp")
+        if schedule is not None and schedule not in PP_SCHEDULES:
+            raise ValueError(f"schedule={schedule!r} not in {PP_SCHEDULES}")
+        # None = resolve at forward time (strategy/env may change per run)
+        self._schedule = schedule
+        if num_virtual_stages is None:
+            # unset: adopt the strategy/env virtual degree when it divides
+            # the stack, else degrade to non-interleaved (model-zoo call
+            # sites pass nothing; an explicit argument keeps the hard error)
+            s_eff = max(self.num_stages, 1)
+            v = resolve_pp_schedule().virtual_pp_degree if s_eff > 1 else 1
+            if v > 1 and self.num_layers % (s_eff * v) != 0:
+                warnings.warn(
+                    f"virtual_pp_degree={v} does not divide "
+                    f"{self.num_layers} layers over {s_eff} stages; "
+                    "falling back to non-interleaved pipeline",
+                    stacklevel=2)
+                v = 1
+            num_virtual_stages = v
         self.num_virtual_stages = max(int(num_virtual_stages), 1)
         n_chunks = max(self.num_stages, 1) * self.num_virtual_stages
         if self.num_layers % n_chunks != 0:
@@ -259,31 +361,64 @@ class SpmdPipeline(Layer):
             pipe=self,
         )
 
-    def schedule_info(self, batch_size: int) -> dict:
+    def schedule_info(self, batch_size: int,
+                      schedule: Optional[str] = None) -> dict:
         """Step/bubble accounting for the compiled schedule.
 
         Per-step cost is expressed in full-stage layer passes (L/S layers):
         the V=1 circular schedule does 1.0 per step; the phased interleaved
-        schedule does one chunk (= 1/V) per step. `bubble_fraction` is
-        idle-time share per pipeline flush — the quantity interleaved 1F1B
-        exists to shrink (reference: fleet interleaved 1F1B).
+        schedule does one chunk (= 1/V) per step. `bubble_fraction` is the
+        forward idle-time share per pipeline flush — the quantity
+        interleaved 1F1B exists to shrink (reference: fleet interleaved
+        1F1B).
+
+        Analytic fwd+bwd model (docs/PIPELINE.md §3), unit costs per
+        micro-batch per full stage: F=1, full B=2, input-grad B=1,
+        weight-grad W=1 (per-chunk costs divide by V):
+        `fwd_bwd_total_cost` / `analytic_bubble_fraction` — the schedule's
+        planned flush time and idle share (gpipe and synchronous 1f1b tie;
+        zero_bubble fills the drain with deferred weight-grad, reaching 0
+        when M >= 2(S-1)/V). `measured_bubble_fraction` is the idle-cell
+        fraction of the compiled (stage, tick) schedule table (fwd + bwd
+        grids; zero_bubble's deferred weight-grad scan counts as dense
+        ticks), i.e. what the compiled program actually schedules, and is
+        what `pp_bubble_fraction` reports via telemetry.
         """
         S, V = self.num_stages, self.num_virtual_stages
         M = _choose_microbatches(batch_size, self.num_microbatches or S, warn=False)
+        sched = (schedule or self._schedule
+                 or resolve_pp_schedule().schedule)
         if _uses_scan_fallback(S):
             S = 1
         if S <= 1:
             return {"steps": 1, "step_cost": float(M), "total_cost": float(M),
-                    "ideal_cost": float(M), "bubble_fraction": 0.0, "M": M}
-        if V == 1:
+                    "ideal_cost": float(M), "bubble_fraction": 0.0, "M": M,
+                    "schedule": "fold", "fwd_bwd_total_cost": 3.0 * M,
+                    "analytic_bubble_fraction": 0.0,
+                    "measured_bubble_fraction": 0.0,
+                    "schedule_ticks": M, "act_microbatches": M}
+        if sched == "gpipe" and V == 1:
             steps, cost = M + S - 1, 1.0
         else:
             groups = -(-M // S)
             steps, cost = groups * S * V + S - 1, 1.0 / V
         total = steps * cost
+        busy = V * M                   # scheduled cells per stage per grid
+        ticks = 2 * steps + (busy if sched == "zero_bubble" else 0)
+        idle = 2 * (steps - busy)
+        fill = (S - 1) / V
+        if sched == "zero_bubble":
+            fb_total = 3.0 * M + max(0.0, 2.0 * fill - M)
+        else:
+            fb_total = 3.0 * M + 3.0 * fill
         return {"steps": steps, "step_cost": cost, "total_cost": total,
                 "ideal_cost": float(M), "bubble_fraction": 1.0 - M / total,
-                "M": M}
+                "M": M, "schedule": sched,
+                "fwd_bwd_total_cost": fb_total,
+                "analytic_bubble_fraction": 1.0 - 3.0 * M / fb_total,
+                "measured_bubble_fraction": idle / ticks,
+                "schedule_ticks": ticks,
+                "act_microbatches": busy}
 
 
 def fold_or_list(blocks, fold: bool, recompute: bool = False,
@@ -438,6 +573,7 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     B = x.shape[0]
     M = _choose_microbatches(B, pipe.num_microbatches or S)
     mb = B // M
+    sched_name = pipe._schedule or resolve_pp_schedule().schedule
 
     from ... import grad_comm as _grad_comm
 
@@ -565,12 +701,30 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
 
     if z_layout is None:
         sched_block = block
+        sched_block_raw = pipe._apply_block
     else:
         def _gathered_block(leaves, h):
             return pipe._apply_block(_prep_layer(leaves), h)
 
         sched_block = (jax.checkpoint(_gathered_block, policy=ckpt_policy)
                        if pipe.recompute_block else _gathered_block)
+        # the explicitly-scheduled backward recomputes each chunk from its
+        # stashed input inside its own tick (inherent "full" remat), so it
+        # uses the UNcheckpointed block — wrapping would recompute twice
+        sched_block_raw = _gathered_block
+
+    # the scheduled (1f1b / zero_bubble) backward re-traces the chunk body
+    # per jax.vjp call; random ops must replay the FORWARD trace's bits
+    # exactly or dropout masks diverge between the stashed forward and its
+    # backward recompute (silently wrong gradients). One explicit
+    # trace-scoped key pins every chunk application — fwd and bwd — to the
+    # same deterministic stream (masks repeat across chunks/micro-batches,
+    # the documented schedule-path limitation above).
+    train_key = _rng_pp = None
+    if sched_name != "gpipe" and getattr(tmpl, "training", False):
+        from ....framework import rng as _rng_pp  # noqa: F811
+
+        train_key = _rng_pp.next_key()
 
     def stage_apply(local_leaves, h):
         def body(h, leaves):
@@ -668,8 +822,202 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
         )
         return out_buf
 
-    if V > 1:
+    def spmd_fn_scheduled(region, xm_all):
+        """Explicitly SCHEDULED pipeline (schedule=1f1b / zero_bubble): the
+        forward runs the phased chunk ring (same decode as the interleaved
+        schedule, for any V>=1) and stashes each chunk's input; a
+        jax.custom_vjp replays the ring in REVERSE tick order for the
+        backward, so the compiled backward follows the 1F1B tick/slot
+        discipline — each backward tick recomputes one chunk from its
+        stashed input (inherent "full" remat; only the M x V chunk inputs
+        persist per stage) and hands the input-cotangent to the previous
+        stage over the reverse ppermute ring.
+
+        zero_bubble additionally splits each backward tick into an
+        input-grad-only hop (weights constant under the vjp, so no
+        weight-grad math delays the ring) and defers ALL weight-grad work
+        to a dense scan after the ring drains — the work that fills the
+        drain bubble on real hardware (ZB-H1 decomposition; see
+        docs/PIPELINE.md §2). Numerics: identical math to the derived
+        path up to reassociation (equivalence pinned <=1e-5 over 3 AdamW
+        steps in tests/test_pipeline_schedules.py).
+        """
+        L_chunk = pipe.num_layers // (S * V)
+        groups = -(-M // S)
+        n_steps = groups * S * V + S - 1
+
+        def decode(t):
+            st = lax.axis_index("pp")
+            rel_total = t - st
+            g = jnp.maximum(rel_total, 0) // (S * V)
+            rel = rel_total - g * S * V
+            k_raw = rel // S
+            m_local = rel % S
+            mb_idx = jnp.clip(g * S + m_local, 0, M - 1)
+            valid = (rel_total >= 0) & (g < groups) & (g * S + m_local < M)
+            k = jnp.clip(k_raw, 0, V - 1)
+            inject = valid & (st == 0) & (k_raw == 0)
+            done = valid & (st == S - 1) & (k_raw == V - 1)
+            return mb_idx, k, valid, inject, done
+
+        def as_chunks(leaves):
+            return tuple(
+                l.reshape((V, L_chunk) + l.shape[1:]) for l in leaves)
+
+        def chunk_apply(lv, h):
+            def body(h, leaves):
+                return sched_block_raw(leaves, h), None
+
+            if train_key is not None:
+                with _rng_pp.trace_key_scope(train_key):
+                    h, _ = lax.scan(body, h, lv)
+            else:
+                h, _ = lax.scan(body, h, lv)
+            return h
+
+        def fwd_loop(leaves, xm_):
+            local_v = as_chunks(leaves)
+            h0 = jnp.zeros(xm_.shape[1:], xm_.dtype)
+            out0 = jnp.zeros_like(xm_)
+            acts0 = jnp.zeros((V * M,) + xm_.shape[1:], xm_.dtype)
+
+            def tick(t, carry):
+                h_, out_, acts_ = carry
+                mb_idx, k, valid, inject, done = decode(t)
+                inp = jnp.where(inject, xm_[mb_idx], h_)
+                slot = k * M + mb_idx
+                old_a = lax.dynamic_index_in_dim(acts_, slot, 0,
+                                                 keepdims=False)
+                acts_ = lax.dynamic_update_index_in_dim(
+                    acts_, jnp.where(valid, inp, old_a), slot, 0)
+                lv = tuple(lax.dynamic_index_in_dim(l, k, 0, keepdims=False)
+                           for l in local_v)
+                o = chunk_apply(lv, inp)
+                old = lax.dynamic_index_in_dim(out_, mb_idx, 0,
+                                               keepdims=False)
+                out_ = lax.dynamic_update_index_in_dim(
+                    out_, jnp.where(done, o, old), mb_idx, 0)
+                h_next = lax.ppermute(
+                    o, "pp", [(i, (i + 1) % S) for i in range(S)])
+                return h_next, out_, acts_
+
+            _, out, acts = lax.fori_loop(0, n_steps, tick, (h0, out0, acts0))
+            return out, acts
+
+        @jax.custom_vjp
+        def sched(leaves, xm_):
+            return fwd_loop(leaves, xm_)[0]
+
+        def sched_fwd(leaves, xm_):
+            out, acts = fwd_loop(leaves, xm_)
+            return out, (leaves, acts)
+
+        def sched_bwd(res, g_out):
+            leaves, acts = res
+            local_v = as_chunks(leaves)
+            zb = sched_name == "zero_bubble"
+            c0 = jnp.zeros(g_out.shape[1:], g_out.dtype)
+            gx0 = jnp.zeros_like(g_out)
+            wg0 = tuple(jnp.zeros_like(l) for l in local_v)
+            cts0 = (jnp.zeros_like(acts) if zb
+                    else jnp.zeros((1,), g_out.dtype))
+
+            def tick(tb, carry):
+                c_, gx_, wg_, cts_ = carry
+                tf = n_steps - 1 - tb
+                mb_idx, k, valid, inject, done = decode(tf)
+                # the final chunk's output cotangent comes from the loss
+                # side; every other tick consumes the ring
+                ct = jnp.where(done, g_out[mb_idx], c_)
+                slot = k * M + mb_idx
+                inp = lax.dynamic_index_in_dim(acts, slot, 0, keepdims=False)
+                lv = tuple(lax.dynamic_index_in_dim(l, k, 0, keepdims=False)
+                           for l in local_v)
+                if zb:
+                    _, dgrad = jax.vjp(lambda h_: chunk_apply(lv, h_), inp)
+                    (d_inp,) = dgrad(ct)
+                    old_c = lax.dynamic_index_in_dim(cts_, slot, 0,
+                                                     keepdims=False)
+                    cts_ = lax.dynamic_update_index_in_dim(
+                        cts_, jnp.where(valid, ct, old_c), slot, 0)
+                else:
+                    _, vjp_fn = jax.vjp(chunk_apply, lv, inp)
+                    d_lv, d_inp = vjp_fn(ct)
+                    wg_upd = []
+                    for w, dl in zip(wg_, d_lv):
+                        cur = lax.dynamic_index_in_dim(w, k, 0,
+                                                       keepdims=False)
+                        upd = cur + jnp.where(valid, dl, jnp.zeros_like(dl))
+                        wg_upd.append(
+                            lax.dynamic_update_index_in_dim(w, upd, k, 0))
+                    wg_ = tuple(wg_upd)
+                d_inp = jnp.where(valid, d_inp, jnp.zeros_like(d_inp))
+                old = lax.dynamic_index_in_dim(gx_, mb_idx, 0, keepdims=False)
+                gx_ = lax.dynamic_update_index_in_dim(
+                    gx_, jnp.where(inject, d_inp, old), mb_idx, 0)
+                c_next = lax.ppermute(
+                    jnp.where(valid & ~inject, d_inp, jnp.zeros_like(d_inp)),
+                    "pp", [(i, (i - 1) % S) for i in range(S)])
+                return c_next, gx_, wg_, cts_
+
+            _, gx, wg, cts = lax.fori_loop(
+                0, n_steps, tick, (c0, gx0, wg0, cts0))
+
+            if zb:
+                # deferred weight-grad: dense scan over the stashed
+                # (input, cotangent) pairs of each local chunk slot.
+                # Invalid slots hold zero cotangents -> zero contribution.
+                acts_v = acts.reshape((V, M) + acts.shape[1:])
+                cts_v = cts.reshape((V, M) + cts.shape[1:])
+                per_k = []
+                for k in range(V):
+                    lv = tuple(l[k] for l in local_v)
+
+                    def body(acc, pair, lv=lv):
+                        inp, ct = pair
+                        _, wjp = jax.vjp(
+                            lambda lv_: chunk_apply(lv_, inp), lv)
+                        (d_lv,) = wjp(ct)
+                        return tuple(a + d for a, d in zip(acc, d_lv)), None
+
+                    acc0 = tuple(jnp.zeros_like(l) for l in lv)
+                    acc, _ = lax.scan(body, acc0, (acts_v[k], cts_v[k]))
+                    per_k.append(acc)
+                wg = tuple(
+                    jnp.stack([per_k[k][j] for k in range(V)], 0)
+                    for j in range(len(local_v)))
+            d_leaves = tuple(
+                w.reshape((V * L_chunk,) + w.shape[2:]) for w in wg)
+            return d_leaves, gx
+
+        sched.defvjp(sched_fwd, sched_bwd)
+
+        local_stacked = _leaves_of(region)
+        out_buf = sched(tuple(local_stacked), xm_all)
+        stage = lax.axis_index("pp")
+        return _psum_f32safe(
+            jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf)), "pp")
+
+    if sched_name != "gpipe":
+        spmd_fn = spmd_fn_scheduled
+    elif V > 1:
         spmd_fn = spmd_fn_interleaved
+
+    # pp_* telemetry (single writer: this module — scripts/
+    # check_observability.py OWNED_PREFIXES): compiled-schedule shape and
+    # the comm volume the bucket structure lets backward hide. Trace-time
+    # statics, mirroring grad_comm.record_build_stats.
+    info = pipe.schedule_info(B, schedule=sched_name)
+    _obs.set_gauge("pp_schedule_ticks", float(info["schedule_ticks"]))
+    _obs.set_gauge("pp_bubble_fraction",
+                   float(info["measured_bubble_fraction"]))
+    hidden_bytes = 0
+    if bucket_layouts:
+        wire_it = cfg.wire_itemsize if cfg.quantized else 4
+        hidden_bytes = pipe.num_layers * (
+            sum(l.total for l in bucket_layouts)
+            - bucket_layouts[0].total) * wire_it
+    _obs.set_gauge("pp_overlap_hidden_bytes", float(hidden_bytes))
 
     # On the CPU backend, sub-f32 i/o crosses the shard_map boundary as
     # f32: the replicated input's cotangent is a jax-inserted psum at this

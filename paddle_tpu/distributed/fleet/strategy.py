@@ -54,10 +54,16 @@ class DistributedStrategy:
         self.sharding_configs: _SubConfig = _SubConfig(
             sharding_degree=1, stage=1, offload=False
         )
-        # pipeline
+        # pipeline. `schedule` picks the compiled micro-batch schedule
+        # (gpipe | 1f1b | zero_bubble — see docs/PIPELINE.md) and
+        # `virtual_pp_degree` the interleaving factor; both are resolved by
+        # meta_parallel.pipeline_parallel.resolve_pp_schedule with a
+        # PADDLE_TPU_PP_SCHEDULE env override. schedule_mode is the
+        # reference's legacy spelling, accepted but subordinate.
         self.pipeline = False
         self.pipeline_configs: _SubConfig = _SubConfig(
-            micro_batch_size=1, accumulate_steps=1, schedule_mode="1F1B"
+            micro_batch_size=1, accumulate_steps=1, schedule_mode="1F1B",
+            schedule="gpipe", virtual_pp_degree=1,
         )
         self.gradient_merge = False
         self.gradient_merge_configs: _SubConfig = _SubConfig(k_steps=1, avg=True)
@@ -90,10 +96,13 @@ class DistributedStrategy:
         # bucket_mb is deliberately ABSENT here: unset, the bucket size
         # defaults to fuse_grad_size_in_MB (the reference's fused-allreduce
         # buffer knob) so tuned ports keep their comm granularity.
+        # `overlap` issues each tail bucket's collective inside the backward
+        # chain (as its cotangents finalize) instead of after the full
+        # backward; kill switch overlap=0 in PADDLE_TPU_GRAD_COMM.
         self.grad_comm = False
         self.grad_comm_configs: _SubConfig = _SubConfig(
             wire_dtype="f32", error_feedback=False,
-            zero_update=True, pipeline_batch_shard=True,
+            zero_update=True, pipeline_batch_shard=True, overlap=True,
         )
         self.nccl_comm_num = 1
         self.find_unused_parameters = False

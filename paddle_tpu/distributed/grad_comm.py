@@ -81,6 +81,12 @@ class GradCommConfig:
     error_feedback: bool = False
     zero_update: bool = True
     pipeline_batch_shard: bool = True
+    # issue each tail bucket's collective INSIDE the backward chain, as its
+    # cotangents finalize, instead of after the full backward (docs/
+    # PIPELINE.md §4). ZeRO buckets (shard-shaped scatter result) and
+    # error-feedback (residual state can't escape a vjp) keep the
+    # post-backward issue regardless.
+    overlap: bool = True
 
     @property
     def quantized(self) -> bool:
@@ -122,6 +128,7 @@ def _strategy_config(strategy) -> GradCommConfig:
         zero_update=bool(sub.get("zero_update", cfg.zero_update)),
         pipeline_batch_shard=bool(
             sub.get("pipeline_batch_shard", cfg.pipeline_batch_shard)),
+        overlap=bool(sub.get("overlap", cfg.overlap)),
     )
 
 
@@ -136,7 +143,7 @@ def resolve_config(strategy=None) -> GradCommConfig:
       ``bf16`` / ``int8``      enable with that wire dtype
       comma list of ``k=v``    fine-grained: ``wire=int8,bucket_mb=8,``
                                ``error_feedback=1,zero=0,batch_shard=0,``
-                               ``enable=1``
+                               ``overlap=0,enable=1``
     """
     if strategy is None:
         from . import fleet as _fleet
@@ -183,6 +190,8 @@ def resolve_config(strategy=None) -> GradCommConfig:
             cfg = replace(cfg, zero_update=v in _TRUE)
         elif k in ("batch_shard", "pipeline_batch_shard"):
             cfg = replace(cfg, pipeline_batch_shard=v in _TRUE)
+        elif k == "overlap":
+            cfg = replace(cfg, overlap=v in _TRUE)
         elif k == "enable":
             cfg = replace(cfg, enable=v in _TRUE)
         else:
@@ -484,6 +493,7 @@ class DpPlan:
     tail_layouts: Tuple[BucketLayout, ...]
     bytes_f32: int                    # one direction, f32 payload
     bytes_wire: int                   # same payload at the wire dtype
+    overlap_tail: bool = False        # tail buckets issue in-backward
 
     @property
     def n_buckets(self) -> int:
@@ -539,6 +549,8 @@ def plan_dp_exchange(cfg: GradCommConfig, mesh, param_shapes,
         axes=axes, group=group, nshards=S,
         zero_layouts=tuple(zero_layouts), tail_layouts=tuple(tail_layouts),
         bytes_f32=n_elems * 4, bytes_wire=n_elems * cfg.wire_itemsize,
+        overlap_tail=bool(cfg.overlap and tail_layouts
+                          and not (cfg.quantized and cfg.error_feedback)),
     )
 
 
@@ -631,6 +643,43 @@ def build_explicit_dp_step(cfg: GradCommConfig, plan: DpPlan, mesh, *,
     ef = use_residuals
     clip = getattr(opt, "_grad_clip", None)
     all_layouts = tuple(plan.zero_layouts) + tuple(plan.tail_layouts)
+    # Backward-overlapped exchange (docs/PIPELINE.md §4): tail buckets wrap
+    # their params in a custom_vjp identity whose backward packs the
+    # bucket's cotangents, quantizes, and issues the group psum RIGHT THERE
+    # — the returned "cotangent" IS the exchanged gradient, so XLA must
+    # schedule the collective before any earlier layer's backward that
+    # consumes nothing from it, i.e. it runs concurrently with the
+    # remaining backward instead of after all of it. ZeRO buckets can't
+    # ride this (psum_scatter yields shard-shaped grads, but a cotangent
+    # must match the full param) and error feedback can't either (the
+    # residual update is state escaping a vjp) — both keep the
+    # post-backward issue.
+    overlap_tail = plan.overlap_tail and not ef
+
+    def _overlapped(shapes):
+        @jax.custom_vjp
+        def ident(*leaves):
+            return leaves
+
+        def fwd(*leaves):
+            return leaves, None
+
+        def bwd(_, cts):
+            flat = jnp.concatenate(
+                [c.astype(jnp.float32).reshape(-1) for c in cts])
+            if cfg.quantized:
+                flat = quantize_roundtrip(flat, cfg.wire_dtype)
+            flat = lax.psum(flat, axes) / group
+            out, off = [], 0
+            for shp in shapes:
+                n = int(np.prod(shp)) if shp else 1
+                out.append(lax.dynamic_slice_in_dim(
+                    flat, off, n, 0).reshape(shp))
+                off += n
+            return tuple(out)
+
+        ident.defvjp(fwd, bwd)
+        return ident
 
     def body(p_vals, b_vals, states, residuals, batch_vals, lr, rng_key):
         # decorrelate per-rank randomness (dropout) across the group
@@ -638,7 +687,18 @@ def build_explicit_dp_step(cfg: GradCommConfig, plan: DpPlan, mesh, *,
         for a in axes:
             ridx = ridx * mesh.shape[a] + lax.axis_index(a)
         rng_local = jax.random.fold_in(rng_key, ridx)
-        (loss, new_b), grads = jax.value_and_grad(loss_of, has_aux=True)(
+        loss_fn = loss_of
+        if overlap_tail:
+            def loss_fn(p_list, aux):
+                p_list = list(p_list)
+                for lay in plan.tail_layouts:
+                    ident = _overlapped(
+                        [tuple(p_list[i].shape) for i in lay.indices])
+                    for i, w in zip(lay.indices,
+                                    ident(*[p_list[i] for i in lay.indices])):
+                        p_list[i] = w
+                return loss_of(p_list, aux)
+        (loss, new_b), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             list(p_vals), (list(b_vals), list(batch_vals), rng_local))
         loss = lax.psum(loss.astype(jnp.float32), axes) / group
         # sync only buffers the model actually mutated (running stats):
@@ -654,6 +714,13 @@ def build_explicit_dp_step(cfg: GradCommConfig, plan: DpPlan, mesh, *,
         shard_pairs, tail_pairs, new_res = [], [], {}
         for b, lay in enumerate(all_layouts):
             is_zero = b < len(plan.zero_layouts)
+            if not is_zero and overlap_tail:
+                # exchanged in-backward by the custom_vjp identity above:
+                # grads[i] already carries the reduced (and, if quantized,
+                # wire-round-tripped) group average
+                tail_pairs.extend(
+                    (i, grads[i].astype(jnp.float32)) for i in lay.indices)
+                continue
             if is_zero:
                 flat = pack_shard_major(grads, lay)
             else:

@@ -86,6 +86,18 @@ METRICS = {
     "grad_comm_overlap_ratio": (
         "gauge", "Share of exchanged bytes outside the last-issued bucket "
                  "— the part that can overlap remaining backward compute"),
+    # -- pipeline schedules (fleet/meta_parallel/pipeline_parallel.py) ------
+    "pp_bubble_fraction": (
+        "gauge", "Idle-cell fraction of the compiled pipeline schedule "
+                 "table (fwd + bwd tick grids; smaller = better overlap)"),
+    "pp_schedule_ticks": (
+        "gauge", "Total (stage, tick) grid length of the compiled pipeline "
+                 "schedule (fwd + bwd; zero_bubble adds its deferred "
+                 "weight-grad scan)"),
+    "pp_overlap_hidden_bytes": (
+        "gauge", "Wire bytes of bucketed pipeline-region gradient "
+                 "collectives issued before the last bucket — comm the "
+                 "backward can hide (0 = monolithic or unbucketed)"),
     # -- serving decode engine (inference/engine.py) ------------------------
     "serving_requests_total": (
         "counter", "Requests submitted to the decode engine"),

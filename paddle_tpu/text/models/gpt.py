@@ -210,6 +210,10 @@ class GPTModel(nn.Layer):
                 blocks, num_stages=pp, recompute_block=config.use_recompute,
                 recompute_granularity=getattr(
                     config, "recompute_granularity", "full"),
+                # per-model overrides; None defers to DistributedStrategy
+                # pipeline_configs / PADDLE_TPU_PP_SCHEDULE
+                num_virtual_stages=getattr(config, "virtual_pp_degree", None),
+                schedule=getattr(config, "pp_schedule", None),
             )
         else:
             from ...distributed.fleet.meta_parallel.pipeline_parallel import (

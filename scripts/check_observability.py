@@ -57,6 +57,8 @@ OWNED_PREFIXES = {
     "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
     "serving_": os.path.join("paddle_tpu", "inference", "engine.py"),
     "reshard_": os.path.join("paddle_tpu", "distributed", "reshard.py"),
+    "pp_": os.path.join("paddle_tpu", "distributed", "fleet",
+                        "meta_parallel", "pipeline_parallel.py"),
 }
 
 

@@ -41,6 +41,12 @@ CONFIGS = {
     "sharding8_z1": ({"dp_degree": 1}, {"sharding_degree": 8}, {}),
     "dp2_pp2_mp2": ({"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}, {},
                     {}),
+    # same mesh, interleaved 1F1B with 2 virtual stages (2 chunks/stage of
+    # the 4-layer probe) — the per-config JSON records both schedules'
+    # bubble fractions side by side (docs/PIPELINE.md)
+    "dp2_pp2_mp2_1f1b_v2": (
+        {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}, {},
+        {"PADDLE_TPU_PP_SCHEDULE": "1f1b,virtual=2"}),
     "2slice_dp2_mp4": ({"dp_degree": 2, "mp_degree": 4}, {},
                        {"PADDLE_TPU_NUM_SLICES": "2"}),
 }
@@ -58,6 +64,12 @@ def run_config(name):
     import jax
 
     degrees, extra, _env = CONFIGS[name]
+    # enable gauge recording (pp_* schedule telemetry is env-gated)
+    if "PADDLE_TPU_TELEMETRY_DIR" not in os.environ:
+        import tempfile
+
+        os.environ["PADDLE_TPU_TELEMETRY_DIR"] = tempfile.mkdtemp(
+            prefix="pt_scaling_telemetry_")
     s = fleet.DistributedStrategy()
     s.hybrid_configs.update(degrees)
     for k, v in extra.items():
@@ -100,12 +112,41 @@ def run_config(name):
     slice_of = {d.id: s_ for d, s_ in zip(mesh.devices.flat, slices)}
     crossing = comm_analysis.slice_crossing_traffic(hlo, mesh, slice_of)
 
+    # pipeline-schedule attribution: compiled schedule, analytic + measured
+    # (table idle-cell) bubble fractions, and the bucketed grad-exchange
+    # bytes the backward can hide (docs/PIPELINE.md). Gauges are recorded
+    # at trace time, so _compiled_for above already populated them.
+    pipeline = None
+    try:
+        import paddle_tpu.observability as _obs
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            SpmdPipeline)
+
+        pipe = next((sub for _p, sub in model.named_sublayers(include_self=True)
+                     if isinstance(sub, SpmdPipeline)), None)
+        if pipe is not None and degrees.get("pp_degree", 1) > 1:
+            info = pipe.schedule_info(int(ids.shape[0]))
+            pipeline = {
+                "schedule": info["schedule"],
+                "virtual_pp_degree": pipe.num_virtual_stages,
+                "microbatches": info["M"],
+                "analytic_bubble_fraction": round(
+                    float(info["analytic_bubble_fraction"]), 4),
+                "measured_bubble_fraction": round(
+                    float(info["measured_bubble_fraction"]), 4),
+                "overlap_hidden_bytes": int(
+                    _obs.gauge("pp_overlap_hidden_bytes").value() or 0),
+            }
+    except Exception:
+        pass
+
     print(json.dumps({
         "config": name, "compile_s": round(compile_s, 1),
         "n_collectives": len(colls),
         "per_axis_wire_bytes_per_device": per_axis,
         "per_axis_payload_bytes": per_axis_payload,
         "flops_per_device_per_step": flops,
+        "pipeline": pipeline,
         "cross_slice": [
             {**c, "axes": list(c["axes"])} for c in crossing],
     }), flush=True)

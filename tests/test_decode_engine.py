@@ -74,6 +74,7 @@ def test_engine_greedy_bit_equal_generate(model):
     np.testing.assert_array_equal(ref, out)
 
 
+@pytest.mark.slow
 def test_engine_greedy_bit_equal_generate_padded(model):
     ids = _prompts(2, 9, seed=3)
     ref = generation.generate_padded(model, ids, max_length=24,
@@ -92,6 +93,7 @@ def test_generate_bucketing_matches_fixed_shape(model):
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_join_mid_flight_and_slot_reuse(model):
     # 3 requests on 2 slots: the third joins only after a slot frees,
     # and its tokens must equal a solo run (slot reuse leaks no KV).
@@ -140,6 +142,7 @@ def test_eos_evicts_and_frees_slot(model):
     assert eng.stats()["running"] == 0 and len(eng._free) == 2
 
 
+@pytest.mark.slow
 def test_int8_kv_close_to_f32(model):
     ids = _prompts(2, 8, seed=9)
     f32 = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))

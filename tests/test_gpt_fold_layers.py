@@ -34,6 +34,7 @@ def test_fold_layers_forward_parity():
     np.testing.assert_allclose(lo_fold, lo_un, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_llama_fold_layers_forward_parity():
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
@@ -51,6 +52,7 @@ def test_llama_fold_layers_forward_parity():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bert_fold_layers_parity_with_mask():
     """Encoder fold: the attention mask rides the scan as a per-call extra
     arg, every layer sees it unchanged."""

@@ -238,6 +238,7 @@ def test_shared_pages_counted_and_released(model):
     _pool_invariant(eng.pool)
 
 
+@pytest.mark.slow
 def test_admission_waits_for_pages_then_recovers(model):
     """A pool too small for all slots at once must queue, not deadlock or
     double-book: every request still completes."""
@@ -259,6 +260,7 @@ def test_admission_waits_for_pages_then_recovers(model):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_compile_count_o1_with_speculation(model):
     """Compiled programs: one per used prefill tail bucket + ONE decode +
     ONE verify — invariant in request count and request lengths."""
@@ -283,6 +285,7 @@ def test_compile_count_o1_with_speculation(model):
     assert eng.stats()["compile_count"] == before
 
 
+@pytest.mark.slow
 def test_quick_churn_no_leaked_pages(model):
     """Tier-1-sized churn: random lengths and budgets through a small
     pool; the free list must account for every page afterwards."""

@@ -36,6 +36,7 @@ def _sparse_sites(st):
 
 
 # compile-heavy: full-suite only (fast tier keeps the sibling smokes)
+@pytest.mark.slow
 def test_subm_conv3d_values_and_structure():
     rng = np.random.default_rng(0)
     dense, sites = _random_sparse_input(rng)
@@ -104,6 +105,7 @@ def test_subm_conv3d_rejects_stride():
 
 
 @pytest.mark.fast
+@pytest.mark.slow
 def test_sparse_max_pool3d():
     rng = np.random.default_rng(4)
     dense, sites = _random_sparse_input(rng, shape=(1, 4, 4, 4, 2), nnz=6)
