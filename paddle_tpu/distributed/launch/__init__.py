@@ -52,6 +52,12 @@ def build_parser():
                    help="seconds between liveness beats; >0 arms the hung-rank "
                         "watchdog in every worker (PADDLE_HEARTBEAT_MISS beats "
                         "of silence fail the job loudly). 0 disables.")
+    p.add_argument("--serving_master", type=str, default=None,
+                   help="host:port of a serving coordination store; exported "
+                        "as PADDLE_SERVING_MASTER so a supervised "
+                        "serving.worker registers there (a relaunch after "
+                        "--max_restarts joins as a FRESH engine index — the "
+                        "router fails over the dead one's work meanwhile)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -187,6 +193,9 @@ def launch(argv=None):
         # workers read these in init_parallel_env (runtime.watchdog)
         os.environ["PADDLE_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
         os.environ.setdefault("PADDLE_HEARTBEAT_MISS", "5")
+    if args.serving_master:
+        # serving.worker's --master defaults to this env var
+        os.environ["PADDLE_SERVING_MASTER"] = args.serving_master
     cmd = [sys.executable, args.training_script] + list(args.training_script_args)
     env = os.environ.copy()
     # the worker is a fresh interpreter: propagate the launcher's import

@@ -133,6 +133,15 @@ class EngineConfig:
     donate: Optional[bool] = None
     #: base seed for requests that don't carry their own
     seed: int = 0
+    #: jax.sharding.Mesh to run the compiled programs on. An ``mp`` axis
+    #: with degree > 1 shards the KV pools (and int8 scales) over kv
+    #: heads — GQA groups stay whole per shard, so mp must divide
+    #: num_kv_heads — while page tables, sampling, and everything outside
+    #: attention stay replicated (greedy output is bit-equal to the
+    #: single-device engine; docs/SERVING.md §mp sharding). Give each
+    #: engine its OWN mesh slice: a dp axis here replicates the pools,
+    #: engine replicas belong behind serving.Router instead.
+    mesh: Optional[object] = None
 
     def resolved_buckets(self) -> List[int]:
         if self.prompt_buckets:
@@ -385,6 +394,54 @@ def _layer_kv(cache, scales, layer, int8):
     return lay
 
 
+def _pin_pool_shardings(kc, vc, ksc, vsc):
+    """Trailing constraints pinning the RETURNED pools to the kv-head-
+    sharded layout the engine committed them with, so the compiled
+    program's output shardings match its input shardings and the
+    cache-carry loop never flaps between layouts (a flap would recompile,
+    breaking the buckets_used + 2 program-count gate). No-op without an
+    active mp mesh."""
+    from ..distributed import mesh as _mesh
+
+    m = _mesh.get_global_mesh()
+    if m is None or m.empty or _mesh.mesh_axis_size("mp", m) <= 1:
+        return kc, vc, ksc, vsc
+    kv = _mesh.P(None, None, "mp")  # [L, N, Hkv, ...]: shard kv heads
+    kc = _mesh.sharding_constraint(kc, kv, m)
+    vc = _mesh.sharding_constraint(vc, kv, m)
+    if ksc is not None:
+        ksc = _mesh.sharding_constraint(ksc, kv, m)
+        vsc = _mesh.sharding_constraint(vsc, kv, m)
+    return kc, vc, ksc, vsc
+
+
+def _shard_kv_heads(kv):
+    """Constraint hint sharding a fresh K/V projection [..., Hkv, D] over
+    the mp axis on its head dim (axis -2), so the page-pool scatter that
+    follows stays shard-local instead of gathering the pool. No-op
+    without an active mp mesh or when mp doesn't divide Hkv."""
+    from ..distributed import mesh as _mesh
+
+    m = _mesh.get_global_mesh()
+    if m is None or m.empty or _mesh.mesh_axis_size("mp", m) <= 1:
+        return kv
+    spec = [None] * kv.ndim
+    spec[-2] = "mp"
+    return _mesh.sharding_constraint(kv, _mesh.P(*spec), m)
+
+
+def _replicate_out(x):
+    """Constraint hint forcing a program output replicated (sampled
+    tokens, logits) so the one-int32-per-slot host transfer reads the
+    same bits on every shard. No-op without an active mesh."""
+    from ..distributed import mesh as _mesh
+
+    m = _mesh.get_global_mesh()
+    if m is None or m.empty or _mesh.mesh_axis_size("mp", m) <= 1:
+        return x
+    return _mesh.sharding_constraint(x, _mesh.P(), m)
+
+
 def _sample_tokens(logits, keys, temperature, top_k, top_p, greedy):
     """On-device sampling for N rows: logits [N, V] f32, keys [N, ks],
     temperature/top_p f32 [N], top_k i32 [N], greedy bool [N]. Per-row
@@ -449,6 +506,18 @@ class DecodeEngine:
                  "int8": jnp.int8}[cfg.kv_dtype]
         self._mp = cfg.max_pages
         self._num_pages = cfg.resolved_num_pages()
+        self._mesh = cfg.mesh
+        self._mp_degree = 1
+        if self._mesh is not None:
+            from ..distributed.mesh import mesh_axis_size
+
+            self._mp_degree = mesh_axis_size("mp", self._mesh)
+            if (self._mp_degree > 1
+                    and ad.num_kv_heads % self._mp_degree != 0):
+                raise ValueError(
+                    f"mp={self._mp_degree} must divide num_kv_heads="
+                    f"{ad.num_kv_heads}: the KV pool shards by whole kv "
+                    "heads (GQA groups stay intact per shard)")
         shape = (ad.num_layers, self._num_pages, ad.num_kv_heads,
                  cfg.page_size, ad.head_dim)
         self._kc = jnp.zeros(shape, store)
@@ -458,6 +527,21 @@ class DecodeEngine:
             self._vsc = jnp.ones(shape[:-1], jnp.float32)
         else:
             self._ksc = self._vsc = None
+        if self._mesh is not None:
+            # commit the pools kv-head-sharded and the model state
+            # replicated ONCE — per-call device_put of the weights would
+            # re-replicate them every step
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            kv_sh = NamedSharding(self._mesh, _P(None, None, "mp"))
+            rep = NamedSharding(self._mesh, _P())
+            self._kc = jax.device_put(self._kc, kv_sh)
+            self._vc = jax.device_put(self._vc, kv_sh)
+            if self._int8:
+                self._ksc = jax.device_put(self._ksc, kv_sh)
+                self._vsc = jax.device_put(self._vsc, kv_sh)
+            self._replicated_sharding = rep
         self.pool = PagePool(self._num_pages)
         cap = (cfg.prefix_registry_blocks
                if cfg.prefix_registry_blocks is not None
@@ -478,6 +562,10 @@ class DecodeEngine:
             if id(b) not in seen:
                 seen.add(id(b))
                 self._state.append(b)
+        if self._mesh is not None:
+            for t in self._state:
+                t._value = jax.device_put(t._value,
+                                          self._replicated_sharding)
         donate = cfg.donate
         if donate is None:
             donate = jax.default_backend() in ("tpu", "gpu")
@@ -499,6 +587,9 @@ class DecodeEngine:
         self.prefix_hit_tokens = 0
         self.peak_pages_in_use = 0
         self.peak_running = 0
+        self.admission_waits = 0
+        self.admission_wait_s = 0.0
+        self._backoff_s = 0.0
         self._base_key = jax.random.PRNGKey(cfg.seed)
         self._zero_key = np.asarray(self._base_key)
         self._waiting: deque = deque()
@@ -557,7 +648,10 @@ class DecodeEngine:
         engine is fully idle."""
         self._admit()
         if not self._running:
+            if self._waiting:
+                self._admission_backoff()
             return bool(self._waiting)
+        self._backoff_s = 0.0
         k = self.config.speculate_k
         if k > 0 and self._spec_worthwhile(k):
             drafts, any_real = self._collect_drafts(k)
@@ -566,6 +660,18 @@ class DecodeEngine:
                 return True
         self._step_decode()
         return True
+
+    def _admission_backoff(self):
+        """Every waiting request is blocked on free KV pages (or slots
+        pinned by an external holder) and no slot is decoding: sleep a
+        bounded exponentially-growing backoff instead of hot-spinning —
+        run() would otherwise busy-loop _admit at 100% CPU until another
+        actor releases pages. Reset the moment any slot runs again."""
+        self._backoff_s = min(max(self._backoff_s * 2, 1e-3), 0.05)
+        self.admission_waits += 1
+        self.admission_wait_s += self._backoff_s
+        _obs.observe("serving_admission_wait_seconds", self._backoff_s)
+        time.sleep(self._backoff_s)
 
     def _spec_worthwhile(self, k: int) -> bool:
         """Adaptive gate: speculate when the measured step-time and
@@ -811,6 +917,28 @@ class DecodeEngine:
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
+            "admission_waits": self.admission_waits,
+            "admission_wait_s": self.admission_wait_s,
+        }
+
+    def occupancy(self) -> dict:
+        """Scheduler-load snapshot for the serving router: the numbers
+        serving/worker.py publishes to the coordination store each poll
+        (least-outstanding-tokens dispatch reads outstanding_tokens;
+        slots_free/pages_free gate admission-side throttling)."""
+        outstanding = sum(r.params.max_new_tokens - len(r.tokens)
+                          for r in self._running.values())
+        outstanding += sum(len(r.prompt) + r.params.max_new_tokens
+                           for r in self._waiting)
+        return {
+            "outstanding_tokens": int(outstanding),
+            "running": len(self._running),
+            "waiting": len(self._waiting),
+            "slots_free": len(self._free),
+            "pages_free": self.pool.available(),
+            "prefix_hit_tokens": int(self.prefix_hit_tokens),
+            "decode_steps": int(self.decode_steps),
+            "total_tokens": int(self.total_tokens),
         }
 
     # -- internals ----------------------------------------------------------
@@ -948,10 +1076,23 @@ class DecodeEngine:
         _obs.set_gauge("serving_kv_pages_shared",
                        float(self.pool.shared_pages()))
 
+    def _mesh_ctx(self):
+        """Activate the engine's mesh for a compiled-program call, so the
+        sharding-constraint hints inside F.paged_attention and the pure
+        bodies see it at trace time (thread-local; restored after)."""
+        if self._mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from ..distributed.mesh import global_mesh
+
+        return global_mesh(self._mesh)
+
     def _run_counted(self, name, fn, *args):
         first = name not in self._compiled
         t0 = time.perf_counter() if first else 0.0
-        out = fn(*args)
+        with self._mesh_ctx():
+            out = fn(*args)
         if first:
             jax.block_until_ready(out[-2])
             dt = time.perf_counter() - t0
@@ -989,11 +1130,11 @@ class DecodeEngine:
                         h = ad.pre_attn(l, x)
                         q, k, v = ad.qkv(l, h, positions)
                         kc, ksc = _block_page_write(
-                            kc, ksc, l, raw(k), row, cached_len, true_len,
-                            int8, psz)
+                            kc, ksc, l, _shard_kv_heads(raw(k)), row,
+                            cached_len, true_len, int8, psz)
                         vc, vsc = _block_page_write(
-                            vc, vsc, l, raw(v), row, cached_len, true_len,
-                            int8, psz)
+                            vc, vsc, l, _shard_kv_heads(raw(v)), row,
+                            cached_len, true_len, int8, psz)
                         o = F.paged_attention(
                             q, _layer_kv(kc, ksc, l, int8),
                             _layer_kv(vc, vsc, l, int8), table, start)
@@ -1016,7 +1157,9 @@ class DecodeEngine:
             step_key = jax.random.fold_in(key, true_len)
             nxt = _sample_tokens(logits, step_key[None], temp[None],
                                  top_k[None], top_p[None], greedy[None])
-            return kc, vc, ksc, vsc, nxt[0], logits[0]
+            kc, vc, ksc, vsc = _pin_pool_shardings(kc, vc, ksc, vsc)
+            return (kc, vc, ksc, vsc, _replicate_out(nxt[0]),
+                    _replicate_out(logits[0]))
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)
@@ -1039,9 +1182,11 @@ class DecodeEngine:
                         h = ad.pre_attn(l, x)
                         q, k, v = ad.qkv(l, h, pos2)
                         kc, ksc = _token_page_write(
-                            kc, ksc, l, raw(k), tables, pos2, int8, psz)
+                            kc, ksc, l, _shard_kv_heads(raw(k)), tables,
+                            pos2, int8, psz)
                         vc, vsc = _token_page_write(
-                            vc, vsc, l, raw(v), tables, pos2, int8, psz)
+                            vc, vsc, l, _shard_kv_heads(raw(v)), tables,
+                            pos2, int8, psz)
                         o = F.paged_attention(
                             q, _layer_kv(kc, ksc, l, int8),
                             _layer_kv(vc, vsc, l, int8), tables, positions)
@@ -1055,7 +1200,9 @@ class DecodeEngine:
             step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
             nxt = _sample_tokens(logits, step_keys, temp, top_k, top_p,
                                  greedy)
-            return kc, vc, ksc, vsc, nxt, logits
+            kc, vc, ksc, vsc = _pin_pool_shardings(kc, vc, ksc, vsc)
+            return (kc, vc, ksc, vsc, _replicate_out(nxt),
+                    _replicate_out(logits))
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)
@@ -1083,9 +1230,11 @@ class DecodeEngine:
                         h = ad.pre_attn(l, x)
                         q, k, v = ad.qkv(l, h, pos2)
                         kc, ksc = _token_page_write(
-                            kc, ksc, l, raw(k), tables, pos2, int8, psz)
+                            kc, ksc, l, _shard_kv_heads(raw(k)), tables,
+                            pos2, int8, psz)
                         vc, vsc = _token_page_write(
-                            vc, vsc, l, raw(v), tables, pos2, int8, psz)
+                            vc, vsc, l, _shard_kv_heads(raw(v)), tables,
+                            pos2, int8, psz)
                         o = F.paged_attention(
                             q, _layer_kv(kc, ksc, l, int8),
                             _layer_kv(vc, vsc, l, int8), tables, positions)
@@ -1103,7 +1252,9 @@ class DecodeEngine:
             targets = _sample_tokens(
                 flat, step_keys.reshape(s * k1, -1), rep(temp), rep(top_k),
                 rep(top_p), rep(greedy)).reshape(s, k1)
-            return kc, vc, ksc, vsc, targets, logits
+            kc, vc, ksc, vsc = _pin_pool_shardings(kc, vc, ksc, vsc)
+            return (kc, vc, ksc, vsc, _replicate_out(targets),
+                    _replicate_out(logits))
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)

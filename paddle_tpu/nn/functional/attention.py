@@ -168,6 +168,43 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
+def _mp_degree_for(hkv: int):
+    """(mesh, mp) when a global mesh with an mp axis that divides the kv
+    heads is active, else (None, 1). Decode attention shards over kv
+    heads: each mp shard owns whole GQA groups, so the per-shard math is
+    exactly the single-device math restricted to its head block."""
+    from ...distributed import mesh as _mesh
+
+    m = _mesh.get_global_mesh()
+    if m is None or m.empty:
+        return None, 1
+    mp = _mesh.mesh_axis_size("mp", m)
+    if mp <= 1 or hkv % mp != 0:
+        return None, 1
+    return m, mp
+
+
+def _shard_heads(x, axis: int, mesh):
+    """Constraint hint: shard `x` over the mp axis along `axis` (kv/query
+    heads). GSPMD propagates the layout through the einsums, so the
+    O(H·T·K) logits/probs never materialize replicated."""
+    from ...distributed import mesh as _mesh
+
+    spec = [None] * x.ndim
+    spec[axis] = "mp"
+    return _mesh.sharding_constraint(x, _mesh.P(*spec), mesh)
+
+
+def _replicate(x, mesh):
+    """Constraint hint: force `x` replicated. Placed on the attention
+    OUTPUT so GSPMD emits an exact all-gather (pure concatenation over the
+    head axis — bitwise-identical to single-device) instead of a psum of
+    partial projections, whose float reduction order would drift."""
+    from ...distributed import mesh as _mesh
+
+    return _mesh.sharding_constraint(x, _mesh.P(), mesh)
+
+
 @defop(amp="white", name="decode_attention_op")
 def _decode_attention_op(q, ck, cv, cache_position, scale):
     """Single-token decode attention against a static slot-indexed cache.
@@ -183,14 +220,20 @@ def _decode_attention_op(q, ck, cv, cache_position, scale):
     hkv, t = ck.shape[1], ck.shape[2]
     group = h // hkv
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    mesh, mp = _mp_degree_for(hkv)
     qf = q[:, 0].astype(jnp.float32).reshape(s_, hkv, group, d)
+    if mesh is not None:
+        qf = _shard_heads(qf, 1, mesh)
+        ck = _shard_heads(ck, 1, mesh)
+        cv = _shard_heads(cv, 1, mesh)
     logits = jnp.einsum("shgd,shtd->shgt", qf, ck.astype(jnp.float32)) * sc
     mask = jnp.arange(t)[None, None, None, :] \
         <= cache_position[:, None, None, None]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("shgt,shtd->shgd", probs, cv.astype(jnp.float32))
-    return out.reshape(s_, 1, h, d).astype(q.dtype)
+    out = out.reshape(s_, 1, h, d).astype(q.dtype)
+    return out if mesh is None else _replicate(out, mesh)
 
 
 def decode_attention(query, cache_k, cache_v, cache_position, scale=None,
@@ -224,22 +267,29 @@ def _paged_attention_op(q, pk, pv, page_table, start_position, scale):
     mp = page_table.shape[1]
     group = h // hkv
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    mesh, mp_deg = _mp_degree_for(hkv)
 
     def gather(pool):
+        if mesh is not None:
+            pool = _shard_heads(pool, 1, mesh)  # [N, Hkv, P, D]
         g = pool[page_table]                   # [S, MP, Hkv, P, D]
         g = jnp.swapaxes(g, 1, 2)              # [S, Hkv, MP, P, D]
-        return g.reshape(s_, hkv, mp * p, d)
+        g = g.reshape(s_, hkv, mp * p, d)
+        return g if mesh is None else _shard_heads(g, 1, mesh)
 
     k = gather(pk).astype(jnp.float32)
     v = gather(pv).astype(jnp.float32)
     qf = q.astype(jnp.float32).reshape(s_, t, hkv, group, d)
+    if mesh is not None:
+        qf = _shard_heads(qf, 2, mesh)
     logits = jnp.einsum("sthgd,shkd->shgtk", qf, k) * sc
     qpos = start_position[:, None] + jnp.arange(t)[None, :]       # [S, T]
     mask = jnp.arange(mp * p)[None, None, :] <= qpos[:, :, None]  # [S, T, K]
     logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("shgtk,shkd->sthgd", probs, v)
-    return out.reshape(s_, t, h, d).astype(q.dtype)
+    out = out.reshape(s_, t, h, d).astype(q.dtype)
+    return out if mesh is None else _replicate(out, mesh)
 
 
 def paged_attention(query, pool_k, pool_v, page_table, start_position,

@@ -135,6 +135,34 @@ METRICS = {
     "serving_spec_accept_ratio": (
         "gauge", "Accepted / proposed draft tokens of speculative decode "
                  "since engine start (0..1)"),
+    "serving_admission_wait_seconds": (
+        "histogram", "Bounded-backoff sleep taken when waiting requests "
+                     "cannot be admitted (no free slot/pages) — replaces "
+                     "the old hot-spin; each observation is one backoff"),
+    # -- serving router (serving/router.py) ---------------------------------
+    "serving_router_requests_total": (
+        "counter", "Requests submitted to the multi-engine router"),
+    "serving_router_shed_total": (
+        "counter", "Requests shed by SLO admission control (queue_full or "
+                   "deadline) — never a silent drop"),
+    "serving_router_dispatch_total": (
+        "counter", "Requests dispatched to an engine worker (resubmits "
+                   "after failover count again)"),
+    "serving_router_failover_total": (
+        "counter", "In-flight requests resubmitted because their engine's "
+                   "occupancy beat went stale past the grace window"),
+    "serving_router_affinity_hits_total": (
+        "counter", "Dispatches routed by prefix affinity (a chain-hashed "
+                   "prompt block previously served by that engine)"),
+    "serving_router_queue_depth": (
+        "gauge", "Admitted requests queued at the router across all SLO "
+                 "classes (dispatched requests excluded)"),
+    "serving_router_engines": (
+        "gauge", "Live engines known to the router (beat fresh within the "
+                 "grace window)"),
+    "serving_router_request_seconds": (
+        "histogram", "Router-side request latency: submit() through result "
+                     "harvest (includes queueing, dispatch, decode)"),
     # -- resharding (distributed/reshard.py) --------------------------------
     "reshard_total": (
         "counter", "Completed reshard operations (labels: what = "
@@ -176,6 +204,10 @@ EVENTS = {
     "reshard",            # one reshard completed (what, leaves, peak bytes)
     "reshard_stall",      # a reshard collective exceeded its deadline
     "elastic_resize",     # live fleet resize (old/new size, outcome)
+    "serving_router_shed",         # admission control rejected a request
+    "serving_router_failover",     # a request was resubmitted off a dead engine
+    "serving_router_engine_up",    # router discovered a registered engine
+    "serving_router_engine_dead",  # an engine's beat stalled past grace
 }
 
 

@@ -1,0 +1,25 @@
+"""Multi-engine serving: tensor-parallel decode engines behind an
+SLO-aware request router.
+
+Two layers (docs/SERVING.md):
+
+- Each worker wraps one ``inference.DecodeEngine`` — optionally
+  mp-sharded over a device mesh (``EngineConfig.mesh``) so the paged KV
+  pools split across kv heads under GSPMD — and coordinates through the
+  training stack's TCPStore (``serving.protocol`` key schema).
+- The ``Router`` admits requests against a bounded queue with SLO
+  classes (shed-lowest-first under overload), places them by
+  least-outstanding-tokens with prefix affinity, and fails over dead
+  engines by resubmitting their unfinished work — bit-equal, because
+  every request carries a router-assigned sampling seed.
+"""
+from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
+                       deadline_guard)
+from .router import Router, RouterConfig, RouterRequest
+from .worker import EngineWorker
+
+__all__ = [
+    "Router", "RouterConfig", "RouterRequest", "EngineWorker",
+    "SLO_CLASSES", "DEFAULT_DEADLINES", "DEFAULT_NAMESPACE",
+    "deadline_guard",
+]

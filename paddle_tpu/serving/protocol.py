@@ -1,0 +1,120 @@
+"""Router <-> engine-worker wire protocol over the coordination store.
+
+The multi-engine serving plane reuses the SAME TCPStore the training
+stack rendezvouses on (runtime/py_store.py): engine workers register
+under a namespace, publish occupancy beats, and receive requests as
+store keys — no new transport, no new failure modes beyond the ones the
+store hardening (deadlines, idempotent-op retry) already covers.
+
+Key schema (all under one namespace, default ``__srv``)::
+
+    {ns}/count            engine counter: ``add(key, 1) - 1`` is a fresh
+                          engine index (race-free discovery — ``add`` is
+                          the store's atomic fetch-and-add)
+    {ns}/engine/{i}       registration record of engine index i
+    {ns}/occ/{name}       occupancy beat of engine `name` (monotone
+                          ``beat`` field; a stalled beat past the grace
+                          window means the worker is dead)
+    {ns}/req/{name}/{seq} request seq dispatched to engine `name`
+                          (workers consume their stream in seq order and
+                          ack via ``acked_seq`` in the occupancy beat)
+    {ns}/done/{rid}       completed token stream of router request `rid`
+                          (written BEFORE the occupancy ack, so failover
+                          can harvest finished work from a dead engine)
+    {ns}/ctl              router shutdown broadcast
+
+Values are pickled python dicts (``pack``/``unpack``): the store is a
+trusted same-job coordination plane, exactly like the launch rendezvous
+that already rides it.
+
+Every store op in router.py/worker.py must sit under ``deadline_guard``
+— ``scripts/check_robustness.py`` rule 4 enforces it statically, the
+same discipline rule 3 applies to reshard collectives.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import sys
+import threading
+from typing import Optional
+
+DEFAULT_NAMESPACE = "__srv"
+
+#: SLO classes in ascending priority; overload sheds the lowest first.
+SLO_CLASSES = ("batch", "standard", "interactive")
+
+#: per-class default deadline budget (seconds from submit); a request
+#: still queued past its deadline is shed, not dispatched late.
+DEFAULT_DEADLINES = {"interactive": 30.0, "standard": 120.0, "batch": 600.0}
+
+
+def k_count(ns: str) -> str:
+    return f"{ns}/count"
+
+
+def k_engine(ns: str, index: int) -> str:
+    return f"{ns}/engine/{index}"
+
+
+def k_occ(ns: str, name: str) -> str:
+    return f"{ns}/occ/{name}"
+
+
+def k_req(ns: str, name: str, seq: int) -> str:
+    return f"{ns}/req/{name}/{seq}"
+
+
+def k_done(ns: str, rid: int) -> str:
+    return f"{ns}/done/{rid}"
+
+
+def k_ctl(ns: str) -> str:
+    return f"{ns}/ctl"
+
+
+def pack(obj) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack(data):
+    return pickle.loads(bytes(data))
+
+
+def _deadline_seconds() -> float:
+    try:
+        return float(os.environ.get("PADDLE_TPU_SERVING_TIMEOUT", "120"))
+    except ValueError:
+        return 120.0
+
+
+@contextlib.contextmanager
+def deadline_guard(what: str, seconds: Optional[float] = None):
+    """Bound a router/worker store op the way reshard.deadline_guard
+    bounds collectives: a watchdog timer fires if the op stalls past the
+    deadline (store peer dead, network wedge), prints a diagnosis naming
+    the op, and raises TimeoutError once the block exits — a serving
+    control-plane stall becomes a diagnosed failure instead of a silent
+    router hang. ``check_robustness.py`` rule 4 statically requires every
+    store call site in paddle_tpu/serving to sit inside this guard."""
+    limit = _deadline_seconds() if seconds is None else float(seconds)
+    fired = threading.Event()
+
+    def _stall():
+        fired.set()
+        print(f"[serving] store op {what!r} exceeded its {limit:.0f}s "
+              "deadline — coordination store unreachable or peer wedged; "
+              "raise PADDLE_TPU_SERVING_TIMEOUT for slow fabrics",
+              file=sys.stderr, flush=True)
+
+    timer = threading.Timer(limit, _stall)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
+    if fired.is_set():
+        raise TimeoutError(
+            f"serving store op {what!r} exceeded its {limit:.0f}s deadline")

@@ -1,0 +1,457 @@
+"""SLO-aware request router over a fleet of store-registered engines.
+
+The router is the serving control plane: clients submit prompts tagged
+with an SLO class, the router admits or sheds them against a bounded
+queue, and each ``pump()`` round dispatches queued work to the live
+engine fleet discovered through the coordination store. Placement is
+least-outstanding-tokens — the engine-reported occupancy plus the load
+this router dispatched but the engine has not yet acked — softened by
+prefix affinity: a request whose chain-hashed prompt blocks were last
+served by a particular engine routes back there (reusing that engine's
+paged prefix cache) unless the load skew exceeds the affinity slack.
+
+Overload policy: when the queue is full an incoming request preempts the
+youngest request of a strictly lower SLO class, otherwise it is itself
+shed. Shedding is always explicit — a counter, an event, and a
+``RuntimeError`` from ``result`` naming the reason (queue_full or
+deadline) — never a silent drop.
+
+Failover: a worker whose occupancy beat stalls past the grace window is
+declared dead. Its finished work is harvested from ``done`` keys (workers
+write those before acking), and everything else is resubmitted to the
+FRONT of its class queue. Reruns are bit-equal because the router stamps
+every request with an explicit sampling seed at admission, so placement
+is invisible in the token streams (no loss, no duplicates, no drift).
+
+This module is the single writer of the ``serving_router_*`` telemetry
+family (scripts/check_observability.py enforces that), and every store
+call sits under ``protocol.deadline_guard`` (check_robustness.py rule 4).
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as _obs
+from ..inference.engine import PrefixRegistry, SamplingParams
+from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
+                       deadline_guard, k_ctl, k_done, k_engine, k_occ,
+                       k_req, k_count, pack, unpack)
+
+__all__ = ["Router", "RouterConfig", "RouterRequest"]
+
+#: bound on the prefix-affinity LRU (block-key -> engine name entries)
+_AFFINITY_CAP = 65536
+
+
+@dataclass
+class RouterConfig:
+    namespace: str = DEFAULT_NAMESPACE
+    #: total queued (not yet dispatched) requests across all SLO classes
+    queue_limit: int = 64
+    #: seconds from submit before a still-queued request is shed, per class
+    deadlines: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_DEADLINES))
+    #: occupancy beat staleness past which an engine is declared dead
+    engine_grace_s: float = 5.0
+    #: outstanding-token skew an affinity route may cost before the
+    #: router abandons cache reuse for load balance
+    affinity_slack_tokens: int = 512
+    #: dispatched-but-unfinished requests allowed per engine
+    #: (0 = twice the engine's slot count)
+    max_inflight_per_engine: int = 0
+    #: prompt block size for affinity chain hashes — match the engines'
+    #: page_size or affinity keys never line up with their prefix caches
+    page_size: int = 16
+    #: base of the per-request sampling seeds the router assigns so
+    #: reruns after failover are bit-equal on any engine
+    seed: int = 0
+
+
+@dataclass
+class RouterRequest:
+    rid: int
+    prompt: np.ndarray
+    params: SamplingParams
+    slo: str
+    submit_t: float
+    deadline_t: float
+    block_keys: List[bytes]
+    status: str = "queued"  # queued | dispatched | done | failed | shed
+    engine: Optional[str] = None
+    seq: int = -1
+    tokens: Optional[np.ndarray] = None
+    error: Optional[str] = None
+    shed_reason: Optional[str] = None
+    finish_t: Optional[float] = None
+    resubmits: int = 0
+
+
+@dataclass
+class _EngineState:
+    name: str
+    index: int
+    record: dict
+    occ: dict = field(default_factory=dict)
+    beat: int = -1
+    acked_seq: int = 0
+    next_seq: int = 0
+    #: engine-reported completions already scanned for (-1 = never scanned)
+    harvested_done: int = -1
+    last_change: float = 0.0
+    alive: bool = True
+    #: rid -> RouterRequest, dispatch order (oldest first)
+    inflight: "OrderedDict[int, RouterRequest]" = field(
+        default_factory=OrderedDict)
+
+
+class Router:
+    """Admit, place, and track requests across the registered engines."""
+
+    def __init__(self, store, config: Optional[RouterConfig] = None,
+                 **overrides):
+        if config is None:
+            config = RouterConfig(**overrides)
+        elif overrides:
+            raise ValueError("pass config= or field overrides, not both")
+        for cls in config.deadlines:
+            if cls not in SLO_CLASSES:
+                raise ValueError(f"unknown SLO class {cls!r}")
+        self.config = config
+        self._store = store
+        self._ns = config.namespace
+        self._engines: Dict[str, _EngineState] = {}
+        self._by_index: Dict[int, _EngineState] = {}
+        self._queues: Dict[str, deque] = {c: deque() for c in SLO_CLASSES}
+        self._requests: Dict[int, RouterRequest] = {}
+        self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
+        self._next_rid = 0
+        self._known_engines = 0
+        self.counters = {"submitted": 0, "done": 0, "failed": 0, "shed": 0,
+                         "dispatched": 0, "failover_resubmits": 0,
+                         "affinity_hits": 0, "engines_lost": 0}
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               slo: str = "standard", **sampling) -> int:
+        """Admit a request (or shed it under overload). Returns its rid;
+        a shed request keeps the rid so ``status``/``result`` can report
+        the rejection."""
+        if slo not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {slo!r}; expected one of {SLO_CLASSES}")
+        if params is None:
+            params = SamplingParams(**sampling)
+        elif sampling:
+            raise ValueError("pass params= or sampling kwargs, not both")
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        if params.seed is None:
+            # explicit seed => bit-equal streams on ANY engine, which is
+            # what makes failover reruns invisible in the results
+            params = SamplingParams(**{**asdict(params),
+                                       "seed": self.config.seed * 1_000_003
+                                       + self._next_rid})
+        now = time.perf_counter()
+        req = RouterRequest(
+            rid=self._next_rid, prompt=prompt, params=params, slo=slo,
+            submit_t=now,
+            deadline_t=now + self.config.deadlines.get(
+                slo, DEFAULT_DEADLINES[slo]),
+            block_keys=PrefixRegistry.block_keys(
+                prompt, self.config.page_size))
+        self._next_rid += 1
+        self._requests[req.rid] = req
+        self.counters["submitted"] += 1
+        _obs.inc("serving_router_requests_total")
+        self._admit(req)
+        _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
+        return req.rid
+
+    def _queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _admit(self, req: RouterRequest):
+        if self._queue_depth() < self.config.queue_limit:
+            self._queues[req.slo].append(req)
+            return
+        # full: preempt the youngest request of a strictly lower class,
+        # else the incoming request itself is the lowest and is shed
+        for cls in SLO_CLASSES:
+            if cls == req.slo:
+                break
+            if self._queues[cls]:
+                victim = self._queues[cls].pop()
+                self._shed(victim, "queue_full")
+                self._queues[req.slo].append(req)
+                return
+        self._shed(req, "queue_full")
+
+    def _shed(self, req: RouterRequest, reason: str):
+        req.status = "shed"
+        req.shed_reason = reason
+        req.finish_t = time.perf_counter()
+        self.counters["shed"] += 1
+        _obs.inc("serving_router_shed_total")
+        _obs.event("serving_router_shed", rid=req.rid, slo=req.slo,
+                   reason=reason)
+
+    # -- fleet discovery & liveness -----------------------------------------
+
+    def _discover(self):
+        with deadline_guard("discover engines"):
+            count = int(self._store.add(k_count(self._ns), 0))
+        while self._known_engines < count:
+            idx = self._known_engines
+            key = k_engine(self._ns, idx)
+            with deadline_guard("discover engines"):
+                if not self._store.check(key):
+                    return  # registration record not written yet; retry
+                record = unpack(self._store.get(key))
+            est = _EngineState(name=record["name"], index=idx, record=record,
+                               last_change=time.monotonic())
+            self._engines[est.name] = est
+            self._by_index[idx] = est
+            self._known_engines = idx + 1
+            _obs.event("serving_router_engine_up", name=est.name, index=idx)
+            _obs.set_gauge("serving_router_engines", self._alive_count())
+
+    def _alive_count(self) -> int:
+        return sum(1 for e in self._engines.values() if e.alive)
+
+    def _read_occupancy(self):
+        now = time.monotonic()
+        for est in self._engines.values():
+            if not est.alive:
+                continue
+            key = k_occ(self._ns, est.name)
+            with deadline_guard("read occupancy"):
+                if not self._store.check(key):
+                    continue
+                occ = unpack(self._store.get(key))
+            if int(occ.get("beat", -1)) != est.beat:
+                est.beat = int(occ.get("beat", -1))
+                est.occ = occ
+                est.acked_seq = int(occ.get("acked_seq", 0))
+                est.last_change = now
+
+    def _failover_dead(self):
+        now = time.monotonic()
+        for est in self._engines.values():
+            if not est.alive:
+                continue
+            if now - est.last_change <= self.config.engine_grace_s:
+                continue
+            est.alive = False
+            self.counters["engines_lost"] += 1
+            _obs.event("serving_router_engine_dead", name=est.name,
+                       inflight=len(est.inflight))
+            _obs.set_gauge("serving_router_engines", self._alive_count())
+            # harvest everything the dead engine already finished (done
+            # keys are written before the ack), then resubmit the rest to
+            # the FRONT of their class queues so failover does not add
+            # queueing delay on top of the rerun
+            resubmit = []
+            for rid, req in est.inflight.items():
+                with deadline_guard("harvest results"):
+                    finished = self._store.check(k_done(self._ns, rid))
+                if finished:
+                    self._finish_from_store(req)
+                else:
+                    resubmit.append(req)
+            est.inflight.clear()
+            for req in reversed(resubmit):
+                req.status = "queued"
+                req.engine = None
+                req.seq = -1
+                req.resubmits += 1
+                self._queues[req.slo].appendleft(req)
+                self.counters["failover_resubmits"] += 1
+                _obs.inc("serving_router_failover_total")
+                _obs.event("serving_router_failover", rid=req.rid,
+                           engine=est.name, slo=req.slo)
+
+    # -- results -------------------------------------------------------------
+
+    def _finish_from_store(self, req: RouterRequest):
+        with deadline_guard("harvest results"):
+            rec = unpack(self._store.get(k_done(self._ns, req.rid)))
+        req.finish_t = time.perf_counter()
+        if "error" in rec:
+            req.status = "failed"
+            req.error = rec["error"]
+            self.counters["failed"] += 1
+        else:
+            req.status = "done"
+            req.tokens = np.asarray(rec["tokens"], dtype=np.int64)
+            self.counters["done"] += 1
+            _obs.observe("serving_router_request_seconds",
+                         req.finish_t - req.submit_t)
+
+    def _harvest_done(self):
+        for est in self._engines.values():
+            if not est.inflight:
+                continue
+            # only scan done keys when the engine's beat advertises new
+            # completions: per-rid checks are store round trips, and with
+            # deep inflight queues a blind every-pump scan contends the
+            # store against the engines' own traffic
+            reported = int(est.occ.get("done_count", -1))
+            if reported >= 0 and reported == est.harvested_done:
+                continue
+            est.harvested_done = reported
+            for rid, req in list(est.inflight.items()):
+                with deadline_guard("harvest results"):
+                    finished = self._store.check(k_done(self._ns, rid))
+                if not finished:
+                    continue
+                self._finish_from_store(req)
+                del est.inflight[rid]
+
+    # -- placement -----------------------------------------------------------
+
+    def _engine_cap(self, est: _EngineState) -> int:
+        if self.config.max_inflight_per_engine > 0:
+            return self.config.max_inflight_per_engine
+        return 2 * int(est.record.get("num_slots", 1))
+
+    def _load_tokens(self, est: _EngineState) -> int:
+        """Outstanding tokens the engine reported, plus dispatched work it
+        has not acked yet (seq >= acked_seq) so burst dispatches between
+        beats don't all pile onto the same engine."""
+        load = int(est.occ.get("outstanding_tokens", 0))
+        for req in est.inflight.values():
+            if req.seq >= est.acked_seq:
+                load += len(req.prompt) + req.params.max_new_tokens
+        return load
+
+    def _pick_engine(self, req: RouterRequest):
+        """(engine, via_affinity) or (None, False) when no capacity."""
+        candidates = [e for e in self._engines.values()
+                      if e.alive and len(e.inflight) < self._engine_cap(e)]
+        if not candidates:
+            return None, False
+        loads = {e.name: self._load_tokens(e) for e in candidates}
+        best = min(candidates, key=lambda e: (loads[e.name], e.index))
+        # deepest prompt block we have seen routed somewhere live wins,
+        # unless honoring it would skew load past the slack
+        for key in reversed(req.block_keys):
+            name = self._affinity.get(key)
+            if name is None:
+                continue
+            est = self._engines.get(name)
+            if est is None or est not in candidates:
+                break
+            if loads[name] - loads[best.name] \
+                    <= self.config.affinity_slack_tokens:
+                return est, True
+            break
+        return best, False
+
+    def _dispatch_one(self, req: RouterRequest, est: _EngineState):
+        req.seq = est.next_seq
+        est.next_seq += 1
+        rec = {"rid": req.rid, "prompt": req.prompt.tolist(),
+               "params": asdict(req.params)}
+        with deadline_guard("dispatch request"):
+            self._store.set(k_req(self._ns, est.name, req.seq), pack(rec))
+        req.status = "dispatched"
+        req.engine = est.name
+        est.inflight[req.rid] = req
+        self.counters["dispatched"] += 1
+        _obs.inc("serving_router_dispatch_total")
+        for key in req.block_keys:
+            self._affinity[key] = est.name
+            self._affinity.move_to_end(key)
+        while len(self._affinity) > _AFFINITY_CAP:
+            self._affinity.popitem(last=False)
+
+    def _dispatch(self):
+        now = time.perf_counter()
+        for cls in reversed(SLO_CLASSES):  # interactive drains first
+            queue = self._queues[cls]
+            while queue:
+                req = queue[0]
+                if now > req.deadline_t:
+                    queue.popleft()
+                    self._shed(req, "deadline")
+                    continue
+                est, via_affinity = self._pick_engine(req)
+                if est is None:
+                    return  # fleet saturated; lower classes wait too
+                queue.popleft()
+                if via_affinity:
+                    self.counters["affinity_hits"] += 1
+                    _obs.inc("serving_router_affinity_hits_total")
+                self._dispatch_one(req, est)
+        _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
+
+    # -- driving -------------------------------------------------------------
+
+    def pump(self):
+        """One scheduling round: discover new engines, refresh occupancy,
+        fail over dead workers, harvest finished results, dispatch."""
+        self._discover()
+        self._read_occupancy()
+        self._failover_dead()
+        self._harvest_done()
+        self._dispatch()
+        _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
+
+    def pending(self) -> int:
+        """Requests admitted but not yet finished (queued + in flight)."""
+        return sum(1 for r in self._requests.values()
+                   if r.status in ("queued", "dispatched"))
+
+    def drain(self, timeout: Optional[float] = None, poll: float = 0.005):
+        """Pump until every admitted request resolves (done/failed/shed).
+        Returns True on full drain, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.pending():
+            self.pump()
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(poll)
+        return True
+
+    def shutdown(self):
+        """Broadcast stop to every worker polling this namespace."""
+        with deadline_guard("broadcast stop"):
+            self._store.set(k_ctl(self._ns), pack({"stop": True}))
+
+    # -- inspection ----------------------------------------------------------
+
+    def status(self, rid: int) -> str:
+        return self._requests[rid].status
+
+    def result(self, rid: int) -> np.ndarray:
+        req = self._requests[rid]
+        if req.status == "done":
+            return req.tokens
+        if req.status == "shed":
+            raise RuntimeError(
+                f"request {rid} was shed ({req.shed_reason}); "
+                f"slo={req.slo}")
+        if req.status == "failed":
+            raise RuntimeError(f"request {rid} failed on {req.engine}: "
+                               f"{req.error}")
+        raise RuntimeError(f"request {rid} not finished (status "
+                           f"{req.status!r}); pump() the router")
+
+    def latencies(self) -> Dict[str, List[float]]:
+        """submit->finish seconds of completed requests, per SLO class."""
+        out: Dict[str, List[float]] = {c: [] for c in SLO_CLASSES}
+        for req in self._requests.values():
+            if req.status == "done" and req.finish_t is not None:
+                out[req.slo].append(req.finish_t - req.submit_t)
+        return out
+
+    def stats(self) -> dict:
+        return {**self.counters,
+                "queue_depth": self._queue_depth(),
+                "engines_alive": self._alive_count(),
+                "engines_known": self._known_engines}

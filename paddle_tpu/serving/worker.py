@@ -1,0 +1,291 @@
+"""Engine worker: one DecodeEngine behind the coordination store.
+
+Registers in the store under the serving namespace (a race-free index
+from the atomic ``add`` counter), then loops: drain dispatched requests
+into the engine, advance the scheduler one step (with a chaos
+``engine_fence`` so soaks can SIGKILL it mid-decode), publish finished
+token streams, and publish an occupancy beat. The router
+(serving/router.py) never talks to the worker directly — everything
+rides store keys, so a worker death is detected by beat staleness and
+its unfinished work is resubmitted elsewhere.
+
+Crash-safety ordering: a request's ``done`` key is written BEFORE the
+occupancy beat that acks it, so failover can harvest everything a dead
+engine finished; anything not harvested is re-run bit-equal (the router
+assigns every request an explicit sampling seed — the engine's implicit
+``fold_in(base_key, local_rid)`` default would differ across engines).
+
+Run standalone (the bench and chaos soaks spawn this)::
+
+    python -m paddle_tpu.serving.worker --master 127.0.0.1:29510 \
+        --model-seed 7 --hidden 64 --layers 2 --heads 4 --vocab 128
+
+The launch CLI can supervise it (``--serving_master`` exports
+PADDLE_SERVING_MASTER and relaunch-on-death re-registers the worker as a
+fresh engine; the router fails the dead one over in the meantime).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..inference.engine import DecodeEngine, EngineConfig, SamplingParams
+from ..testing import chaos
+from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl, k_done,
+                       k_engine, k_occ, k_req, k_count, pack, unpack)
+
+__all__ = ["EngineWorker", "main"]
+
+
+class EngineWorker:
+    """Wrap a DecodeEngine as a store-coordinated serving worker."""
+
+    def __init__(self, model, store, config: Optional[EngineConfig] = None,
+                 *, name: Optional[str] = None,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 step_floor_s: float = 0.0, **overrides):
+        self.engine = DecodeEngine(model, config, **overrides)
+        self._store = store
+        self._ns = namespace
+        self._step_floor_s = float(step_floor_s)
+        with deadline_guard("register engine"):
+            self.index = int(self._store.add(k_count(namespace), 1)) - 1
+        self.name = name or f"engine{self.index}"
+        cfg = self.engine.config
+        record = {
+            "name": self.name,
+            "index": self.index,
+            "num_slots": cfg.num_slots,
+            "max_length": cfg.max_length,
+            "page_size": cfg.page_size,
+            "buckets": list(self.engine.buckets),
+            "pid": os.getpid(),
+        }
+        with deadline_guard("register engine"):
+            self._store.set(k_engine(namespace, self.index), pack(record))
+        self._next_seq = 0  # next request seq to consume from the store
+        self._beat = 0
+        self._local_rid: Dict[int, int] = {}  # engine rid -> router rid
+        self._last_occ_pub = 0.0
+        self._last_drain = -float("inf")
+        self._done_count = 0  # lifetime results published (rides the beat)
+        self.publish_occupancy()
+
+    # -- store I/O ----------------------------------------------------------
+
+    def _drain_requests(self):
+        """Consume this engine's request stream in seq order; each record
+        becomes one engine.submit with the router-assigned seed."""
+        while True:
+            key = k_req(self._ns, self.name, self._next_seq)
+            with deadline_guard("recv request"):
+                if not self._store.check(key):
+                    return
+                rec = unpack(self._store.get(key))
+            self._next_seq += 1
+            rid = rec["rid"]
+            try:
+                local = self.engine.submit(
+                    np.asarray(rec["prompt"], np.int64),
+                    SamplingParams(**rec["params"]))
+            except ValueError as e:
+                # invalid geometry for THIS engine (bucket/page limits):
+                # report instead of dying — the router surfaces the error
+                with deadline_guard("publish result"):
+                    self._store.set(k_done(self._ns, rid), pack(
+                        {"rid": rid, "engine": self.name, "error": str(e)}))
+                self._done_count += 1
+                continue
+            self._local_rid[local] = rid
+
+    def _publish_done(self) -> int:
+        """Write finished token streams; returns how many. Runs BEFORE
+        publish_occupancy in poll_once so a completed request is always
+        harvestable once its seq is acked — the failover no-loss/no-dup
+        invariant."""
+        published = 0
+        for local, rid in list(self._local_rid.items()):
+            if self.engine._requests[local].status != "done":
+                continue
+            tokens = self.engine.result(local)
+            with deadline_guard("publish result"):
+                self._store.set(k_done(self._ns, rid), pack({
+                    "rid": rid, "engine": self.name,
+                    "tokens": np.asarray(tokens).tolist()}))
+            del self._local_rid[local]
+            self._done_count += 1
+            published += 1
+        return published
+
+    def publish_occupancy(self):
+        """Occupancy beat: engine load snapshot + monotone ``beat`` (the
+        router's liveness signal) + ``acked_seq`` (requests consumed, so
+        the router can estimate load it dispatched but the engine hasn't
+        reported yet)."""
+        self._beat += 1
+        self._last_occ_pub = time.monotonic()
+        occ = self.engine.occupancy()
+        occ["beat"] = self._beat
+        occ["acked_seq"] = self._next_seq
+        occ["done_count"] = self._done_count
+        occ["name"] = self.name
+        with deadline_guard("publish occupancy"):
+            self._store.set(k_occ(self._ns, self.name), pack(occ))
+
+    def stop_requested(self) -> bool:
+        ctl = k_ctl(self._ns)
+        with deadline_guard("poll ctl"):
+            if not self._store.check(ctl):
+                return False
+            rec = unpack(self._store.get(ctl))
+        return bool(rec.get("stop"))
+
+    # -- scheduler ----------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """One deterministic worker round: drain new requests, advance the
+        engine one step (chaos fence first — PADDLE_CHAOS_ENGINE_* can
+        SIGKILL here, mid-decode), publish results + occupancy. The
+        occupancy beat is throttled to ~100 Hz: the router samples it far
+        slower, and unthrottled publishes just contend the store (the
+        routers' liveness grace is seconds, results ride done keys, and
+        a fresh publish always follows a finished request). The request
+        drain check is likewise throttled to ~50 Hz while the engine is
+        busy — its internal queue keeps the slots fed between checks; an
+        idle engine checks every poll so first dispatch lands fast.
+        Returns True while the engine still holds work."""
+        now = time.monotonic()
+        if not self._local_rid or now - self._last_drain >= 0.02:
+            self._last_drain = now
+            self._drain_requests()
+        chaos.engine_fence(self.engine.decode_steps)
+        t_step = time.monotonic()
+        busy = self.engine.step()
+        if busy and self._step_floor_s > 0.0:
+            # device-step floor: pace the scheduler as if each step were
+            # accelerator-bound (host idle while the device runs). Lets
+            # CPU-only hosts measure control-plane scaling, and doubles
+            # as a crude per-engine rate limiter.
+            rem = self._step_floor_s - (time.monotonic() - t_step)
+            if rem > 0.0:
+                time.sleep(rem)
+        published = self._publish_done()
+        if published or time.monotonic() - self._last_occ_pub >= 0.025:
+            self.publish_occupancy()
+        return busy or bool(self._local_rid)
+
+    def serve(self, poll_interval: float = 0.005,
+              ctl_interval: float = 0.25):
+        """Poll until the router broadcasts stop. Idle rounds sleep
+        ``poll_interval`` (the engine's own admission backoff bounds the
+        pages-starved case); the stop broadcast is only polled every
+        ``ctl_interval`` seconds — it is the cold path."""
+        last_ctl = -float("inf")
+        while True:
+            now = time.monotonic()
+            if now - last_ctl >= ctl_interval:
+                last_ctl = now
+                if self.stop_requested():
+                    return
+            if not self.poll_once():
+                time.sleep(poll_interval)
+
+
+def build_worker_model(args):
+    """Deterministic tiny-GPT build shared by every worker process AND the
+    in-process reference engines of the tests/bench: same seed => same
+    weights => bit-equal token streams across processes."""
+    import paddle_tpu as paddle
+    from ..text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(args.model_seed)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_hidden_layers=args.layers, num_attention_heads=args.heads,
+        max_position_embeddings=args.max_positions,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    model.eval()
+    return model
+
+
+def build_arg_parser():
+    import argparse
+
+    p = argparse.ArgumentParser("paddle_tpu.serving.worker")
+    p.add_argument("--master", default=os.environ.get(
+        "PADDLE_SERVING_MASTER", "127.0.0.1:29500"),
+        help="host:port of the coordination store (PADDLE_SERVING_MASTER)")
+    p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
+    p.add_argument("--name", default=None)
+    p.add_argument("--poll-interval", type=float, default=0.005)
+    p.add_argument("--step-floor-ms", type=float, default=0.0,
+                   help="minimum wall time per scheduler step; emulates "
+                        "accelerator-bound steps on CPU-only hosts and "
+                        "doubles as a crude rate limiter")
+    p.add_argument("--warmup", action="store_true",
+                   help="pre-compile every prefill bucket and the decode "
+                        "program before serving, so placement luck cannot "
+                        "land an XLA compile on the request path")
+    # model spec (must match the router/bench reference build)
+    p.add_argument("--model-seed", type=int, default=7)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--max-positions", type=int, default=512)
+    # engine geometry
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-length", type=int, default=256)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--speculate-k", type=int, default=0)
+    p.add_argument("--no-prefix-cache", action="store_true")
+    p.add_argument("--kv-dtype", default="f32")
+    p.add_argument("--mp", type=int, default=1,
+                   help="shard decode over this many devices' mp axis "
+                        "(dp1 x mp mesh over the first mp local devices)")
+    return p
+
+
+def main(argv=None):
+    args = build_arg_parser().parse_args(argv)
+    from ..runtime import TCPStore
+
+    model = build_worker_model(args)
+    mesh = None
+    if args.mp > 1:
+        import jax
+
+        from ..distributed.mesh import build_mesh
+
+        mesh = build_mesh((1, args.mp), ("dp", "mp"),
+                          devices=jax.devices()[:args.mp])
+    host, port = args.master.rsplit(":", 1)
+    store = TCPStore(host=host, port=int(port), is_master=False, timeout=60.0)
+    worker = EngineWorker(
+        model, store, name=args.name, namespace=args.namespace,
+        step_floor_s=args.step_floor_ms / 1000.0,
+        num_slots=args.slots, max_length=args.max_length,
+        page_size=args.page_size, speculate_k=args.speculate_k,
+        prefix_cache=not args.no_prefix_cache, kv_dtype=args.kv_dtype,
+        mesh=mesh)
+    if args.warmup:
+        for b in worker.engine.buckets:
+            n = max(1, min(int(b), args.max_length - 4))
+            worker.engine.submit(np.full(n, 1, np.int64),
+                                 SamplingParams(max_new_tokens=2))
+        worker.engine.run()
+        print(f"[serving] worker {worker.name} warm "
+              f"({len(worker.engine.buckets)} buckets)",
+              file=sys.stderr, flush=True)
+    print(f"[serving] worker {worker.name} (engine {worker.index}) "
+          f"serving via {args.master}", file=sys.stderr, flush=True)
+    worker.serve(poll_interval=args.poll_interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

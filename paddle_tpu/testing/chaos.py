@@ -39,6 +39,11 @@ Env knobs (all read lazily so tests can flip them per-case):
                                     (fences count planned collective steps
                                     across a reshard; default 0 = first)
   PADDLE_CHAOS_RESHARD_LATENCY_MS=<ms>  sleep injected by the latency mode
+  PADDLE_CHAOS_ENGINE_MODE=kill|latency
+  PADDLE_CHAOS_ENGINE_AT=<k>        which serving decode step the engine
+                                    fault fires before (serving/worker.py
+                                    fences every scheduler step; default 0)
+  PADDLE_CHAOS_ENGINE_LATENCY_MS=<ms>  sleep injected by the latency mode
 
 The tear/corrupt helpers at the bottom are also callable directly from
 tests (no env needed) to manufacture damaged checkpoints.
@@ -164,6 +169,41 @@ def reshard_fence(index: int, what: str) -> None:
     elif mode == "latency":
         ms = float(_env("PADDLE_CHAOS_RESHARD_LATENCY_MS", "0"))
         _fault("reshard_latency", index=index, what=what, ms=ms)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
+# Serving-engine faults (called by serving/worker.py before each step)
+# ---------------------------------------------------------------------------
+def engine_fence(step: int) -> None:
+    """Fault point before a serving worker's scheduler step. ``step``
+    counts decode/verify steps executed by this worker's engine, so
+    PADDLE_CHAOS_ENGINE_AT can target "mid-decode" precisely: requests
+    admitted, KV pages held, tokens half-emitted — the window the router's
+    failover must drain without losing or duplicating a request.
+
+    kill    — SIGKILL at the matching step; the router must detect the
+              stale occupancy beat and resubmit the engine's in-flight
+              requests to a live engine (bit-equal reruns: request seeds
+              are explicit).
+    latency — sleep PADDLE_CHAOS_ENGINE_LATENCY_MS at the matching step,
+              exercising the router's staleness grace without a death.
+    """
+    if not armed():
+        return
+    mode = _env("PADDLE_CHAOS_ENGINE_MODE")
+    if mode is None:
+        return
+    at = int(_env("PADDLE_CHAOS_ENGINE_AT", "0"))
+    if step != at:
+        return
+    if mode == "kill":
+        _fault("engine_kill", step=step)
+        _sigkill(f"kill injected at serving decode step {step}")
+    elif mode == "latency":
+        ms = float(_env("PADDLE_CHAOS_ENGINE_LATENCY_MS", "0"))
+        _fault("engine_latency", step=step, ms=ms)
         if ms > 0:
             time.sleep(ms / 1000.0)
 

@@ -36,6 +36,7 @@ SCAN_DIRS = [
     os.path.join("paddle_tpu", "testing"),
     os.path.join("paddle_tpu", "observability"),
     os.path.join("paddle_tpu", "inference"),
+    os.path.join("paddle_tpu", "serving"),
 ]
 
 #: module aliases the facade is imported under at instrumented call sites
@@ -56,10 +57,23 @@ RECORDERS = {
 OWNED_PREFIXES = {
     "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
     "serving_": os.path.join("paddle_tpu", "inference", "engine.py"),
+    "serving_router_": os.path.join("paddle_tpu", "serving", "router.py"),
     "reshard_": os.path.join("paddle_tpu", "distributed", "reshard.py"),
     "pp_": os.path.join("paddle_tpu", "distributed", "fleet",
                         "meta_parallel", "pipeline_parallel.py"),
 }
+
+
+def _owner_for(name: str):
+    """Longest matching owned prefix wins, so a nested family
+    (serving_router_* inside serving_*) can have its own sole writer
+    without the parent family's owner claiming it."""
+    best = None
+    for prefix, owner in OWNED_PREFIXES.items():
+        if name.startswith(prefix) and (
+                best is None or len(prefix) > len(best[0])):
+            best = (prefix, owner)
+    return best
 
 
 def _load_catalog(root):
@@ -135,11 +149,13 @@ def check_file(path: str, catalog, rel: str = None):
                        f"metric {name!r} is declared as a {declared[0]} but "
                        f"recorded via .{func.attr} (needs a {kind})")
         # rule 3: owned metric families are single-writer
-        for prefix, owner in OWNED_PREFIXES.items():
-            if name.startswith(prefix) and rel is not None and rel != owner:
-                yield (node.lineno,
-                       f"metric {name!r} may only be recorded from {owner} "
-                       f"(the {prefix}* family is single-writer)")
+        # (longest matching prefix decides the owner)
+        owned = _owner_for(name)
+        if owned is not None and rel is not None and rel != owned[1]:
+            prefix, owner = owned
+            yield (node.lineno,
+                   f"metric {name!r} may only be recorded from {owner} "
+                   f"(the {prefix}* family is single-writer)")
 
 
 def main(argv=None):

@@ -16,6 +16,14 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
      inside a ``with deadline_guard(...)`` block: a collective with a dead
      peer never returns, and the guard is what turns that into a diagnosed
      ``reshard_stall`` instead of a silent fleet-wide hang.
+  4. unguarded serving store ops — in ``paddle_tpu/serving`` (router.py,
+     worker.py) every coordination-store call (``<store>.set/get/add/
+     wait/check/delete_key`` on a receiver whose name mentions "store")
+     must sit lexically inside a ``with deadline_guard(...)`` block: the
+     router/worker control plane blocks on the store, and an unguarded op
+     against a dead store peer is a silent serving outage. Convention:
+     store clients in the serving plane are named ``store``/``_store``;
+     nothing else (dicts, caches) may use those names.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -41,6 +49,15 @@ GUARDED_FILES = [
 #: call names that ARE collectives/transfers in the guarded files:
 #: bare-name calls and attribute calls (obj.<name>) both match
 GUARDED_CALLS = {"_constrain", "device_put"}
+
+#: files whose coordination-store ops must run under deadline_guard
+GUARDED_STORE_FILES = [
+    os.path.join("paddle_tpu", "serving", "router.py"),
+    os.path.join("paddle_tpu", "serving", "worker.py"),
+]
+
+#: TCPStore/PyTCPStore client methods that block on the network
+STORE_OPS = {"set", "get", "add", "wait", "check", "delete_key"}
 
 
 def _py_files(root):
@@ -129,6 +146,47 @@ def check_guarded_collectives(path: str):
                    "forever with no diagnosis (rule 3, reshard path)")
 
 
+def _receiver_mentions_store(func: ast.Attribute) -> bool:
+    """True when the call receiver is (or dereferences) a name containing
+    "store": ``store.get``, ``self._store.set``, ``worker.store.add``."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return "store" in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return "store" in value.attr.lower()
+    return False
+
+
+def check_guarded_store_ops(path: str):
+    """Yield (line, message) for serving store ops not lexically inside a
+    ``with deadline_guard(...)`` (rule 4)."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    parent = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in STORE_OPS
+                and _receiver_mentions_store(func)):
+            continue
+        anc, guarded = node, False
+        while anc in parent:
+            anc = parent[anc]
+            if isinstance(anc, ast.With) and _is_deadline_guard_with(anc):
+                guarded = True
+                break
+        if not guarded:
+            yield (node.lineno,
+                   f"store op .{func.attr}(...) outside any `with "
+                   "deadline_guard(...)` — a dead store peer makes the "
+                   "serving control plane hang silently (rule 4)")
+
+
 def main(argv=None):
     root = (argv or sys.argv[1:] or [REPO])[0]
     violations = []
@@ -141,6 +199,12 @@ def main(argv=None):
         if not os.path.isfile(path):
             continue
         for line, msg in check_guarded_collectives(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for rel in GUARDED_STORE_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        for line, msg in check_guarded_store_ops(path):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

@@ -24,6 +24,7 @@ def _mk(fold):
     return GPTForCausalLM(cfg)
 
 
+@pytest.mark.slow
 def test_fold_layers_forward_parity():
     rs = np.random.RandomState(0)
     ids = paddle.to_tensor(rs.randint(0, 128, (2, 16)).astype(np.int32))

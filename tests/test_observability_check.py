@@ -133,6 +133,51 @@ def test_inference_dir_is_scanned():
     assert "serving_" in check_observability.OWNED_PREFIXES
 
 
+def test_serving_dir_is_scanned():
+    assert os.path.join("paddle_tpu", "serving") in check_observability.SCAN_DIRS
+    assert "serving_router_" in check_observability.OWNED_PREFIXES
+
+
+_ROUTER_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.inc("serving_router_shed_total")
+"""
+
+
+def test_router_metric_owned_by_longest_prefix(tmp_path):
+    # serving_router_* nests inside serving_*: the LONGEST matching
+    # prefix decides ownership, so router.py records it...
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_ROUTER_SRC))
+    rel = os.path.join("paddle_tpu", "serving", "router.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_router_metric_from_engine_rejected(tmp_path):
+    # ...and the serving_* owner (inference/engine.py) may NOT: the
+    # parent family's writer does not inherit the nested family
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_ROUTER_SRC))
+    rel = os.path.join("paddle_tpu", "inference", "engine.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "serving_router_" in v[0][1]
+
+
+def test_router_event_from_worker_rejected(tmp_path):
+    # events are ownership-checked too: worker.py records NO router
+    # telemetry (the router is the single writer of its own decisions)
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        from paddle_tpu import observability as _obs
+        def f():
+            _obs.event("serving_router_failover", rid=1)
+    """))
+    rel = os.path.join("paddle_tpu", "serving", "worker.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+
+
 def test_registered_literals_allowed(tmp_path):
     assert not _violations(tmp_path, """
         from paddle_tpu import observability as _obs

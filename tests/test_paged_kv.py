@@ -159,6 +159,7 @@ def test_prompt_lookup_draft():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_greedy_bitequal_prefix_cache_on_off(model):
     rng = np.random.default_rng(3)
     shared = _prompt(rng, 32)
@@ -175,6 +176,7 @@ def test_greedy_bitequal_prefix_cache_on_off(model):
     assert off.stats()["prefix_hit_tokens"] == 0
 
 
+@pytest.mark.slow
 def test_greedy_bitequal_speculation_on_off(model):
     rng = np.random.default_rng(4)
     # repetitive prompts give the n-gram draft something to match

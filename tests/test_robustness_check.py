@@ -102,3 +102,54 @@ def test_collective_helper_definition_allowed(tmp_path):
 def test_live_reshard_module_is_guarded():
     target = os.path.join(REPO, "paddle_tpu", "distributed", "reshard.py")
     assert not list(check_robustness.check_guarded_collectives(target))
+
+
+# -- rule 4: serving store ops run under deadline_guard ---------------------
+def _store_violations(tmp_path, src):
+    f = tmp_path / "serving_mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_guarded_store_ops(str(f)))
+
+
+def test_unguarded_store_op_rejected(tmp_path):
+    v = _store_violations(tmp_path, """
+        def f(store, key):
+            return store.get(key)
+    """)
+    assert len(v) == 1 and "deadline_guard" in v[0][1]
+
+
+def test_unguarded_attr_store_op_rejected(tmp_path):
+    # self._store.<op> counts: the receiver dereferences a store name
+    v = _store_violations(tmp_path, """
+        class W:
+            def f(self, key):
+                self._store.set(key, b"x")
+                return self._store.add(key, 1)
+    """)
+    assert len(v) == 2
+
+
+def test_guarded_store_op_allowed(tmp_path):
+    assert not _store_violations(tmp_path, """
+        from paddle_tpu.serving.protocol import deadline_guard
+
+        def f(store, key):
+            with deadline_guard("read"):
+                return store.get(key)
+    """)
+
+
+def test_non_store_receiver_ignored(tmp_path):
+    # dict/cache methods that happen to share op names are not store ops
+    assert not _store_violations(tmp_path, """
+        def f(cache, key):
+            return cache.get(key)
+    """)
+
+
+def test_live_serving_modules_are_guarded():
+    for rel in check_robustness.GUARDED_STORE_FILES:
+        target = os.path.join(REPO, rel)
+        assert os.path.isfile(target), rel
+        assert not list(check_robustness.check_guarded_store_ops(target)), rel

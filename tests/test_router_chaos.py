@@ -1,0 +1,130 @@
+"""Router failover soak: SIGKILL an engine worker mid-decode.
+
+Two real ``python -m paddle_tpu.serving.worker`` processes serve an
+in-process router; the chaos harness (PADDLE_CHAOS_ENGINE_MODE=kill) is
+armed in ONE of them and SIGKILLs it at a chosen decode step. The
+acceptance criterion: every admitted request still completes, and the
+token streams are BIT-EQUAL to a single-engine in-process reference —
+failover must lose nothing, duplicate nothing, and leave no trace in
+the results.
+
+Marked slow+chaos: boots 2 fresh interpreters that compile the engine
+programs on CPU; run with ``pytest tests/test_router_chaos.py --runslow``.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+from conftest import free_port
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB = 61
+MODEL_ARGS = ["--model-seed", "7", "--vocab", str(VOCAB), "--hidden", "32",
+              "--layers", "2", "--heads", "4", "--max-positions", "128"]
+ENGINE_ARGS = ["--slots", "2", "--max-length", "64", "--page-size", "16"]
+
+
+def _spawn_worker(master, chaos_env=None):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_CHAOS")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.update(chaos_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.worker",
+         "--master", master, "--poll-interval", "0.002",
+         *MODEL_ARGS, *ENGINE_ARGS],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _reference(requests):
+    """Single-engine ground truth with the router-assigned params."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    model.eval()
+    eng = DecodeEngine(model, EngineConfig(num_slots=4, max_length=64,
+                                           page_size=16, prefix_cache=True))
+    rids = [eng.submit(p, params) for p, params in requests]
+    eng.run()
+    return [eng.result(r) for r in rids]
+
+
+def test_engine_kill_failover_completes_all_bit_equal():
+    from paddle_tpu.runtime import TCPStore
+    from paddle_tpu.serving import Router
+
+    port = free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=30.0)
+    master = f"127.0.0.1:{port}"
+    survivor = _spawn_worker(master)
+    victim = _spawn_worker(master, chaos_env={
+        "PADDLE_CHAOS": "1",
+        "PADDLE_CHAOS_ENGINE_MODE": "kill",
+        "PADDLE_CHAOS_ENGINE_AT": "3",
+    })
+    procs = [survivor, victim]
+    # grace must comfortably exceed one CPU program compile (a worker
+    # does not beat while XLA compiles its first prefill/decode program)
+    # deadline budgets must exceed grace + failover rerun time, or the
+    # requeued work of the dead engine is shed instead of rerun
+    router = Router(store, queue_limit=32, engine_grace_s=20.0, seed=11,
+                    deadlines={"interactive": 240.0, "standard": 240.0,
+                               "batch": 600.0})
+    try:
+        # both engines registered before traffic, so the victim gets work
+        deadline = time.monotonic() + 120.0
+        while router._known_engines < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            for p in procs:
+                assert p.poll() is None or p is victim, p.stderr.read()[-2000:]
+            router.pump()
+            time.sleep(0.05)
+
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+                   for n in (12, 25, 18, 31, 9, 22)]
+        rids = []
+        for i, p in enumerate(prompts):
+            slo = ("interactive", "standard", "batch")[i % 3]
+            rids.append(router.submit(
+                p, slo=slo, max_new_tokens=10, do_sample=(i % 2 == 0),
+                temperature=0.8, top_k=8))
+
+        assert router.drain(timeout=240.0), router.stats()
+        st = router.stats()
+        assert st["done"] == len(rids) and st["shed"] == 0
+        # the kill really happened and really cost us an engine
+        assert victim.wait(timeout=30) == -9
+        assert st["engines_lost"] == 1
+        assert st["failover_resubmits"] >= 1
+
+        want = _reference([(p, router._requests[r].params)
+                           for p, r in zip(prompts, rids)])
+        for r, w in zip(rids, want):
+            np.testing.assert_array_equal(router.result(r), w)
+    finally:
+        router.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=20)
+        store.close()

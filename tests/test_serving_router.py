@@ -1,0 +1,240 @@
+"""SLO-aware multi-engine router invariants (docs/SERVING.md).
+
+Deterministic in-process tests: the router and its engine workers share
+one interpreter and are driven by hand (``router.pump()`` interleaved
+with ``worker.poll_once()``), so every scheduling decision is replayable.
+Gates the three router promises:
+
+* failover loses nothing and duplicates nothing — all admitted requests
+  complete BIT-EQUAL to a single-engine run even when an engine dies
+  with work in flight (router-assigned seeds make reruns placement-
+  invariant, done-before-ack makes finished work harvestable);
+* prefix affinity routes shared-prefix requests back to the engine
+  holding the cached pages, unless the load skew exceeds the slack;
+* overload sheds the lowest SLO class first, explicitly (status, reason,
+  raising ``result``), never silently.
+"""
+import numpy as np
+import pytest
+from conftest import free_port
+
+import paddle_tpu.inference as inference
+from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+from paddle_tpu.serving import Router, EngineWorker
+from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+VOCAB = 61
+ENG = dict(num_slots=2, max_length=64, page_size=16, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.fleet.topology import (
+        get_hybrid_communicate_group, set_hybrid_communicate_group)
+
+    prev = get_hybrid_communicate_group()
+    prev_mesh = _mesh.get_global_mesh()
+    set_hybrid_communicate_group(None)
+    _mesh.set_global_mesh(None)
+    try:
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        m.eval()
+        yield m
+        inference.disable_decode_engine(m)
+    finally:
+        set_hybrid_communicate_group(prev)
+        _mesh.set_global_mesh(prev_mesh)
+
+
+@pytest.fixture()
+def store():
+    from paddle_tpu.runtime import TCPStore
+
+    s = TCPStore(host="127.0.0.1", port=free_port(), is_master=True,
+                 timeout=20.0)
+    yield s
+    s.close()
+
+
+def _reference(model, requests):
+    """Single-engine ground truth for [(prompt, params), ...]."""
+    eng = DecodeEngine(model, EngineConfig(num_slots=4, max_length=64,
+                                           page_size=16, prefix_cache=True))
+    rids = [eng.submit(p, params) for p, params in requests]
+    eng.run()
+    return [eng.result(r) for r in rids]
+
+
+def _drive(router, workers, rounds=500):
+    for _ in range(rounds):
+        router.pump()
+        for w in workers:
+            w.poll_once()
+        if not router.pending():
+            return
+    raise AssertionError(f"undrained after {rounds} rounds: {router.stats()}")
+
+
+@pytest.mark.slow
+def test_dispatch_balances_and_results_bit_equal(model, store):
+    w0 = EngineWorker(model, store, **ENG)
+    w1 = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=16, seed=5)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (20, 33, 17, 25)]
+    rids = [router.submit(p, slo="standard", max_new_tokens=8,
+                          do_sample=(i % 2 == 0), temperature=0.7,
+                          top_k=8) for i, p in enumerate(prompts)]
+    router.pump()
+    # least-outstanding-tokens placement: with no occupancy beats between
+    # dispatches, the unacked-delta estimate must spread the burst
+    assert {router._requests[r].engine for r in rids} == {w0.name, w1.name}
+    _drive(router, [w0, w1])
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    for r, w in zip(rids, want):
+        np.testing.assert_array_equal(router.result(r), w)
+    assert router.stats()["done"] == 4
+    assert router.stats()["shed"] == 0
+
+
+@pytest.mark.slow
+def test_failover_no_loss_no_dup_bit_equal(model, store):
+    """Kill an engine with work in flight: finished results are harvested
+    (done-before-ack), unfinished work reruns elsewhere bit-equal, and
+    nothing completes twice."""
+    victim = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=16, engine_grace_s=0.05, seed=9)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (12, 21, 30)]
+    # short greedy + two longer sampled requests, all land on the victim
+    rids = [router.submit(prompts[0], slo="interactive", max_new_tokens=2),
+            router.submit(prompts[1], slo="standard", max_new_tokens=12,
+                          do_sample=True, temperature=0.8, top_k=8),
+            router.submit(prompts[2], slo="standard", max_new_tokens=12,
+                          do_sample=True, temperature=0.8, top_k=8)]
+    router.pump()
+    assert all(router._requests[r].engine == victim.name for r in rids)
+    # run the victim just long enough to FINISH the short request (its
+    # done key is written before the occupancy ack) but not the others
+    for _ in range(50):
+        victim.poll_once()
+        if victim.engine._requests[0].status == "done":
+            break
+    assert victim.engine._requests[0].status == "done"
+    # the victim dies: collapse the grace window so the very next pump
+    # sees a stale beat and takes the failover path (the normal-harvest
+    # path never ran, so the finished request is still in flight from
+    # the router's point of view — exactly the crash window)
+    router.config.engine_grace_s = 0.0
+    router.pump()
+    router.config.engine_grace_s = 5.0
+    st = router.stats()
+    assert st["engines_lost"] == 1
+    # the finished request was harvested off the dead engine (done key
+    # written before the ack), NOT rerun; the unfinished two requeued
+    assert router.status(rids[0]) == "done"
+    assert st["failover_resubmits"] == 2
+    # a survivor registers; the requeued work reruns there
+    survivor = EngineWorker(model, store, **ENG)
+    _drive(router, [survivor])
+    st = router.stats()
+    assert st["done"] == 3 and st["shed"] == 0
+    # each request completed exactly once (3 initial + 2 rerun dispatches)
+    assert st["dispatched"] == 5
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    for r, w in zip(rids, want):
+        np.testing.assert_array_equal(router.result(r), w)
+
+
+@pytest.mark.slow
+def test_prefix_affinity_routes_to_caching_engine(model, store):
+    w0 = EngineWorker(model, store, **ENG)
+    w1 = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=16, seed=1)
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, VOCAB, size=32, dtype=np.int64)  # 2 full pages
+    a = np.concatenate([prefix, rng.integers(1, VOCAB, size=5)]).astype(np.int64)
+    b = np.concatenate([prefix, rng.integers(1, VOCAB, size=9)]).astype(np.int64)
+    ra = router.submit(a, slo="standard", max_new_tokens=6)
+    router.pump()
+    first = router._requests[ra].engine
+    # `a` is still in flight: its engine carries outstanding tokens, so
+    # pure load balance would send `b` to the OTHER engine — affinity
+    # (within the slack) must route it back to the cached prefix
+    rb = router.submit(b, slo="standard", max_new_tokens=6)
+    router.pump()
+    assert router._requests[rb].engine == first
+    assert router.stats()["affinity_hits"] == 1
+    _drive(router, [w0, w1])
+    assert router.stats()["done"] == 2
+
+
+@pytest.mark.slow
+def test_prefix_affinity_yields_to_load_skew(model, store):
+    w0 = EngineWorker(model, store, **ENG)
+    w1 = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=16, affinity_slack_tokens=1, seed=1)
+    rng = np.random.default_rng(4)
+    prefix = rng.integers(1, VOCAB, size=32, dtype=np.int64)
+    a = np.concatenate([prefix, rng.integers(1, VOCAB, size=5)]).astype(np.int64)
+    b = np.concatenate([prefix, rng.integers(1, VOCAB, size=9)]).astype(np.int64)
+    ra = router.submit(a, slo="standard", max_new_tokens=6)
+    router.pump()
+    rb = router.submit(b, slo="standard", max_new_tokens=6)
+    router.pump()
+    # skew (a's outstanding tokens) exceeds the 1-token slack: load wins
+    assert router._requests[rb].engine != router._requests[ra].engine
+    assert router.stats()["affinity_hits"] == 0
+    _drive(router, [w0, w1])
+
+
+def test_overload_sheds_lowest_slo_first():
+    # admission control is store-free: no workers, no pump
+    router = Router(None, queue_limit=2)
+    b1 = router.submit([1, 2, 3], slo="batch", max_new_tokens=4)
+    b2 = router.submit([4, 5, 6], slo="batch", max_new_tokens=4)
+    # full queue + higher class incoming: the YOUNGEST batch request is
+    # preempted, the interactive one is admitted
+    i1 = router.submit([7, 8, 9], slo="interactive", max_new_tokens=4)
+    assert router.status(b2) == "shed"
+    assert router._requests[b2].shed_reason == "queue_full"
+    assert router.status(i1) == "queued"
+    # still full; standard preempts the remaining batch request
+    s1 = router.submit([1, 1, 1], slo="standard", max_new_tokens=4)
+    assert router.status(b1) == "shed" and router.status(s1) == "queued"
+    # full of >= classes: an incoming batch request itself is shed
+    b3 = router.submit([2, 2, 2], slo="batch", max_new_tokens=4)
+    assert router.status(b3) == "shed"
+    with pytest.raises(RuntimeError, match="queue_full"):
+        router.result(b3)
+    assert router.stats()["shed"] == 3
+
+
+def test_deadline_expired_requests_are_shed(model, store):
+    EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=8,
+                    deadlines={"standard": 0.0})
+    rid = router.submit([1, 2, 3, 4], slo="standard", max_new_tokens=4)
+    router.pump()
+    assert router.status(rid) == "shed"
+    assert router._requests[rid].shed_reason == "deadline"
+    with pytest.raises(RuntimeError, match="deadline"):
+        router.result(rid)
+
+
+def test_shutdown_broadcast_reaches_workers(model, store):
+    w = EngineWorker(model, store, **ENG)
+    router = Router(store)
+    assert not w.stop_requested()
+    router.shutdown()
+    assert w.stop_requested()
