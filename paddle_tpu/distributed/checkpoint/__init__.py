@@ -106,6 +106,7 @@ def _record_save(path: str, seconds: float) -> None:
     _obs.inc("checkpoint_save_bytes_total", nbytes)
     _obs.event("checkpoint_save", path=path, seconds=round(seconds, 6),
                bytes=nbytes)
+    _obs.record_span("ckpt_save", dur_s=seconds, path=path, bytes=nbytes)
 
 
 class _AtomicCommit:
@@ -327,6 +328,7 @@ def _check_saved_shapes(ckptr, path: str, target) -> None:
 def _record_restore(path: str, seconds: float) -> None:
     _obs.observe("checkpoint_restore_seconds", seconds)
     _obs.event("checkpoint_restore", path=path, seconds=round(seconds, 6))
+    _obs.record_span("ckpt_restore", dur_s=seconds, path=path)
 
 
 save = save_state_dict

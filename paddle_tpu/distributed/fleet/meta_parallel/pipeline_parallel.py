@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 import warnings
 import re
 from dataclasses import dataclass, replace
@@ -1007,6 +1008,7 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     # check_observability.py OWNED_PREFIXES): compiled-schedule shape and
     # the comm volume the bucket structure lets backward hide. Trace-time
     # statics, mirroring grad_comm.record_build_stats.
+    t_sched = time.perf_counter()
     info = pipe.schedule_info(B, schedule=sched_name)
     _obs.set_gauge("pp_schedule_ticks", float(info["schedule_ticks"]))
     _obs.set_gauge("pp_bubble_fraction",
@@ -1018,6 +1020,14 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
             sum(l.total for l in bucket_layouts)
             - bucket_layouts[0].total) * wire_it
     _obs.set_gauge("pp_overlap_hidden_bytes", float(hidden_bytes))
+    # host-side schedule-build span: the per-tick device time runs inside
+    # the single compiled SPMD program, so the attrs (tick grid, bubble
+    # fraction) are the trace-visible shape of the window
+    _obs.record_span("pp_tick_window",
+                     dur_s=time.perf_counter() - t_sched,
+                     schedule=sched_name,
+                     ticks=int(info["schedule_ticks"]),
+                     bubble_fraction=float(info["measured_bubble_fraction"]))
 
     # On the CPU backend, sub-f32 i/o crosses the shard_map boundary as
     # f32: the replicated input's cotangent is a jax-inserted psum at this

@@ -823,6 +823,10 @@ def record_build_stats(n_buckets: int, payload_bytes_f32: int,
     if payload_bytes_f32 > 0:
         _obs.set_gauge("grad_comm_quantized_fraction",
                        1.0 - payload_bytes_wire / payload_bytes_f32)
+    # instant marker span (dur 0): the build happens inside tracing, so
+    # wall time is not separable here — the attrs are what matters
+    _obs.record_span("grad_comm_exchange", dur_s=0.0, buckets=n_buckets,
+                     wire_bytes=payload_bytes_wire)
 
 
 def record_overlap_ratio(first_bucket_bytes: int, total_bytes: int) -> None:

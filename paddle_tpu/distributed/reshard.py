@@ -600,6 +600,8 @@ def record_plan_metrics(plans: Sequence[LeafPlan], *, what: str,
     _obs.event("reshard", what=what, leaves=len(plans), steps=nsteps,
                peak_bytes=peak, moved_bytes=moved,
                seconds=round(seconds, 6))
+    _obs.record_span("reshard_exec", dur_s=seconds, what=what,
+                     leaves=len(plans), steps=nsteps)
 
 
 def record_fallback(why: str, **fields) -> None:

@@ -199,6 +199,19 @@ class Request:
     page_ids: List[int] = field(default_factory=list)
     #: tokens served from the prefix registry (multiple of page_size)
     cached_len: int = 0
+    #: distributed-trace context from the router wire record (spans are
+    #: emitted only when trace_id is set — standalone engines stay quiet)
+    trace_id: Optional[str] = None
+    trace_parent: Optional[str] = None
+    resubmitted: bool = False
+    #: phase accounting (perf_counter stamps) behind the enriched
+    #: serving_request_done event; maintained regardless of tracing
+    prefill_t0: Optional[float] = None
+    prefill_s: float = 0.0
+    decode_t0: Optional[float] = None
+    decode_steps_n: int = 0
+    verify_steps_n: int = 0
+    spec_accepted_n: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -601,9 +614,12 @@ class DecodeEngine:
     # -- scheduler ----------------------------------------------------------
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               **kw) -> int:
+               *, trace: Optional[dict] = None, **kw) -> int:
         """Queue one request; returns its id. `prompt` is a 1-D int array
-        (Tensor/np/list); keyword args build a SamplingParams."""
+        (Tensor/np/list); keyword args build a SamplingParams. ``trace``
+        is the router's propagated span context (protocol.py ``trace``
+        field): when given, the engine's prefill/decode/verify spans join
+        that request tree."""
         if params is None:
             params = SamplingParams(**kw)
         ids = np.asarray(raw(prompt), dtype=np.int32).reshape(-1)
@@ -633,6 +649,10 @@ class DecodeEngine:
         req = Request(req_id=rid, prompt=ids, params=params,
                       key_np=np.asarray(key),
                       submit_time=time.perf_counter())
+        if trace:
+            req.trace_id = trace.get("trace_id")
+            req.trace_parent = trace.get("parent_id")
+            req.resubmitted = int(trace.get("resubmits", 0) or 0) > 0
         self._requests[rid] = req
         self._waiting.append(req)
         _obs.inc("serving_requests_total")
@@ -737,6 +757,9 @@ class DecodeEngine:
         self._last_logits = logits
         active = list(self._running.items())
         for slot, req in active:
+            if req.decode_t0 is None:
+                req.decode_t0 = t0  # first batched step this request joined
+            req.decode_steps_n += 1
             self.total_tokens += 1
             self._append_token(req, int(nxt_host[slot]))
         _obs.inc("serving_tokens_total", len(active))
@@ -794,6 +817,11 @@ class DecodeEngine:
                 m += 1
             self.spec_proposed += k
             self.spec_accepted += m
+            if req.decode_t0 is None:
+                req.decode_t0 = t0
+            req.decode_steps_n += 1
+            req.verify_steps_n += 1
+            req.spec_accepted_n += m
             for tok in tgt[:m + 1]:
                 if req.status != "running":
                     break  # budget/eos hit mid-emission
@@ -1020,6 +1048,7 @@ class DecodeEngine:
         ids = np.zeros((1, tb), np.int32)
         ids[0, :len(tail)] = tail
         t_, k_, p_, g_ = req.params.fields()
+        tp0 = time.perf_counter()
         out = self._run_counted(
             f"prefill_b{tb}", fn,
             self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
@@ -1030,7 +1059,14 @@ class DecodeEngine:
         token = int(nxt)
         now = time.perf_counter()
         req.first_token_time = now
+        req.prefill_t0 = tp0
+        req.prefill_s = now - tp0
         _obs.observe("serving_ttft_seconds", now - req.submit_time)
+        if req.trace_id is not None:
+            _obs.record_span(
+                "srv_prefill", trace_id=req.trace_id,
+                parent_id=req.trace_parent, dur_s=req.prefill_s,
+                rid=req.req_id, bucket=int(tb), cached_len=int(cached_len))
         req.slot = slot
         req.status = "running"
         self._running[slot] = req
@@ -1057,9 +1093,31 @@ class DecodeEngine:
         req.page_ids = []
         ttft = (None if req.first_token_time is None
                 else req.first_token_time - req.submit_time)
+        now = time.perf_counter()
+        queue_s = (None if req.prefill_t0 is None
+                   else req.prefill_t0 - req.submit_time)
+        decode_s = 0.0 if req.decode_t0 is None else now - req.decode_t0
+        if req.trace_id is not None and req.decode_t0 is not None:
+            did = _obs.record_span(
+                "srv_decode", trace_id=req.trace_id,
+                parent_id=req.trace_parent, dur_s=decode_s,
+                rid=req.req_id, steps=req.decode_steps_n,
+                tokens=len(req.tokens))
+            if req.verify_steps_n:
+                # the speculative share of the decode window, parented to
+                # the srv_decode span it partitions
+                _obs.record_span(
+                    "srv_verify", trace_id=req.trace_id, parent_id=did,
+                    dur_s=decode_s * req.verify_steps_n
+                    / max(req.decode_steps_n, 1),
+                    steps=req.verify_steps_n, accepted=req.spec_accepted_n)
         _obs.event("serving_request_done", req_id=req.req_id,
                    prompt_tokens=int(len(req.prompt)),
-                   generated_tokens=len(req.tokens), ttft_seconds=ttft)
+                   generated_tokens=len(req.tokens), ttft_seconds=ttft,
+                   queue_s=queue_s, prefill_s=round(req.prefill_s, 6),
+                   decode_s=round(decode_s, 6),
+                   spec_accepted=req.spec_accepted_n,
+                   resubmitted=req.resubmitted)
 
     def _update_gauges(self):
         used = sum(len(r.prompt) + len(r.tokens)

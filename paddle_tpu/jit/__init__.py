@@ -367,11 +367,13 @@ class TrainStep:
         dt = time.perf_counter() - t0
         if miss:
             # compile steps are tracked separately so they don't pollute
-            # the steady-state step-time distribution
+            # the steady-state step-time distribution (record_compile also
+            # emits the 'compile' span)
             _obs.record_compile("train_step", dt,
                                 signature=f"{type(self).__name__} {key!r}")
         else:
             _obs.observe("train_step_seconds", dt)
+            _obs.record_span("train_step", dur_s=dt)
         return out
 
     def _place_batch(self, batch_vals):

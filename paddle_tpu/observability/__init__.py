@@ -10,7 +10,12 @@ Three layers (docs/OBSERVABILITY.md):
 3. **fleet aggregation** (``fleet.py``): ranks publish registry snapshots
    through the coordination store, rank 0 merges them into one
    ``fleet_metrics.json`` with per-rank min/max/mean and straggler
-   diagnosis.
+   diagnosis;
+4. **distributed tracing** (``tracing.py``): span trees with cross-process
+   context propagation over per-rank ``spans_rank{R}.jsonl`` sinks —
+   ``span``/``start_span``/``end_span``/``record_span`` re-exported here;
+   ``scripts/trace_report.py`` merges the files into a Perfetto timeline
+   and a per-SLO-class latency attribution table.
 
 Everything is env-gated on ``PADDLE_TPU_TELEMETRY_DIR``: with it unset, the
 module-level helpers below return before touching the registry or the
@@ -41,6 +46,7 @@ import time
 from typing import Optional
 
 from . import catalog
+from . import tracing
 from .metrics import (  # noqa: F401  (re-exported registry API)
     Counter,
     Gauge,
@@ -48,18 +54,31 @@ from .metrics import (  # noqa: F401  (re-exported registry API)
     MetricsRegistry,
     NAME_RE,
 )
+from .tracing import (  # noqa: F401  (re-exported span API)
+    end_span,
+    new_trace_id,
+    record_span,
+    span,
+    start_span,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "telemetry_dir", "enabled", "rank", "registry",
     "counter", "gauge", "histogram",
     "inc", "set_gauge", "observe", "event", "timed", "record_compile",
+    "span", "start_span", "end_span", "record_span", "new_trace_id",
     "flush", "snapshot", "reset",
     "fleet_sync", "merge_snapshots",
 ]
 
 _registry = MetricsRegistry(catalog=catalog.METRICS)
 _io_lock = threading.Lock()
+
+# every recorded span also bumps the registry counter; tracing.py itself
+# stays stdlib-standalone (trace_report.py loads it without this package)
+tracing._counter_hook = (
+    lambda name: _registry.counter("trace_spans_total").inc(1, name=name))
 
 
 # ---------------------------------------------------------------------------
@@ -183,6 +202,9 @@ def record_compile(where: str, seconds: float,
     observe("xla_compile_seconds", seconds, where=where)
     event("xla_compile", where=where, seconds=round(seconds, 6),
           signature=(signature or "")[:240])
+    # every compile-instrumented site also traces: one single-span tree
+    record_span("compile", dur_s=seconds, where=where,
+                signature=(signature or "")[:240])
 
 
 # ---------------------------------------------------------------------------

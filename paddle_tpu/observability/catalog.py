@@ -183,6 +183,9 @@ METRICS = {
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
+    # -- tracing (observability/tracing.py) ---------------------------------
+    "trace_spans_total": (
+        "counter", "Spans recorded to the per-rank span log (labels: name)"),
 }
 
 #: JSONL event kinds (the `kind` field of every event log record).
@@ -211,7 +214,97 @@ EVENTS = {
 }
 
 
+#: Span names (observability/tracing.py) -> (owner, help). The owner is
+#: the ONE file (posix-relative to the repo root) allowed to record the
+#: span — enforced statically by ``scripts/check_observability.py`` the
+#: way event/metric prefixes are, so every span name in a merged trace
+#: has exactly one producing call site family. Serving spans form the
+#: request tree documented in docs/OBSERVABILITY.md §9; training spans
+#: are single-span traces tied to the step/commit they time.
+SPANS = {
+    # -- serving request tree: router process -------------------------------
+    "srv_request": (
+        "paddle_tpu/serving/router.py",
+        "Root span of one routed request: submit() through result harvest "
+        "(attrs: rid, slo, status, engine, resubmits)"),
+    "srv_admit": (
+        "paddle_tpu/serving/router.py",
+        "SLO admission control: queue-limit check + class queue insert"),
+    "srv_queue": (
+        "paddle_tpu/serving/router.py",
+        "Time spent admitted-but-undispatched in the class queue "
+        "(first attempt only; failover requeues are srv_retry)"),
+    "srv_dispatch": (
+        "paddle_tpu/serving/router.py",
+        "Engine selection + request record write to the coordination "
+        "store (attrs: engine, seq, retry, affinity)"),
+    "srv_retry": (
+        "paddle_tpu/serving/router.py",
+        "Failover resubmission window: engine declared dead through "
+        "redispatch of this request (retry=True, attrs: engine=dead one)"),
+    # -- serving request tree: worker process -------------------------------
+    "srv_store_transit": (
+        "paddle_tpu/serving/worker.py",
+        "Router store write to worker drain, wall-to-wall across "
+        "processes (subject to host clock skew; durations elsewhere are "
+        "monotonic)"),
+    "srv_drain": (
+        "paddle_tpu/serving/worker.py",
+        "Worker consumed the request record and submitted it to its "
+        "local engine"),
+    # -- serving request tree: engine ---------------------------------------
+    "srv_prefill": (
+        "paddle_tpu/inference/engine.py",
+        "Bucketed prompt prefill that produced the first token (attrs: "
+        "bucket, cached_len; includes compile on a cold bucket)"),
+    "srv_decode": (
+        "paddle_tpu/inference/engine.py",
+        "The request's decode window: first batched step it joined "
+        "through its finish (attrs: steps, tokens)"),
+    "srv_verify": (
+        "paddle_tpu/inference/engine.py",
+        "Speculative share of the decode window, child of srv_decode "
+        "(attrs: steps, accepted); emitted only when the request ran "
+        "draft/verify steps"),
+    # -- training side ------------------------------------------------------
+    "compile": (
+        "paddle_tpu/observability/__init__.py",
+        "One jit cache miss (emitted by record_compile, so every "
+        "compile-instrumented site traces for free; attrs: where, "
+        "signature)"),
+    "train_step": (
+        "paddle_tpu/jit/__init__.py",
+        "One warm train-step dispatch (cache hits only; misses are "
+        "'compile' spans)"),
+    "pp_tick_window": (
+        "paddle_tpu/distributed/fleet/meta_parallel/pipeline_parallel.py",
+        "Host-side pipeline schedule build for one micro-batched step "
+        "(attrs: schedule, ticks, bubble_fraction); per-tick device time "
+        "lives inside the single compiled program and is not host-"
+        "observable"),
+    "grad_comm_exchange": (
+        "paddle_tpu/distributed/grad_comm.py",
+        "Bucketed gradient-exchange build (attrs: buckets, wire_bytes); "
+        "instant marker when the caller did not time the build"),
+    "ckpt_save": (
+        "paddle_tpu/distributed/checkpoint/__init__.py",
+        "Checkpoint save, body write through commit (attrs: path)"),
+    "ckpt_restore": (
+        "paddle_tpu/distributed/checkpoint/__init__.py",
+        "Checkpoint restore (attrs: path)"),
+    "reshard_exec": (
+        "paddle_tpu/distributed/reshard.py",
+        "One reshard plan+execute over all leaves (attrs: what, leaves)"),
+}
+
+
 def metric_kind(name: str):
     """Declared kind for a registered name, or None."""
     entry = METRICS.get(name)
+    return entry[0] if entry else None
+
+
+def span_owner(name: str):
+    """Owning file (posix repo-relative) for a registered span, or None."""
+    entry = SPANS.get(name)
     return entry[0] if entry else None

@@ -26,11 +26,33 @@ import sys
 import time
 from typing import Dict, Optional
 
-#: mean step-time above fleet mean by this fraction flags a straggler
+#: default straggler factor: mean step-time above fleet mean by this
+#: fraction flags a straggler (override via PADDLE_TPU_STRAGGLER_FACTOR)
 STRAGGLER_THRESHOLD = 1.2
 
 #: histograms compared rank-to-rank for straggler diagnosis
 _STRAGGLER_METRICS = ("train_step_seconds",)
+
+
+def straggler_threshold() -> float:
+    """The straggler-diagnosis factor, from ``PADDLE_TPU_STRAGGLER_FACTOR``
+    when set (re-read per merge — supervisors flip it per run). Values
+    that do not parse or are <= 1.0 (which would flag every rank, or
+    none meaningfully) are diagnosed to stderr and fall back to the
+    default."""
+    raw = os.environ.get("PADDLE_TPU_STRAGGLER_FACTOR")
+    if not raw:
+        return STRAGGLER_THRESHOLD
+    try:
+        v = float(raw)
+    except ValueError:
+        v = -1.0
+    if v <= 1.0:
+        print(f"[telemetry] invalid PADDLE_TPU_STRAGGLER_FACTOR={raw!r} "
+              f"(need a float > 1.0); using {STRAGGLER_THRESHOLD}",
+              file=sys.stderr)
+        return STRAGGLER_THRESHOLD
+    return v
 
 _store = None  # cached telemetry store (rank 0 hosts; binding twice fails)
 _synced = False
@@ -79,13 +101,14 @@ def merge_snapshots(snaps: Dict[int, dict], world_size: int) -> dict:
                 min_rank=int(lo_r), max_rank=int(hi_r))
 
     stragglers = []
+    factor = straggler_threshold()
     for name in _STRAGGLER_METRICS:
         for label_str, slot in aggregate.get(name, {}).items():
             mean = slot.get("mean")
             if mean is None or mean <= 0 or len(slot["per_rank"]) < 2:
                 continue
             for r, v in slot["per_rank"].items():
-                if v > mean * STRAGGLER_THRESHOLD:
+                if v > mean * factor:
                     stragglers.append({
                         "rank": int(r), "metric": name, "labels": label_str,
                         "mean_seconds": v, "fleet_mean_seconds": mean,
@@ -115,6 +138,33 @@ def _write_fleet_metrics(doc: dict) -> str:
         json.dump(doc, f, indent=1)
     os.replace(tmp, path)
     return path
+
+
+def _write_trace_summary() -> Optional[str]:
+    """Merge this host's span files into ``fleet_trace_summary.json``
+    (rank 0, alongside fleet_metrics.json). Skipped when no rank wrote
+    spans; never raises — the metrics merge must not die on a torn span
+    file."""
+    from . import telemetry_dir
+    from . import tracing
+
+    d = telemetry_dir()
+    if d is None:
+        return None
+    try:
+        doc = tracing.summarize_dir(d)
+        if doc is None:
+            return None
+        path = os.path.join(d, "fleet_trace_summary.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        return path
+    except OSError as e:
+        print(f"[telemetry] trace summary write failed: {e!r}",
+              file=sys.stderr)
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -161,6 +211,7 @@ def fleet_sync(store=None, rank: Optional[int] = None,
     local = snapshot()
     if world_size < 2:
         path = _write_fleet_metrics(merge_snapshots({rank: local}, 1))
+        _write_trace_summary()
         _synced = True
         return path
 
@@ -192,6 +243,9 @@ def fleet_sync(store=None, rank: Optional[int] = None,
                           file=sys.stderr)
             doc = merge_snapshots(snaps, world_size)
             path = _write_fleet_metrics(doc)
+            # span files land in the shared telemetry dir per rank; the
+            # same rank-0 merge point folds them into the attribution table
+            _write_trace_summary()
             event("fleet_aggregate", ranks=sorted(snaps),
                   missing=doc["missing_ranks"],
                   stragglers=len(doc["stragglers"]), path=path)

@@ -17,7 +17,15 @@ Key schema (all under one namespace, default ``__srv``)::
                           window means the worker is dead)
     {ns}/req/{name}/{seq} request seq dispatched to engine `name`
                           (workers consume their stream in seq order and
-                          ack via ``acked_seq`` in the occupancy beat)
+                          ack via ``acked_seq`` in the occupancy beat).
+                          With telemetry on, the record carries a
+                          ``trace`` dict — ``{"trace_id", "parent_id",
+                          "resubmits", "dispatch_ts"}`` — next to the
+                          router-assigned seed; the worker and engine
+                          continue that trace (observability/tracing.py)
+                          so one request is one span tree across all
+                          three processes. Absent when telemetry is off:
+                          tracing adds zero wire bytes when disabled.
     {ns}/done/{rid}       completed token stream of router request `rid`
                           (written BEFORE the occupancy ack, so failover
                           can harvest finished work from a dead engine)

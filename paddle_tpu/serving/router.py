@@ -26,6 +26,14 @@ is invisible in the token streams (no loss, no duplicates, no drift).
 This module is the single writer of the ``serving_router_*`` telemetry
 family (scripts/check_observability.py enforces that), and every store
 call sits under ``protocol.deadline_guard`` (check_robustness.py rule 4).
+
+Tracing: with telemetry enabled the router mints one trace per admitted
+request and owns its router-side spans — ``srv_request`` (the root,
+submit through result), ``srv_admit``, ``srv_queue``, ``srv_dispatch``
+and ``srv_retry`` (failover resubmission windows, retry=True). The trace
+context rides the ``__srv`` request record (protocol.py) so the worker
+and engine continue the same tree; failover reruns attach under the same
+root, never minting a second one.
 """
 from __future__ import annotations
 
@@ -89,6 +97,7 @@ class RouterRequest:
     shed_reason: Optional[str] = None
     finish_t: Optional[float] = None
     resubmits: int = 0
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -131,6 +140,9 @@ class Router:
         self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
         self._next_rid = 0
         self._known_engines = 0
+        #: rid -> open span handles ("root", "queue", "retry"); entries
+        #: exist only while telemetry is on and the request is unresolved
+        self._tspans: Dict[int, dict] = {}
         self.counters = {"submitted": 0, "done": 0, "failed": 0, "shed": 0,
                          "dispatched": 0, "failover_resubmits": 0,
                          "affinity_hits": 0, "engines_lost": 0}
@@ -168,7 +180,26 @@ class Router:
         self._requests[req.rid] = req
         self.counters["submitted"] += 1
         _obs.inc("serving_router_requests_total")
-        self._admit(req)
+        if _obs.enabled():
+            # one trace per admitted request; the id travels the wire so
+            # the worker's and engine's spans join this tree
+            root = _obs.start_span(
+                "srv_request", trace_id=_obs.new_trace_id(), rid=req.rid,
+                slo=slo, prompt_tokens=int(prompt.size))
+            req.trace_id = root.trace_id
+            self._tspans[req.rid] = {"root": root}
+            ta = time.perf_counter()
+            self._admit(req)
+            _obs.record_span("srv_admit", trace_id=root.trace_id,
+                             parent_id=root.span_id,
+                             dur_s=time.perf_counter() - ta,
+                             outcome=req.status)
+            if req.status == "queued":
+                self._tspans[req.rid]["queue"] = _obs.start_span(
+                    "srv_queue", trace_id=root.trace_id,
+                    parent_id=root.span_id, slo=slo)
+        else:
+            self._admit(req)
         _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
         return req.rid
 
@@ -199,6 +230,12 @@ class Router:
         _obs.inc("serving_router_shed_total")
         _obs.event("serving_router_shed", rid=req.rid, slo=req.slo,
                    reason=reason)
+        t = self._tspans.pop(req.rid, None)
+        if t:
+            for k in ("queue", "retry"):
+                if t.get(k):
+                    _obs.end_span(t[k], outcome="shed")
+            _obs.end_span(t["root"], status="shed", reason=reason)
 
     # -- fleet discovery & liveness -----------------------------------------
 
@@ -274,6 +311,14 @@ class Router:
                 _obs.inc("serving_router_failover_total")
                 _obs.event("serving_router_failover", rid=req.rid,
                            engine=est.name, slo=req.slo)
+                t = self._tspans.get(req.rid)
+                if t:
+                    # retry-flagged child under the SAME root: the window
+                    # from declared-dead through this request's redispatch
+                    t["retry"] = _obs.start_span(
+                        "srv_retry", trace_id=t["root"].trace_id,
+                        parent_id=t["root"].span_id, retry=True,
+                        engine=est.name, resubmit=req.resubmits)
 
     # -- results -------------------------------------------------------------
 
@@ -291,6 +336,13 @@ class Router:
             self.counters["done"] += 1
             _obs.observe("serving_router_request_seconds",
                          req.finish_t - req.submit_t)
+        t = self._tspans.pop(req.rid, None)
+        if t:
+            for k in ("queue", "retry"):
+                if t.get(k):
+                    _obs.end_span(t[k], engine=req.engine)
+            _obs.end_span(t["root"], status=req.status, engine=req.engine,
+                          resubmits=req.resubmits)
 
     def _harvest_done(self):
         for est in self._engines.values():
@@ -352,13 +404,35 @@ class Router:
             break
         return best, False
 
-    def _dispatch_one(self, req: RouterRequest, est: _EngineState):
+    def _dispatch_one(self, req: RouterRequest, est: _EngineState,
+                      via_affinity: bool = False):
         req.seq = est.next_seq
         est.next_seq += 1
         rec = {"rid": req.rid, "prompt": req.prompt.tolist(),
                "params": asdict(req.params)}
+        t = self._tspans.get(req.rid)
+        dh = None
+        if t:
+            root = t["root"]
+            for k in ("queue", "retry"):
+                h = t.pop(k, None)
+                if h:
+                    _obs.end_span(h, engine=est.name)
+            dh = _obs.start_span(
+                "srv_dispatch", trace_id=root.trace_id,
+                parent_id=root.span_id, engine=est.name, seq=req.seq,
+                retry=req.resubmits > 0, affinity=via_affinity)
+            # cross-process context: worker + engine continue this trace
+            # (dispatch_ts is WALL clock — the worker closes the
+            # srv_store_transit span against it)
+            rec["trace"] = {"trace_id": root.trace_id,
+                            "parent_id": root.span_id,
+                            "resubmits": req.resubmits,
+                            "dispatch_ts": time.time()}
         with deadline_guard("dispatch request"):
             self._store.set(k_req(self._ns, est.name, req.seq), pack(rec))
+        if dh:
+            _obs.end_span(dh)
         req.status = "dispatched"
         req.engine = est.name
         est.inflight[req.rid] = req
@@ -387,7 +461,7 @@ class Router:
                 if via_affinity:
                     self.counters["affinity_hits"] += 1
                     _obs.inc("serving_router_affinity_hits_total")
-                self._dispatch_one(req, est)
+                self._dispatch_one(req, est, via_affinity)
         _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
 
     # -- driving -------------------------------------------------------------

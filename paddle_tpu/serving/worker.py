@@ -33,6 +33,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from .. import observability as _obs
 from ..inference.engine import DecodeEngine, EngineConfig, SamplingParams
 from ..testing import chaos
 from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl, k_done,
@@ -88,18 +89,37 @@ class EngineWorker:
                 rec = unpack(self._store.get(key))
             self._next_seq += 1
             rid = rec["rid"]
+            tr = rec.get("trace")
+            dh = None
+            if tr:
+                # continue the router's trace: the transit span is wall-
+                # to-wall against the router's dispatch_ts (host clock
+                # skew shifts it; every other duration is monotonic)
+                _obs.record_span(
+                    "srv_store_transit", trace_id=tr["trace_id"],
+                    parent_id=tr["parent_id"],
+                    start_ts=tr.get("dispatch_ts"), rid=rid,
+                    engine=self.name,
+                    retry=int(tr.get("resubmits", 0) or 0) > 0)
+                dh = _obs.start_span(
+                    "srv_drain", trace_id=tr["trace_id"],
+                    parent_id=tr["parent_id"], rid=rid, engine=self.name)
             try:
                 local = self.engine.submit(
                     np.asarray(rec["prompt"], np.int64),
-                    SamplingParams(**rec["params"]))
+                    SamplingParams(**rec["params"]), trace=tr)
             except ValueError as e:
                 # invalid geometry for THIS engine (bucket/page limits):
                 # report instead of dying — the router surfaces the error
+                if dh:
+                    _obs.end_span(dh, error=str(e))
                 with deadline_guard("publish result"):
                     self._store.set(k_done(self._ns, rid), pack(
                         {"rid": rid, "engine": self.name, "error": str(e)}))
                 self._done_count += 1
                 continue
+            if dh:
+                _obs.end_span(dh)
             self._local_rid[local] = rid
 
     def _publish_done(self) -> int:

@@ -221,11 +221,13 @@ def _parallel_ceiling():
     return min(2.0, min(ceilings))
 
 
-def _spawn_router_worker(args, master, namespace):
+def _spawn_router_worker(args, master, namespace, extra_env=None):
     import subprocess
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     env.update({
         # one virtual device and ONE compute thread per worker: XLA's
         # eigen pool defaults to all cores, and n workers x all-core
@@ -379,6 +381,7 @@ def run_router(args):
         for a, b in zip(outputs[1], outputs[2]):
             np.testing.assert_array_equal(
                 a, b, err_msg="router results changed with engine count")
+        trace_summary = _traced_router_phase(args, store, master)
     finally:
         store.close()
     return {
@@ -391,6 +394,76 @@ def run_router(args):
         "device_step_floor_ms": args.router_step_floor_ms,
         "machine_parallel_ceiling": round(ceiling, 2),
         "bit_equal_across_scales": True,
+        "trace_summary": trace_summary,
+    }
+
+
+def _traced_router_phase(args, store, master):
+    """A short 2-worker workload with distributed tracing ON, in its own
+    namespace with freshly spawned telemetry-enabled workers — the timed
+    trials above stay untraced so tracing cost can never bias the scaling
+    gate. Returns the per-SLO-class phase-share block for
+    BENCH_SERVING.json (latency attribution tracked across PRs)."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.serving import Router
+
+    ns = "__bencht"
+    tdir = tempfile.mkdtemp(prefix="bench_trace_")
+    print(f"router: traced phase (2 workers, spans -> {tdir})...",
+          file=sys.stderr)
+    procs = [_spawn_router_worker(
+        args, master, ns,
+        extra_env={"PADDLE_TPU_TELEMETRY_DIR": tdir,
+                   "PADDLE_TRAINER_ID": str(i + 1)}) for i in range(2)]
+    os.environ["PADDLE_TPU_TELEMETRY_DIR"] = tdir  # router = rank 0
+    try:
+        router = Router(store, namespace=ns, queue_limit=256,
+                        engine_grace_s=120.0, page_size=args.page_size,
+                        seed=args.seed, affinity_slack_tokens=128,
+                        max_inflight_per_engine=64,
+                        deadlines={"interactive": 600.0,
+                                   "standard": 600.0, "batch": 600.0})
+        deadline = time.monotonic() + 300.0
+        while router._known_engines < 2:
+            if time.monotonic() > deadline:
+                raise RuntimeError("router bench: traced-phase workers "
+                                   "never registered")
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError("router bench: traced-phase worker "
+                                       f"died rc={p.returncode}")
+            router.pump()
+            time.sleep(0.05)
+        rng = np.random.default_rng(args.seed + 2)
+        sub = _router_traffic(args, rng)[::3]
+        for prompt, slo, new in sub:
+            router.submit(prompt, slo=slo, max_new_tokens=new)
+        if not router.drain(timeout=600.0, poll=0.02):
+            raise RuntimeError(
+                f"router bench: traced phase undrained {router.stats()}")
+        router.shutdown()
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        os.environ.pop("PADDLE_TPU_TELEMETRY_DIR", None)
+    from paddle_tpu.observability import tracing
+
+    spans = tracing.load_spans(tdir)
+    problems = tracing.validate_trees(spans)
+    summary = tracing.summarize_spans(spans)
+    if problems:
+        raise RuntimeError(
+            f"router bench: trace trees invalid: {problems[:5]}")
+    return {
+        "telemetry_dir": tdir,
+        "spans": len(spans),
+        "requests": summary["requests"],
+        "phase_share_mean": {
+            cls: {p: v["mean"] for p, v in c["phase_share"].items()}
+            for cls, c in summary["classes"].items()},
     }
 
 

@@ -16,7 +16,13 @@ classes of telemetry rot:
      (inc→counter, set_gauge→gauge, observe→histogram, event→EVENTS).
      Literal names keep every dashboard series grep-able to its call
      sites; the kind check stops two subsystems from exporting one name
-     with two meanings.
+     with two meanings;
+  3. unregistered or mis-owned SPAN names — every span recorded through
+     the facade (``_obs.span/start_span/record_span``) must pass a
+     STRING-LITERAL name declared in ``catalog.SPANS``, and may only be
+     recorded from that name's declared owning file: a merged trace
+     where two subsystems emit the same span name is unreadable, so
+     span families are single-writer by construction.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — the catalog is loaded
@@ -37,7 +43,15 @@ SCAN_DIRS = [
     os.path.join("paddle_tpu", "observability"),
     os.path.join("paddle_tpu", "inference"),
     os.path.join("paddle_tpu", "serving"),
+    os.path.join("paddle_tpu", "jit"),
 ]
+
+#: files exempt from the bare-print rule: set_code_level's transformed-
+#: source dump is CONTRACTUAL stdout (paddle API parity, asserted by
+#: tests/test_surface_round3b.py via capsys.out)
+PRINT_EXEMPT = {
+    os.path.join("paddle_tpu", "jit", "dy2static.py"),
+}
 
 #: module aliases the facade is imported under at instrumented call sites
 OBS_ALIASES = {"_obs", "obs", "observability"}
@@ -50,6 +64,10 @@ RECORDERS = {
     "event": None,
 }
 
+#: facade span recorders (tracing.py); names live in catalog.SPANS and
+#: carry per-name ownership (end_span takes a handle, not a name)
+SPAN_RECORDERS = {"span", "start_span", "record_span"}
+
 #: metric-name prefix -> sole file allowed to record it. Serieses with an
 #: owner stay single-writer: grad_comm_* numbers describe the compiled
 #: gradient exchange, and a second writer (a bench script, a model) would
@@ -61,6 +79,7 @@ OWNED_PREFIXES = {
     "reshard_": os.path.join("paddle_tpu", "distributed", "reshard.py"),
     "pp_": os.path.join("paddle_tpu", "distributed", "fleet",
                         "meta_parallel", "pipeline_parallel.py"),
+    "trace_": os.path.join("paddle_tpu", "observability", "tracing.py"),
 }
 
 
@@ -110,21 +129,48 @@ def check_file(path: str, catalog, rel: str = None):
         func = node.func
         # rule 1: bare print to stdout
         if isinstance(func, ast.Name) and func.id == "print":
+            if rel in PRINT_EXEMPT:
+                continue
             if not any(kw.arg == "file" for kw in node.keywords):
                 yield (node.lineno,
                        "bare print() — runtime/distributed layers must not "
                        "write to stdout; use print(..., file=sys.stderr) or "
                        "observability.event(...)")
             continue
-        # rule 2: facade recorders take registered literal names
+        # rules 2+3 apply to facade recorder calls only
         if not (isinstance(func, ast.Attribute)
                 and isinstance(func.value, ast.Name)
                 and func.value.id in OBS_ALIASES
-                and func.attr in RECORDERS):
+                and (func.attr in RECORDERS
+                     or func.attr in SPAN_RECORDERS)):
             continue
         if not node.args:
             continue
         first = node.args[0]
+        # rule 4: span names are literal, registered, and single-writer
+        if func.attr in SPAN_RECORDERS:
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                yield (node.lineno,
+                       f"{func.value.id}.{func.attr}(...) with a non-"
+                       "literal span name — span names must be string "
+                       "literals so every trace row is grep-able to its "
+                       "call site")
+                continue
+            name = first.value
+            spans = getattr(catalog, "SPANS", {})
+            entry = spans.get(name)
+            if entry is None:
+                yield (node.lineno,
+                       f"span {name!r} is not registered in "
+                       "observability/catalog.py SPANS")
+            elif rel is not None:
+                owner = entry[0].replace("/", os.sep)
+                if rel != owner:
+                    yield (node.lineno,
+                           f"span {name!r} may only be recorded from "
+                           f"{owner} (span names are single-writer)")
+            continue
         if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
             yield (node.lineno,
                    f"{func.value.id}.{func.attr}(...) with a non-literal "

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Merge per-rank span files into a Perfetto trace + SLO attribution table.
+
+Reads every ``spans_rank*.jsonl`` under a telemetry dir (the sink
+``paddle_tpu/observability/tracing.py`` writes) and produces:
+
+* ``trace.json`` — a chrome-trace/Perfetto document (the same
+  ``{"traceEvents": [...]}``, microsecond ``ph:"X"`` convention the
+  profiler's ``export_chrome_tracing`` emits): one process track per
+  rank, one thread track per writing pid (named after the engine when
+  the spans carry one), spans placed by their wall-clock starts relative
+  to the earliest span. Open at https://ui.perfetto.dev or
+  chrome://tracing.
+* ``fleet_trace_summary.json`` — the per-SLO-class latency attribution
+  table (p50/p95 share of queue / store transit / prefill / decode /
+  failover per request tree), the same document ``fleet_sync`` writes on
+  rank 0 at job end.
+
+Stdlib-only by construction: tracing.py is loaded straight from its file
+path (the ``check_observability.py`` catalog idiom), so this never
+imports jax and runs anywhere the span files land.
+
+Usage::
+
+    python scripts/trace_report.py TELEMETRY_DIR \
+        [--trace-out trace.json] [--summary-out fleet_trace_summary.json]
+    python scripts/trace_report.py --selftest
+
+``--selftest`` synthesizes a 2-rank span set (including a failover
+retry tree and a torn tail line), merges it, and asserts the tree,
+timeline, and attribution invariants — wired into tier-1 via
+tests/test_tracing.py.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TRACING_PY = os.path.join(
+    _REPO, "paddle_tpu", "observability", "tracing.py")
+
+
+def _load_tracing():
+    spec = importlib.util.spec_from_file_location("_tracing", _TRACING_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def to_perfetto(spans):
+    """Chrome-trace events from span records: pid = rank, tid = writer
+    pid, timestamps in µs relative to the earliest span start (chrome
+    renders absolute epoch µs poorly). Metadata events name the tracks."""
+    if not spans:
+        return {"traceEvents": []}
+    t_base = min(float(s.get("ts", 0.0)) for s in spans)
+    # name each (rank, pid) thread track after the engine its spans
+    # mention, falling back to the writer pid
+    thread_label = {}
+    for s in spans:
+        key = (int(s.get("rank", 0)), int(s.get("pid", 0)))
+        engine = (s.get("attrs") or {}).get("engine")
+        if engine and not thread_label.get(key):
+            thread_label[key] = str(engine)
+        thread_label.setdefault(key, None)
+    events = []
+    for rank in sorted({k[0] for k in thread_label}):
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+    for (rank, pid), label in sorted(thread_label.items()):
+        events.append({"name": "thread_name", "ph": "M", "pid": rank,
+                       "tid": pid,
+                       "args": {"name": label or f"pid {pid}"}})
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        args = {"trace_id": s.get("trace_id"),
+                "span_id": s.get("span_id"),
+                "parent_id": s.get("parent_id")}
+        args.update(attrs)
+        events.append({
+            "name": s.get("name", "?"),
+            "ph": "X",
+            "pid": int(s.get("rank", 0)),
+            "tid": int(s.get("pid", 0)),
+            "ts": round((float(s.get("ts", 0.0)) - t_base) * 1e6, 3),
+            "dur": max(round(float(s.get("dur_s", 0.0)) * 1e6, 3), 1.0),
+            "args": args,
+        })
+    return {"traceEvents": events}
+
+
+def _write_json(doc, path):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def run_report(telemetry_dir, trace_out, summary_out):
+    tracing = _load_tracing()
+    spans = tracing.load_spans(telemetry_dir)
+    if not spans:
+        print(f"[trace_report] no span files under {telemetry_dir} "
+              "(run with PADDLE_TPU_TELEMETRY_DIR set)", file=sys.stderr)
+        return 1
+    problems = tracing.validate_trees(spans)
+    for p in problems:
+        print(f"[trace_report] WARNING: {p}", file=sys.stderr)
+    _write_json(to_perfetto(spans), trace_out)
+    summary = tracing.summarize_spans(spans)
+    _write_json(summary, summary_out)
+    print(f"[trace_report] {len(spans)} spans, {summary['traces']} traces, "
+          f"{summary['requests']} request trees "
+          f"({len(problems)} tree problems) -> {trace_out}, {summary_out}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+def _synthesize(tracing, d):
+    """Two-rank serving workload: rank 0 is the router (roots + queue +
+    dispatch + one retry), rank 1 the worker/engine (transit, prefill,
+    decode). Written through the real record API so the selftest also
+    covers the sink."""
+    os.environ["PADDLE_TPU_TELEMETRY_DIR"] = d
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+    trees = []
+    for i, (slo, retried) in enumerate(
+            [("interactive", False), ("standard", False),
+             ("standard", True), ("batch", False)]):
+        tid = tracing.new_trace_id()
+        root = tracing.record_span(
+            "srv_request", trace_id=tid, dur_s=1.0, rid=i, slo=slo,
+            status="done", resubmits=int(retried))
+        tracing.record_span("srv_queue", trace_id=tid, parent_id=root,
+                            dur_s=0.2, slo=slo)
+        tracing.record_span("srv_dispatch", trace_id=tid, parent_id=root,
+                            dur_s=0.01, engine="engine0", retry=False)
+        if retried:
+            tracing.record_span("srv_retry", trace_id=tid, parent_id=root,
+                                dur_s=0.15, retry=True, engine="engine0")
+        trees.append((tid, root))
+    os.environ["PADDLE_TRAINER_ID"] = "1"
+    for i, (tid, root) in enumerate(trees):
+        tracing.record_span("srv_store_transit", trace_id=tid,
+                            parent_id=root, dur_s=0.05, rid=i,
+                            engine="engine1")
+        tracing.record_span("srv_prefill", trace_id=tid, parent_id=root,
+                            dur_s=0.1, rid=i, bucket=64, engine="engine1")
+        tracing.record_span("srv_decode", trace_id=tid, parent_id=root,
+                            dur_s=0.5, rid=i, steps=16, engine="engine1")
+    # a single-span training trace and a torn tail line must both be fine
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+    tracing.record_span("compile", dur_s=2.5, where="train_step")
+    with open(os.path.join(d, "spans_rank1.jsonl"), "a") as f:
+        f.write('{"kind": "span", "name": "torn')
+
+
+def selftest():
+    tracing = _load_tracing()
+    saved = {k: os.environ.get(k)
+             for k in ("PADDLE_TPU_TELEMETRY_DIR", "PADDLE_TRAINER_ID")}
+    with tempfile.TemporaryDirectory(prefix="trace_selftest_") as d:
+        try:
+            _synthesize(tracing, d)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        spans = tracing.load_spans(d)
+        # 4 trees x (root + queue + dispatch) + 1 retry on rank 0,
+        # 4 x (transit + prefill + decode) on rank 1, + 1 compile trace;
+        # the torn tail line must be skipped, not counted or fatal
+        assert len(spans) == 26, f"unexpected span count {len(spans)}"
+        assert tracing.validate_trees(spans) == [], \
+            tracing.validate_trees(spans)
+        assert {s["rank"] for s in spans} == {0, 1}
+
+        doc = to_perfetto(spans)
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(evs) == len(spans)
+        assert all(e["ts"] >= 0.0 and e["dur"] >= 1.0 and e["name"]
+                   for e in evs)
+        pids = {e["pid"] for e in evs}
+        assert pids == {0, 1}, pids  # one track per rank
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas
+                if m["name"] == "process_name"} == {"rank 0", "rank 1"}
+
+        summary = tracing.summarize_spans(spans)
+        assert summary["requests"] == 4
+        cls = summary["classes"]
+        assert set(cls) == {"interactive", "standard", "batch"}
+        assert cls["standard"]["resubmitted"] == 1
+        for c in cls.values():
+            total = sum(v["mean"] for v in c["phase_share"].values())
+            assert abs(total - 1.0) < 1e-6, (c, total)
+            assert c["latency_seconds"]["p50"] > 0
+        print("trace_report selftest ok "
+              f"({len(spans)} spans, {summary['requests']} trees, "
+              f"{len(cls)} classes)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("trace_report")
+    ap.add_argument("telemetry_dir", nargs="?",
+                    help="dir holding spans_rank*.jsonl")
+    ap.add_argument("--trace-out", default=None,
+                    help="Perfetto output path "
+                         "(default: TELEMETRY_DIR/trace.json)")
+    ap.add_argument("--summary-out", default=None,
+                    help="attribution table output path "
+                         "(default: TELEMETRY_DIR/fleet_trace_summary.json)")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.telemetry_dir:
+        ap.error("telemetry_dir is required (or --selftest)")
+    d = args.telemetry_dir
+    return run_report(
+        d, args.trace_out or os.path.join(d, "trace.json"),
+        args.summary_out or os.path.join(d, "fleet_trace_summary.json"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

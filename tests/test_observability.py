@@ -263,6 +263,33 @@ def test_merge_snapshots_no_false_stragglers():
     assert doc["stragglers"] == [] and doc["missing_ranks"] == []
 
 
+def test_straggler_threshold_env_override(monkeypatch, capsys):
+    from paddle_tpu.observability.fleet import straggler_threshold
+
+    monkeypatch.delenv("PADDLE_TPU_STRAGGLER_FACTOR", raising=False)
+    assert straggler_threshold() == 1.2
+    monkeypatch.setenv("PADDLE_TPU_STRAGGLER_FACTOR", "1.5")
+    assert straggler_threshold() == 1.5
+    # <= 1.0 would flag every rank; unparseable is operator error — both
+    # diagnose to stderr and fall back rather than poison the merge
+    for bad in ("0.5", "1.0", "abc"):
+        monkeypatch.setenv("PADDLE_TPU_STRAGGLER_FACTOR", bad)
+        assert straggler_threshold() == 1.2
+        assert "invalid PADDLE_TPU_STRAGGLER_FACTOR" in capsys.readouterr().err
+
+
+def test_merge_snapshots_honors_straggler_factor(monkeypatch):
+    # rank 1 at 2x fleet mean: flagged at the default 1.2, ignored at 4x
+    monkeypatch.setenv("PADDLE_TPU_STRAGGLER_FACTOR", "4.0")
+    doc = merge_snapshots({0: _snap(0, 0.01), 1: _snap(1, 0.02)},
+                          world_size=2)
+    assert doc["stragglers"] == []
+    monkeypatch.delenv("PADDLE_TPU_STRAGGLER_FACTOR")
+    doc = merge_snapshots({0: _snap(0, 0.01), 1: _snap(1, 0.02)},
+                          world_size=2)
+    assert [s["rank"] for s in doc["stragglers"]] == [1]
+
+
 def test_fleet_sync_single_process_writes_locally(tdir, monkeypatch):
     monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
     obs.observe("train_step_seconds", 0.01)

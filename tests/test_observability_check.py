@@ -178,6 +178,70 @@ def test_router_event_from_worker_rejected(tmp_path):
     assert len(v) == 1 and "single-writer" in v[0][1]
 
 
+def test_nonliteral_span_name_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f(name):
+            _obs.record_span(name, dur_s=0.1)
+    """)
+    assert len(v) == 1 and "non-literal span name" in v[0][1]
+
+
+def test_unregistered_span_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f():
+            with _obs.span("made_up_span"):
+                pass
+    """)
+    assert len(v) == 1 and "SPANS" in v[0][1]
+
+
+_SPAN_SRC = """
+    from paddle_tpu import observability as _obs
+    def f(req):
+        _obs.record_span("srv_prefill", trace_id=req.trace_id, dur_s=0.1)
+"""
+
+
+def test_span_from_wrong_file_rejected(tmp_path):
+    # srv_prefill belongs to the engine; the worker may not emit it
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_SPAN_SRC))
+    rel = os.path.join("paddle_tpu", "serving", "worker.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+
+
+def test_span_from_owner_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_SPAN_SRC))
+    rel = os.path.join("paddle_tpu", "inference", "engine.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_start_span_ownership_checked_end_span_not(tmp_path):
+    # start_span carries the name (checked); end_span takes a handle, so
+    # closing someone else's span from a helper is fine
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        from paddle_tpu import observability as _obs
+        def f(h):
+            g = _obs.start_span("srv_queue", rid=1)
+            _obs.end_span(h)
+            return g
+    """))
+    rel = os.path.join("paddle_tpu", "inference", "engine.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "srv_queue" in v[0][1]
+
+
+def test_every_cataloged_span_names_a_real_owner():
+    for name, (owner, _help) in CATALOG.SPANS.items():
+        assert os.path.exists(os.path.join(REPO, owner)), \
+            f"span {name!r} owner {owner} does not exist"
+
+
 def test_registered_literals_allowed(tmp_path):
     assert not _violations(tmp_path, """
         from paddle_tpu import observability as _obs

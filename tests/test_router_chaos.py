@@ -65,19 +65,28 @@ def _reference(requests):
     return [eng.result(r) for r in rids]
 
 
-def test_engine_kill_failover_completes_all_bit_equal():
+def test_engine_kill_failover_completes_all_bit_equal(tmp_path, monkeypatch):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
     from paddle_tpu.runtime import TCPStore
     from paddle_tpu.serving import Router
+
+    # tracing ON across the kill: the dead engine's requests must show up
+    # as retry-flagged children of their original trees, never new roots
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    obs.reset()
 
     port = free_port()
     store = TCPStore(host="127.0.0.1", port=port, is_master=True,
                      timeout=30.0)
     master = f"127.0.0.1:{port}"
-    survivor = _spawn_worker(master)
+    survivor = _spawn_worker(master, chaos_env={"PADDLE_TRAINER_ID": "1"})
     victim = _spawn_worker(master, chaos_env={
         "PADDLE_CHAOS": "1",
         "PADDLE_CHAOS_ENGINE_MODE": "kill",
         "PADDLE_CHAOS_ENGINE_AT": "3",
+        "PADDLE_TRAINER_ID": "2",
     })
     procs = [survivor, victim]
     # grace must comfortably exceed one CPU program compile (a worker
@@ -119,6 +128,22 @@ def test_engine_kill_failover_completes_all_bit_equal():
                            for p, r in zip(prompts, rids)])
         for r, w in zip(rids, want):
             np.testing.assert_array_equal(router.result(r), w)
+
+        # --- the kill is visible in the trace, and ONLY as retry-flagged
+        # children: a SIGKILL loses the victim's unfinished spans but must
+        # never tear a tree or mint a second root
+        spans = tracing.load_spans(str(tmp_path))
+        assert tracing.validate_trees(spans) == []
+        roots = {s["trace_id"]: s for s in spans
+                 if s["name"] == "srv_request"}
+        retries = [s for s in spans if s["name"] == "srv_retry"]
+        assert len(retries) >= 1
+        for s in retries:
+            assert s["attrs"]["retry"] is True
+            root = roots[s["trace_id"]]  # child of an admitted request
+            assert s["parent_id"] == root["span_id"]
+            assert root["attrs"]["status"] == "done"
+            assert root["attrs"]["resubmits"] >= 1
     finally:
         router.shutdown()
         for p in procs:
@@ -128,3 +153,4 @@ def test_engine_kill_failover_completes_all_bit_equal():
                 p.kill()
                 p.wait(timeout=20)
         store.close()
+        obs.reset()

@@ -238,3 +238,65 @@ def test_shutdown_broadcast_reaches_workers(model, store):
     assert not w.stop_requested()
     router.shutdown()
     assert w.stop_requested()
+
+
+@pytest.mark.slow
+def test_request_trace_tree_and_enriched_done_event(model, store, tmp_path,
+                                                    monkeypatch):
+    """Tracing on: every routed request is ONE contiguous span tree across
+    router -> worker -> engine, the done event carries the phase
+    breakdown, and results stay bit-equal to the untraced reference."""
+    import json
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
+
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    obs.reset()
+    try:
+        w = EngineWorker(model, store, **ENG)
+        router = Router(store, queue_limit=16, seed=3)
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+                   for n in (18, 27)]
+        rids = [router.submit(p, slo=slo, max_new_tokens=6)
+                for p, slo in zip(prompts, ("interactive", "batch"))]
+        _drive(router, [w])
+
+        spans = tracing.load_spans(str(tmp_path))
+        assert tracing.validate_trees(spans) == []
+        roots = [s for s in spans if s["name"] == "srv_request"]
+        assert len(roots) == 2  # one tree per request, no strays
+        assert all(not s.get("parent_id") for s in roots)
+        assert {s["attrs"]["status"] for s in roots} == {"done"}
+        assert {s["attrs"]["slo"] for s in roots} == {"interactive",
+                                                      "batch"}
+        for root in roots:
+            names = {s["name"] for s in spans
+                     if s["trace_id"] == root["trace_id"]}
+            assert {"srv_request", "srv_admit", "srv_queue",
+                    "srv_dispatch", "srv_store_transit", "srv_drain",
+                    "srv_prefill", "srv_decode"} <= names
+
+        # the done event carries the phase breakdown for dashboards that
+        # never load span files
+        evs = [json.loads(l) for l in
+               (tmp_path / "events_rank0.jsonl").read_text().splitlines()]
+        done = [e for e in evs if e["kind"] == "serving_request_done"]
+        assert len(done) == 2
+        for e in done:
+            assert e["queue_s"] >= 0 and e["prefill_s"] > 0
+            assert e["decode_s"] >= 0
+            assert e["spec_accepted"] == 0 and e["resubmitted"] is False
+
+        # greedy output is bit-equal with tracing on (the reference
+        # engine gets no trace context, so it emits no serving spans)
+        want = _reference(model, [(p, router._requests[r].params)
+                                  for p, r in zip(prompts, rids)])
+        for r, exp in zip(rids, want):
+            np.testing.assert_array_equal(router.result(r), exp)
+        assert len([s for s in tracing.load_spans(str(tmp_path))
+                    if s["name"] == "srv_request"]) == 2
+    finally:
+        obs.reset()

@@ -1,0 +1,304 @@
+"""Distributed tracing (docs/OBSERVABILITY.md §8-§9): the span API and
+sink, cross-function handles, retroactive records, tree validation, the
+SLO attribution summary, and the trace_report CLI/selftest.
+
+In-process and compile-free; the multi-process serving acceptance runs
+live in tests/test_tracing_e2e.py (slow) and the in-router slow case in
+tests/test_serving_router.py."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "scripts", "trace_report.py")
+
+
+@pytest.fixture
+def tdir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    obs.reset()
+    yield tmp_path
+    obs.reset()
+
+
+def _spans(tdir, rank=0):
+    p = tdir / f"spans_rank{rank}.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(l) for l in p.read_text().splitlines() if l.strip()]
+
+
+# ---------------------------------------------------------------------------
+# recording API
+# ---------------------------------------------------------------------------
+def test_span_cm_nests_and_inherits_trace(tdir):
+    with obs.span("ckpt_save", path="/x") as parent:
+        with obs.span("compile", where="inner") as child:
+            assert child.trace_id == parent.trace_id
+            assert child.parent_id == parent.span_id
+
+    recs = {r["name"]: r for r in _spans(tdir)}
+    assert set(recs) == {"ckpt_save", "compile"}
+    root, inner = recs["ckpt_save"], recs["compile"]
+    assert root["parent_id"] is None
+    assert inner["trace_id"] == root["trace_id"]
+    assert inner["parent_id"] == root["span_id"]
+    assert root["attrs"] == {"path": "/x"}
+    for r in recs.values():  # envelope
+        assert {"kind", "name", "trace_id", "span_id", "ts", "dur_s",
+                "rank", "pid"} <= set(r)
+        assert r["kind"] == "span" and r["dur_s"] >= 0.0
+    # the child line is flushed before the parent's (inner exits first)
+    assert [r["name"] for r in _spans(tdir)] == ["compile", "ckpt_save"]
+
+
+def test_span_cm_records_exception_as_error_attr(tdir):
+    with pytest.raises(RuntimeError):
+        with obs.span("ckpt_save"):
+            raise RuntimeError("disk full")
+    (rec,) = _spans(tdir)
+    assert "disk full" in rec["attrs"]["error"]
+
+
+def test_start_end_span_cross_function_handle(tdir):
+    """The router pattern: a handle held open across pump() rounds,
+    closed later with merged attrs. start_span must NOT touch the
+    thread-local stack — a sibling opened meanwhile is not its child."""
+    h = obs.start_span("srv_queue", rid=7)
+    sib = obs.start_span("train_step")
+    assert sib.trace_id != h.trace_id and sib.parent_id is None
+    obs.end_span(sib)
+    sid = obs.end_span(h, engine="e0")
+    assert sid == h.span_id
+    recs = {r["name"]: r for r in _spans(tdir)}
+    assert recs["srv_queue"]["attrs"] == {"rid": 7, "engine": "e0"}
+
+
+def test_start_span_inherits_from_enclosing_cm(tdir):
+    with obs.span("ckpt_save") as root:
+        h = obs.start_span("compile")
+        obs.end_span(h)
+    assert h.trace_id == root.trace_id
+    assert h.parent_id == root.span_id
+
+
+def test_record_span_retroactive(tdir):
+    # duration measured elsewhere: ts is backdated end - dur
+    before = time.time()
+    sid = tracing.record_span("srv_decode", dur_s=2.0, steps=16)
+    (rec,) = _spans(tdir)
+    assert sid == rec["span_id"] and rec["dur_s"] == 2.0
+    assert rec["ts"] <= before - 2.0 + 1.0  # backdated ~2s
+    # explicit wall start (the cross-process srv_store_transit case)
+    t0 = time.time() - 0.5
+    tracing.record_span("srv_store_transit", trace_id=rec["trace_id"],
+                        parent_id=sid, start_ts=t0)
+    rec2 = _spans(tdir)[-1]
+    assert rec2["parent_id"] == sid and abs(rec2["ts"] - t0) < 0.01
+    assert 0.4 < rec2["dur_s"] < 60.0  # derived end(now) - start
+    # negative intervals (skewed clocks) clamp to zero, never negative
+    tracing.record_span("srv_store_transit", start_ts=time.time() + 99)
+    assert _spans(tdir)[-1]["dur_s"] == 0.0
+
+
+def test_spans_count_into_registry(tdir):
+    with obs.span("ckpt_save"):
+        pass
+    tracing.record_span("compile", dur_s=0.1)
+    c = obs.registry().get("trace_spans_total")
+    assert c.value(name="ckpt_save") == 1
+    assert c.value(name="compile") == 1
+
+
+def test_rank_env_selects_span_file(tdir, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    tracing.record_span("compile", dur_s=0.1)
+    assert _spans(tdir, rank=3) and not (tdir / "spans_rank0.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+def test_disabled_is_inert_and_noop_handles_thread(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_TELEMETRY_DIR", raising=False)
+    obs.reset()
+    with obs.span("ckpt_save") as h:
+        assert not h and h.span_id is None and h.trace_id is None
+    q = obs.start_span("srv_queue", rid=1)
+    assert not q  # falsy -> `if handle:` call sites skip their end_span
+    assert obs.end_span(q) is None
+    assert tracing.record_span("srv_decode", dur_s=1.0) is None
+    assert not any(tmp_path.iterdir())
+    assert obs.registry().get("trace_spans_total") is None
+
+
+def test_disabled_tracing_adds_no_measurable_overhead(monkeypatch):
+    """Same guard as the metrics facade: with telemetry off a span call
+    must stay a single env lookup. 20us/call is ~10x the observed cost on
+    a loaded CI box."""
+    monkeypatch.delenv("PADDLE_TPU_TELEMETRY_DIR", raising=False)
+    obs.reset()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs.span("ckpt_save"):
+            pass
+        obs.record_span("srv_decode", dur_s=0.01)
+    per_call = (time.perf_counter() - t0) / (2 * n)
+    assert per_call < 20e-6, \
+        f"disabled tracing costs {per_call * 1e6:.2f}us per call"
+
+
+# ---------------------------------------------------------------------------
+# load / validate / summarize (pure helpers)
+# ---------------------------------------------------------------------------
+def test_load_spans_skips_torn_and_foreign_lines(tdir):
+    tracing.record_span("compile", dur_s=0.1)
+    with open(tdir / "spans_rank0.jsonl", "a") as f:
+        f.write('{"kind": "event", "name": "not_a_span"}\n')
+        f.write('{"kind": "span", "name": "torn_by_sigki')  # no newline
+    spans = tracing.load_spans(str(tdir))
+    assert [s["name"] for s in spans] == ["compile"]
+    assert tracing.load_spans(str(tdir / "missing")) == []
+
+
+def test_validate_trees_flags_double_roots_and_orphans():
+    ok = [{"trace_id": "t1", "span_id": "a", "parent_id": None},
+          {"trace_id": "t1", "span_id": "b", "parent_id": "a"}]
+    assert tracing.validate_trees(ok) == []
+    two_roots = ok + [{"trace_id": "t1", "span_id": "c", "parent_id": None,
+                       "name": "srv_request"}]
+    assert any("2 roots" in p for p in tracing.validate_trees(two_roots))
+    orphan = ok + [{"trace_id": "t1", "span_id": "d", "parent_id": "zz",
+                    "name": "srv_decode"}]
+    assert any("orphaned" in p for p in tracing.validate_trees(orphan))
+
+
+def _tree(tid, slo, dur, phases, status="done", resubmits=0):
+    root = {"trace_id": tid, "span_id": f"{tid}-r", "parent_id": None,
+            "name": "srv_request", "ts": 0.0, "dur_s": dur,
+            "attrs": {"slo": slo, "status": status,
+                      "resubmits": resubmits}}
+    out = [root]
+    for i, (name, d) in enumerate(phases):
+        out.append({"trace_id": tid, "span_id": f"{tid}-{i}",
+                    "parent_id": root["span_id"], "name": name,
+                    "ts": 0.0, "dur_s": d})
+    return out
+
+
+def test_summarize_spans_shares_partition_request_time():
+    spans = _tree("t1", "interactive", 1.0,
+                  [("srv_queue", 0.2), ("srv_prefill", 0.1),
+                   ("srv_decode", 0.5)])
+    doc = tracing.summarize_spans(spans)
+    assert doc["requests"] == 1 and doc["unfinished"] == 0
+    c = doc["classes"]["interactive"]
+    sh = {p: v["mean"] for p, v in c["phase_share"].items()}
+    assert sh["queue"] == pytest.approx(0.2)
+    assert sh["decode"] == pytest.approx(0.5)
+    assert sh["other"] == pytest.approx(0.2)  # 1 - 0.8 tracked
+    assert sum(sh.values()) == pytest.approx(1.0)
+    assert c["latency_seconds"]["p50"] == pytest.approx(1.0)
+
+
+def test_summarize_spans_normalizes_retry_double_count():
+    """A failed-over request records BOTH attempts' phases; their sum can
+    exceed the root wall time, and the shares must still partition 1.0."""
+    spans = _tree("t1", "standard", 1.0,
+                  [("srv_queue", 0.3), ("srv_prefill", 0.4),
+                   ("srv_prefill", 0.4), ("srv_decode", 0.6),
+                   ("srv_retry", 0.3)], resubmits=1)
+    c = tracing.summarize_spans(spans)["classes"]["standard"]
+    assert c["resubmitted"] == 1
+    sh = {p: v["mean"] for p, v in c["phase_share"].items()}
+    assert sum(sh.values()) == pytest.approx(1.0)
+    assert sh["failover"] > 0 and sh["other"] == pytest.approx(0.0)
+
+
+def test_summarize_spans_counts_shed_and_unfinished():
+    spans = (_tree("t1", "batch", 1.0, [], status="shed")
+             + _tree("t2", "batch", 1.0, [], status="dispatched")
+             + _tree("t3", "batch", 2.0, [("srv_decode", 1.0)]))
+    doc = tracing.summarize_spans(spans)
+    assert doc["requests"] == 3 and doc["unfinished"] == 1
+    c = doc["classes"]["batch"]
+    assert c["shed"] == 1 and c["requests"] == 1
+
+
+def test_summarize_dir_none_without_span_files(tmp_path):
+    assert tracing.summarize_dir(str(tmp_path)) is None
+    assert tracing.summarize_dir(None) is None
+
+
+# ---------------------------------------------------------------------------
+# trace_report CLI
+# ---------------------------------------------------------------------------
+def test_trace_report_selftest():
+    proc = subprocess.run([sys.executable, REPORT, "--selftest"],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest ok" in proc.stdout
+
+
+def test_trace_report_cli_writes_perfetto_and_summary(tdir, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    tid = tracing.new_trace_id()
+    root = tracing.record_span("srv_request", trace_id=tid, dur_s=1.0,
+                               slo="interactive", status="done",
+                               resubmits=0)
+    tracing.record_span("srv_queue", trace_id=tid, parent_id=root,
+                        dur_s=0.2)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    tracing.record_span("srv_decode", trace_id=tid, parent_id=root,
+                        dur_s=0.5, engine="engine1")
+
+    proc = subprocess.run([sys.executable, REPORT, str(tdir)],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    doc = json.load(open(tdir / "trace.json"))
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(evs) == 3
+    assert all(e["ts"] >= 0 and e["dur"] >= 1.0 for e in evs)
+    assert {e["pid"] for e in evs} == {0, 1}  # one track per rank
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "thread_name"}
+    assert "engine1" in names  # engine-carrying pid track is named
+
+    summary = json.load(open(tdir / "fleet_trace_summary.json"))
+    assert summary["requests"] == 1
+    sh = summary["classes"]["interactive"]["phase_share"]
+    assert sum(v["mean"] for v in sh.values()) == pytest.approx(1.0)
+
+
+def test_trace_report_cli_empty_dir_is_rc1(tmp_path):
+    proc = subprocess.run([sys.executable, REPORT, str(tmp_path)],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert "no span files" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: rank-0 sync writes the attribution table
+# ---------------------------------------------------------------------------
+def test_fleet_sync_writes_trace_summary(tdir, monkeypatch):
+    monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
+    tid = tracing.new_trace_id()
+    root = tracing.record_span("srv_request", trace_id=tid, dur_s=1.0,
+                               slo="batch", status="done", resubmits=0)
+    tracing.record_span("srv_decode", trace_id=tid, parent_id=root,
+                        dur_s=0.7)
+    obs.fleet_sync()
+    doc = json.load(open(tdir / "fleet_trace_summary.json"))
+    assert doc["schema"] == 1 and doc["requests"] == 1
+    assert doc["classes"]["batch"]["phase_share"]["decode"]["mean"] == \
+        pytest.approx(0.7)

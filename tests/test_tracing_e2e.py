@@ -1,0 +1,166 @@
+"""End-to-end distributed tracing acceptance (docs/OBSERVABILITY.md §8-§9).
+
+Two real ``python -m paddle_tpu.serving.worker`` processes (telemetry
+ranks 1 and 2) serve an in-process router (rank 0) with
+``PADDLE_TPU_TELEMETRY_DIR`` set everywhere. The acceptance criteria:
+
+* every admitted request is exactly ONE contiguous span tree spanning
+  all three processes (router admit/queue/dispatch, worker
+  transit/drain, engine prefill/decode) — cross-process propagation
+  through the ``__srv`` wire record actually works;
+* ``scripts/trace_report.py`` over the dir yields a valid Perfetto
+  document (one track per rank) and a per-SLO-class attribution table
+  whose phase shares partition 1.0;
+* results stay BIT-EQUAL to an untraced single-engine reference —
+  tracing must be invisible in the tokens.
+
+Marked slow: boots 2 fresh interpreters that compile engine programs on
+CPU; run with ``pytest tests/test_tracing_e2e.py --runslow``.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+from conftest import free_port
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "scripts", "trace_report.py")
+VOCAB = 61
+MODEL_ARGS = ["--model-seed", "7", "--vocab", str(VOCAB), "--hidden", "32",
+              "--layers", "2", "--heads", "4", "--max-positions", "128"]
+ENGINE_ARGS = ["--slots", "2", "--max-length", "64", "--page-size", "16"]
+
+#: the full request chain every done tree must cover (srv_verify only
+#: appears for speculative decode, srv_retry only after failover)
+CHAIN = {"srv_request", "srv_admit", "srv_queue", "srv_dispatch",
+         "srv_store_transit", "srv_drain", "srv_prefill", "srv_decode"}
+
+
+def _spawn_worker(master, rank, tdir):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "PADDLE_TPU_TELEMETRY_DIR": str(tdir),
+        "PADDLE_TRAINER_ID": str(rank),
+    })
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.worker",
+         "--master", master, "--poll-interval", "0.002",
+         *MODEL_ARGS, *ENGINE_ARGS],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _reference(requests):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    model.eval()
+    eng = DecodeEngine(model, EngineConfig(num_slots=4, max_length=64,
+                                           page_size=16, prefix_cache=True))
+    rids = [eng.submit(p, params) for p, params in requests]
+    eng.run()
+    return [eng.result(r) for r in rids]
+
+
+def test_trace_spans_three_processes_and_reports(tmp_path, monkeypatch):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.runtime import TCPStore
+    from paddle_tpu.serving import Router
+
+    tdir = tmp_path / "tele"
+    tdir.mkdir()
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tdir))
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    obs.reset()
+
+    port = free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=30.0)
+    master = f"127.0.0.1:{port}"
+    procs = [_spawn_worker(master, rank, tdir) for rank in (1, 2)]
+    router = Router(store, queue_limit=32, engine_grace_s=120.0, seed=13,
+                    deadlines={"interactive": 240.0, "standard": 240.0,
+                               "batch": 600.0})
+    try:
+        deadline = time.monotonic() + 120.0
+        while router._known_engines < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            for p in procs:
+                assert p.poll() is None, p.stderr.read()[-2000:]
+            router.pump()
+            time.sleep(0.05)
+
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+                   for n in (14, 23, 31, 11)]
+        slos = ("interactive", "standard", "batch", "interactive")
+        rids = [router.submit(p, slo=slo, max_new_tokens=8)
+                for p, slo in zip(prompts, slos)]
+        assert router.drain(timeout=240.0), router.stats()
+        st = router.stats()
+        assert st["done"] == len(rids) and st["shed"] == 0
+
+        want = _reference([(p, router._requests[r].params)
+                           for p, r in zip(prompts, rids)])
+        for r, w in zip(rids, want):
+            np.testing.assert_array_equal(router.result(r), w)
+    finally:
+        router.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=20)
+        store.close()
+        obs.reset()
+
+    # --- span-file invariants: one contiguous tree per request, spanning
+    # the router process (rank 0) and a worker process (rank 1 or 2)
+    spans = tracing.load_spans(str(tdir))
+    assert tracing.validate_trees(spans) == []
+    roots = [s for s in spans if s["name"] == "srv_request"]
+    assert len(roots) == 4
+    assert {s["attrs"]["status"] for s in roots} == {"done"}
+    for root in roots:
+        tree = [s for s in spans if s["trace_id"] == root["trace_id"]]
+        assert CHAIN <= {s["name"] for s in tree}
+        ranks = {s["rank"] for s in tree}
+        assert 0 in ranks and ranks & {1, 2}, ranks
+
+    # --- the report CLI over the raw files
+    proc = subprocess.run([sys.executable, REPORT, str(tdir)],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 tree problems" in proc.stdout
+
+    doc = json.load(open(tdir / "trace.json"))
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(evs) == len(spans)
+    assert all({"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+               for e in evs)
+    assert {e["pid"] for e in evs} == {0, 1, 2}  # one track per rank
+
+    summary = json.load(open(tdir / "fleet_trace_summary.json"))
+    assert summary["requests"] == 4 and summary["unfinished"] == 0
+    assert set(summary["classes"]) == {"interactive", "standard", "batch"}
+    for cls in summary["classes"].values():
+        total = sum(v["mean"] for v in cls["phase_share"].values())
+        # shares are rounded to 6 decimals in the document
+        assert total == pytest.approx(1.0, abs=1e-4)
