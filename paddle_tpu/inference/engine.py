@@ -36,6 +36,12 @@ static-shape serving discipline on XLA):
   (``fold_in(request_key, position)``), so greedy output stays bit-equal
   and sampled streams stay scheduling-invariant with speculation on or
   off.
+* **Disaggregated prefill.** ``prefill_export`` runs a prompt's prefill
+  here and returns its content KV pages as host arrays;
+  ``try_import_prefill`` adopts them on a decode engine, seating the
+  request straight into the decode batch. Raw transfer with a matching
+  ``kv_dtype`` is bit-equal to a local prefill (the serving worker
+  streams the payload through ``serving/transport.py``'s KV codec).
 * **Continuous batching / on-device sampling / int8 KV** as before
   (PR 5): pure-Python scheduler admits into free slots between compiled
   steps, one int32 per slot per step host transfer (``k+1`` for verify),
@@ -968,6 +974,233 @@ class DecodeEngine:
             "decode_steps": int(self.decode_steps),
             "total_tokens": int(self.total_tokens),
         }
+
+    # -- disaggregated prefill: KV-page export / import ---------------------
+
+    def prefill_export(self, prompt, params: Optional[SamplingParams] = None,
+                       *, trace: Optional[dict] = None, **kw):
+        """Run one prompt's prefill HERE and hand its KV pages to a decode
+        engine (disaggregated serving; serving/worker.py streams the
+        result over transport.encode_kv).
+
+        Only the ``ceil(t0 / page_size)`` content pages are exported — the
+        decode side allocates its own generation pages — and the slabs are
+        bit-equal to what a local prefill leaves in this pool (padding
+        rows past ``true_len`` included), so a raw-wire import decodes
+        bit-equal to a unified engine. Sampled streams additionally need
+        an explicit ``params.seed`` (the router always sets one); without
+        it the two engines derive different request keys and only greedy
+        output matches.
+
+        Returns ``None`` when no slot (or pages) are free right now — the
+        caller retries next poll; ``{"done": prompt+tokens}`` when the
+        request finished at prefill (1-token budget / instant EOS); else
+        ``{"first_token", "true_len", "prefill_s", "pool_dtype", "k", "v"
+        [, "ks", "vs"]}`` with k/v ``[L, n_pages, Hkv, P, D]`` host arrays
+        (plus the int8 scale slabs when this pool is int8). Raises
+        ValueError on the same bad-request conditions as ``submit``.
+        """
+        if params is None:
+            params = SamplingParams(**kw)
+        ids = np.asarray(raw(prompt), dtype=np.int32).reshape(-1)
+        t0 = int(ids.shape[0])
+        if t0 < 1:
+            raise ValueError("empty prompt")
+        if t0 > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {t0} exceeds the largest prompt bucket "
+                f"{self.buckets[-1]}")
+        if t0 + params.max_new_tokens > self.config.max_length:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({params.max_new_tokens}) "
+                f"exceeds max_length={self.config.max_length}")
+        p = self.config.page_size
+        content_pages = -(-t0 // p)
+        if content_pages > self._num_pages - 1:
+            raise ValueError(
+                f"prompt needs {content_pages} KV pages but the pool only "
+                f"has {self._num_pages - 1}")
+        if not self._free:
+            return None
+        slot = self._free[-1]
+        keys: List[bytes] = []
+        shared: List[int] = []
+        if self.registry is not None:
+            keys = PrefixRegistry.block_keys(ids, p)
+            shareable = min(len(keys), (t0 - 1) // p)
+            shared = self.registry.lookup_chain(keys[:shareable])
+        need = content_pages - len(shared)
+        if self.pool.available() < need and self.registry is not None:
+            self.registry.evict_unused(need - self.pool.available())
+        pages = self.pool.alloc(need)
+        if pages is None:
+            for pg in shared:
+                self.pool.decref(pg)
+            return None
+        rid = self._next_id
+        self._next_id += 1
+        if params.seed is not None:
+            key = jax.random.PRNGKey(params.seed)
+        else:
+            key = jax.random.fold_in(self._base_key, rid)
+        cached_len = len(shared) * p
+        row = np.zeros(self._mp, np.int32)
+        row[:len(shared)] = shared
+        row[len(shared):content_pages] = pages
+        self._tables[slot] = row
+        req = Request(req_id=rid, prompt=ids, params=params,
+                      key_np=np.asarray(key),
+                      submit_time=time.perf_counter())
+        if trace:
+            req.trace_id = trace.get("trace_id")
+            req.trace_parent = trace.get("parent_id")
+            req.resubmitted = int(trace.get("resubmits", 0) or 0) > 0
+        req.page_ids = shared + pages
+        req.cached_len = cached_len
+        self.prefix_hit_tokens += cached_len
+        if cached_len:
+            _obs.inc("serving_prefix_hit_tokens", cached_len)
+        if self.registry is not None:
+            for j in range(len(shared), t0 // p):
+                self.registry.register(keys[j], int(row[j]))
+        self._requests[rid] = req
+        self._prefill(req, slot, row, cached_len)
+        self._free.pop()  # _finish may have re-appended it; net correct
+        if req.status == "done":
+            return {"done": self.result(rid)}
+        idx = jnp.asarray(row[:content_pages])
+        out = {
+            "first_token": int(req.tokens[0]),
+            "true_len": t0,
+            "prefill_s": float(req.prefill_s),
+            "pool_dtype": self.config.kv_dtype,
+            "k": np.asarray(jnp.take(self._kc, idx, axis=1)),
+            "v": np.asarray(jnp.take(self._vc, idx, axis=1)),
+        }
+        if self._int8:
+            out["ks"] = np.asarray(jnp.take(self._ksc, idx, axis=1))
+            out["vs"] = np.asarray(jnp.take(self._vsc, idx, axis=1))
+        # detach: the decode engine owns the request from its first token
+        # on. The registry's +1 refs keep this prompt's full blocks
+        # resident for future prefix hits; the request's own refs drop.
+        del self._running[slot]
+        self._tables[slot] = 0
+        self._free.append(slot)
+        req.slot = -1
+        for page in req.page_ids:
+            self.pool.decref(page)
+        req.page_ids = []
+        req.status = "done"
+        self._update_gauges()
+        return out
+
+    def try_import_prefill(self, prompt, params: SamplingParams, kv: dict,
+                           *, trace: Optional[dict] = None) -> Optional[int]:
+        """Adopt a prefill computed on ANOTHER engine: write its exported
+        content pages into this pool and seat the request directly in
+        decode (no local prefill program runs). `kv` is a
+        ``prefill_export`` payload (after any wire codec round trip).
+
+        With a raw wire and matching ``kv_dtype`` the imported pages are
+        bit-identical to a local prefill, so greedy decode matches a
+        unified engine exactly; an int8 wire over a float pool dequantizes
+        on import (trajectory-tolerance territory). Returns the new
+        request id, or ``None`` when no slot or pages are free right now
+        (the caller retries next poll). Raises ValueError on bad requests
+        or a prompt/payload length mismatch.
+        """
+        ids = np.asarray(raw(prompt), dtype=np.int32).reshape(-1)
+        t0 = int(ids.shape[0])
+        if t0 < 1:
+            raise ValueError("empty prompt")
+        if int(kv["true_len"]) != t0:
+            raise ValueError(
+                f"KV payload prefilled {int(kv['true_len'])} tokens but the "
+                f"prompt has {t0}")
+        if t0 + params.max_new_tokens > self.config.max_length:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({params.max_new_tokens}) "
+                f"exceeds max_length={self.config.max_length}")
+        p = self.config.page_size
+        total_pages = -(-(t0 + params.max_new_tokens) // p)
+        if total_pages > self._num_pages - 1:
+            raise ValueError(
+                f"request needs {total_pages} KV pages but the pool only "
+                f"has {self._num_pages - 1}")
+        if not self._free:
+            return None
+        if self.pool.available() < total_pages and self.registry is not None:
+            self.registry.evict_unused(total_pages - self.pool.available())
+        pages = self.pool.alloc(total_pages)
+        if pages is None:
+            return None
+        slot = self._free.pop()
+        content_pages = -(-t0 // p)
+        row = np.zeros(self._mp, np.int32)
+        row[:total_pages] = pages
+        self._tables[slot] = row
+        idx = jnp.asarray(np.asarray(pages[:content_pages], np.int32))
+        k_in, v_in = kv["k"], kv["v"]
+        if self._int8 and "ks" in kv:
+            # int8 source pool -> int8 pool: copy the quantized slabs and
+            # their scales verbatim (bit-equal)
+            self._kc = self._kc.at[:, idx].set(
+                jnp.asarray(k_in, self._kc.dtype))
+            self._vc = self._vc.at[:, idx].set(
+                jnp.asarray(v_in, self._vc.dtype))
+            self._ksc = self._ksc.at[:, idx].set(
+                jnp.asarray(kv["ks"], jnp.float32))
+            self._vsc = self._vsc.at[:, idx].set(
+                jnp.asarray(kv["vs"], jnp.float32))
+        elif self._int8:
+            # float payload into an int8 pool: requantize at the same
+            # per-[page, head, token] granularity _block_page_write uses
+            qk, sk = quantize_absmax(jnp.asarray(k_in, jnp.float32), axis=-1)
+            qv, sv = quantize_absmax(jnp.asarray(v_in, jnp.float32), axis=-1)
+            self._kc = self._kc.at[:, idx].set(qk.astype(self._kc.dtype))
+            self._vc = self._vc.at[:, idx].set(qv.astype(self._vc.dtype))
+            self._ksc = self._ksc.at[:, idx].set(sk[..., 0])
+            self._vsc = self._vsc.at[:, idx].set(sv[..., 0])
+        else:
+            if "ks" in kv:  # int8 source pool -> float pool
+                k_in = dequantize_absmax(
+                    jnp.asarray(k_in), jnp.asarray(kv["ks"])[..., None])
+                v_in = dequantize_absmax(
+                    jnp.asarray(v_in), jnp.asarray(kv["vs"])[..., None])
+            self._kc = self._kc.at[:, idx].set(
+                jnp.asarray(k_in, self._kc.dtype))
+            self._vc = self._vc.at[:, idx].set(
+                jnp.asarray(v_in, self._vc.dtype))
+        rid = self._next_id
+        self._next_id += 1
+        if params.seed is not None:
+            key = jax.random.PRNGKey(params.seed)
+        else:
+            key = jax.random.fold_in(self._base_key, rid)
+        now = time.perf_counter()
+        req = Request(req_id=rid, prompt=ids, params=params,
+                      key_np=np.asarray(key), submit_time=now,
+                      status="running", slot=slot)
+        req.page_ids = list(pages)
+        req.prefill_t0 = now
+        req.prefill_s = float(kv.get("prefill_s", 0.0))
+        req.first_token_time = now
+        if trace:
+            req.trace_id = trace.get("trace_id")
+            req.trace_parent = trace.get("parent_id")
+            req.resubmitted = int(trace.get("resubmits", 0) or 0) > 0
+        if self.registry is not None:
+            keys = PrefixRegistry.block_keys(ids, p)
+            for j in range(t0 // p):
+                self.registry.register(keys[j], int(row[j]))
+        self._requests[rid] = req
+        self._running[slot] = req
+        _obs.inc("serving_requests_total")
+        # the first token was sampled (and counted) on the prefill engine;
+        # _append_token handles the instant-EOS / 1-token budget edge
+        self._append_token(req, int(kv["first_token"]))
+        self._update_gauges()
+        return rid
 
     # -- internals ----------------------------------------------------------
 

@@ -163,6 +163,20 @@ METRICS = {
     "serving_router_request_seconds": (
         "histogram", "Router-side request latency: submit() through result "
                      "harvest (includes queueing, dispatch, decode)"),
+    # -- streaming dataplane (serving/transport.py) --------------------------
+    "serving_transport_frames_total": (
+        "counter", "Frames moved over the streaming router<->worker "
+                   "transport (labels: dir=send|recv, kind=frame tag)"),
+    "serving_transport_bytes_total": (
+        "counter", "Encoded frame bytes on the streaming transport "
+                   "(labels: dir; recv counts land via send on the peer)"),
+    "serving_transport_reconnect_total": (
+        "counter", "Transport client redials after a severed connection "
+                   "(jittered-backoff reconnect path)"),
+    "serving_transport_stream_seconds": (
+        "histogram", "Wire latency of timestamped frames (occ heartbeats, "
+                     "token-stream updates): send wall clock to receive "
+                     "(wall-to-wall, subject to host clock skew)"),
     # -- resharding (distributed/reshard.py) --------------------------------
     "reshard_total": (
         "counter", "Completed reshard operations (labels: what = "
@@ -211,6 +225,7 @@ EVENTS = {
     "serving_router_failover",     # a request was resubmitted off a dead engine
     "serving_router_engine_up",    # router discovered a registered engine
     "serving_router_engine_dead",  # an engine's beat stalled past grace
+    "serving_router_retransmit",   # unacked wire dispatches re-sent + mirrored
 }
 
 
@@ -247,7 +262,17 @@ SPANS = {
         "paddle_tpu/serving/worker.py",
         "Router store write to worker drain, wall-to-wall across "
         "processes (subject to host clock skew; durations elsewhere are "
-        "monotonic)"),
+        "monotonic); emitted only on the legacy store dataplane"),
+    "srv_net_transit": (
+        "paddle_tpu/serving/worker.py",
+        "Router dispatch-frame send to worker drain over the streaming "
+        "transport, wall-to-wall across processes (the dataplane hop "
+        "that replaced srv_store_transit; subject to host clock skew)"),
+    "srv_kv_stream": (
+        "paddle_tpu/serving/worker.py",
+        "Disaggregated prefill handoff: prefill engine's KV-page export "
+        "send through the decode engine's page import, wall-to-wall "
+        "(attrs: rid, pages, wire)"),
     "srv_drain": (
         "paddle_tpu/serving/worker.py",
         "Worker consumed the request record and submitted it to its "

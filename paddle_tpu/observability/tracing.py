@@ -9,7 +9,12 @@ three processes::
     srv_request (router)
       ├─ srv_admit / srv_queue / srv_dispatch      (router)
       ├─ srv_retry                                 (router; failover, retry=True)
-      ├─ srv_store_transit / srv_drain             (worker)
+      ├─ srv_net_transit / srv_drain               (worker; streaming
+      │                                             dataplane — the store
+      │                                             path emits
+      │                                             srv_store_transit)
+      ├─ srv_kv_stream                             (decode worker; only on
+      │                                             disaggregated prefill)
       └─ srv_prefill / srv_decode ── srv_verify    (engine)
 
 and the training side emits single-span trees per compile miss, train
@@ -40,8 +45,10 @@ the correct account of a killed process).
 Timing: durations come from the monotonic ``time.perf_counter`` clock;
 each record also carries a wall-clock start (``ts``) so per-process span
 streams can be merged onto one Perfetto timeline (scripts/trace_report.py).
-Cross-host wall skew shifts tracks, never durations. The one
-cross-process span, ``srv_store_transit``, is wall-to-wall by necessity.
+Cross-host wall skew shifts tracks, never durations. The cross-process
+spans — ``srv_store_transit``/``srv_net_transit`` (dispatch transit) and
+``srv_kv_stream`` (prefill->decode KV handoff) — are wall-to-wall by
+necessity.
 
 This module is dependency-free (stdlib only) and importable straight from
 its file path — ``scripts/trace_report.py`` loads it the way
@@ -70,16 +77,21 @@ _local = threading.local()
 #: registry (trace_spans_total); None keeps this module stdlib-standalone
 _counter_hook = None
 
-#: span name -> report phase for per-request latency attribution
+#: span name -> report phase for per-request latency attribution.
+#: store_transit and net_transit are mutually exclusive per attempt (the
+#: worker emits one or the other depending on which dataplane carried
+#: the dispatch), so their SUM is the request's transit share.
 PHASE_OF = {
     "srv_queue": "queue",
     "srv_store_transit": "store_transit",
+    "srv_net_transit": "net_transit",
+    "srv_kv_stream": "kv_stream",
     "srv_prefill": "prefill",
     "srv_decode": "decode",
     "srv_retry": "failover",
 }
-PHASES = ("queue", "store_transit", "prefill", "decode", "failover",
-          "other")
+PHASES = ("queue", "store_transit", "net_transit", "prefill", "kv_stream",
+          "decode", "failover", "other")
 
 
 def _dir() -> Optional[str]:

@@ -1,25 +1,36 @@
 """Multi-engine serving: tensor-parallel decode engines behind an
-SLO-aware request router.
+SLO-aware request router, on a streaming dataplane.
 
-Two layers (docs/SERVING.md):
+Three layers (docs/SERVING.md):
 
 - Each worker wraps one ``inference.DecodeEngine`` — optionally
   mp-sharded over a device mesh (``EngineConfig.mesh``) so the paged KV
-  pools split across kv heads under GSPMD — and coordinates through the
-  training stack's TCPStore (``serving.protocol`` key schema).
+  pools split across kv heads under GSPMD — registers through the
+  training stack's TCPStore (``serving.protocol`` key schema), and
+  serves its hot path over a persistent transport socket. Workers take a
+  role: ``unified`` (classic), ``prefill`` (export KV pages and stream
+  them to decode workers), or ``decode`` (import streamed prefills).
+- The ``transport`` module is the dataplane: length-prefixed frames over
+  plain TCP carrying dispatches, completion acks, occupancy heartbeats,
+  and KV-page streams, with jittered-backoff reconnects. The store stays
+  the membership/failover ground truth (done-before-ack is a store-write
+  ordering), so losing a socket can never lose a request.
 - The ``Router`` admits requests against a bounded queue with SLO
   classes (shed-lowest-first under overload), places them by
-  least-outstanding-tokens with prefix affinity, and fails over dead
-  engines by resubmitting their unfinished work — bit-equal, because
-  every request carries a router-assigned sampling seed.
+  least-outstanding-tokens with prefix affinity — long prompts go to a
+  prefill worker and stream KV to their decode worker — and fails over
+  dead engines by resubmitting their unfinished work — bit-equal,
+  because every request carries a router-assigned sampling seed.
 """
 from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
                        deadline_guard)
 from .router import Router, RouterConfig, RouterRequest
+from .transport import TransportClient, TransportServer
 from .worker import EngineWorker
 
 __all__ = [
     "Router", "RouterConfig", "RouterRequest", "EngineWorker",
+    "TransportClient", "TransportServer",
     "SLO_CLASSES", "DEFAULT_DEADLINES", "DEFAULT_NAMESPACE",
     "deadline_guard",
 ]

@@ -1,23 +1,37 @@
-"""Router <-> engine-worker wire protocol over the coordination store.
+"""Router <-> engine-worker coordination protocol over the store.
 
-The multi-engine serving plane reuses the SAME TCPStore the training
-stack rendezvouses on (runtime/py_store.py): engine workers register
-under a namespace, publish occupancy beats, and receive requests as
-store keys — no new transport, no new failure modes beyond the ones the
-store hardening (deadlines, idempotent-op retry) already covers.
+The serving plane splits control from data. The coordination store (the
+SAME TCPStore the training stack rendezvouses on, runtime/py_store.py)
+is the GROUND TRUTH for membership and failover: engine workers register
+under a namespace, publish occupancy beats, and persist every finished
+request's ``done`` key there. The per-request hot path — dispatch,
+completion acks, token/KV streams — normally rides the direct streaming
+sockets in ``serving/transport.py`` instead; the ``req`` keys below are
+the legacy store dataplane, kept fully working behind the router's
+``dataplane="store"`` A/B switch and as the fallback when a worker's
+socket drops mid-dispatch. Either way the crash-safety contract is the
+store's: a ``done`` key is written before the occupancy ack, so a dead
+worker's finished work is always harvestable.
 
 Key schema (all under one namespace, default ``__srv``)::
 
     {ns}/count            engine counter: ``add(key, 1) - 1`` is a fresh
                           engine index (race-free discovery — ``add`` is
                           the store's atomic fetch-and-add)
-    {ns}/engine/{i}       registration record of engine index i
+    {ns}/engine/{i}       registration record of engine index i (carries
+                          ``addr`` — the worker's transport listen
+                          address the router dials — plus ``role``:
+                          prefill | decode | unified, and ``kv_wire``)
     {ns}/occ/{name}       occupancy beat of engine `name` (monotone
                           ``beat`` field; a stalled beat past the grace
                           window means the worker is dead)
-    {ns}/req/{name}/{seq} request seq dispatched to engine `name`
-                          (workers consume their stream in seq order and
-                          ack via ``acked_seq`` in the occupancy beat).
+    {ns}/req/{name}/{seq} request seq dispatched to engine `name` on the
+                          legacy store dataplane or the socket-failure
+                          fallback (workers consume their stream in seq
+                          order and ack via ``acked_seq``; the streaming
+                          transport reuses the SAME seq numbering, so a
+                          worker drains wire and store dispatches as one
+                          stream).
                           With telemetry on, the record carries a
                           ``trace`` dict — ``{"trace_id", "parent_id",
                           "resubmits", "dispatch_ts"}`` — next to the

@@ -1,14 +1,28 @@
-"""SLO-aware request router over a fleet of store-registered engines.
+"""SLO-aware, role-aware request router over a fleet of engine workers.
 
 The router is the serving control plane: clients submit prompts tagged
 with an SLO class, the router admits or sheds them against a bounded
 queue, and each ``pump()`` round dispatches queued work to the live
-engine fleet discovered through the coordination store. Placement is
-least-outstanding-tokens — the engine-reported occupancy plus the load
-this router dispatched but the engine has not yet acked — softened by
-prefix affinity: a request whose chain-hashed prompt blocks were last
-served by a particular engine routes back there (reusing that engine's
-paged prefix cache) unless the load skew exceeds the affinity slack.
+engine fleet discovered through the coordination store. On the default
+``streaming`` dataplane the router keeps one persistent transport
+connection per worker (serving/transport.py): dispatches go out as
+batched wire frames, completions and occupancy beats come back on the
+same sockets, and the store is demoted to membership + failover ground
+truth (``dataplane="store"`` keeps the legacy store-key hot path for
+A/B runs; a worker whose socket drops gets its frames re-written as
+store keys, so the wire is never a new way to lose work).
+
+Placement is least-outstanding-tokens — the engine-reported occupancy
+plus the load this router dispatched but the engine has not yet acked —
+softened by prefix affinity: a request whose chain-hashed prompt blocks
+were last served by a particular engine routes back there (reusing that
+engine's paged prefix cache) unless the load skew exceeds the affinity
+slack. Workers registered with ``role="prefill"`` never decode: long
+prompts (``prefill_threshold_tokens``) are placed on the prefill worker
+with the shallowest queue, which streams the finished KV pages straight
+to the chosen decode worker (``kv_to`` in the dispatch record); short
+prompts — and everything, when no prefill worker is registered — take
+the classic unified path.
 
 Overload policy: when the queue is full an incoming request preempts the
 youngest request of a strictly lower SLO class, otherwise it is itself
@@ -17,11 +31,19 @@ shed. Shedding is always explicit — a counter, an event, and a
 deadline) — never a silent drop.
 
 Failover: a worker whose occupancy beat stalls past the grace window is
-declared dead. Its finished work is harvested from ``done`` keys (workers
-write those before acking), and everything else is resubmitted to the
-FRONT of its class queue. Reruns are bit-equal because the router stamps
-every request with an explicit sampling seed at admission, so placement
-is invisible in the token streams (no loss, no duplicates, no drift).
+declared dead (beats ride the wire as heartbeats AND the store as the
+slow mirror; either source only counts when the beat ADVANCES). Its
+finished work is harvested from ``done`` keys (workers write those
+before acking — the wire ``done`` frames are an echo, the store is the
+ground truth), and everything else is resubmitted to the FRONT of its
+class queue. Reruns are bit-equal because the router stamps every
+request with an explicit sampling seed at admission, so placement —
+including a rerun of a disaggregated request as a unified one — is
+invisible in the token streams (no loss, no duplicates, no drift).
+Dispatch frames lost in flight are retransmitted once the worker's
+``acked_seq`` stalls past ``retransmit_s`` (idempotent: workers skip
+consumed seqs), with a store-key write alongside so even a half-open
+socket cannot wedge a request.
 
 This module is the single writer of the ``serving_router_*`` telemetry
 family (scripts/check_observability.py enforces that), and every store
@@ -31,9 +53,9 @@ Tracing: with telemetry enabled the router mints one trace per admitted
 request and owns its router-side spans — ``srv_request`` (the root,
 submit through result), ``srv_admit``, ``srv_queue``, ``srv_dispatch``
 and ``srv_retry`` (failover resubmission windows, retry=True). The trace
-context rides the ``__srv`` request record (protocol.py) so the worker
-and engine continue the same tree; failover reruns attach under the same
-root, never minting a second one.
+context rides the dispatch record (protocol.py) so the worker and engine
+continue the same tree; failover reruns attach under the same root,
+never minting a second one.
 """
 from __future__ import annotations
 
@@ -49,16 +71,24 @@ from ..inference.engine import PrefixRegistry, SamplingParams
 from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
                        deadline_guard, k_ctl, k_done, k_engine, k_occ,
                        k_req, k_count, pack, unpack)
+from .transport import TransportClient
 
 __all__ = ["Router", "RouterConfig", "RouterRequest"]
 
 #: bound on the prefix-affinity LRU (block-key -> engine name entries)
 _AFFINITY_CAP = 65536
 
+#: store-mirror cadence: how often the streaming router re-reads the
+#: store occupancy copies (wire beats are the hot liveness signal)
+_STORE_MIRROR_S = 0.25
+
 
 @dataclass
 class RouterConfig:
     namespace: str = DEFAULT_NAMESPACE
+    #: "streaming" rides persistent transport sockets; "store" is the
+    #: legacy store-key dataplane (kept for A/B benches and fallback)
+    dataplane: str = "streaming"
     #: total queued (not yet dispatched) requests across all SLO classes
     queue_limit: int = 64
     #: seconds from submit before a still-queued request is shed, per class
@@ -75,6 +105,13 @@ class RouterConfig:
     #: prompt block size for affinity chain hashes — match the engines'
     #: page_size or affinity keys never line up with their prefix caches
     page_size: int = 16
+    #: prompts at least this long go to a prefill-role worker (when one
+    #: is alive) and stream their KV pages to the decode worker; shorter
+    #: prompts prefill where they decode
+    prefill_threshold_tokens: int = 64
+    #: seconds of acked_seq stall before unacked wire dispatches are
+    #: retransmitted (and mirrored to the store)
+    retransmit_s: float = 1.0
     #: base of the per-request sampling seeds the router assigns so
     #: reruns after failover are bit-equal on any engine
     seed: int = 0
@@ -98,6 +135,13 @@ class RouterRequest:
     finish_t: Optional[float] = None
     resubmits: int = 0
     trace_id: Optional[str] = None
+    #: disaggregated path: name of the prefill engine streaming KV pages
+    #: to ``engine`` (None on the unified path)
+    kv_from: Optional[str] = None
+    #: engine whose seq stream carries this dispatch (the prefill engine
+    #: for disaggregated requests) + the wire record for retransmits
+    wire_engine: Optional[str] = None
+    wire_rec: Optional[dict] = None
 
 
 @dataclass
@@ -113,9 +157,27 @@ class _EngineState:
     harvested_done: int = -1
     last_change: float = 0.0
     alive: bool = True
-    #: rid -> RouterRequest, dispatch order (oldest first)
+    #: rid -> RouterRequest, dispatch order (oldest first). Disaggregated
+    #: requests appear in BOTH their prefill and decode engine's map
+    #: until the relay/done frame retires them.
     inflight: "OrderedDict[int, RouterRequest]" = field(
         default_factory=OrderedDict)
+    #: streaming dataplane: persistent connection to this worker
+    link: Optional[TransportClient] = None
+    #: link.reconnects value the last hello was sent on (-1 = never)
+    hello_sent: int = -1
+    #: dispatch records built this pump, flushed as one batched frame
+    outbox: List[dict] = field(default_factory=list)
+    #: monotonic stamp of the last ack progress (retransmit timer)
+    last_ack_t: float = 0.0
+
+    @property
+    def role(self) -> str:
+        return self.record.get("role", "unified")
+
+    @property
+    def addr(self) -> Optional[str]:
+        return self.record.get("addr")
 
 
 class Router:
@@ -130,6 +192,10 @@ class Router:
         for cls in config.deadlines:
             if cls not in SLO_CLASSES:
                 raise ValueError(f"unknown SLO class {cls!r}")
+        if config.dataplane not in ("streaming", "store"):
+            raise ValueError(
+                f"dataplane must be streaming|store, got "
+                f"{config.dataplane!r}")
         self.config = config
         self._store = store
         self._ns = config.namespace
@@ -140,12 +206,18 @@ class Router:
         self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
         self._next_rid = 0
         self._known_engines = 0
+        self._last_occ_read = -float("inf")
         #: rid -> open span handles ("root", "queue", "retry"); entries
         #: exist only while telemetry is on and the request is unresolved
         self._tspans: Dict[int, dict] = {}
         self.counters = {"submitted": 0, "done": 0, "failed": 0, "shed": 0,
                          "dispatched": 0, "failover_resubmits": 0,
-                         "affinity_hits": 0, "engines_lost": 0}
+                         "affinity_hits": 0, "engines_lost": 0,
+                         "retransmits": 0, "disagg_dispatches": 0}
+
+    @property
+    def _streaming(self) -> bool:
+        return self.config.dataplane == "streaming"
 
     # -- admission -----------------------------------------------------------
 
@@ -250,18 +322,73 @@ class Router:
                     return  # registration record not written yet; retry
                 record = unpack(self._store.get(key))
             est = _EngineState(name=record["name"], index=idx, record=record,
-                               last_change=time.monotonic())
+                               last_change=time.monotonic(),
+                               last_ack_t=time.monotonic())
+            if self._streaming and record.get("addr"):
+                # fail-soft dial: a worker that listens but is not yet
+                # polling still accepts (backlog); a dead addr backs off
+                est.link = TransportClient(record["addr"],
+                                           seed=self.config.seed)
             self._engines[est.name] = est
             self._by_index[idx] = est
             self._known_engines = idx + 1
-            _obs.event("serving_router_engine_up", name=est.name, index=idx)
+            _obs.event("serving_router_engine_up", name=est.name, index=idx,
+                       role=est.role)
             _obs.set_gauge("serving_router_engines", self._alive_count())
 
     def _alive_count(self) -> int:
         return sum(1 for e in self._engines.values() if e.alive)
 
+    def _apply_occ(self, est: _EngineState, occ: dict, now: float):
+        """Adopt an occupancy beat from EITHER source. Only an ADVANCING
+        beat refreshes liveness — the slow store mirror lags the wire, and
+        a stale copy must never resurrect a silent worker."""
+        beat = int(occ.get("beat", -1))
+        if beat <= est.beat:
+            return
+        est.beat = beat
+        est.occ = occ
+        est.acked_seq = int(occ.get("acked_seq", 0))
+        est.last_change = now
+
+    def _pump_wire(self):
+        """Streaming dataplane intake: (re)introduce ourselves after
+        (re)connects, then drain every worker connection — occupancy
+        heartbeats, completion echoes, and prefill->decode relay
+        notices."""
+        if not self._streaming:
+            return
+        now = time.monotonic()
+        for est in self._engines.values():
+            link = est.link
+            if link is None:
+                continue
+            if link.connected() and est.hello_sent != link.reconnects:
+                if link.send({"t": "hello", "peer": "router",
+                              "name": "router"}):
+                    est.hello_sent = link.reconnects
+            for frame in link.poll():
+                t = frame.get("t")
+                if t == "occ":
+                    if est.alive:
+                        self._apply_occ(est, frame.get("occ", {}), now)
+                elif t == "done":
+                    for rec in frame.get("recs", ()):
+                        self._finish_from_wire(rec)
+                elif t == "relay":
+                    # the prefill engine handed these rids' KV pages to
+                    # their decode engine; the decode side owns them now
+                    for rid in frame.get("rids", ()):
+                        est.inflight.pop(rid, None)
+
     def _read_occupancy(self):
         now = time.monotonic()
+        if self._streaming:
+            # the wire carries the hot beats; the store copy is only the
+            # failover mirror and needs no more than the mirror cadence
+            if now - self._last_occ_read < _STORE_MIRROR_S:
+                return
+        self._last_occ_read = now
         for est in self._engines.values():
             if not est.alive:
                 continue
@@ -270,11 +397,7 @@ class Router:
                 if not self._store.check(key):
                     continue
                 occ = unpack(self._store.get(key))
-            if int(occ.get("beat", -1)) != est.beat:
-                est.beat = int(occ.get("beat", -1))
-                est.occ = occ
-                est.acked_seq = int(occ.get("acked_seq", 0))
-                est.last_change = now
+            self._apply_occ(est, occ, now)
 
     def _failover_dead(self):
         now = time.monotonic()
@@ -293,7 +416,7 @@ class Router:
             # the FRONT of their class queues so failover does not add
             # queueing delay on top of the rerun
             resubmit = []
-            for rid, req in est.inflight.items():
+            for rid, req in list(est.inflight.items()):
                 with deadline_guard("harvest results"):
                     finished = self._store.check(k_done(self._ns, rid))
                 if finished:
@@ -302,9 +425,16 @@ class Router:
                     resubmit.append(req)
             est.inflight.clear()
             for req in reversed(resubmit):
+                # a disaggregated request dies with EITHER of its engines:
+                # drop it from the partner's book too and rerun from
+                # scratch (fresh prefill — bit-equal, the seed is explicit)
+                self._resolve_inflight(req.rid)
                 req.status = "queued"
                 req.engine = None
                 req.seq = -1
+                req.kv_from = None
+                req.wire_engine = None
+                req.wire_rec = None
                 req.resubmits += 1
                 self._queues[req.slo].appendleft(req)
                 self.counters["failover_resubmits"] += 1
@@ -322,9 +452,14 @@ class Router:
 
     # -- results -------------------------------------------------------------
 
-    def _finish_from_store(self, req: RouterRequest):
-        with deadline_guard("harvest results"):
-            rec = unpack(self._store.get(k_done(self._ns, req.rid)))
+    def _resolve_inflight(self, rid: int):
+        """Drop a rid from every engine's book (a disaggregated request
+        is tracked on both its prefill and decode engine)."""
+        for est in self._engines.values():
+            est.inflight.pop(rid, None)
+
+    def _finish_with(self, req: RouterRequest, rec: dict):
+        self._resolve_inflight(req.rid)
         req.finish_t = time.perf_counter()
         if "error" in rec:
             req.status = "failed"
@@ -344,6 +479,21 @@ class Router:
             _obs.end_span(t["root"], status=req.status, engine=req.engine,
                           resubmits=req.resubmits)
 
+    def _finish_from_store(self, req: RouterRequest):
+        with deadline_guard("harvest results"):
+            rec = unpack(self._store.get(k_done(self._ns, req.rid)))
+        self._finish_with(req, rec)
+
+    def _finish_from_wire(self, rec: dict):
+        """A ``done`` frame: trust it directly — the worker wrote the
+        store key BEFORE sending it (done-before-ack), so acting on the
+        echo can never outrun the ground truth. Late echoes for requests
+        already resolved (or resubmitted after a failover) are dropped."""
+        req = self._requests.get(rec.get("rid"))
+        if req is None or req.status != "dispatched":
+            return
+        self._finish_with(req, rec)
+
     def _harvest_done(self):
         for est in self._engines.values():
             if not est.inflight:
@@ -357,12 +507,14 @@ class Router:
                 continue
             est.harvested_done = reported
             for rid, req in list(est.inflight.items()):
+                if req.status != "dispatched":
+                    est.inflight.pop(rid, None)
+                    continue
                 with deadline_guard("harvest results"):
                     finished = self._store.check(k_done(self._ns, rid))
                 if not finished:
                     continue
                 self._finish_from_store(req)
-                del est.inflight[rid]
 
     # -- placement -----------------------------------------------------------
 
@@ -374,17 +526,26 @@ class Router:
     def _load_tokens(self, est: _EngineState) -> int:
         """Outstanding tokens the engine reported, plus dispatched work it
         has not acked yet (seq >= acked_seq) so burst dispatches between
-        beats don't all pile onto the same engine."""
+        beats don't all pile onto the same engine. A request whose KV
+        pages are streaming in from a prefill engine counts until it
+        finishes — the decode engine's own occupancy may not see it yet
+        (deliberate over-estimate; it errs toward spreading load)."""
         load = int(est.occ.get("outstanding_tokens", 0))
         for req in est.inflight.values():
-            if req.seq >= est.acked_seq:
-                load += len(req.prompt) + req.params.max_new_tokens
+            cost = len(req.prompt) + req.params.max_new_tokens
+            if req.kv_from is not None:
+                if req.engine == est.name:
+                    load += cost
+            elif req.seq >= est.acked_seq:
+                load += cost
         return load
 
     def _pick_engine(self, req: RouterRequest):
-        """(engine, via_affinity) or (None, False) when no capacity."""
+        """(decode-capable engine, via_affinity) or (None, False) when no
+        capacity. Prefill-role workers never decode and are excluded."""
         candidates = [e for e in self._engines.values()
-                      if e.alive and len(e.inflight) < self._engine_cap(e)]
+                      if e.alive and e.role != "prefill"
+                      and len(e.inflight) < self._engine_cap(e)]
         if not candidates:
             return None, False
         loads = {e.name: self._load_tokens(e) for e in candidates}
@@ -404,14 +565,37 @@ class Router:
             break
         return best, False
 
-    def _dispatch_one(self, req: RouterRequest, est: _EngineState,
-                      via_affinity: bool = False):
+    def _prefill_load(self, est: _EngineState) -> int:
+        """Prefill placement signal: reported queue depth + handoffs
+        dispatched but not yet acked."""
+        load = int(est.occ.get("prefill_queue", 0))
+        load += sum(1 for r in est.inflight.values()
+                    if r.kv_from == est.name and r.seq >= est.acked_seq)
+        return load
+
+    def _pick_prefill(self, req: RouterRequest) -> Optional[_EngineState]:
+        """Shallowest-queue live prefill worker, or None (unified path).
+        Only the streaming dataplane can carry the KV stream."""
+        if (not self._streaming
+                or len(req.prompt) < self.config.prefill_threshold_tokens):
+            return None
+        candidates = [e for e in self._engines.values()
+                      if e.alive and e.role == "prefill"
+                      and len(e.inflight) < self._engine_cap(e)]
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda e: (self._prefill_load(e), e.index))
+
+    def _build_rec(self, req: RouterRequest, est: _EngineState,
+                   via_affinity: bool) -> dict:
+        """Dispatch record on ``est``'s seq stream + the dispatch span.
+        Shared by the unified and disaggregated paths."""
         req.seq = est.next_seq
         est.next_seq += 1
         rec = {"rid": req.rid, "prompt": req.prompt.tolist(),
                "params": asdict(req.params)}
         t = self._tspans.get(req.rid)
-        dh = None
         if t:
             root = t["root"]
             for k in ("queue", "retry"):
@@ -423,26 +607,66 @@ class Router:
                 parent_id=root.span_id, engine=est.name, seq=req.seq,
                 retry=req.resubmits > 0, affinity=via_affinity)
             # cross-process context: worker + engine continue this trace
-            # (dispatch_ts is WALL clock — the worker closes the
-            # srv_store_transit span against it)
+            # (dispatch_ts is WALL clock — the worker closes the transit
+            # span against it)
             rec["trace"] = {"trace_id": root.trace_id,
                             "parent_id": root.span_id,
                             "resubmits": req.resubmits,
                             "dispatch_ts": time.time()}
+            _obs.end_span(dh)
+        return rec
+
+    def _enqueue_rec(self, est: _EngineState, rec: dict,
+                     req: RouterRequest):
+        """Hand the record to the dataplane: wire outbox (flushed as one
+        batched frame per engine per pump) or a store key on the legacy
+        path."""
+        if self._streaming and est.link is not None:
+            rec["seq"] = req.seq
+            req.wire_engine = est.name
+            req.wire_rec = rec
+            est.outbox.append(rec)
+            return
         with deadline_guard("dispatch request"):
             self._store.set(k_req(self._ns, est.name, req.seq), pack(rec))
-        if dh:
-            _obs.end_span(dh)
+
+    def _note_affinity(self, req: RouterRequest, name: str):
+        for key in req.block_keys:
+            self._affinity[key] = name
+            self._affinity.move_to_end(key)
+        while len(self._affinity) > _AFFINITY_CAP:
+            self._affinity.popitem(last=False)
+
+    def _dispatch_one(self, req: RouterRequest, est: _EngineState,
+                      via_affinity: bool = False):
+        rec = self._build_rec(req, est, via_affinity)
+        self._enqueue_rec(est, rec, req)
         req.status = "dispatched"
         req.engine = est.name
         est.inflight[req.rid] = req
         self.counters["dispatched"] += 1
         _obs.inc("serving_router_dispatch_total")
-        for key in req.block_keys:
-            self._affinity[key] = est.name
-            self._affinity.move_to_end(key)
-        while len(self._affinity) > _AFFINITY_CAP:
-            self._affinity.popitem(last=False)
+        self._note_affinity(req, est.name)
+
+    def _dispatch_disagg(self, req: RouterRequest, pe: _EngineState,
+                         de: _EngineState, via_affinity: bool):
+        """Disaggregated placement: the record rides the PREFILL engine's
+        seq stream and names the decode target (``kv_to``); the request is
+        booked on both engines until the relay frame retires the prefill
+        side. Affinity follows the decode engine — that is where the KV
+        pages (and the registered prefix blocks) land."""
+        rec = self._build_rec(req, pe, via_affinity)
+        rec["kv_to"] = {"addr": de.addr, "name": de.name}
+        req.kv_from = pe.name
+        self._enqueue_rec(pe, rec, req)
+        req.status = "dispatched"
+        req.engine = de.name
+        pe.inflight[req.rid] = req
+        de.inflight[req.rid] = req
+        self.counters["dispatched"] += 1
+        self.counters["disagg_dispatches"] += 1
+        _obs.inc("serving_router_dispatch_total")
+        self._note_affinity(req, de.name)
 
     def _dispatch(self):
         now = time.perf_counter()
@@ -456,22 +680,84 @@ class Router:
                     continue
                 est, via_affinity = self._pick_engine(req)
                 if est is None:
+                    self._flush_outboxes()
                     return  # fleet saturated; lower classes wait too
                 queue.popleft()
                 if via_affinity:
                     self.counters["affinity_hits"] += 1
                     _obs.inc("serving_router_affinity_hits_total")
-                self._dispatch_one(req, est, via_affinity)
+                pe = self._pick_prefill(req)
+                if pe is not None:
+                    self._dispatch_disagg(req, pe, est, via_affinity)
+                else:
+                    self._dispatch_one(req, est, via_affinity)
+        self._flush_outboxes()
         _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
+
+    def _flush_outboxes(self):
+        """One batched dispatch frame per engine per pump. A failed send
+        falls back to store keys for the SAME seqs — the worker merges
+        both sources by seq, so the fallback is ordering-safe and
+        idempotent."""
+        for est in self._engines.values():
+            if not est.outbox:
+                continue
+            batch, est.outbox = est.outbox, []
+            if est.link is not None and est.link.send(
+                    {"t": "dispatch", "reqs": batch}):
+                continue
+            for rec in batch:
+                with deadline_guard("dispatch request"):
+                    self._store.set(k_req(self._ns, est.name, rec["seq"]),
+                                    pack(rec))
+
+    def _retransmit(self):
+        """Re-send wire dispatches a worker has not acked within
+        ``retransmit_s`` — and mirror them to the store, so even a
+        half-open socket (sends 'succeed', peer never sees them) cannot
+        wedge a request. Idempotent end to end: workers skip seqs below
+        their consume cursor, and the store key for a consumed seq is
+        never re-read."""
+        if not self._streaming:
+            return
+        now = time.monotonic()
+        for est in self._engines.values():
+            if not est.alive:
+                continue
+            unacked = [r for r in est.inflight.values()
+                       if r.wire_engine == est.name and r.wire_rec is not None
+                       and r.seq >= est.acked_seq
+                       and r.status == "dispatched"]
+            if not unacked:
+                est.last_ack_t = now
+                continue
+            if now - est.last_ack_t < self.config.retransmit_s:
+                continue
+            est.last_ack_t = now
+            unacked.sort(key=lambda r: r.seq)
+            recs = [r.wire_rec for r in unacked]
+            self.counters["retransmits"] += len(recs)
+            _obs.event("serving_router_retransmit", engine=est.name,
+                       seqs=[r.seq for r in unacked])
+            if est.link is not None:
+                est.link.send({"t": "dispatch", "reqs": recs})
+            for rec in recs:
+                with deadline_guard("dispatch request"):
+                    self._store.set(k_req(self._ns, est.name, rec["seq"]),
+                                    pack(rec))
 
     # -- driving -------------------------------------------------------------
 
     def pump(self):
-        """One scheduling round: discover new engines, refresh occupancy,
-        fail over dead workers, harvest finished results, dispatch."""
+        """One scheduling round: discover new engines, drain the wire,
+        refresh the store occupancy mirror, fail over dead workers,
+        retransmit stalled dispatches, harvest finished results,
+        dispatch."""
         self._discover()
+        self._pump_wire()
         self._read_occupancy()
         self._failover_dead()
+        self._retransmit()
         self._harvest_done()
         self._dispatch()
         _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
@@ -496,6 +782,9 @@ class Router:
         """Broadcast stop to every worker polling this namespace."""
         with deadline_guard("broadcast stop"):
             self._store.set(k_ctl(self._ns), pack({"stop": True}))
+        for est in self._engines.values():
+            if est.link is not None:
+                est.link.close()
 
     # -- inspection ----------------------------------------------------------
 

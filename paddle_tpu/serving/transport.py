@@ -1,0 +1,396 @@
+"""Streaming dataplane: persistent router <-> worker sockets.
+
+PR 10's tracing measured the store dataplane at 77-88% of per-request
+latency (``store_transit`` share in BENCH_SERVING.json): every dispatch
+and every completion paid multiple coordination-store round trips. This
+module moves the DATA onto direct TCP connections and demotes the store
+to what it is good at — membership and failover ground truth.
+
+Wire format: length-prefixed pickled frames (``struct.pack(">I", n)`` +
+``protocol.pack``; the store wire already trusts same-job pickles, this
+is the same trust domain over a different socket). Frames are dicts with
+a ``t`` tag:
+
+    hello     {"t","peer","name"}            connection identification
+    dispatch  {"t","reqs":[rec,...]}         batched request records; each
+                                             rec carries its engine-stream
+                                             ``seq`` so the worker consumes
+                                             in order and duplicates
+                                             (retransmits) are skipped
+    occ       {"t","occ":{...},"ts"}         occupancy beat riding the same
+                                             connection (heartbeat)
+    done      {"t","recs":[...]}             completed token streams; ALWAYS
+                                             written to the store first
+                                             (done-before-ack invariant)
+    stream    {"t","updates":[(rid,n)],"ts"} incremental token counts
+    relay     {"t","rids":[...]}             prefill->router: KV pages of
+                                             these rids were handed to their
+                                             decode engine
+    kv        {"t","rid","rec",...}          prefill->decode KV-page stream
+                                             (``encode_kv``/``decode_kv``)
+
+Failure model: frames are best-effort; a lost ``dispatch`` is retransmitted
+by the router when the worker's acked_seq stalls (idempotent — workers skip
+seqs already consumed), a lost ``done``/``occ`` is recovered from the store
+ground truth, and a lost ``kv`` falls back to router failover (re-dispatch
+reruns the prefill bit-equal, seeds are explicit). Reconnects use jittered
+exponential backoff so a restarted worker is not dialed in lockstep.
+
+Every socket send/recv sits under ``protocol.deadline_guard`` —
+``scripts/check_robustness.py`` rule 5 enforces it statically, the same
+discipline rule 4 applies to store ops. Chaos (PADDLE_CHAOS_NET_MODE)
+injects drop/half_open/latency faults at the send fences.
+
+This module is the single writer of the ``serving_transport_*`` metric
+family (scripts/check_observability.py enforces that).
+"""
+from __future__ import annotations
+
+import select
+import socket
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import observability as _obs
+from ..testing import chaos
+from .protocol import deadline_guard, pack, unpack
+
+__all__ = [
+    "TransportServer", "TransportClient", "FrameDecoder",
+    "encode_frame", "encode_kv", "decode_kv",
+]
+
+_HDR = struct.Struct(">I")
+
+#: per-process frame-send counter, the chaos net_fence index — a soak can
+#: target "the Nth frame this process sends" deterministically
+_send_index = 0
+
+#: jittered-backoff bounds for client redials (seconds)
+_BACKOFF_MIN = 0.05
+_BACKOFF_MAX = 2.0
+
+#: blocking-op timeout: sends and dials must fail fast, the deadline
+#: guard above them is the watchdog of last resort
+_IO_TIMEOUT = 5.0
+
+
+def encode_frame(frame: dict) -> bytes:
+    """Length-prefixed pickled frame, ready for one sendall."""
+    payload = pack(frame)
+    return _HDR.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental decoder: feed raw bytes, get whole frames out."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[dict]:
+        self._buf.extend(data)
+        frames = []
+        while True:
+            if len(self._buf) < _HDR.size:
+                return frames
+            (n,) = _HDR.unpack(self._buf[:_HDR.size])
+            if len(self._buf) < _HDR.size + n:
+                return frames
+            payload = bytes(self._buf[_HDR.size:_HDR.size + n])
+            del self._buf[:_HDR.size + n]
+            frames.append(unpack(payload))
+
+
+def _count(direction: str, kind: str, nbytes: int):
+    _obs.inc("serving_transport_frames_total", dir=direction, kind=kind)
+    _obs.inc("serving_transport_bytes_total", nbytes, dir=direction)
+
+
+def _observe_latency(frame: dict):
+    """Wire latency of heartbeat-class frames that carry a send wall
+    clock (occ/stream) — the streaming dataplane's transit histogram.
+    Wall-to-wall, so host clock skew shifts it like srv_net_transit."""
+    ts = frame.get("ts")
+    if isinstance(ts, (int, float)):
+        _obs.observe("serving_transport_stream_seconds",
+                     max(time.time() - float(ts), 0.0))
+
+
+def _send_on(raw_sock, frame: dict, what: str) -> bool:
+    """Send one frame on a connected socket; chaos net fence first.
+    Returns True when the frame was delivered to the kernel (half_open
+    pretends success — the silently-swallowed-frame fault). Raises
+    OSError on a dead peer (and ConnectionError on a chaos drop) so the
+    caller can tear down and reconnect."""
+    global _send_index
+    idx = _send_index
+    _send_index += 1
+    action = chaos.net_fence(idx)
+    if action == "half_open":
+        return True  # swallowed: peer never sees it, sender thinks it did
+    if action == "drop":
+        raise ConnectionResetError("chaos net_drop severed the connection")
+    data = encode_frame(frame)
+    with deadline_guard(what):
+        raw_sock.sendall(data)
+    _count("send", str(frame.get("t")), len(data))
+    return True
+
+
+def _drain_sock(raw_sock, decoder: FrameDecoder, what: str) -> Optional[List[dict]]:
+    """Read everything currently available; None means the peer closed
+    (or errored) and the connection must be dropped."""
+    frames: List[dict] = []
+    while True:
+        with deadline_guard(what):
+            ready, _, _ = select.select([raw_sock], [], [], 0.0)
+            if not ready:
+                break
+            try:
+                data = raw_sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                return None
+        if not data:
+            return None
+        for fr in decoder.feed(data):
+            _count("recv", str(fr.get("t")), 0)
+            _observe_latency(fr)
+            frames.append(fr)
+    return frames
+
+
+class TransportServer:
+    """Worker-side listener: accepts router/peer connections, drains
+    frames from all of them, and can address replies by connection id."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        listen_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listen_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        with deadline_guard("transport listen"):
+            listen_sock.bind((host, port))
+            listen_sock.listen(16)
+        listen_sock.setblocking(False)
+        self._listen_sock = listen_sock
+        self._host, self._port = listen_sock.getsockname()[:2]
+        self._conns: Dict[int, socket.socket] = {}
+        self._decoders: Dict[int, FrameDecoder] = {}
+        self._next_conn = 0
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def conn_ids(self) -> List[int]:
+        return list(self._conns)
+
+    def _accept(self):
+        while True:
+            with deadline_guard("transport accept"):
+                try:
+                    conn_sock, _ = self._listen_sock.accept()
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError:
+                    return
+            conn_sock.settimeout(_IO_TIMEOUT)
+            conn_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            cid = self._next_conn
+            self._next_conn += 1
+            self._conns[cid] = conn_sock
+            self._decoders[cid] = FrameDecoder()
+
+    def poll(self) -> List[Tuple[int, dict]]:
+        """Accept pending connections and drain every readable one.
+        Returns (conn_id, frame) pairs in arrival order per connection."""
+        self._accept()
+        out: List[Tuple[int, dict]] = []
+        for cid in list(self._conns):
+            frames = _drain_sock(self._conns[cid], self._decoders[cid],
+                                 "transport recv")
+            if frames is None:
+                self._drop(cid)
+                continue
+            out.extend((cid, fr) for fr in frames)
+        return out
+
+    def send(self, conn_id: int, frame: dict) -> bool:
+        """Best-effort send to one connection; a dead peer drops the
+        connection and returns False (the router ground-truths through
+        the store, so nothing is lost — only late)."""
+        conn_sock = self._conns.get(conn_id)
+        if conn_sock is None:
+            return False
+        try:
+            return _send_on(conn_sock, frame, "transport send")
+        except OSError:
+            self._drop(conn_id)
+            return False
+
+    def _drop(self, conn_id: int):
+        conn_sock = self._conns.pop(conn_id, None)
+        self._decoders.pop(conn_id, None)
+        if conn_sock is not None:
+            try:
+                conn_sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        for cid in list(self._conns):
+            self._drop(cid)
+        try:
+            self._listen_sock.close()
+        except OSError:
+            pass
+
+
+class TransportClient:
+    """Dialer side (router->worker, prefill->decode): one persistent
+    connection with jittered-backoff reconnect. ``send``/``poll`` never
+    raise on a dead peer — they fail soft and schedule a redial, because
+    liveness decisions belong to the router's beat-staleness failover,
+    not to the transport."""
+
+    def __init__(self, addr: str, seed: int = 0):
+        host, port = addr.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._sock: Optional[socket.socket] = None
+        self._decoder = FrameDecoder()
+        # deterministic jitter stream per client (seeded by the target
+        # port by default) so backoff schedules are reproducible in soaks
+        import random as _random
+        self._jitter = _random.Random((seed << 16) ^ self._port)
+        self._backoff = _BACKOFF_MIN
+        self._next_dial = 0.0
+        self.reconnects = 0
+        self._ever_connected = False
+        self._dial()
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _dial(self) -> bool:
+        now = time.monotonic()
+        if now < self._next_dial:
+            return False
+        try:
+            with deadline_guard("transport dial"):
+                dial_sock = socket.create_connection(
+                    (self._host, self._port), timeout=_IO_TIMEOUT)
+            dial_sock.settimeout(_IO_TIMEOUT)
+            dial_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = dial_sock
+            self._decoder = FrameDecoder()
+            self._backoff = _BACKOFF_MIN
+            if self._ever_connected:
+                self.reconnects += 1
+                _obs.inc("serving_transport_reconnect_total")
+            self._ever_connected = True
+            return True
+        except OSError:
+            # jittered exponential backoff: reconnect storms from a fleet
+            # of routers must not land on a restarted worker in lockstep
+            delay = self._backoff * (0.5 + self._jitter.random())
+            self._backoff = min(self._backoff * 2.0, _BACKOFF_MAX)
+            self._next_dial = now + delay
+            self._sock = None
+            return False
+
+    def _teardown(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._next_dial = 0.0  # redial immediately on the next op
+
+    def send(self, frame: dict) -> bool:
+        if self._sock is None and not self._dial():
+            return False
+        try:
+            return _send_on(self._sock, frame, "transport send")
+        except OSError:
+            self._teardown()
+            return False
+
+    def poll(self) -> List[dict]:
+        if self._sock is None:
+            self._dial()
+            return []
+        frames = _drain_sock(self._sock, self._decoder, "transport recv")
+        if frames is None:
+            self._teardown()
+            return []
+        return frames
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# KV-page wire codec (the int8 frame slice of ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+def encode_kv(k: np.ndarray, v: np.ndarray, wire: str,
+              k_scale: Optional[np.ndarray] = None,
+              v_scale: Optional[np.ndarray] = None) -> dict:
+    """Encode exported KV pages ``[L, n, Hkv, P, D]`` for the wire.
+
+    ``raw`` ships the pool bytes untouched (bit-equal contract; an int8
+    POOL's pages travel with their scale slabs, still bit-equal). ``int8``
+    quantizes f32/bf16 pages with one absmax scale per ``[layer, page,
+    head]`` (axis=(-2,-1) — the whole page row of a head shares a scale,
+    matching the EQuARX-style coarse-grained wire) via the same
+    ``quantize_absmax`` the dp gradient wire uses. Pages already int8
+    (int8 pool) pass through raw — re-quantizing quantized bytes only
+    loses bits.
+    """
+    if wire not in ("raw", "int8"):
+        raise ValueError(f"kv wire must be raw|int8, got {wire!r}")
+    if wire == "int8" and k.dtype != np.int8:
+        from ..distributed.grad_comm import quantize_absmax
+
+        qk, sk = quantize_absmax(k, axis=(-2, -1))
+        qv, sv = quantize_absmax(v, axis=(-2, -1))
+        return {"wire": "int8", "dtype": str(k.dtype),
+                "k": np.asarray(qk, np.int8), "v": np.asarray(qv, np.int8),
+                "k_scale": np.asarray(sk, np.float32),
+                "v_scale": np.asarray(sv, np.float32)}
+    payload = {"wire": "raw", "dtype": str(k.dtype),
+               "k": np.asarray(k), "v": np.asarray(v)}
+    if k_scale is not None:
+        payload["k_scale"] = np.asarray(k_scale, np.float32)
+        payload["v_scale"] = np.asarray(v_scale, np.float32)
+    return payload
+
+
+def decode_kv(payload: dict) -> dict:
+    """Inverse of ``encode_kv``: raw passes through bit-identical;
+    int8-wire dequantizes back to the export dtype. Returns
+    ``{"k", "v"}`` (+ pool scale slabs for raw int8-pool pages)."""
+    if payload["wire"] == "int8":
+        from ..distributed.grad_comm import dequantize_absmax
+
+        k = np.asarray(dequantize_absmax(payload["k"], payload["k_scale"]))
+        v = np.asarray(dequantize_absmax(payload["v"], payload["v_scale"]))
+        return {"k": k, "v": v}
+    out = {"k": payload["k"], "v": payload["v"]}
+    if "k_scale" in payload:
+        out["k_scale"] = payload["k_scale"]
+        out["v_scale"] = payload["v_scale"]
+    return out

@@ -1,18 +1,35 @@
-"""Engine worker: one DecodeEngine behind the coordination store.
+"""Engine worker: one DecodeEngine behind the streaming dataplane.
 
-Registers in the store under the serving namespace (a race-free index
-from the atomic ``add`` counter), then loops: drain dispatched requests
-into the engine, advance the scheduler one step (with a chaos
+Registers in the coordination store under the serving namespace (a
+race-free index from the atomic ``add`` counter) — the registration
+record carries the worker's transport listen address, its ``role``
+(``unified`` | ``prefill`` | ``decode``) and its KV wire codec — then
+loops: drain dispatched requests (direct ``dispatch`` frames from the
+router's persistent socket, with the legacy store keys as the A/B and
+socket-failure fallback), advance the scheduler one step (with a chaos
 ``engine_fence`` so soaks can SIGKILL it mid-decode), publish finished
-token streams, and publish an occupancy beat. The router
-(serving/router.py) never talks to the worker directly — everything
-rides store keys, so a worker death is detected by beat staleness and
-its unfinished work is resubmitted elsewhere.
+token streams, and publish an occupancy beat. Occupancy rides the SAME
+socket as the data (the heartbeat) and is mirrored to the store at a
+slow cadence — the store stays the membership/failover ground truth,
+but per-request latency no longer pays store round trips.
 
-Crash-safety ordering: a request's ``done`` key is written BEFORE the
-occupancy beat that acks it, so failover can harvest everything a dead
-engine finished; anything not harvested is re-run bit-equal (the router
-assigns every request an explicit sampling seed — the engine's implicit
+Roles (disaggregated prefill/decode):
+
+* ``unified`` — classic worker: local prefill + decode per request.
+* ``prefill`` — dispatch records arrive with a ``kv_to`` target; the
+  worker runs ``engine.prefill_export`` and streams the finished KV
+  pages (``transport.encode_kv``) straight to the target decode worker,
+  then tells the router via a ``relay`` frame. It never decodes.
+* ``decode`` — imports streamed KV pages (``engine.try_import_prefill``,
+  bit-equal to a local prefill on the raw wire) and decodes; it also
+  accepts direct dispatches (short prompts skip disaggregation).
+
+Crash-safety ordering is UNCHANGED from the store dataplane: a request's
+``done`` key is written to the STORE before the occupancy beat that acks
+it — wire ``done`` frames are a latency optimization on top, not the
+ground truth — so failover can harvest everything a dead engine
+finished; anything not harvested is re-run bit-equal (the router assigns
+every request an explicit sampling seed — the engine's implicit
 ``fold_in(base_key, local_rid)`` default would differ across engines).
 
 Run standalone (the bench and chaos soaks spawn this)::
@@ -29,6 +46,7 @@ from __future__ import annotations
 import os
 import sys
 import time
+from collections import deque
 from typing import Dict, Optional
 
 import numpy as np
@@ -38,21 +56,38 @@ from ..inference.engine import DecodeEngine, EngineConfig, SamplingParams
 from ..testing import chaos
 from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl, k_done,
                        k_engine, k_occ, k_req, k_count, pack, unpack)
+from .transport import TransportClient, TransportServer, decode_kv, encode_kv
 
 __all__ = ["EngineWorker", "main"]
 
+ROLES = ("unified", "prefill", "decode")
+
+#: store-mirror cadence for occupancy/fallback drains once a router
+#: socket is attached (the wire is the hot path; the store is failover
+#: ground truth and only needs a slow heartbeat)
+_STORE_MIRROR_S = 0.25
+
 
 class EngineWorker:
-    """Wrap a DecodeEngine as a store-coordinated serving worker."""
+    """Wrap a DecodeEngine as a transport-served, store-coordinated
+    serving worker."""
 
     def __init__(self, model, store, config: Optional[EngineConfig] = None,
                  *, name: Optional[str] = None,
                  namespace: str = DEFAULT_NAMESPACE,
-                 step_floor_s: float = 0.0, **overrides):
+                 step_floor_s: float = 0.0, role: str = "unified",
+                 kv_wire: str = "raw", **overrides):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        if kv_wire not in ("raw", "int8"):
+            raise ValueError(f"kv_wire must be raw|int8, got {kv_wire!r}")
         self.engine = DecodeEngine(model, config, **overrides)
         self._store = store
         self._ns = namespace
         self._step_floor_s = float(step_floor_s)
+        self.role = role
+        self._kv_wire = kv_wire
+        self._server = TransportServer()
         with deadline_guard("register engine"):
             self.index = int(self._store.add(k_count(namespace), 1)) - 1
         self.name = name or f"engine{self.index}"
@@ -65,96 +100,297 @@ class EngineWorker:
             "page_size": cfg.page_size,
             "buckets": list(self.engine.buckets),
             "pid": os.getpid(),
+            "addr": self._server.addr,
+            "role": role,
+            "kv_wire": kv_wire,
         }
         with deadline_guard("register engine"):
             self._store.set(k_engine(namespace, self.index), pack(record))
-        self._next_seq = 0  # next request seq to consume from the store
+        self._next_seq = 0  # next request seq to consume (wire OR store)
         self._beat = 0
         self._local_rid: Dict[int, int] = {}  # engine rid -> router rid
         self._last_occ_pub = 0.0
+        self._last_occ_store = -float("inf")
         self._last_drain = -float("inf")
+        self._last_store_drain = -float("inf")
         self._done_count = 0  # lifetime results published (rides the beat)
+        #: dispatch records that arrived over the wire ahead of their turn
+        self._wire_stash: Dict[int, dict] = {}
+        #: connection ids that sent a router hello (done/occ frames go here)
+        self._router_cids: set = set()
+        #: prefill role: dispatch records awaiting export + KV handoff
+        self._prefill_jobs: deque = deque()
+        #: decode/unified role: kv frames awaiting a free slot
+        self._kv_imports: deque = deque()
+        #: prefill role: persistent links to decode workers, by address
+        self._kv_links: Dict[str, TransportClient] = {}
         self.publish_occupancy()
 
-    # -- store I/O ----------------------------------------------------------
+    # -- transport I/O ------------------------------------------------------
+
+    def _pump_transport(self):
+        """Drain every transport connection: stash dispatch records by
+        seq (the consume loop enforces order and skips duplicates from
+        retransmits), queue KV-page streams, learn which connections are
+        routers."""
+        for cid, frame in self._server.poll():
+            t = frame.get("t")
+            if t == "hello":
+                if frame.get("peer") == "router":
+                    self._router_cids.add(cid)
+            elif t == "dispatch":
+                # only routers dispatch: treat the conn as one even if
+                # its hello frame was lost (chaos half_open)
+                self._router_cids.add(cid)
+                for rec in frame.get("reqs", ()):
+                    seq = int(rec["seq"])
+                    if seq >= self._next_seq and seq not in self._wire_stash:
+                        self._wire_stash[seq] = rec
+            elif t == "kv":
+                self._kv_imports.append(frame)
+        live = set(self._server.conn_ids())
+        self._router_cids &= live
+
+    def _send_routers(self, frame: dict):
+        for cid in list(self._router_cids):
+            self._server.send(cid, frame)
+
+    # -- request intake -----------------------------------------------------
 
     def _drain_requests(self):
-        """Consume this engine's request stream in seq order; each record
-        becomes one engine.submit with the router-assigned seed."""
+        """Consume this engine's request stream in seq order. Wire-stashed
+        records are consumed first (no store round trip); the store key
+        for the same seq is only checked at the slow mirror cadence once
+        a router socket is attached — it is the fallback path for frames
+        lost to a socket failure, and the ONLY path on the legacy store
+        dataplane (no router connection)."""
         while True:
-            key = k_req(self._ns, self.name, self._next_seq)
-            with deadline_guard("recv request"):
-                if not self._store.check(key):
+            rec = self._wire_stash.pop(self._next_seq, None)
+            src = "wire"
+            if rec is None:
+                now = time.monotonic()
+                if (self._router_cids
+                        and now - self._last_store_drain < _STORE_MIRROR_S):
                     return
-                rec = unpack(self._store.get(key))
+                key = k_req(self._ns, self.name, self._next_seq)
+                with deadline_guard("recv request"):
+                    if not self._store.check(key):
+                        self._last_store_drain = now
+                        return
+                    rec = unpack(self._store.get(key))
+                src = "store"
             self._next_seq += 1
-            rid = rec["rid"]
-            tr = rec.get("trace")
-            dh = None
-            if tr:
-                # continue the router's trace: the transit span is wall-
-                # to-wall against the router's dispatch_ts (host clock
-                # skew shifts it; every other duration is monotonic)
+            self._consume(rec, src)
+
+    def _consume(self, rec: dict, src: str):
+        """One dispatch record into the engine (unified/decode) or the
+        prefill job queue (prefill role)."""
+        rid = rec["rid"]
+        tr = rec.get("trace")
+        dh = None
+        if tr:
+            # continue the router's trace: the transit span is wall-to-
+            # wall against the router's dispatch_ts (host clock skew
+            # shifts it; every other duration is monotonic)
+            retry = int(tr.get("resubmits", 0) or 0) > 0
+            if src == "wire":
+                _obs.record_span(
+                    "srv_net_transit", trace_id=tr["trace_id"],
+                    parent_id=tr["parent_id"],
+                    start_ts=tr.get("dispatch_ts"), rid=rid,
+                    engine=self.name, retry=retry)
+            else:
                 _obs.record_span(
                     "srv_store_transit", trace_id=tr["trace_id"],
                     parent_id=tr["parent_id"],
                     start_ts=tr.get("dispatch_ts"), rid=rid,
-                    engine=self.name,
-                    retry=int(tr.get("resubmits", 0) or 0) > 0)
-                dh = _obs.start_span(
-                    "srv_drain", trace_id=tr["trace_id"],
-                    parent_id=tr["parent_id"], rid=rid, engine=self.name)
-            try:
-                local = self.engine.submit(
-                    np.asarray(rec["prompt"], np.int64),
-                    SamplingParams(**rec["params"]), trace=tr)
-            except ValueError as e:
-                # invalid geometry for THIS engine (bucket/page limits):
-                # report instead of dying — the router surfaces the error
-                if dh:
-                    _obs.end_span(dh, error=str(e))
-                with deadline_guard("publish result"):
-                    self._store.set(k_done(self._ns, rid), pack(
-                        {"rid": rid, "engine": self.name, "error": str(e)}))
-                self._done_count += 1
-                continue
+                    engine=self.name, retry=retry)
+            dh = _obs.start_span(
+                "srv_drain", trace_id=tr["trace_id"],
+                parent_id=tr["parent_id"], rid=rid, engine=self.name)
+        if self.role == "prefill":
+            self._prefill_jobs.append({"rec": rec, "frame": None})
             if dh:
-                _obs.end_span(dh)
+                _obs.end_span(dh, queued="prefill")
+            return
+        try:
+            local = self.engine.submit(
+                np.asarray(rec["prompt"], np.int64),
+                SamplingParams(**rec["params"]), trace=tr)
+        except ValueError as e:
+            # invalid geometry for THIS engine (bucket/page limits):
+            # report instead of dying — the router surfaces the error
+            if dh:
+                _obs.end_span(dh, error=str(e))
+            self._publish_one_done(
+                {"rid": rid, "engine": self.name, "error": str(e)})
+            return
+        if dh:
+            _obs.end_span(dh)
+        self._local_rid[local] = rid
+
+    # -- disaggregated prefill ----------------------------------------------
+
+    def _advance_prefill(self):
+        """Prefill role: run the head job's prefill and stream its KV
+        pages to the target decode worker. A job whose export cannot get
+        a slot/pages stays at the head and is retried next poll; a job
+        whose KV send failed rotates to the BACK so one unreachable
+        decode peer cannot head-of-line-block handoffs to the others
+        (the built frame is cached, so a resend never re-runs the
+        prefill)."""
+        for _ in range(len(self._prefill_jobs)):
+            job = self._prefill_jobs[0]
+            rec = job["rec"]
+            rid = rec["rid"]
+            if job["frame"] is None:
+                tr = rec.get("trace")
+                try:
+                    payload = self.engine.prefill_export(
+                        np.asarray(rec["prompt"], np.int64),
+                        SamplingParams(**rec["params"]), trace=tr)
+                except ValueError as e:
+                    self._publish_one_done(
+                        {"rid": rid, "engine": self.name, "error": str(e)})
+                    self._prefill_jobs.popleft()
+                    continue
+                if payload is None:
+                    return  # no slot/pages yet; retry next poll
+                if "done" in payload:
+                    # finished at prefill (1-token budget / instant EOS)
+                    self._publish_one_done(
+                        {"rid": rid, "engine": self.name,
+                         "tokens": np.asarray(payload["done"]).tolist()})
+                    self._prefill_jobs.popleft()
+                    continue
+                job["frame"] = {
+                    "t": "kv", "rid": rid, "rec": rec,
+                    "first_token": payload["first_token"],
+                    "true_len": payload["true_len"],
+                    "prefill_s": payload["prefill_s"],
+                    "kv": encode_kv(payload["k"], payload["v"],
+                                    self._kv_wire, payload.get("ks"),
+                                    payload.get("vs")),
+                    "ts": time.time(),
+                }
+            link = self._kv_link(rec["kv_to"]["addr"])
+            if not link.send(job["frame"]):
+                # decode peer unreachable; rotate and let backoff govern
+                # the redial while other targets make progress
+                self._prefill_jobs.rotate(-1)
+                continue
+            self._prefill_jobs.popleft()
+            # tell the router the handoff happened, so it can retire this
+            # rid from the prefill stream's load accounting
+            self._send_routers({"t": "relay", "rids": [rid]})
+
+    def _kv_link(self, addr: str) -> TransportClient:
+        link = self._kv_links.get(addr)
+        if link is None:
+            link = TransportClient(addr, seed=self.index)
+            self._kv_links[addr] = link
+        return link
+
+    def _advance_kv_imports(self):
+        """Decode/unified role: adopt streamed prefills as free slots
+        allow. Import order is arrival order; a head frame waiting for a
+        slot blocks the rest (they need slots too)."""
+        while self._kv_imports:
+            frame = self._kv_imports[0]
+            rec = frame["rec"]
+            rid = rec["rid"]
+            tr = rec.get("trace")
+            kv = frame.get("_decoded")
+            if kv is None:
+                got = decode_kv(frame["kv"])
+                kv = {"first_token": frame["first_token"],
+                      "true_len": frame["true_len"],
+                      "prefill_s": frame.get("prefill_s", 0.0),
+                      "k": got["k"], "v": got["v"]}
+                if "k_scale" in got:  # int8 POOL slabs travel raw
+                    kv["ks"] = got["k_scale"]
+                    kv["vs"] = got["v_scale"]
+                frame["_decoded"] = kv
+            try:
+                local = self.engine.try_import_prefill(
+                    np.asarray(rec["prompt"], np.int64),
+                    SamplingParams(**rec["params"]), kv, trace=tr)
+            except ValueError as e:
+                self._publish_one_done(
+                    {"rid": rid, "engine": self.name, "error": str(e)})
+                self._kv_imports.popleft()
+                continue
+            if local is None:
+                return  # no slot/pages yet; retry next poll
+            if tr:
+                # wall-to-wall KV stream span: export-side send stamp to
+                # import completion, the disaggregated analogue of the
+                # transit spans
+                _obs.record_span(
+                    "srv_kv_stream", trace_id=tr["trace_id"],
+                    parent_id=tr["parent_id"], start_ts=frame.get("ts"),
+                    rid=rid, engine=self.name,
+                    wire=frame["kv"].get("wire"),
+                    pages=int(np.asarray(frame["kv"]["k"]).shape[1]))
             self._local_rid[local] = rid
+            self._kv_imports.popleft()
+
+    # -- results + occupancy ------------------------------------------------
+
+    def _publish_one_done(self, rec: dict):
+        """STORE first (harvest ground truth), wire echo second — the
+        done-before-ack invariant rides the store write order."""
+        with deadline_guard("publish result"):
+            self._store.set(k_done(self._ns, rec["rid"]), pack(rec))
+        self._done_count += 1
+        self._send_routers({"t": "done", "recs": [rec]})
 
     def _publish_done(self) -> int:
         """Write finished token streams; returns how many. Runs BEFORE
         publish_occupancy in poll_once so a completed request is always
         harvestable once its seq is acked — the failover no-loss/no-dup
-        invariant."""
-        published = 0
+        invariant. The wire echo (one batched frame) happens after every
+        store write, so a router acting on the frame can already trust
+        the store."""
+        recs = []
         for local, rid in list(self._local_rid.items()):
             if self.engine._requests[local].status != "done":
                 continue
             tokens = self.engine.result(local)
+            rec = {"rid": rid, "engine": self.name,
+                   "tokens": np.asarray(tokens).tolist()}
             with deadline_guard("publish result"):
-                self._store.set(k_done(self._ns, rid), pack({
-                    "rid": rid, "engine": self.name,
-                    "tokens": np.asarray(tokens).tolist()}))
+                self._store.set(k_done(self._ns, rid), pack(rec))
             del self._local_rid[local]
             self._done_count += 1
-            published += 1
-        return published
+            recs.append(rec)
+        if recs:
+            self._send_routers({"t": "done", "recs": recs})
+        return len(recs)
 
-    def publish_occupancy(self):
+    def publish_occupancy(self, force_store: bool = False):
         """Occupancy beat: engine load snapshot + monotone ``beat`` (the
         router's liveness signal) + ``acked_seq`` (requests consumed, so
         the router can estimate load it dispatched but the engine hasn't
-        reported yet)."""
+        reported yet). The beat rides the router socket as the heartbeat;
+        the store copy — the failover ground truth — is mirrored at a
+        slow cadence (every write follows the done keys it acks)."""
         self._beat += 1
-        self._last_occ_pub = time.monotonic()
+        now = time.monotonic()
+        self._last_occ_pub = now
         occ = self.engine.occupancy()
         occ["beat"] = self._beat
         occ["acked_seq"] = self._next_seq
         occ["done_count"] = self._done_count
         occ["name"] = self.name
-        with deadline_guard("publish occupancy"):
-            self._store.set(k_occ(self._ns, self.name), pack(occ))
+        occ["role"] = self.role
+        occ["prefill_queue"] = len(self._prefill_jobs)
+        self._send_routers({"t": "occ", "occ": occ, "ts": time.time()})
+        if (force_store or not self._router_cids
+                or now - self._last_occ_store >= _STORE_MIRROR_S):
+            self._last_occ_store = now
+            with deadline_guard("publish occupancy"):
+                self._store.set(k_occ(self._ns, self.name), pack(occ))
 
     def stop_requested(self) -> bool:
         ctl = k_ctl(self._ns)
@@ -167,21 +403,25 @@ class EngineWorker:
     # -- scheduler ----------------------------------------------------------
 
     def poll_once(self) -> bool:
-        """One deterministic worker round: drain new requests, advance the
-        engine one step (chaos fence first — PADDLE_CHAOS_ENGINE_* can
-        SIGKILL here, mid-decode), publish results + occupancy. The
-        occupancy beat is throttled to ~100 Hz: the router samples it far
-        slower, and unthrottled publishes just contend the store (the
-        routers' liveness grace is seconds, results ride done keys, and
-        a fresh publish always follows a finished request). The request
-        drain check is likewise throttled to ~50 Hz while the engine is
-        busy — its internal queue keeps the slots fed between checks; an
-        idle engine checks every poll so first dispatch lands fast.
-        Returns True while the engine still holds work."""
+        """One deterministic worker round: pump the transport, drain new
+        requests, advance the engine one step (chaos fence first —
+        PADDLE_CHAOS_ENGINE_* can SIGKILL here, mid-decode), publish
+        results + occupancy. The occupancy beat is throttled to ~40 Hz on
+        the wire (its store mirror far slower): the router samples it far
+        slower still, and unthrottled publishes just contend the fabric.
+        The request drain check is likewise throttled to ~50 Hz while the
+        engine is busy — its internal queue keeps the slots fed between
+        checks; an idle engine checks every poll so first dispatch lands
+        fast. Returns True while the engine still holds work."""
+        self._pump_transport()
         now = time.monotonic()
         if not self._local_rid or now - self._last_drain >= 0.02:
             self._last_drain = now
             self._drain_requests()
+        if self.role == "prefill":
+            self._advance_prefill()
+        if self._kv_imports:
+            self._advance_kv_imports()
         chaos.engine_fence(self.engine.decode_steps)
         t_step = time.monotonic()
         busy = self.engine.step()
@@ -195,8 +435,9 @@ class EngineWorker:
                 time.sleep(rem)
         published = self._publish_done()
         if published or time.monotonic() - self._last_occ_pub >= 0.025:
-            self.publish_occupancy()
-        return busy or bool(self._local_rid)
+            self.publish_occupancy(force_store=bool(published))
+        return (busy or bool(self._local_rid) or bool(self._prefill_jobs)
+                or bool(self._kv_imports))
 
     def serve(self, poll_interval: float = 0.005,
               ctl_interval: float = 0.25):
@@ -205,14 +446,19 @@ class EngineWorker:
         pages-starved case); the stop broadcast is only polled every
         ``ctl_interval`` seconds — it is the cold path."""
         last_ctl = -float("inf")
-        while True:
-            now = time.monotonic()
-            if now - last_ctl >= ctl_interval:
-                last_ctl = now
-                if self.stop_requested():
-                    return
-            if not self.poll_once():
-                time.sleep(poll_interval)
+        try:
+            while True:
+                now = time.monotonic()
+                if now - last_ctl >= ctl_interval:
+                    last_ctl = now
+                    if self.stop_requested():
+                        return
+                if not self.poll_once():
+                    time.sleep(poll_interval)
+        finally:
+            self._server.close()
+            for link in self._kv_links.values():
+                link.close()
 
 
 def build_worker_model(args):
@@ -241,6 +487,14 @@ def build_arg_parser():
         help="host:port of the coordination store (PADDLE_SERVING_MASTER)")
     p.add_argument("--namespace", default=DEFAULT_NAMESPACE)
     p.add_argument("--name", default=None)
+    p.add_argument("--role", default="unified", choices=list(ROLES),
+                   help="unified = prefill+decode per request; prefill = "
+                        "export KV pages and stream them to decode "
+                        "workers; decode = import streamed prefills")
+    p.add_argument("--kv-wire", default="raw", choices=["raw", "int8"],
+                   help="KV-page stream codec: raw is bit-equal, int8 "
+                        "absmax-quantizes per [layer, page, head] "
+                        "(~4x smaller frames, trajectory-level fidelity)")
     p.add_argument("--poll-interval", type=float, default=0.005)
     p.add_argument("--step-floor-ms", type=float, default=0.0,
                    help="minimum wall time per scheduler step; emulates "
@@ -287,7 +541,8 @@ def main(argv=None):
     store = TCPStore(host=host, port=int(port), is_master=False, timeout=60.0)
     worker = EngineWorker(
         model, store, name=args.name, namespace=args.namespace,
-        step_floor_s=args.step_floor_ms / 1000.0,
+        step_floor_s=args.step_floor_ms / 1000.0, role=args.role,
+        kv_wire=args.kv_wire,
         num_slots=args.slots, max_length=args.max_length,
         page_size=args.page_size, speculate_k=args.speculate_k,
         prefix_cache=not args.no_prefix_cache, kv_dtype=args.kv_dtype,
@@ -301,8 +556,9 @@ def main(argv=None):
         print(f"[serving] worker {worker.name} warm "
               f"({len(worker.engine.buckets)} buckets)",
               file=sys.stderr, flush=True)
-    print(f"[serving] worker {worker.name} (engine {worker.index}) "
-          f"serving via {args.master}", file=sys.stderr, flush=True)
+    print(f"[serving] worker {worker.name} (engine {worker.index}, "
+          f"{worker.role}) serving via {args.master} + {worker._server.addr}",
+          file=sys.stderr, flush=True)
     worker.serve(poll_interval=args.poll_interval)
     return 0
 

@@ -44,6 +44,17 @@ Env knobs (all read lazily so tests can flip them per-case):
                                     fault fires before (serving/worker.py
                                     fences every scheduler step; default 0)
   PADDLE_CHAOS_ENGINE_LATENCY_MS=<ms>  sleep injected by the latency mode
+  PADDLE_CHAOS_NET_MODE=drop|half_open|latency
+  PADDLE_CHAOS_NET_AT=<k>           which transport frame send the network
+                                    fault fires at (serving/transport.py
+                                    fences every frame send; default 0)
+      drop      — sever the connection before the frame goes out (the
+                  sender must reconnect with backoff; the frame is lost)
+      half_open — swallow the frame but report success (the TCP half-open
+                  fault: sender believes delivery, receiver sees nothing;
+                  recovery is ack-stall retransmit or store ground truth)
+      latency   — sleep PADDLE_CHAOS_NET_LATENCY_MS, then send normally
+  PADDLE_CHAOS_NET_LATENCY_MS=<ms>  sleep injected by the latency mode
 
 The tear/corrupt helpers at the bottom are also callable directly from
 tests (no env needed) to manufacture damaged checkpoints.
@@ -206,6 +217,46 @@ def engine_fence(step: int) -> None:
         _fault("engine_latency", step=step, ms=ms)
         if ms > 0:
             time.sleep(ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
+# Streaming-transport faults (called by serving/transport.py per frame send)
+# ---------------------------------------------------------------------------
+def net_fence(index: int) -> Optional[str]:
+    """Fault point before a streaming-transport frame send. ``index``
+    counts frame sends in this process, so PADDLE_CHAOS_NET_AT can target
+    "the Nth frame" precisely — mid-dispatch, mid-KV-stream, or between a
+    done record and the occupancy beat that acks it (the done-before-ack
+    window the store ground truth must cover).
+
+    Returns the action the transport must take: ``"drop"`` (sever the
+    connection; the frame is lost and the sender reconnects with jittered
+    backoff) or ``"half_open"`` (swallow the frame, report success — the
+    silent half-open-socket fault). ``latency`` sleeps here and returns
+    None (send proceeds), exercising the transport deadline guards.
+    """
+    if not armed():
+        return None
+    mode = _env("PADDLE_CHAOS_NET_MODE")
+    if mode is None:
+        return None
+    at = int(_env("PADDLE_CHAOS_NET_AT", "0"))
+    if index != at:
+        return None
+    if mode == "drop":
+        _fault("net_drop", index=index)
+        _log(f"net drop injected at transport frame {index}")
+        return "drop"
+    if mode == "half_open":
+        _fault("net_half_open", index=index)
+        _log(f"net half_open injected at transport frame {index}")
+        return "half_open"
+    if mode == "latency":
+        ms = float(_env("PADDLE_CHAOS_NET_LATENCY_MS", "0"))
+        _fault("net_latency", index=index, ms=ms)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+    return None
 
 
 # ---------------------------------------------------------------------------

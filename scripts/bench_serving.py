@@ -26,7 +26,15 @@ A third scenario (``router``) boots real ``serving.worker`` processes
 pushes a mixed chat/batch/long-context workload through 1 then 2 engine
 workers: aggregate tokens/s, p50/p99 latency per SLO class, shed rate,
 and the 2-worker scaling ratio (gate: >= 1.8x), with token streams
-asserted bit-equal across scales.
+asserted bit-equal across scales. The router scenario runs on the
+streaming dataplane by default (``--dataplane store`` is the legacy A/B);
+its traced phase runs BOTH dataplanes, so BENCH_SERVING.json prices the
+wire directly — transit share (store_transit + net_transit) per SLO
+class, gated < 0.30 on streaming (``--max-transit-share``) vs the
+0.77-0.88 the store dataplane measures. A disaggregated sub-scenario
+drives a long-prompt-heavy workload through 1 prefill + 1 decode worker
+vs 1 unified worker and asserts the token streams are bit-equal (raw KV
+wire).
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/bench_serving.py
@@ -221,7 +229,8 @@ def _parallel_ceiling():
     return min(2.0, min(ceilings))
 
 
-def _spawn_router_worker(args, master, namespace, extra_env=None):
+def _spawn_router_worker(args, master, namespace, extra_env=None,
+                         role=None):
     import subprocess
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -239,18 +248,19 @@ def _spawn_router_worker(args, master, namespace, extra_env=None):
         "OPENBLAS_NUM_THREADS": "1",
         "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
     })
-    return subprocess.Popen(
-        [sys.executable, "-m", "paddle_tpu.serving.worker",
-         "--master", master, "--namespace", namespace, "--warmup",
-         "--poll-interval", "0.01", "--model-seed", "7",
-         "--vocab", str(args.vocab), "--hidden", str(args.hidden),
-         "--layers", str(args.layers), "--heads", str(args.heads),
-         "--max-positions", str(args.max_length),
-         "--slots", str(args.router_slots),
-         "--max-length", str(args.max_length),
-         "--page-size", str(args.page_size),
-         "--step-floor-ms", str(args.router_step_floor_ms)],
-        env=env, cwd=repo)
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.worker",
+           "--master", master, "--namespace", namespace, "--warmup",
+           "--poll-interval", "0.01", "--model-seed", "7",
+           "--vocab", str(args.vocab), "--hidden", str(args.hidden),
+           "--layers", str(args.layers), "--heads", str(args.heads),
+           "--max-positions", str(args.max_length),
+           "--slots", str(args.router_slots),
+           "--max-length", str(args.max_length),
+           "--page-size", str(args.page_size),
+           "--step-floor-ms", str(args.router_step_floor_ms)]
+    if role:
+        cmd += ["--role", role]
+    return subprocess.Popen(cmd, env=env, cwd=repo)
 
 
 def _router_traffic(args, rng):
@@ -309,6 +319,7 @@ def run_router(args):
             # queues so they wave through slots back-to-back instead of
             # idling a router poll interval between waves.
             router = Router(store, namespace=ns, queue_limit=256,
+                            dataplane=args.dataplane,
                             engine_grace_s=120.0, page_size=args.page_size,
                             seed=args.seed, affinity_slack_tokens=128,
                             max_inflight_per_engine=64,
@@ -381,10 +392,19 @@ def run_router(args):
         for a, b in zip(outputs[1], outputs[2]):
             np.testing.assert_array_equal(
                 a, b, err_msg="router results changed with engine count")
-        trace_summary = _traced_router_phase(args, store, master)
+        trace_summary = _traced_router_phase(
+            args, store, master, args.dataplane, "__bencht")
+        # the dataplane A/B: the SAME traced workload on the legacy
+        # store dataplane, so the json prices the wire directly
+        ab_summary = None
+        if args.dataplane == "streaming":
+            ab_summary = _traced_router_phase(
+                args, store, master, "store", "__benchs")
+        disagg = run_disagg(args, store, master)
     finally:
         store.close()
-    return {
+    report = {
+        "dataplane": args.dataplane,
         "slots_per_worker": args.router_slots,
         "page_size": args.page_size,
         "one_worker": scales[1],
@@ -395,25 +415,30 @@ def run_router(args):
         "machine_parallel_ceiling": round(ceiling, 2),
         "bit_equal_across_scales": True,
         "trace_summary": trace_summary,
+        "disaggregated": disagg,
     }
+    if ab_summary is not None:
+        report["store_dataplane_trace"] = ab_summary
+    return report
 
 
-def _traced_router_phase(args, store, master):
+def _traced_router_phase(args, store, master, dataplane, ns):
     """A short 2-worker workload with distributed tracing ON, in its own
     namespace with freshly spawned telemetry-enabled workers — the timed
     trials above stay untraced so tracing cost can never bias the scaling
-    gate. Returns the per-SLO-class phase-share block for
-    BENCH_SERVING.json (latency attribution tracked across PRs)."""
+    gate. Runs on the given ``dataplane`` (streaming for the shipped
+    numbers, store for the A/B row). Returns the per-SLO-class
+    phase-share block for BENCH_SERVING.json (latency attribution
+    tracked across PRs)."""
     import tempfile
 
     import numpy as np
 
     from paddle_tpu.serving import Router
 
-    ns = "__bencht"
-    tdir = tempfile.mkdtemp(prefix="bench_trace_")
-    print(f"router: traced phase (2 workers, spans -> {tdir})...",
-          file=sys.stderr)
+    tdir = tempfile.mkdtemp(prefix=f"bench_trace_{dataplane}_")
+    print(f"router: traced phase ({dataplane} dataplane, 2 workers, "
+          f"spans -> {tdir})...", file=sys.stderr)
     procs = [_spawn_router_worker(
         args, master, ns,
         extra_env={"PADDLE_TPU_TELEMETRY_DIR": tdir,
@@ -421,6 +446,7 @@ def _traced_router_phase(args, store, master):
     os.environ["PADDLE_TPU_TELEMETRY_DIR"] = tdir  # router = rank 0
     try:
         router = Router(store, namespace=ns, queue_limit=256,
+                        dataplane=dataplane,
                         engine_grace_s=120.0, page_size=args.page_size,
                         seed=args.seed, affinity_slack_tokens=128,
                         max_inflight_per_engine=64,
@@ -439,6 +465,21 @@ def _traced_router_phase(args, store, master):
             time.sleep(0.05)
         rng = np.random.default_rng(args.seed + 2)
         sub = _router_traffic(args, rng)[::3]
+        # warmup round first: workers register BEFORE their bucket
+        # warmup finishes, so a cold fleet would book XLA compile time
+        # against the transit phase. The warmup trees (and the compile
+        # spans) are then dropped by resetting the span files — each
+        # span write is an independent open/append/close, so removal
+        # between rounds is safe and the measured round starts clean.
+        for prompt, slo, new in sub:
+            router.submit(prompt, slo=slo, max_new_tokens=new)
+        if not router.drain(timeout=600.0, poll=0.02):
+            raise RuntimeError(
+                f"router bench: traced warmup undrained {router.stats()}")
+        time.sleep(0.5)  # let in-flight worker spans land
+        for f in os.listdir(tdir):
+            if f.startswith("spans_rank"):
+                os.remove(os.path.join(tdir, f))
         for prompt, slo, new in sub:
             router.submit(prompt, slo=slo, max_new_tokens=new)
         if not router.drain(timeout=600.0, poll=0.02):
@@ -458,12 +499,101 @@ def _traced_router_phase(args, store, master):
         raise RuntimeError(
             f"router bench: trace trees invalid: {problems[:5]}")
     return {
+        "dataplane": dataplane,
         "telemetry_dir": tdir,
         "spans": len(spans),
         "requests": summary["requests"],
         "phase_share_mean": {
             cls: {p: v["mean"] for p, v in c["phase_share"].items()}
             for cls, c in summary["classes"].items()},
+    }
+
+
+def run_disagg(args, store, master):
+    """Disaggregated prefill/decode sub-scenario: the SAME long-prompt-
+    heavy workload through 1 unified worker and through 1 prefill + 1
+    decode worker (prefill streams finished KV pages to decode over the
+    transport, raw wire). Token streams must be BIT-EQUAL — the
+    disaggregation guarantee — and the report carries both tokens/s
+    (the prefill offload frees the decode engine's step budget)."""
+    import numpy as np
+
+    from paddle_tpu.serving import Router
+
+    def rand(rng, n):
+        return rng.integers(0, args.vocab, n, dtype=np.int64)
+
+    results = {}
+    outputs = {}
+    for label, roles in (("unified", [None]),
+                         ("disaggregated", ["prefill", "decode"])):
+        ns = f"__benchg{label[0]}"
+        print(f"router: disagg scenario, {label} fleet "
+              f"({len(roles)} worker(s))...", file=sys.stderr)
+        procs = [_spawn_router_worker(args, master, ns, role=r)
+                 for r in roles]
+        router = Router(store, namespace=ns, queue_limit=256,
+                        engine_grace_s=120.0, page_size=args.page_size,
+                        seed=args.seed, affinity_slack_tokens=128,
+                        max_inflight_per_engine=64,
+                        prefill_threshold_tokens=96,
+                        deadlines={"interactive": 600.0,
+                                   "standard": 600.0, "batch": 600.0})
+        deadline = time.monotonic() + 300.0
+        while router._known_engines < len(roles):
+            if time.monotonic() > deadline:
+                raise RuntimeError("router bench: disagg workers never "
+                                   "registered")
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError("router bench: disagg worker died "
+                                       f"rc={p.returncode}")
+            router.pump()
+            time.sleep(0.05)
+        rng = np.random.default_rng(args.seed + 3)
+        traffic = ([(rand(rng, 160), "standard", 32) for _ in range(10)]
+                   + [(rand(rng, 40), "interactive", 16)
+                      for _ in range(6)])
+        # warmup pass exercises the KV-stream path end to end before
+        # timing (first import compiles the pool write)
+        for prompt, slo, new in traffic[::5]:
+            router.submit(prompt, slo=slo, max_new_tokens=new)
+        if not router.drain(timeout=600.0, poll=0.02):
+            raise RuntimeError("router bench: disagg warmup undrained "
+                               f"{router.stats()}")
+        t0 = time.perf_counter()
+        rids = [router.submit(p, slo=slo, max_new_tokens=new)
+                for p, slo, new in traffic]
+        if not router.drain(timeout=600.0, poll=0.02):
+            raise RuntimeError("router bench: disagg phase undrained "
+                               f"{router.stats()}")
+        wall = time.perf_counter() - t0
+        new_tokens = sum(len(router.result(r)) - len(p)
+                         for r, (p, _s, _n) in zip(rids, traffic))
+        st = router.stats()
+        outputs[label] = [np.asarray(router.result(r)) for r in rids]
+        results[label] = {
+            "workers": len(roles),
+            "requests": len(rids),
+            "new_tokens": int(new_tokens),
+            "seconds": round(wall, 4),
+            "tokens_per_second": round(new_tokens / wall, 2),
+            "disagg_dispatches": st["disagg_dispatches"],
+        }
+        router.shutdown()
+        for p in procs:
+            p.wait(timeout=60)
+    for a, b in zip(outputs["unified"], outputs["disaggregated"]):
+        np.testing.assert_array_equal(
+            a, b, err_msg="disaggregated prefill/decode diverged from "
+                          "the unified fleet")
+    assert results["disaggregated"]["disagg_dispatches"] > 0
+    return {
+        "prefill_threshold_tokens": 96,
+        "kv_wire": "raw",
+        "unified": results["unified"],
+        "disaggregated": results["disaggregated"],
+        "bit_equal": True,
     }
 
 
@@ -507,6 +637,16 @@ def main(argv=None):
     ap.add_argument("--min-router-scaling", type=float, default=1.8,
                     help="fail unless 2-worker router tokens/s reaches "
                          "this multiple of 1 worker (0 disables)")
+    ap.add_argument("--dataplane", choices=("streaming", "store"),
+                    default="streaming",
+                    help="router dataplane for the serving scenario; "
+                         "streaming also runs a store-dataplane traced "
+                         "A/B phase for the transit comparison")
+    ap.add_argument("--max-transit-share", type=float, default=0.30,
+                    help="fail if any SLO class attributes more than this "
+                         "share of request latency to transit "
+                         "(store_transit + net_transit) on the streaming "
+                         "dataplane (0 disables)")
     ap.add_argument("--skip-router", action="store_true",
                     help="skip the multi-engine router scenario")
     ap.add_argument("--router-only", action="store_true",
@@ -634,6 +774,19 @@ def _gate_router(args, router):
               f"ceiling {router['machine_parallel_ceiling']}x)",
               file=sys.stderr)
         return 1
+    if (args.max_transit_share and router.get("dataplane") == "streaming"
+            and router.get("trace_summary")):
+        rc = 0
+        for cls, shares in router["trace_summary"]["phase_share_mean"].items():
+            transit = (shares.get("store_transit", 0.0)
+                       + shares.get("net_transit", 0.0))
+            if transit >= args.max_transit_share:
+                print(f"FAIL: {cls} transit share {transit:.3f} >= max "
+                      f"{args.max_transit_share} on the streaming dataplane",
+                      file=sys.stderr)
+                rc = 1
+        if rc:
+            return rc
     return 0
 
 

@@ -76,6 +76,8 @@ OWNED_PREFIXES = {
     "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
     "serving_": os.path.join("paddle_tpu", "inference", "engine.py"),
     "serving_router_": os.path.join("paddle_tpu", "serving", "router.py"),
+    "serving_transport_": os.path.join("paddle_tpu", "serving",
+                                       "transport.py"),
     "reshard_": os.path.join("paddle_tpu", "distributed", "reshard.py"),
     "pp_": os.path.join("paddle_tpu", "distributed", "fleet",
                         "meta_parallel", "pipeline_parallel.py"),

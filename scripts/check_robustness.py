@@ -24,6 +24,16 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
      against a dead store peer is a silent serving outage. Convention:
      store clients in the serving plane are named ``store``/``_store``;
      nothing else (dicts, caches) may use those names.
+  5. unguarded transport socket ops — in ``paddle_tpu/serving/transport.py``
+     every blocking socket call (``<sock>.send/sendall/recv/accept/
+     connect``, plus ``select.select`` polls, on a receiver whose name
+     mentions "sock") must sit lexically inside a ``with
+     deadline_guard(...)`` block: the streaming dataplane replaces store
+     round trips with direct sockets, and an unguarded socket op against
+     a wedged peer is the same silent outage rule 4 rules out on the
+     store path. Convention: sockets in the transport are named
+     ``*sock*`` (``_sock``, ``conn_sock``, ``listen_sock``); nothing
+     else may use those names.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -58,6 +68,16 @@ GUARDED_STORE_FILES = [
 
 #: TCPStore/PyTCPStore client methods that block on the network
 STORE_OPS = {"set", "get", "add", "wait", "check", "delete_key"}
+
+#: files whose socket ops must run under deadline_guard (rule 5)
+GUARDED_SOCKET_FILES = [
+    os.path.join("paddle_tpu", "serving", "transport.py"),
+]
+
+#: socket methods that block on the network in the guarded files
+#: (create_connection matches via its `socket.` receiver)
+SOCKET_OPS = {"send", "sendall", "recv", "recv_into", "accept", "connect",
+              "connect_ex", "bind", "listen", "create_connection"}
 
 
 def _py_files(root):
@@ -187,6 +207,55 @@ def check_guarded_store_ops(path: str):
                    "serving control plane hang silently (rule 4)")
 
 
+def _receiver_mentions_sock(func: ast.Attribute) -> bool:
+    """True when the call receiver is (or dereferences) a name containing
+    "sock": ``raw_sock.recv``, ``self._listen_sock.accept``,
+    ``socket.create_connection``."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return "sock" in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return "sock" in value.attr.lower()
+    return False
+
+
+def check_guarded_socket_ops(path: str):
+    """Yield (line, message) for transport socket ops not lexically inside
+    a ``with deadline_guard(...)`` (rule 5). ``select.select(...)`` polls
+    count too — they block when given a nonzero timeout."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    parent = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        is_sock_op = (func.attr in SOCKET_OPS
+                      and _receiver_mentions_sock(func))
+        is_select = (func.attr == "select"
+                     and isinstance(func.value, ast.Name)
+                     and func.value.id == "select")
+        if not (is_sock_op or is_select):
+            continue
+        anc, guarded = node, False
+        while anc in parent:
+            anc = parent[anc]
+            if isinstance(anc, ast.With) and _is_deadline_guard_with(anc):
+                guarded = True
+                break
+        if not guarded:
+            yield (node.lineno,
+                   f"socket op .{func.attr}(...) outside any `with "
+                   "deadline_guard(...)` — a wedged transport peer makes "
+                   "the streaming dataplane hang silently (rule 5)")
+
+
 def main(argv=None):
     root = (argv or sys.argv[1:] or [REPO])[0]
     violations = []
@@ -205,6 +274,12 @@ def main(argv=None):
         if not os.path.isfile(path):
             continue
         for line, msg in check_guarded_store_ops(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for rel in GUARDED_SOCKET_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        for line, msg in check_guarded_socket_ops(path):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

@@ -148,11 +148,18 @@ def _synthesize(tracing, d):
         trees.append((tid, root))
     os.environ["PADDLE_TRAINER_ID"] = "1"
     for i, (tid, root) in enumerate(trees):
-        tracing.record_span("srv_store_transit", trace_id=tid,
-                            parent_id=root, dur_s=0.05, rid=i,
-                            engine="engine1")
+        # streaming dataplane: wire transit for most requests, one legacy
+        # store-dataplane tree (the A/B switch), one disaggregated tree
+        # whose KV pages streamed prefill -> decode
+        transit = "srv_store_transit" if i == 3 else "srv_net_transit"
+        tracing.record_span(transit, trace_id=tid, parent_id=root,
+                            dur_s=0.05, rid=i, engine="engine1")
         tracing.record_span("srv_prefill", trace_id=tid, parent_id=root,
                             dur_s=0.1, rid=i, bucket=64, engine="engine1")
+        if i == 1:
+            tracing.record_span("srv_kv_stream", trace_id=tid,
+                                parent_id=root, dur_s=0.03, rid=i,
+                                engine="engine1", wire="raw", pages=4)
         tracing.record_span("srv_decode", trace_id=tid, parent_id=root,
                             dur_s=0.5, rid=i, steps=16, engine="engine1")
     # a single-span training trace and a torn tail line must both be fine
@@ -177,9 +184,10 @@ def selftest():
                     os.environ[k] = v
         spans = tracing.load_spans(d)
         # 4 trees x (root + queue + dispatch) + 1 retry on rank 0,
-        # 4 x (transit + prefill + decode) on rank 1, + 1 compile trace;
-        # the torn tail line must be skipped, not counted or fatal
-        assert len(spans) == 26, f"unexpected span count {len(spans)}"
+        # 4 x (transit + prefill + decode) + 1 kv_stream on rank 1,
+        # + 1 compile trace; the torn tail line must be skipped, not
+        # counted or fatal
+        assert len(spans) == 27, f"unexpected span count {len(spans)}"
         assert tracing.validate_trees(spans) == [], \
             tracing.validate_trees(spans)
         assert {s["rank"] for s in spans} == {0, 1}
@@ -200,6 +208,12 @@ def selftest():
         cls = summary["classes"]
         assert set(cls) == {"interactive", "standard", "batch"}
         assert cls["standard"]["resubmitted"] == 1
+        # the dataplane split is visible in attribution: standard trees
+        # carry wire transit (one with a KV stream), the batch tree rode
+        # the legacy store dataplane
+        assert cls["standard"]["phase_share"]["net_transit"]["mean"] > 0
+        assert cls["standard"]["phase_share"]["kv_stream"]["mean"] > 0
+        assert cls["batch"]["phase_share"]["store_transit"]["mean"] > 0
         for c in cls.values():
             total = sum(v["mean"] for v in c["phase_share"].values())
             assert abs(total - 1.0) < 1e-6, (c, total)

@@ -153,3 +153,67 @@ def test_live_serving_modules_are_guarded():
         target = os.path.join(REPO, rel)
         assert os.path.isfile(target), rel
         assert not list(check_robustness.check_guarded_store_ops(target)), rel
+
+
+# -- rule 5: transport socket ops run under deadline_guard -------------------
+def _socket_violations(tmp_path, src):
+    f = tmp_path / "transport_mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_guarded_socket_ops(str(f)))
+
+
+def test_unguarded_socket_op_rejected(tmp_path):
+    v = _socket_violations(tmp_path, """
+        def f(raw_sock, data):
+            raw_sock.sendall(data)
+            return raw_sock.recv(4096)
+    """)
+    assert len(v) == 2 and all("deadline_guard" in m for _, m in v)
+
+
+def test_unguarded_attr_socket_op_rejected(tmp_path):
+    # self._listen_sock.<op> counts: the receiver dereferences a *sock* name
+    v = _socket_violations(tmp_path, """
+        class S:
+            def f(self):
+                return self._listen_sock.accept()
+    """)
+    assert len(v) == 1
+
+
+def test_unguarded_select_poll_rejected(tmp_path):
+    # select.select blocks too when given a nonzero timeout
+    v = _socket_violations(tmp_path, """
+        import select
+
+        def f(raw_sock):
+            return select.select([raw_sock], [], [], 1.0)
+    """)
+    assert len(v) == 1 and ".select" in v[0][1]
+
+
+def test_guarded_socket_op_allowed(tmp_path):
+    assert not _socket_violations(tmp_path, """
+        from paddle_tpu.serving.protocol import deadline_guard
+
+        def f(raw_sock, data):
+            with deadline_guard("send frame"):
+                raw_sock.sendall(data)
+    """)
+
+
+def test_non_socket_receiver_ignored(tmp_path):
+    # a queue/channel that happens to share op names is not a socket
+    assert not _socket_violations(tmp_path, """
+        def f(chan, data):
+            chan.send(data)
+            return chan.recv()
+    """)
+
+
+def test_live_transport_module_is_guarded():
+    for rel in check_robustness.GUARDED_SOCKET_FILES:
+        target = os.path.join(REPO, rel)
+        assert os.path.isfile(target), rel
+        assert not list(
+            check_robustness.check_guarded_socket_ops(target)), rel

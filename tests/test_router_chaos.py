@@ -1,14 +1,25 @@
-"""Router failover soak: SIGKILL an engine worker mid-decode.
+"""Router failover soaks: SIGKILL and network chaos against real workers.
 
-Two real ``python -m paddle_tpu.serving.worker`` processes serve an
-in-process router; the chaos harness (PADDLE_CHAOS_ENGINE_MODE=kill) is
-armed in ONE of them and SIGKILLs it at a chosen decode step. The
-acceptance criterion: every admitted request still completes, and the
-token streams are BIT-EQUAL to a single-engine in-process reference —
-failover must lose nothing, duplicate nothing, and leave no trace in
-the results.
+Real ``python -m paddle_tpu.serving.worker`` processes serve an
+in-process router over the streaming dataplane; the chaos harness is
+armed in chosen workers:
 
-Marked slow+chaos: boots 2 fresh interpreters that compile the engine
+* ``PADDLE_CHAOS_ENGINE_MODE=kill`` SIGKILLs a worker at a chosen decode
+  step — mid-stream, with dispatch/done frames in flight on its sockets.
+  Failover must harvest what its store done keys prove finished
+  (done-before-ack) and rerun the rest bit-equal.
+* ``PADDLE_CHAOS_NET_MODE=drop|half_open`` injects transport faults at
+  exact frame-send indices: a severed connection must heal by redial, a
+  silently-swallowed frame must be recovered from the store ground truth
+  (done harvest / dispatch retransmit) — with NO worker declared dead
+  and NO token drift.
+
+The acceptance criterion everywhere: every admitted request completes,
+and the token streams are BIT-EQUAL to a single-engine in-process
+reference — chaos must lose nothing, duplicate nothing, and leave no
+trace in the results.
+
+Marked slow+chaos: boots fresh interpreters that compile the engine
 programs on CPU; run with ``pytest tests/test_router_chaos.py --runslow``.
 """
 import os
@@ -154,3 +165,82 @@ def test_engine_kill_failover_completes_all_bit_equal(tmp_path, monkeypatch):
                 p.wait(timeout=20)
         store.close()
         obs.reset()
+
+
+def test_net_chaos_drop_and_half_open_recover_bit_equal():
+    """Transport faults at frame fences: one worker's connection is
+    SEVERED mid-stream (drop), the other silently swallows a frame while
+    reporting success (half_open). Both are transient network faults, so
+    the invariant is stronger than failover: NO engine may be declared
+    dead, every request completes bit-equal, and recovery rides redial +
+    the store ground truth (done harvest / dispatch retransmit) — the
+    done-before-ack ordering under chaos."""
+    from paddle_tpu.runtime import TCPStore
+    from paddle_tpu.serving import Router
+
+    port = free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=30.0)
+    master = f"127.0.0.1:{port}"
+    dropper = _spawn_worker(master, chaos_env={
+        "PADDLE_CHAOS": "1",
+        "PADDLE_CHAOS_NET_MODE": "drop",
+        "PADDLE_CHAOS_NET_AT": "6",
+        "PADDLE_TRAINER_ID": "1",
+    })
+    swallower = _spawn_worker(master, chaos_env={
+        "PADDLE_CHAOS": "1",
+        "PADDLE_CHAOS_NET_MODE": "half_open",
+        "PADDLE_CHAOS_NET_AT": "8",
+        "PADDLE_TRAINER_ID": "2",
+    })
+    procs = [dropper, swallower]
+    router = Router(store, queue_limit=32, engine_grace_s=20.0, seed=13,
+                    retransmit_s=0.5,
+                    deadlines={"interactive": 240.0, "standard": 240.0,
+                               "batch": 600.0})
+    try:
+        deadline = time.monotonic() + 120.0
+        while router._known_engines < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            for p in procs:
+                assert p.poll() is None, p.stderr.read()[-2000:]
+            router.pump()
+            time.sleep(0.05)
+
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+                   for n in (14, 27, 20, 33, 11, 24)]
+        rids = []
+        for i, p in enumerate(prompts):
+            slo = ("interactive", "standard", "batch")[i % 3]
+            rids.append(router.submit(
+                p, slo=slo, max_new_tokens=10, do_sample=(i % 2 == 0),
+                temperature=0.8, top_k=8))
+
+        assert router.drain(timeout=240.0), router.stats()
+        st = router.stats()
+        assert st["done"] == len(rids) and st["shed"] == 0
+        # transient network faults are NOT failover events
+        assert st["engines_lost"] == 0
+        assert st["failover_resubmits"] == 0
+
+        want = _reference([(p, router._requests[r].params)
+                           for p, r in zip(prompts, rids)])
+        for r, w in zip(rids, want):
+            np.testing.assert_array_equal(router.result(r), w)
+    finally:
+        router.shutdown()
+        errs = []
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=20)
+            errs.append(p.stderr.read())
+        store.close()
+    # the faults really fired, in the intended worker each
+    assert "net drop injected at transport frame 6" in errs[0], errs[0][-2000:]
+    assert "net half_open injected at transport frame 8" in errs[1], \
+        errs[1][-2000:]

@@ -106,6 +106,94 @@ def test_dispatch_balances_and_results_bit_equal(model, store):
 
 
 @pytest.mark.slow
+def test_store_dataplane_ab_bit_equal(model, store):
+    """The legacy store dataplane stays fully working behind
+    ``dataplane="store"`` and produces the SAME tokens as streaming —
+    the A/B switch the bench uses to price the wire."""
+    w0 = EngineWorker(model, store, **ENG)
+    w1 = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=16, seed=5, dataplane="store")
+    assert all(e.link is None for e in router._engines.values())
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (20, 33, 17, 25)]
+    rids = [router.submit(p, slo="standard", max_new_tokens=8,
+                          do_sample=(i % 2 == 0), temperature=0.7,
+                          top_k=8) for i, p in enumerate(prompts)]
+    _drive(router, [w0, w1])
+    assert all(e.link is None for e in router._engines.values())
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    for r, w in zip(rids, want):
+        np.testing.assert_array_equal(router.result(r), w)
+    assert router.stats()["done"] == 4
+
+
+@pytest.mark.slow
+def test_disaggregated_prefill_decode_bit_equal(model, store):
+    """1 prefill + 1 decode worker: long prompts prefill on one engine,
+    stream their KV pages to the other, and decode there — bit-equal to
+    a unified single-engine run (raw wire), short prompts take the
+    unified path on the decode worker."""
+    pw = EngineWorker(model, store, role="prefill", **ENG)
+    dw = EngineWorker(model, store, role="decode", **ENG)
+    router = Router(store, queue_limit=16, seed=5,
+                    prefill_threshold_tokens=24)
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (30, 12, 41, 26)]  # 3 disagg + 1 direct
+    rids = [router.submit(p, slo="standard", max_new_tokens=8,
+                          do_sample=(i % 2 == 1), temperature=0.8,
+                          top_k=8) for i, p in enumerate(prompts)]
+    _drive(router, [pw, dw], rounds=2000)
+    st = router.stats()
+    assert st["done"] == 4 and st["shed"] == 0
+    assert st["disagg_dispatches"] == 3
+    # the prefill engine never decodes; every request resolves on decode
+    assert all(router._requests[r].engine == dw.name for r in rids)
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    for r, w in zip(rids, want):
+        np.testing.assert_array_equal(router.result(r), w)
+    # KV pages left no residue: both engines drained back to idle
+    assert pw.engine.occupancy()["running"] == 0
+    assert dw.engine.occupancy()["running"] == 0
+
+
+@pytest.mark.slow
+def test_disaggregated_int8_kv_wire_trajectory(model, store):
+    """``--kv-wire int8`` quantizes the streamed KV pages (absmax per
+    [page, head_dim]): not bit-equal by design, but the trajectory must
+    stay anchored — the first token is computed at the prefill engine
+    BEFORE quantization (exact), runs are deterministic, and greedy
+    decode tracks the float reference for most of the stream."""
+    pw = EngineWorker(model, store, role="prefill", kv_wire="int8", **ENG)
+    dw = EngineWorker(model, store, role="decode", **ENG)
+    router = Router(store, queue_limit=16, seed=5,
+                    prefill_threshold_tokens=24)
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (28, 37)]
+    rids = [router.submit(p, slo="standard", max_new_tokens=8)
+            for p in prompts]
+    _drive(router, [pw, dw], rounds=2000)
+    st = router.stats()
+    assert st["done"] == 2 and st["disagg_dispatches"] == 2
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    agree = total = 0
+    for r, w in zip(rids, want):
+        got = router.result(r)
+        assert got.shape == w.shape
+        # the prefill-side argmax rides the wire as plain ints: exact
+        assert got[len(router._requests[r].prompt)] == \
+            w[len(router._requests[r].prompt)]
+        agree += int(np.sum(got == w))
+        total += int(w.size)
+    assert agree / total >= 0.75, (agree, total)
+
+
+@pytest.mark.slow
 def test_failover_no_loss_no_dup_bit_equal(model, store):
     """Kill an engine with work in flight: finished results are harvested
     (done-before-ack), unfinished work reruns elsewhere bit-equal, and
@@ -275,9 +363,12 @@ def test_request_trace_tree_and_enriched_done_event(model, store, tmp_path,
         for root in roots:
             names = {s["name"] for s in spans
                      if s["trace_id"] == root["trace_id"]}
+            # default dataplane is streaming: dispatch transit is the
+            # wire span, not the legacy store span
             assert {"srv_request", "srv_admit", "srv_queue",
-                    "srv_dispatch", "srv_store_transit", "srv_drain",
+                    "srv_dispatch", "srv_net_transit", "srv_drain",
                     "srv_prefill", "srv_decode"} <= names
+            assert "srv_store_transit" not in names
 
         # the done event carries the phase breakdown for dashboards that
         # never load span files

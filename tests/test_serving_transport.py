@@ -1,0 +1,210 @@
+"""Streaming-dataplane transport invariants (docs/SERVING.md section 8).
+
+Pure control-plane tests — no model, no engine — so they run in tier-1:
+
+* frame codec: length-prefixed frames survive arbitrary re-chunking
+  (partial headers, coalesced frames) byte-for-byte;
+* server/client loopback: hello/dispatch/done round trips over real
+  sockets, connection ids stay stable, and a client outlives a server
+  restart (jittered-backoff redial, ``reconnects`` counter);
+* KV wire codec: ``raw`` is bit-equal (the disaggregated bit-equality
+  guarantee rides on it), ``int8`` reconstructs within absmax-quant
+  tolerance and actually shrinks the payload ~4x.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.transport import (FrameDecoder, TransportClient,
+                                          TransportServer, decode_kv,
+                                          encode_frame, encode_kv)
+
+
+def test_frame_codec_roundtrip_any_chunking():
+    frames = [
+        {"t": "hello", "peer": "router", "name": "router"},
+        {"t": "dispatch", "reqs": [{"rid": 0, "seq": 0,
+                                    "prompt": list(range(40)),
+                                    "params": {"max_new_tokens": 8}}]},
+        {"t": "occ", "occ": {"beat": 3, "acked_seq": 1}, "ts": 12.5},
+        {"t": "done", "recs": [{"rid": 0, "tokens": [1, 2, 3]}]},
+    ]
+    blob = b"".join(encode_frame(f) for f in frames)
+    # every chunk size — including 1 byte at a time, which splits headers
+    # mid-word — must reassemble the identical frame sequence
+    for chunk in (1, 2, 3, 7, len(blob)):
+        dec = FrameDecoder()
+        got = []
+        for i in range(0, len(blob), chunk):
+            got.extend(dec.feed(blob[i:i + chunk]))
+        assert got == frames
+
+
+def test_frame_codec_numpy_payloads_roundtrip():
+    k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    (frame,) = FrameDecoder().feed(encode_frame({"t": "kv", "k": k}))
+    np.testing.assert_array_equal(frame["k"], k)
+
+
+def _poll_until(fn, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.005)
+    raise AssertionError("transport poll timed out")
+
+
+def test_server_client_roundtrip_and_reply():
+    server = TransportServer()
+    client = TransportClient(server.addr)
+    try:
+        assert client.send({"t": "hello", "peer": "router", "name": "r"})
+        got = _poll_until(server.poll)
+        (cid, frame), = got
+        assert frame == {"t": "hello", "peer": "router", "name": "r"}
+        assert cid in server.conn_ids()
+        assert server.send(cid, {"t": "done", "recs": [{"rid": 7}]})
+        (reply,) = _poll_until(client.poll)
+        assert reply["recs"][0]["rid"] == 7
+    finally:
+        client.close()
+        server.close()
+
+
+def test_client_reconnects_after_server_restart():
+    server = TransportServer()
+    addr = server.addr
+    host, port = addr.rsplit(":", 1)
+    client = TransportClient(addr)
+    try:
+        assert client.send({"t": "occ", "occ": {"beat": 1}})
+        _poll_until(server.poll)
+        server.close()
+        # sends fail while the listener is down; the client keeps backing
+        # off instead of raising into the worker loop
+        deadline = time.monotonic() + 5.0
+        while client.connected() and time.monotonic() < deadline:
+            client.send({"t": "occ", "occ": {"beat": 2}})
+            time.sleep(0.01)
+        assert not client.connected()
+        server2 = TransportServer(host=host, port=int(port))
+        try:
+            got = None
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                client.send({"t": "occ", "occ": {"beat": 3}})
+                got = server2.poll()
+                if got:
+                    break
+                time.sleep(0.01)
+            assert got, "client never re-delivered after server restart"
+            assert got[0][1]["occ"]["beat"] == 3
+            assert client.reconnects >= 1
+        finally:
+            server2.close()
+    finally:
+        client.close()
+
+
+def test_chaos_net_fence_modes(monkeypatch):
+    """PADDLE_CHAOS_NET_MODE faults fire at exact frame-send indices:
+    ``half_open`` swallows the frame while reporting success, ``drop``
+    severs the connection (the client redials with backoff), ``latency``
+    delays the send but still delivers. The dataplane above recovers all
+    three from the store ground truth + retransmits."""
+    from paddle_tpu.serving import transport
+
+    server = TransportServer()
+    client = TransportClient(server.addr)
+    try:
+        assert client.send({"t": "occ", "occ": {"beat": 1}})
+        (_, f1), = _poll_until(server.poll)
+        assert f1["occ"]["beat"] == 1
+
+        monkeypatch.setenv("PADDLE_CHAOS", "1")
+        monkeypatch.setenv("PADDLE_CHAOS_NET_AT", "0")
+
+        # half_open: the sender believes the frame went out; the peer
+        # never sees it — the next frame (index 1) is delivered
+        monkeypatch.setenv("PADDLE_CHAOS_NET_MODE", "half_open")
+        monkeypatch.setattr(transport, "_send_index", 0)
+        assert client.send({"t": "occ", "occ": {"beat": 2}})
+        assert client.connected()
+        assert client.send({"t": "occ", "occ": {"beat": 3}})
+        got = _poll_until(server.poll)
+        assert [fr["occ"]["beat"] for _, fr in got] == [3]
+
+        # drop: the send fails, the connection is torn down, and the
+        # client redials (jittered backoff) and re-delivers
+        monkeypatch.setenv("PADDLE_CHAOS_NET_MODE", "drop")
+        monkeypatch.setattr(transport, "_send_index", 0)
+        assert not client.send({"t": "occ", "occ": {"beat": 4}})
+        monkeypatch.delenv("PADDLE_CHAOS_NET_MODE")
+        deadline = time.monotonic() + 10.0
+        got = []
+        while not got and time.monotonic() < deadline:
+            client.send({"t": "occ", "occ": {"beat": 5}})
+            got = server.poll()
+            time.sleep(0.01)
+        assert got and got[-1][1]["occ"]["beat"] == 5
+        assert client.reconnects >= 1
+
+        # latency: delayed but delivered on the live connection
+        monkeypatch.setenv("PADDLE_CHAOS_NET_MODE", "latency")
+        monkeypatch.setenv("PADDLE_CHAOS_NET_LATENCY_MS", "120")
+        monkeypatch.setattr(transport, "_send_index", 0)
+        t0 = time.monotonic()
+        assert client.send({"t": "occ", "occ": {"beat": 6}})
+        assert time.monotonic() - t0 >= 0.12
+        got = _poll_until(server.poll)
+        assert got[-1][1]["occ"]["beat"] == 6
+    finally:
+        client.close()
+        server.close()
+
+
+def test_kv_wire_raw_is_bit_equal():
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 3, 16, 8), dtype=np.float32)
+    v = rng.standard_normal((2, 3, 16, 8), dtype=np.float32)
+    payload = encode_kv(k, v, "raw")
+    # through the full frame codec, as the worker ships it
+    (frame,) = FrameDecoder().feed(
+        encode_frame({"t": "kv", "kv": payload}))
+    out = decode_kv(frame["kv"])
+    np.testing.assert_array_equal(out["k"], k)
+    np.testing.assert_array_equal(out["v"], v)
+
+
+def test_kv_wire_raw_passes_int8_pool_scales_through():
+    # an int8 KV pool ships its pages verbatim: int8 slabs + scale slabs
+    rng = np.random.default_rng(1)
+    k = rng.integers(-127, 128, size=(2, 3, 16, 8)).astype(np.int8)
+    v = rng.integers(-127, 128, size=(2, 3, 16, 8)).astype(np.int8)
+    ks = rng.random((2, 3, 16, 1), dtype=np.float32)
+    vs = rng.random((2, 3, 16, 1), dtype=np.float32)
+    out = decode_kv(encode_kv(k, v, "raw", ks, vs))
+    np.testing.assert_array_equal(out["k"], k)
+    np.testing.assert_array_equal(out["k_scale"], ks)
+    np.testing.assert_array_equal(out["v_scale"], vs)
+
+
+def test_kv_wire_int8_tolerance_and_size():
+    rng = np.random.default_rng(2)
+    k = rng.standard_normal((2, 4, 32, 8), dtype=np.float32)
+    v = rng.standard_normal((2, 4, 32, 8), dtype=np.float32)
+    payload = encode_kv(k, v, "int8")
+    assert payload["wire"] == "int8"
+    assert np.asarray(payload["k"]).dtype == np.int8
+    out = decode_kv(payload)
+    # absmax over the [page, head_dim] tail: worst case one quant step
+    # of each page's absmax
+    for got, ref in ((out["k"], k), (out["v"], v)):
+        step = np.abs(ref).max(axis=(-2, -1), keepdims=True) / 127.0
+        assert np.max(np.abs(got - ref) / step) <= 1.0 + 1e-5
+    raw_bytes = len(encode_frame({"kv": encode_kv(k, v, "raw")}))
+    int8_bytes = len(encode_frame({"kv": payload}))
+    assert int8_bytes < raw_bytes / 3  # ~4x smaller minus scale slabs
