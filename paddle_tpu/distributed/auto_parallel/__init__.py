@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 from ...framework.core import Tensor
 from ...framework.op import raw
 from .. import mesh as _mesh
+from . import planner  # noqa: F401  (cost-model layout planner, AUTOPLAN.md)
 
 
 class Placement:

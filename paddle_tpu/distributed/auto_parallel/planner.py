@@ -1,0 +1,603 @@
+"""Cost-model auto-parallel planner: pick (dp, mp, pp, sharding, schedule,
+virtual_pp_degree, grad-comm bucket) layouts analytically.
+
+Mesh choice has been manual via ``DistributedStrategy`` since fleet landed,
+and every wrong guess costs a 4.7–7 s XLA compile (MULTICHIP_SCALING.json
+``compile_s``) before the first step time can even be observed. Following
+the Mesh-TensorFlow layout-cost formulation (arXiv:1811.02084) and the
+weight-update sharding analysis of arXiv:2004.13336, this module scores
+every divisibility-legal layout with a closed-form alpha-beta-gamma model
+
+    step ≈ x · compute  +  y · wire_bytes  +  z · collective_launches
+
+whose three constants are calibrated once against the measured proxy
+entries in MULTICHIP_SCALING.json (``calibrate``). The terms per candidate:
+
+  * **compute** — 6·params·tokens FLOPs inflated by the analytic pipeline
+    bubble of the candidate's schedule, the exact formulas of
+    ``SpmdPipeline.schedule_info`` (PR 8): fill = (S−1)/V,
+    fb_total = 3M + 3·fill (gpipe/1f1b) or 3M + max(0, 2·fill − M)
+    (zero_bubble), bubble = 1 − 3M/fb_total.
+  * **wire_bytes** — per-axis analytic collective payloads mirroring the
+    ``comm_analysis`` axis attribution recorded per entry: mp activation
+    all-reduces, ZeRO all-gather/reduce-scatter on the sharding axis, dp
+    gradient all-reduce, pp boundary activations (× virtual chunks). Axes
+    that cross the slice boundary are charged at the ICI/DCN bandwidth
+    ratio (``Topology.dcn_penalty``).
+  * **collective_launches** — per-step collective count; the latency/
+    dispatch term that separates many-small from few-large layouts.
+
+The calibration entries are weak-scaling runs of ONE host emulating all n
+devices, so the fitted constants are host-aggregate (cost terms sum over
+devices, not per-device); the model form is identical on real hardware,
+only the constants change.
+
+``plan(model_config, topology)`` enumerates legal meshes (degrees divide
+the device count, mp divides heads and hidden, pp divides layers, the
+batch splits over dp·sharding), prunes candidates whose analytic
+params + optimizer-state + activation footprint (remat-granularity aware)
+exceeds the per-device memory bound, ranks the rest, and returns a
+``Plan``. ``apply_auto_plan`` merges the winner into a
+``DistributedStrategy`` — **manual settings always win**: any knob the
+user moved off its default is pinned and constrains the search instead of
+being overwritten. Opt-in via ``DistributedStrategy.auto()`` or
+``PADDLE_TPU_AUTO_PLAN=1``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field, asdict, replace
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ... import observability as _obs
+
+__all__ = [
+    "ModelConfig", "Topology", "Candidate", "Plan", "CostConstants",
+    "plan", "score", "calibrate", "load_calibration", "apply_auto_plan",
+    "enumerate_candidates", "memory_bytes",
+]
+
+
+# ---------------------------------------------------------------------------
+# inputs
+# ---------------------------------------------------------------------------
+@dataclass
+class ModelConfig:
+    """Workload shape for the cost model. Defaults mirror the scaling
+    proxy's tiny GPT (``__graft_entry__._tiny_cfg``) so the calibration
+    entries and the planner score the same model out of the box."""
+
+    hidden: int = 64
+    layers: int = 4
+    heads: int = 4
+    vocab: int = 256
+    seq_len: int = 32
+    global_batch: int = 16
+    dtype_bytes: int = 4           # f32 master math on the proxy
+    remat: str = "none"            # none | selective | full
+
+    @property
+    def params(self) -> int:
+        # transformer block ≈ 12·h² (qkv+proj 4h², mlp 8h²) + tied embed
+        return 12 * self.layers * self.hidden ** 2 + self.vocab * self.hidden
+
+    @property
+    def tokens(self) -> int:
+        return self.global_batch * self.seq_len
+
+    @property
+    def flops(self) -> float:
+        # fwd+bwd ≈ 6 FLOPs per param per token (the PaLM rule of thumb)
+        return 6.0 * self.params * self.tokens
+
+
+@dataclass
+class Topology:
+    """Device fabric description. Bandwidths are per-chip link rates; the
+    defaults are the TPU v4 constants used by scripts/scaling_model.py.
+    ``host_serialized`` marks the CPU-emulation regime of the calibration
+    proxy (all devices share one host, costs sum instead of parallelize) —
+    it is informational; the fitted constants already absorb it."""
+
+    n_devices: int = 8
+    num_slices: int = 1
+    ici_bw: float = 1.6e11         # bytes/s per chip over ICI
+    dcn_bw: float = 3.1e9          # bytes/s per chip across slices
+    peak_flops: float = 197e12     # bf16 per chip
+    hbm_bytes: float = 32e9        # per chip
+    host_serialized: bool = True
+
+    @property
+    def dcn_penalty(self) -> float:
+        """ICI-equivalent byte multiplier for slice-crossing traffic."""
+        return self.ici_bw / self.dcn_bw
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    schedule: str = "gpipe"
+    virtual_pp_degree: int = 1
+    microbatches: int = 1
+    bucket_mb: int = 32
+    # filled by score()
+    predicted_step_s: float = 0.0
+    breakdown: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def ndev(self) -> int:
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def mesh_dict(self) -> Dict[str, int]:
+        return {"dp": self.dp, "mp": self.mp, "pp": self.pp,
+                "sharding": self.sharding}
+
+
+@dataclass
+class CostConstants:
+    """The calibrated cost constants (see module docstring).
+
+    ``fixed_s`` is the per-step dispatch floor (host launch + program
+    setup — why 8→16 devices scales sublinearly on the emulation host);
+    ``sec_per_dp_over_byte`` charges the data-parallel gradient exchange
+    at (dp−1)·payload per device, the all-gather-style overcommit the
+    measured ``dp+sharding`` attribution shows (per-device bytes roughly
+    double from dp=2 to dp=4) — why 16→32 scales superlinearly."""
+
+    fixed_s: float = 0.0
+    sec_per_flop: float = 2.0e-10       # CPU-proxy scale fallbacks
+    sec_per_byte: float = 1.0e-8
+    sec_per_collective: float = 1.0e-4
+    sec_per_dp_over_byte: float = 0.0
+    source: str = "defaults"
+    max_rel_error: float = float("nan")
+
+    def as_vector(self) -> np.ndarray:
+        return np.asarray([self.fixed_s, self.sec_per_flop,
+                           self.sec_per_byte, self.sec_per_collective,
+                           self.sec_per_dp_over_byte], float)
+
+
+@dataclass
+class Plan:
+    best: Candidate
+    candidates: List[Candidate]
+    pruned_memory: int
+    constants: CostConstants
+    plan_seconds: float
+
+
+# ---------------------------------------------------------------------------
+# analytic terms
+# ---------------------------------------------------------------------------
+def _bubble(cand: Candidate, mc: ModelConfig) -> float:
+    """Analytic bubble fraction — the exact ``schedule_info`` formulas."""
+    S, V = cand.pp, cand.virtual_pp_degree
+    M = max(1, cand.microbatches)
+    if S <= 1:
+        return 0.0
+    fill = (S - 1) / V
+    if cand.schedule == "zero_bubble":
+        fb_total = 3.0 * M + max(0.0, 2.0 * fill - M)
+    else:
+        fb_total = 3.0 * M + 3.0 * fill
+    return 1.0 - 3.0 * M / fb_total
+
+
+def _choose_microbatches(batch: int, requested: int) -> int:
+    m = max(1, min(int(requested), int(batch)))
+    while batch % m != 0:
+        m -= 1
+    return m
+
+
+def _axis_bytes(cand: Candidate, mc: ModelConfig) -> Dict[str, float]:
+    """Per-device wire bytes per step, by mesh axis — the analytic mirror
+    of the ``comm_analysis`` ``per_axis`` attribution. Ring collective of
+    size k moves 2·(k−1)/k of the payload per participant."""
+
+    def ring(k: int) -> float:
+        return 2.0 * (k - 1) / k if k > 1 else 0.0
+
+    pbytes = mc.params * mc.dtype_bytes
+    local_batch = mc.global_batch / max(1, cand.dp * cand.sharding)
+    act = local_batch * mc.seq_len * mc.hidden * mc.dtype_bytes
+    out: Dict[str, float] = {}
+    # mp: 2 fwd + 2 bwd activation all-reduces per layer (attn out + mlp out)
+    out["mp"] = 4.0 * mc.layers * act * ring(cand.mp)
+    # sharding (ZeRO): all-gather params fwd + reduce-scatter grads bwd over
+    # the model-parallel shard each device owns
+    shard_pbytes = pbytes / max(1, cand.mp * cand.pp)
+    out["sharding"] = 2.0 * shard_pbytes * ring(cand.sharding)
+    # dp: gradient all-reduce of the per-device grad shard
+    grad_pd = pbytes / max(1, cand.mp * cand.pp * cand.sharding)
+    out["dp"] = grad_pd * ring(cand.dp)
+    # pp: boundary activations per microbatch, fwd + bwd, × virtual chunks
+    if cand.pp > 1:
+        out["pp"] = 2.0 * act * cand.virtual_pp_degree
+    else:
+        out["pp"] = 0.0
+    return out
+
+
+def _collective_count(cand: Candidate, mc: ModelConfig) -> float:
+    """Collective launches per step per device — the latency term."""
+    M = max(1, cand.microbatches)
+    n = 0.0
+    if cand.mp > 1:
+        n += 4.0 * mc.layers * M
+    if cand.sharding > 1:
+        n += 2.0 * _n_buckets(cand, mc)
+    if cand.dp > 1:
+        n += _n_buckets(cand, mc)
+    if cand.pp > 1:
+        n += 2.0 * M * cand.virtual_pp_degree
+    return n
+
+
+def _n_buckets(cand: Candidate, mc: ModelConfig) -> float:
+    grad_mb = mc.params * 4 / (max(1, cand.mp * cand.pp) * 2 ** 20)
+    return max(1.0, np.ceil(grad_mb / max(1, cand.bucket_mb)))
+
+
+def _features(cand: Candidate, mc: ModelConfig,
+              topo: Topology) -> np.ndarray:
+    """Cost feature vector in host-aggregate units, aligned with
+    ``CostConstants.as_vector``: [1, flops, wire_bytes, launches,
+    dp_overcommit_bytes]. Every variable term is stretched by the
+    analytic pipeline bubble — collectives idle through the fill/drain
+    just like compute does."""
+    stretch = 1.0 / max(1e-9, 1.0 - _bubble(cand, mc))
+    ax = _axis_bytes(cand, mc)
+    dcn_axes = _slice_crossing_axes(cand, topo)
+    wire = sum(
+        b * (topo.dcn_penalty if a in dcn_axes else 1.0)
+        for a, b in ax.items())
+    # dp overcommit: the gradient exchange observed on the emulated
+    # fabric moves (dp-1)·payload per device, not the ring-optimal
+    # 2(dp-1)/dp — charged separately so calibration can weigh it
+    grad_pd = mc.params * mc.dtype_bytes / max(
+        1, cand.mp * cand.pp * cand.sharding)
+    dp_over = grad_pd * max(0, cand.dp - 1)
+    if "dp" in dcn_axes:
+        dp_over *= topo.dcn_penalty
+    n = cand.ndev
+    return np.asarray([
+        1.0,
+        mc.flops * stretch,
+        wire * n * stretch,
+        _collective_count(cand, mc) * n * stretch,
+        dp_over * n * stretch,
+    ], float)
+
+
+def _slice_crossing_axes(cand: Candidate, topo: Topology) -> set:
+    """Axes whose groups straddle the slice boundary. Mesh order is
+    (dp, pp, sharding, sep, mp) with dp outermost — with ≥2 slices the
+    boundary cuts the outermost non-trivial axis."""
+    if topo.num_slices <= 1:
+        return set()
+    for a, k in (("dp", cand.dp), ("pp", cand.pp),
+                 ("sharding", cand.sharding), ("mp", cand.mp)):
+        if k > 1:
+            return {a}
+    return set()
+
+
+def memory_bytes(cand: Candidate, mc: ModelConfig) -> float:
+    """Analytic per-device footprint: params + grads + AdamW moments
+    (ZeRO-sharded) + activations under the remat granularity."""
+    pbytes = mc.params * mc.dtype_bytes
+    model_shard = max(1, cand.mp * cand.pp)
+    params = pbytes / model_shard
+    grads = pbytes / model_shard
+    # two f32 moments, weight-update-sharded over the sharding axis
+    opt = 2.0 * mc.params * 4.0 / (model_shard * max(1, cand.sharding))
+    local_batch = mc.global_batch / max(1, cand.dp * cand.sharding)
+    per_layer = local_batch * mc.seq_len * mc.hidden * mc.dtype_bytes
+    layers_live = mc.layers / max(1, cand.pp)
+    act_factor = {"none": 8.0, "selective": 3.0, "full": 1.0}.get(
+        mc.remat, 8.0)
+    acts = per_layer * layers_live * act_factor
+    return params + grads + opt + acts
+
+
+# ---------------------------------------------------------------------------
+# scoring + calibration
+# ---------------------------------------------------------------------------
+def score(cand: Candidate, mc: ModelConfig, topo: Topology,
+          consts: CostConstants) -> Candidate:
+    """Fill ``predicted_step_s`` (+ term breakdown) on a copy of ``cand``."""
+    f = _features(cand, mc, topo)
+    v = consts.as_vector()
+    names = ("fixed_s", "compute_s", "comm_s", "latency_s", "dp_over_s")
+    out = replace(cand)
+    out.breakdown = {k: float(fi * vi) for k, fi, vi in zip(names, f, v)}
+    out.predicted_step_s = float(f @ v)
+    return out
+
+
+def _entry_candidate(entry: Dict[str, Any]) -> Candidate:
+    mesh = entry.get("mesh", {})
+    pipe = entry.get("pipeline") or {}
+    return Candidate(
+        dp=int(mesh.get("dp", 1)), mp=int(mesh.get("mp", 1)),
+        pp=int(mesh.get("pp", 1)), sharding=int(mesh.get("sharding", 1)),
+        schedule=str(pipe.get("schedule", "gpipe")),
+        virtual_pp_degree=int(pipe.get("virtual_pp_degree", 1)),
+        microbatches=int(pipe.get("microbatches", 1)))
+
+
+def _entry_model(entry: Dict[str, Any], mc: ModelConfig) -> ModelConfig:
+    # weak-scaling convention of the proxy: 2 sequences per device
+    return replace(mc, global_batch=2 * int(entry["n"]))
+
+
+def _solve_nonneg(A: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Least squares with nonnegative coefficients, by exhaustive column
+    subsets (5 columns → 31 subsets): negative constants would invert the
+    ranking (rewarding comm-heavy layouts), so they are inadmissible.
+    Residual ties (several subsets fit the few calibration points exactly)
+    prefer solutions that keep the wire-byte term (column 2) — it is the
+    term that differentiates mp/pp/sharding layouts at a fixed device
+    count — and then more active terms."""
+    ncol = A.shape[1]
+    best, best_key = np.zeros(ncol), (np.inf, 1, 0)
+    for mask in range(1, 2 ** ncol):
+        cols = [j for j in range(ncol) if mask >> j & 1]
+        sol, *_ = np.linalg.lstsq(A[:, cols], b, rcond=None)
+        if np.any(sol < 0):
+            continue
+        full = np.zeros(ncol)
+        full[cols] = sol
+        res = float(np.linalg.norm(A @ full - b))
+        key = (round(res, 9), 0 if (2 in cols and full[2] > 0) else 1,
+               -int(np.count_nonzero(full)))
+        if key < best_key:
+            best, best_key = full, key
+    return best
+
+
+def calibrate(entries: Iterable[Dict[str, Any]],
+              mc: Optional[ModelConfig] = None,
+              topo: Optional[Topology] = None) -> CostConstants:
+    """Fit (sec_per_flop, sec_per_byte, sec_per_collective) to the
+    measured single-slice proxy entries. Uses the same analytic features
+    the predictor uses, so the fit IS the prediction error on the
+    calibration set (recorded as ``max_rel_error``)."""
+    mc = mc or ModelConfig()
+    rows, targets = [], []
+    for e in entries:
+        if e.get("two_slice") or not e.get("ok", True):
+            continue
+        cand = _entry_candidate(e)
+        emc = _entry_model(e, mc)
+        t = Topology(n_devices=int(e["n"]),
+                     host_serialized=(topo or Topology()).host_serialized)
+        rows.append(_features(cand, emc, t))
+        targets.append(float(e["step_s"]))
+    if len(rows) < 2:
+        return CostConstants()
+    A = np.asarray(rows, float)
+    b = np.asarray(targets, float)
+    sol = _solve_nonneg(A, b)
+    if not np.any(sol > 0):
+        return CostConstants()
+    pred = A @ sol
+    rel = float(np.max(np.abs(pred - b) / np.maximum(b, 1e-12)))
+    return CostConstants(
+        fixed_s=float(sol[0]), sec_per_flop=float(sol[1]),
+        sec_per_byte=float(sol[2]), sec_per_collective=float(sol[3]),
+        sec_per_dp_over_byte=float(sol[4]),
+        source=f"MULTICHIP_SCALING.json ({len(rows)} entries)",
+        max_rel_error=rel)
+
+
+def _repo_scaling_json() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+    return os.path.join(root, "MULTICHIP_SCALING.json")
+
+
+_CALIBRATION: Optional[CostConstants] = None
+
+
+def load_calibration(path: Optional[str] = None,
+                     mc: Optional[ModelConfig] = None) -> CostConstants:
+    """Constants calibrated against MULTICHIP_SCALING.json (cached after
+    the first load); ``CostConstants()`` defaults when the file is absent
+    or unusable — the planner still ranks, just uncalibrated."""
+    global _CALIBRATION
+    if path is None and mc is None and _CALIBRATION is not None:
+        return _CALIBRATION
+    p = path or _repo_scaling_json()
+    try:
+        with open(p) as f:
+            entries = json.load(f).get("results", [])
+        consts = calibrate(entries, mc)
+    except (OSError, ValueError, KeyError):
+        consts = CostConstants()
+    if path is None and mc is None:
+        _CALIBRATION = consts
+    return consts
+
+
+# ---------------------------------------------------------------------------
+# enumeration + the plan
+# ---------------------------------------------------------------------------
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_candidates(mc: ModelConfig, topo: Topology,
+                         pinned: Optional[Dict[str, Any]] = None
+                         ) -> List[Candidate]:
+    """Every divisibility-legal layout for ``topo.n_devices``. ``pinned``
+    freezes knobs the user set manually ({"mp": 2, "schedule": "1f1b"} …)."""
+    pinned = pinned or {}
+    n = topo.n_devices
+    out: List[Candidate] = []
+
+    def ok(knob: str, v: int) -> bool:
+        return knob not in pinned or int(pinned[knob]) == v
+
+    for mp in _divisors(n):
+        if not ok("mp", mp):
+            continue
+        if mp > 1 and (mc.heads % mp or mc.hidden % mp):
+            continue
+        for pp in _divisors(n // mp):
+            if not ok("pp", pp):
+                continue
+            if pp > 1 and mc.layers % pp:
+                continue
+            for sh in _divisors(n // (mp * pp)):
+                if not ok("sharding", sh):
+                    continue
+                dp = n // (mp * pp * sh)
+                if not ok("dp", dp):
+                    continue
+                if mc.global_batch % (dp * sh):
+                    continue
+                for cand in _schedule_variants(mc, dp, mp, pp, sh, pinned):
+                    out.append(cand)
+    return out
+
+
+def _schedule_variants(mc: ModelConfig, dp: int, mp: int, pp: int, sh: int,
+                       pinned: Dict[str, Any]) -> Iterable[Candidate]:
+    local_batch = mc.global_batch // max(1, dp * sh)
+    if pp <= 1:
+        # no pipeline: the schedule knobs are inert, but a user-pinned
+        # value must ride through un-clobbered (manual settings win)
+        yield Candidate(dp=dp, mp=mp, pp=pp, sharding=sh,
+                        schedule=str(pinned.get("schedule", "gpipe")),
+                        virtual_pp_degree=int(
+                            pinned.get("virtual_pp_degree", 1)),
+                        microbatches=1)
+        return
+    schedules = ("gpipe", "1f1b", "zero_bubble")
+    if "schedule" in pinned:
+        schedules = (str(pinned["schedule"]),)
+    virtuals = (1, 2)
+    if "virtual_pp_degree" in pinned:
+        virtuals = (int(pinned["virtual_pp_degree"]),)
+    for sched in schedules:
+        for v in virtuals:
+            if mc.layers % (pp * v):
+                continue
+            m = _choose_microbatches(local_batch, pp)
+            yield Candidate(dp=dp, mp=mp, pp=pp, sharding=sh,
+                            schedule=sched, virtual_pp_degree=v,
+                            microbatches=m)
+
+
+def plan(model_config: Optional[ModelConfig] = None,
+         topology: Optional[Topology] = None,
+         pinned: Optional[Dict[str, Any]] = None,
+         constants: Optional[CostConstants] = None) -> Plan:
+    """Enumerate → memory-prune → score → rank. Raises ValueError when no
+    legal candidate survives (degrees that cannot divide the devices, or a
+    memory bound nothing fits under)."""
+    t0 = time.perf_counter()
+    mc = model_config or ModelConfig()
+    topo = topology or Topology()
+    consts = constants or load_calibration(mc=None)
+    cands = enumerate_candidates(mc, topo, pinned)
+    n_enumerated = len(cands)
+    fitting = [c for c in cands if memory_bytes(c, mc) <= topo.hbm_bytes]
+    pruned = n_enumerated - len(fitting)
+    if not fitting:
+        raise ValueError(
+            f"auto-plan found no legal layout for ndev={topo.n_devices} "
+            f"(enumerated {n_enumerated}, memory-pruned {pruned})")
+    scored = sorted((score(c, mc, topo, consts) for c in fitting),
+                    key=lambda c: c.predicted_step_s)
+    dt = time.perf_counter() - t0
+    best = scored[0]
+    _obs.set_gauge("autoplan_candidates", n_enumerated)
+    _obs.set_gauge("autoplan_pruned_memory", pruned)
+    _obs.set_gauge("autoplan_predicted_step_seconds", best.predicted_step_s)
+    _obs.observe("autoplan_plan_seconds", dt)
+    _obs.event("autoplan", mesh=best.mesh_dict(), schedule=best.schedule,
+               virtual_pp_degree=best.virtual_pp_degree,
+               microbatches=best.microbatches,
+               predicted_step_s=round(best.predicted_step_s, 6),
+               candidates=n_enumerated, pruned_memory=pruned,
+               calibration=consts.source)
+    return Plan(best=best, candidates=scored, pruned_memory=pruned,
+                constants=consts, plan_seconds=dt)
+
+
+# ---------------------------------------------------------------------------
+# DistributedStrategy integration (manual settings always win)
+# ---------------------------------------------------------------------------
+def _pinned_from_strategy(strategy) -> Dict[str, Any]:
+    """Knobs the user moved off their defaults — the planner must not
+    touch them. dp_degree in (-1, 0, 1) is 'auto' (fleet.init fills it)."""
+    pinned: Dict[str, Any] = {}
+    hc = strategy.hybrid_configs
+    for knob, key in (("dp", "dp_degree"), ("mp", "mp_degree"),
+                      ("pp", "pp_degree"), ("sharding", "sharding_degree")):
+        v = int(hc.get(key, 1))
+        if v > 1:
+            pinned[knob] = v
+    pc = strategy.pipeline_configs
+    if str(pc.get("schedule", "gpipe")) != "gpipe":
+        pinned["schedule"] = str(pc["schedule"])
+    if int(pc.get("virtual_pp_degree", 1)) != 1:
+        pinned["virtual_pp_degree"] = int(pc["virtual_pp_degree"])
+    return pinned
+
+
+def _coerce_model_config(obj) -> ModelConfig:
+    if isinstance(obj, ModelConfig):
+        return obj
+    if isinstance(obj, dict):
+        known = {k: v for k, v in obj.items()
+                 if k in ModelConfig.__dataclass_fields__}
+        return ModelConfig(**known)
+    return ModelConfig()
+
+
+def apply_auto_plan(strategy, ndev: int,
+                    topology: Optional[Topology] = None) -> Optional[Plan]:
+    """Fill the un-set layout knobs of ``strategy`` from the cost model.
+
+    Called by ``fleet.init`` when ``strategy.auto_plan`` or
+    ``PADDLE_TPU_AUTO_PLAN=1``. Never raises: a planner failure leaves the
+    strategy exactly as the user wrote it (and returns None)."""
+    try:
+        raw = getattr(strategy, "auto_plan_configs", {}).get("model_config")
+        mc = _coerce_model_config(raw)
+        explicit_batch = isinstance(raw, ModelConfig) or (
+            isinstance(raw, dict) and "global_batch" in raw)
+        if not explicit_batch:
+            # weak-scaling default: 2 sequences per device, like the proxy
+            mc = replace(mc, global_batch=max(mc.global_batch, 2 * ndev))
+        topo = topology or Topology(
+            n_devices=ndev,
+            num_slices=int(os.environ.get("PADDLE_TPU_NUM_SLICES", "1")))
+        result = plan(mc, topo, pinned=_pinned_from_strategy(strategy))
+    except Exception:  # noqa: BLE001 — planning must never block init
+        return None
+    best = result.best
+    hc = strategy.hybrid_configs
+    hc["dp_degree"] = best.dp
+    hc["mp_degree"] = best.mp
+    hc["pp_degree"] = best.pp
+    hc["sharding_degree"] = best.sharding
+    pc = strategy.pipeline_configs
+    pc["schedule"] = best.schedule
+    pc["virtual_pp_degree"] = best.virtual_pp_degree
+    pc["accumulate_steps"] = best.microbatches
+    strategy.pipeline = best.pp > 1
+    _obs.inc("autoplan_applied_total", ndev=ndev)
+    return result

@@ -64,8 +64,13 @@ def init(role_maker=None, is_collective: bool = False, strategy: Optional[Distri
     global _strategy, _initialized
     init_parallel_env()
     _strategy = strategy or DistributedStrategy()
-    hc = _strategy.hybrid_configs
     ndev = get_world_size()
+    import os as _os
+    if getattr(_strategy, "auto_plan", False) or \
+            _os.environ.get("PADDLE_TPU_AUTO_PLAN", "") == "1":
+        from ..auto_parallel import planner as _planner
+        _planner.apply_auto_plan(_strategy, ndev)
+    hc = _strategy.hybrid_configs
     mp = int(hc.get("mp_degree", 1))
     pp = int(hc.get("pp_degree", 1))
     sh = int(hc.get("sharding_degree", 1))
@@ -821,6 +826,26 @@ class DistTrainStep(TrainStep):
             batch_spec_fn=data_spec_for,
             buffer_changed_cell=changed,
             use_residuals=self._opt._grad_comm_residuals is not None)
+
+    def _aot_key_parts(self):
+        """Strategy + topology knobs for the persistent AOT compile cache:
+        anything that reshapes the SPMD program (mesh split, schedule,
+        bucketed exchange) must change the fingerprint even before the
+        lowered-module hash diverges."""
+        parts = super()._aot_key_parts()
+        strat = self._strategy_of()
+        if strat is not None:
+            parts["hybrid"] = dict(strat.hybrid_configs)
+            parts["pipeline"] = dict(strat.pipeline_configs)
+            parts["grad_comm"] = dict(strat.grad_comm_configs)
+            parts["sharding"] = dict(strat.sharding_configs)
+            parts["bucket_mb"] = strat.fuse_grad_size_in_MB
+        plan = self._grad_comm_plan
+        parts["grad_comm_buckets"] = None if plan is None else plan.n_buckets
+        return parts
+
+    def _aot_mesh(self):
+        return _mesh.get_global_mesh()
 
     def _dispatch(self, key, build, batch_vals):
         out = super()._dispatch(key, build, batch_vals)

@@ -111,6 +111,28 @@ class DistributedStrategy:
         self.tensor_parallel_configs: _SubConfig = _SubConfig(
             tensor_parallel_degree=1, tensor_init_seed=-1
         )
+        # auto-parallel: fleet.init() runs the cost-model planner
+        # (distributed/auto_parallel/planner.py) and fills in any hybrid/
+        # pipeline knob still at its default. Manual settings always win —
+        # the planner only writes knobs the user left untouched. Also
+        # reachable without code changes via PADDLE_TPU_AUTO_PLAN=1.
+        self.auto_plan = False
+        self.auto_plan_configs: _SubConfig = _SubConfig(model_config=None)
+
+    @classmethod
+    def auto(cls, model_config: Any = None) -> "DistributedStrategy":
+        """A strategy whose layout is chosen by the auto-parallel planner.
+
+        ``model_config`` (a ``planner.ModelConfig`` or plain dict of its
+        fields) describes the workload for the cost model; None lets the
+        planner fall back to the calibration proxy's shape. Any knob set
+        manually on the returned strategy afterwards is pinned — the
+        planner never overrides a non-default value.
+        """
+        s = cls()
+        s.auto_plan = True
+        s.auto_plan_configs.model_config = model_config
+        return s
 
     def to_dict(self) -> Dict[str, Any]:
         return {

@@ -65,6 +65,7 @@ import numpy as np
 
 from .. import observability as _obs
 from ..distributed.grad_comm import dequantize_absmax, quantize_absmax
+from ..runtime import compile_cache as _compile_cache
 from ..framework.core import Tensor, no_grad
 from ..framework.op import raw
 from ..nn import functional as F
@@ -593,6 +594,8 @@ class DecodeEngine:
         self._decode_jit = None
         self._verify_jit = None
         self._compiled = set()
+        self._aot: Dict[str, object] = {}  # persistent-cache Compiled objects
+        self.aot_cache_hits = 0
         self.compile_count = 0
         self.total_tokens = 0
         self.decode_steps = 0
@@ -930,9 +933,73 @@ class DecodeEngine:
             self.registry.clear()
         self._update_gauges()
 
+    def warmup(self) -> dict:
+        """Pre-build every compiled program before traffic arrives: one
+        prefill per prompt bucket, the single-token decode, and (when
+        ``speculate_k > 0``) the verify program. Synthetic inputs use
+        all-zero page tables, so every KV write lands on the inert trash
+        page 0 — pool, scheduler, and prefix registry are untouched. With
+        ``PADDLE_TPU_COMPILE_CACHE`` set, each build is served from the
+        persistent AOT cache when fingerprints match; ``cache_hits`` in
+        the returned dict counts those."""
+        cfg = self.config
+        s = cfg.num_slots
+        hits0, n0 = self.aot_cache_hits, self.compile_count
+        row = np.zeros(self._mp, np.int32)
+        for tb in self.buckets:
+            fn = self._prefill_jit.get(tb)
+            if fn is None:
+                fn = self._build_prefill(tb)
+                self._prefill_jit[tb] = fn
+            ids = np.full((1, tb), 1, np.int32)
+            out = self._run_counted(
+                f"prefill_b{tb}", fn,
+                self._state_vals(), self._kc, self._vc, self._ksc,
+                self._vsc, jnp.asarray(ids), np.int32(0), np.int32(tb),
+                jnp.asarray(row), jnp.asarray(self._zero_key),
+                np.float32(1.0), np.int32(0), np.float32(1.0),
+                np.asarray(True))
+            self._kc, self._vc, self._ksc, self._vsc = out[:4]
+        positions = np.zeros(s, np.int32)
+        temp = np.ones(s, np.float32)
+        top_k = np.zeros(s, np.int32)
+        top_p = np.ones(s, np.float32)
+        greedy = np.ones(s, bool)
+        keys = np.array(np.broadcast_to(
+            self._zero_key, (s,) + self._zero_key.shape))
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        out = self._run_counted(
+            "decode", self._decode_jit,
+            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
+            jnp.asarray(np.zeros(s, np.int32)), jnp.asarray(positions),
+            jnp.asarray(self._tables), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy))
+        self._kc, self._vc, self._ksc, self._vsc = out[:4]
+        verify = False
+        k = cfg.speculate_k
+        if k > 0:
+            if self._verify_jit is None:
+                self._verify_jit = self._build_verify(k + 1)
+            out = self._run_counted(
+                f"verify_k{k}", self._verify_jit,
+                self._state_vals(), self._kc, self._vc, self._ksc,
+                self._vsc, jnp.asarray(np.zeros((s, k + 1), np.int32)),
+                jnp.asarray(positions), jnp.asarray(self._tables),
+                jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p), jnp.asarray(greedy))
+            self._kc, self._vc, self._ksc, self._vsc = out[:4]
+            verify = True
+        return {"buckets": len(self.buckets), "decode": True,
+                "verify": verify,
+                "programs": self.compile_count - n0,
+                "cache_hits": self.aot_cache_hits - hits0}
+
     def stats(self) -> dict:
         return {
             "compile_count": self.compile_count,
+            "compile_cache_hits": self.aot_cache_hits,
             "compiled": sorted(self._compiled),
             "buckets": list(self.buckets),
             "decode_steps": self.decode_steps,
@@ -973,6 +1040,7 @@ class DecodeEngine:
             "prefix_hit_tokens": int(self.prefix_hit_tokens),
             "decode_steps": int(self.decode_steps),
             "total_tokens": int(self.total_tokens),
+            "compile_cache_hits": int(self.aot_cache_hits),
         }
 
     # -- disaggregated prefill: KV-page export / import ---------------------
@@ -1382,6 +1450,27 @@ class DecodeEngine:
     def _run_counted(self, name, fn, *args):
         first = name not in self._compiled
         t0 = time.perf_counter() if first else 0.0
+        cached = self._aot.get(name)
+        if cached is not None:
+            fn = cached
+        hit = None
+        if first and cached is None:
+            aot = _compile_cache.resolve()
+            if aot is not None:
+                try:
+                    with self._mesh_ctx():
+                        lowered = fn.lower(*args)
+                    key = aot.key_for(
+                        lowered, config=self._aot_key_parts(name),
+                        mesh=self._mesh)
+                    compiled, hit = aot.load_or_compile(
+                        lowered, key, where="decode_engine")
+                    self._aot[name] = compiled
+                    fn = compiled
+                    if hit:
+                        self.aot_cache_hits += 1
+                except Exception:  # noqa: BLE001 — never break serving
+                    hit = None
         with self._mesh_ctx():
             out = fn(*args)
         if first:
@@ -1390,8 +1479,27 @@ class DecodeEngine:
             self._compiled.add(name)
             self.compile_count += 1
             _obs.inc("serving_engine_compile_total")
-            _obs.record_compile("decode_engine", dt, signature=name)
+            _obs.record_compile("decode_engine", dt, signature=name,
+                                cache_hit=hit)
         return out
+
+    def _aot_key_parts(self, name: str) -> dict:
+        """Semantic fingerprint for the persistent AOT compile cache:
+        everything about the engine geometry that shapes the program
+        (the lowered-module hash covers the model body itself)."""
+        cfg = self.config
+        return {
+            "program": name,
+            "num_slots": cfg.num_slots,
+            "max_length": cfg.max_length,
+            "kv_dtype": cfg.kv_dtype,
+            "page_size": cfg.page_size,
+            "max_pages": self._mp,
+            "buckets": list(self.buckets),
+            "speculate_k": cfg.speculate_k,
+            "donate": self._donate,
+            "adapter": type(self.adapter).__name__,
+        }
 
     # -- compiled programs --------------------------------------------------
     #

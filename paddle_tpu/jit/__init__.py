@@ -25,6 +25,7 @@ import numpy as np
 
 from .. import observability as _obs
 from ..framework import rng as _rng
+from ..runtime import compile_cache as _compile_cache
 from ..framework.core import Tensor, TraceHostSyncError, no_grad
 from ..framework.op import raw
 from ..nn.layer import Layer
@@ -351,12 +352,26 @@ class TrainStep:
         b_vals = [b._value for b in buffers]
         opt_states = self._opt.functional_states()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        rng_key = _rng.next_key()
         jitted = self._cache.get(key)
         miss = jitted is None
+        aot_hit = None
         if miss:
             jitted = build()
+            aot = _compile_cache.resolve()
+            if aot is not None:
+                try:
+                    lowered = jitted.lower(
+                        p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
+                    ckey = aot.key_for(lowered, config=self._aot_key_parts(),
+                                       mesh=self._aot_mesh())
+                    jitted, aot_hit = aot.load_or_compile(
+                        lowered, ckey, where="train_step")
+                except Exception:  # noqa: BLE001
+                    # the cache must never break training — fall back to
+                    # the plain jit path (first call compiles normally)
+                    jitted, aot_hit = build(), None
             self._cache[key] = jitted
-        rng_key = _rng.next_key()
         out, new_p, new_b, new_st = jitted(
             p_vals, b_vals, opt_states, batch_vals, lr, rng_key)
         for p, v in zip(params, new_p):
@@ -370,7 +385,8 @@ class TrainStep:
             # the steady-state step-time distribution (record_compile also
             # emits the 'compile' span)
             _obs.record_compile("train_step", dt,
-                                signature=f"{type(self).__name__} {key!r}")
+                                signature=f"{type(self).__name__} {key!r}",
+                                cache_hit=aot_hit)
         else:
             _obs.observe("train_step_seconds", dt)
             _obs.record_span("train_step", dur_s=dt)
@@ -380,6 +396,18 @@ class TrainStep:
         """Hook: distributed subclasses place the batch on the data mesh axes
         (fleet.DistTrainStep)."""
         return batch_vals
+
+    def _aot_key_parts(self):
+        """Semantic fingerprint parts for the persistent AOT compile cache
+        (``runtime.compile_cache``). The lowered-module hash covers program
+        structure; subclasses add strategy/topology knobs so a changed
+        layout misses even before lowering diverges."""
+        return {"step": type(self).__name__, "donate": bool(self._donate)}
+
+    def _aot_mesh(self):
+        """Hook: the mesh whose axis names/sizes key the AOT cache entry
+        (fleet.DistTrainStep returns the global mesh)."""
+        return None
 
     def _compiled_for(self, *batch):
         """Lower+compile the step for this batch signature (cached) and
@@ -407,6 +435,11 @@ class TrainStep:
         if jitted is None:
             jitted = self._compile()
             self._cache[key] = jitted
+        elif not hasattr(jitted, "lower"):
+            # the dispatch cache may hold an AOT Compiled (persistent
+            # compile-cache path) — lower from a fresh traceable jit
+            # without evicting the warm executable
+            jitted = self._compile()
         rng_key = _rng.next_key()
         lcache = self.__dict__.setdefault("_introspect_lowered", {})
         if key not in lcache:

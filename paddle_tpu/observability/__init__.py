@@ -194,17 +194,24 @@ class timed:
 
 
 def record_compile(where: str, seconds: float,
-                   signature: Optional[str] = None) -> None:
-    """One jit cache miss: count + wall time + an auditable event."""
+                   signature: Optional[str] = None,
+                   cache_hit: Optional[bool] = None) -> None:
+    """One jit cache miss: count + wall time + an auditable event.
+
+    ``cache_hit`` distinguishes a fresh XLA compile (False) from a
+    persistent AOT compile-cache load (True) when the site consulted
+    ``runtime.compile_cache``; None means the cache was not in play.
+    """
     if telemetry_dir() is None:
         return
+    extra = {} if cache_hit is None else {"compile_cache_hit": bool(cache_hit)}
     inc("xla_compile_total", where=where)
     observe("xla_compile_seconds", seconds, where=where)
     event("xla_compile", where=where, seconds=round(seconds, 6),
-          signature=(signature or "")[:240])
+          signature=(signature or "")[:240], **extra)
     # every compile-instrumented site also traces: one single-span tree
     record_span("compile", dur_s=seconds, where=where,
-                signature=(signature or "")[:240])
+                signature=(signature or "")[:240], **extra)
 
 
 # ---------------------------------------------------------------------------

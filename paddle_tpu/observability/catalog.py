@@ -194,6 +194,41 @@ METRICS = {
     "reshard_bytes_total": (
         "counter", "Bytes moved through reshard collectives (sum of "
                    "per-step output local bytes across devices)"),
+    # -- auto-parallel planner (distributed/auto_parallel/planner.py) -------
+    "autoplan_candidates": (
+        "gauge", "Divisibility-legal layout candidates enumerated by the "
+                 "last plan() call (before the memory prune)"),
+    "autoplan_pruned_memory": (
+        "gauge", "Candidates dropped by the analytic per-device memory "
+                 "bound in the last plan() call"),
+    "autoplan_predicted_step_seconds": (
+        "gauge", "Cost-model step-time prediction for the layout the "
+                 "planner chose"),
+    "autoplan_plan_seconds": (
+        "histogram", "Wall time of one plan() enumerate+score+rank pass"),
+    "autoplan_applied_total": (
+        "counter", "Auto-planned layouts merged into a DistributedStrategy "
+                   "(manual knobs always win; labels: ndev)"),
+    # -- persistent AOT compile cache (runtime/compile_cache.py) ------------
+    "compile_cache_hits_total": (
+        "counter", "Executables loaded from the persistent AOT compile "
+                   "cache instead of recompiling (labels: where)"),
+    "compile_cache_miss_total": (
+        "counter", "Compile-cache lookups that fell through to a fresh "
+                   "lowered.compile() (labels: where)"),
+    "compile_cache_corrupt_total": (
+        "counter", "Cache entries that failed to deserialize and were "
+                   "evicted — always followed by a fresh compile, never "
+                   "a crash (labels: where)"),
+    "compile_cache_store_errors_total": (
+        "counter", "Executables that could not be serialized/written to "
+                   "the cache (non-fatal; labels: where)"),
+    "compile_cache_bytes_total": (
+        "counter", "Serialized executable bytes written to the persistent "
+                   "cache"),
+    "compile_cache_load_seconds": (
+        "histogram", "Wall time to read+deserialize+load one cached "
+                     "executable (the price of a hit)"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
@@ -226,6 +261,8 @@ EVENTS = {
     "serving_router_engine_up",    # router discovered a registered engine
     "serving_router_engine_dead",  # an engine's beat stalled past grace
     "serving_router_retransmit",   # unacked wire dispatches re-sent + mirrored
+    "autoplan",           # planner chose a layout (mesh, schedule, cost)
+    "compile_cache_corrupt",  # a cache entry failed to load and was evicted
 }
 
 

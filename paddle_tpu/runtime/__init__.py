@@ -17,8 +17,10 @@ from typing import Optional
 import numpy as np
 
 from . import native
+from . import compile_cache
 
 __all__ = [
+    "compile_cache",
     "native_available",
     "HostArena",
     "default_arena",

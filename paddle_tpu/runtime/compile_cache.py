@@ -1,0 +1,213 @@
+"""Persistent AOT compile cache — serialized XLA executables keyed by a
+config/topology/version fingerprint.
+
+Every jit cache miss on the proxy costs 4.7–7 s of XLA compile
+(MULTICHIP_SCALING.json ``compile_s``) and is paid again on every elastic
+relaunch and every serving cold-start, because the in-process jit cache
+dies with the process. This module makes the compiled artifact outlive the
+process: a train-step or decode-engine program is lowered once
+(``fn.lower(*args)``), compiled, serialized with
+``jax.experimental.serialize_executable``, and written to a directory
+keyed by a fingerprint of everything that could invalidate it —
+
+  * jax / jaxlib versions (XLA serialization is not stable across them),
+  * backend platform, device kind, device count, process count,
+  * mesh axis names and sizes (the sharding topology),
+  * the caller's semantic config (strategy knobs, engine geometry, …),
+  * a hash of the lowered StableHLO module text itself.
+
+The module-text hash means an under-specified ``config`` can never alias
+two different programs onto one entry; the explicit parts exist so a
+*different lowering of the same source* (changed strategy, topology,
+jaxlib) misses instead of deserializing an executable built for another
+world.
+
+Strictly **opt-in**: nothing touches disk unless ``PADDLE_TPU_COMPILE_CACHE``
+names a directory (or a cache is constructed explicitly). On this CPU
+jaxlib some deserialized executables have been observed to abort on
+re-execution (see tests/conftest.py on the removed global XLA cache), so
+the default-off posture is load-bearing; tier-1 never enables it.
+
+Failure posture: a cache entry that fails to read/deserialize is evicted,
+counted (``compile_cache_corrupt_total``), logged as a
+``compile_cache_corrupt`` event, and the caller gets a fresh compile —
+never a crash. Write failures are equally non-fatal: the compile result
+is simply not persisted.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from .. import observability as _obs
+
+__all__ = ["CompileCache", "resolve", "ENV_VAR"]
+
+#: Environment opt-in: a directory path enables the cache process-wide.
+ENV_VAR = "PADDLE_TPU_COMPILE_CACHE"
+
+#: Bump when the on-disk payload layout changes; part of every filename's
+#: fingerprint so old entries simply miss instead of failing to parse.
+_FORMAT = 1
+
+
+def _canonical(obj: Any) -> str:
+    """Deterministic JSON for fingerprinting (sorted keys, no whitespace)."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def _version_parts() -> Dict[str, str]:
+    import jax
+    import jaxlib
+    return {
+        "jax": getattr(jax, "__version__", "?"),
+        "jaxlib": getattr(jaxlib, "__version__", "?"),
+        "format": str(_FORMAT),
+    }
+
+
+def _topology_parts(mesh=None) -> Dict[str, Any]:
+    import jax
+    devs = jax.devices()
+    parts: Dict[str, Any] = {
+        "platform": devs[0].platform if devs else "none",
+        "device_kind": getattr(devs[0], "device_kind", "?") if devs else "?",
+        "n_devices": len(devs),
+        "process_count": jax.process_count(),
+    }
+    if mesh is not None:
+        try:
+            parts["mesh"] = dict(mesh.shape)
+        except Exception:
+            parts["mesh"] = str(mesh)
+    return parts
+
+
+class CompileCache:
+    """File-per-entry executable cache rooted at ``directory``.
+
+    Entries are ``<key>.jex`` pickles of the
+    ``serialize_executable.serialize`` 3-tuple plus a small metadata
+    header. Writes are atomic (tmp + ``os.replace``) so a concurrent
+    reader never sees a torn entry.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- keying -------------------------------------------------------------
+    def key_for(self, lowered=None, *, config: Any = None, mesh=None,
+                schedule: Any = None, extra: Any = None) -> str:
+        """Fingerprint of (config, topology, schedule, versions, module).
+
+        ``lowered`` is a ``jax.stages.Lowered``; its StableHLO text is
+        hashed into the key so distinct programs can never collide even
+        when the explicit parts are under-specified.
+        """
+        parts: Dict[str, Any] = {
+            "versions": _version_parts(),
+            "topology": _topology_parts(mesh),
+            "config": config,
+            "schedule": schedule,
+            "extra": extra,
+        }
+        if lowered is not None:
+            try:
+                text = lowered.as_text()
+            except Exception:
+                text = repr(lowered)
+            parts["module"] = hashlib.blake2b(
+                text.encode(), digest_size=16).hexdigest()
+        return hashlib.blake2b(
+            _canonical(parts).encode(), digest_size=20).hexdigest()
+
+    def path_for(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.jex")
+
+    # -- read side ----------------------------------------------------------
+    def load(self, key: str, where: str = "unknown"):
+        """Deserialized executable for ``key``, or None (miss/corrupt).
+
+        Any failure past "file exists" is treated as corruption: the
+        entry is evicted, counted, and logged — the caller falls back to
+        a fresh compile.
+        """
+        path = self.path_for(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            if blob.get("format") != _FORMAT or blob.get("key") != key:
+                raise ValueError("compile-cache header mismatch")
+            from jax.experimental import serialize_executable as _se
+            return _se.deserialize_and_load(*blob["payload"])
+        except Exception as exc:  # noqa: BLE001 — corrupt entry, any shape
+            _obs.inc("compile_cache_corrupt_total", where=where)
+            _obs.event("compile_cache_corrupt", where=where, key=key,
+                       error=f"{type(exc).__name__}: {exc}"[:240])
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+
+    # -- write side ---------------------------------------------------------
+    def store(self, key: str, compiled, where: str = "unknown") -> bool:
+        """Serialize ``compiled`` under ``key``; non-fatal on failure."""
+        try:
+            from jax.experimental import serialize_executable as _se
+            payload = _se.serialize(compiled)
+            blob = pickle.dumps({"format": _FORMAT, "key": key,
+                                 "where": where, "payload": payload})
+            tmp = self.path_for(key) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.path_for(key))
+            _obs.inc("compile_cache_bytes_total", len(blob))
+            return True
+        except Exception:  # noqa: BLE001 — never fail the step over the cache
+            _obs.inc("compile_cache_store_errors_total", where=where)
+            return False
+
+    # -- the one-call fast path ---------------------------------------------
+    def load_or_compile(self, lowered, key: str, *,
+                        where: str = "unknown") -> Tuple[Any, bool]:
+        """``(executable, hit)`` — cached load, else compile + persist.
+
+        Compile errors propagate (they are the caller's bug, not the
+        cache's); cache-layer errors never do.
+        """
+        t0 = time.perf_counter()
+        compiled = self.load(key, where=where)
+        if compiled is not None:
+            _obs.inc("compile_cache_hits_total", where=where)
+            _obs.observe("compile_cache_load_seconds",
+                         time.perf_counter() - t0, where=where)
+            return compiled, True
+        _obs.inc("compile_cache_miss_total", where=where)
+        compiled = lowered.compile()
+        self.store(key, compiled, where=where)
+        return compiled, False
+
+
+def resolve(explicit: Optional[str] = None) -> Optional[CompileCache]:
+    """The process's cache, or None when disabled.
+
+    ``explicit`` (a directory) wins; otherwise ``PADDLE_TPU_COMPILE_CACHE``
+    is consulted *per call* so tests and supervisors can flip it at
+    runtime. Unset/empty → disabled (the tier-1 default).
+    """
+    d = explicit or os.environ.get(ENV_VAR, "")
+    if not d:
+        return None
+    try:
+        return CompileCache(d)
+    except OSError:
+        return None

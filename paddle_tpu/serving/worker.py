@@ -501,9 +501,12 @@ def build_arg_parser():
                         "accelerator-bound steps on CPU-only hosts and "
                         "doubles as a crude rate limiter")
     p.add_argument("--warmup", action="store_true",
-                   help="pre-compile every prefill bucket and the decode "
-                        "program before serving, so placement luck cannot "
-                        "land an XLA compile on the request path")
+                   help="pre-build every engine program before serving — "
+                        "each prefill bucket, the single-token decode, and "
+                        "the verify program when --speculate-k > 0 — so "
+                        "placement luck cannot land an XLA compile on the "
+                        "request path; with PADDLE_TPU_COMPILE_CACHE set "
+                        "the builds load from the persistent AOT cache")
     # model spec (must match the router/bench reference build)
     p.add_argument("--model-seed", type=int, default=7)
     p.add_argument("--vocab", type=int, default=128)
@@ -548,13 +551,11 @@ def main(argv=None):
         prefix_cache=not args.no_prefix_cache, kv_dtype=args.kv_dtype,
         mesh=mesh)
     if args.warmup:
-        for b in worker.engine.buckets:
-            n = max(1, min(int(b), args.max_length - 4))
-            worker.engine.submit(np.full(n, 1, np.int64),
-                                 SamplingParams(max_new_tokens=2))
-        worker.engine.run()
+        w = worker.engine.warmup()
         print(f"[serving] worker {worker.name} warm "
-              f"({len(worker.engine.buckets)} buckets)",
+              f"({w['buckets']} buckets + decode"
+              + (" + verify" if w["verify"] else "")
+              + f", {w['cache_hits']}/{w['programs']} compile-cache hits)",
               file=sys.stderr, flush=True)
     print(f"[serving] worker {worker.name} (engine {worker.index}, "
           f"{worker.role}) serving via {args.master} + {worker._server.addr}",

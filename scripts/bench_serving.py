@@ -175,6 +175,90 @@ def run_churn(args, model):
     }
 
 
+def _cold_start_child(args):
+    """Fresh-process serving cold start: build the model, stand up the
+    engine, warm every program (prefill buckets + decode + verify), then
+    decode one prompt. Prints one JSON line with time-to-ready and the
+    greedy tokens (the parent asserts cache-on == cache-off bit-equal)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import (DecodeEngine, EngineConfig,
+                                             SamplingParams)
+
+    t0 = time.perf_counter()
+    paddle.seed(args.seed)
+    model = build_model(args)
+    eng = DecodeEngine(model, EngineConfig(
+        num_slots=4, max_length=args.max_length,
+        speculate_k=args.speculate_k))
+    w = eng.warmup()
+    ready_s = time.perf_counter() - t0
+    rng = np.random.default_rng(args.seed)
+    prompt = rng.integers(1, args.vocab, (args.prompt_len,), dtype=np.int64)
+    eng.submit(prompt, SamplingParams(max_new_tokens=8))
+    toks = {str(k): np.asarray(v).tolist() for k, v in eng.run().items()}
+    print(json.dumps({
+        "ready_s": round(ready_s, 3),
+        "programs": w["programs"],
+        "cache_hits": w["cache_hits"],
+        "tokens": toks,
+    }))
+
+
+def run_cold_start(args):
+    """Cold-start scenario: the same fresh-process engine bring-up three
+    times — no compile cache, cold cache (populates it), warm cache (a
+    second process finds every program) — as an ElasticManager relaunch /
+    ``worker --warmup`` restart proxy. Greedy tokens must be bit-equal
+    across all three."""
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_cold_cache_")
+
+    def child(env_extra):
+        env = dict(os.environ)
+        env.pop("PADDLE_TPU_COMPILE_CACHE", None)
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        env["BENCH_SERVING_COLD_CHILD"] = "1"
+        env.update(env_extra)
+        argv = [sys.executable, os.path.abspath(__file__),
+                "--max-length", str(args.max_length),
+                "--prompt-len", str(args.prompt_len),
+                "--hidden", str(args.hidden),
+                "--layers", str(args.layers),
+                "--heads", str(args.heads),
+                "--vocab", str(args.vocab),
+                "--seed", str(args.seed),
+                "--speculate-k", str(args.speculate_k)]
+        p = subprocess.run(argv, env=env, capture_output=True, text=True,
+                           timeout=900)
+        lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        if p.returncode or not lines:
+            raise RuntimeError(f"cold-start child failed rc={p.returncode}: "
+                               f"{(p.stderr or '')[-400:]}")
+        return json.loads(lines[-1])
+
+    print("cold-start: no cache...", file=sys.stderr)
+    none = child({})
+    print("cold-start: cold cache...", file=sys.stderr)
+    cold = child({"PADDLE_TPU_COMPILE_CACHE": cache_dir})
+    print("cold-start: warm cache...", file=sys.stderr)
+    warm = child({"PADDLE_TPU_COMPILE_CACHE": cache_dir})
+    assert none["tokens"] == cold["tokens"] == warm["tokens"], (
+        "cold-start greedy tokens diverged across cache modes")
+    return {
+        "no_cache_s": none["ready_s"],
+        "cold_start_s": cold["ready_s"],
+        "warm_start_s": warm["ready_s"],
+        "programs": warm["programs"],
+        "warm_cache_hits": warm["cache_hits"],
+        "speedup": round(cold["ready_s"] / max(warm["ready_s"], 1e-9), 2),
+        "tokens_bit_equal": True,
+    }
+
+
 def _free_port():
     import socket
 
@@ -653,10 +737,33 @@ def main(argv=None):
                     help="run only the router scenario (faster iteration)")
     ap.add_argument("--skip-naive", action="store_true",
                     help="run only the churn scenario (faster iteration)")
+    ap.add_argument("--cold-start-only", action="store_true",
+                    help="run only the fresh-process cold-start scenario "
+                         "(warm vs cold AOT compile cache) and merge the "
+                         "cold_start block into the existing "
+                         "BENCH_SERVING.json")
+    ap.add_argument("--skip-cold-start", action="store_true",
+                    help="skip the cold-start scenario in the full run")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_SERVING.json"))
     args = ap.parse_args(argv)
+
+    if os.environ.get("BENCH_SERVING_COLD_CHILD"):
+        _cold_start_child(args)
+        return 0
+    if args.cold_start_only:
+        block = run_cold_start(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["cold_start"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"cold_start": block}, indent=2))
+        return 0
 
     import numpy as np
 
@@ -750,6 +857,8 @@ def main(argv=None):
     }
     inference.disable_decode_engine(model)
     report["churn"] = run_churn(args, model)
+    if not args.skip_cold_start:
+        report["cold_start"] = run_cold_start(args)
     if not args.skip_router:
         report["router"] = run_router(args)
     with open(args.out, "w") as f:

@@ -82,6 +82,10 @@ OWNED_PREFIXES = {
     "pp_": os.path.join("paddle_tpu", "distributed", "fleet",
                         "meta_parallel", "pipeline_parallel.py"),
     "trace_": os.path.join("paddle_tpu", "observability", "tracing.py"),
+    "autoplan_": os.path.join("paddle_tpu", "distributed", "auto_parallel",
+                              "planner.py"),
+    "compile_cache_": os.path.join("paddle_tpu", "runtime",
+                                   "compile_cache.py"),
 }
 
 

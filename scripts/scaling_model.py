@@ -214,6 +214,67 @@ def project(entry):
     return out
 
 
+def record_planner_blocks(path=None):
+    """Annotate each MULTICHIP_SCALING.json proxy entry with a ``planner``
+    block: the auto-parallel cost model's predicted step time for the mesh
+    that was actually measured, the relative error, and the layout the
+    planner would have picked for that device count. Pure math over the
+    checked-in measurements (docs/AUTOPLAN.md) — no subprocesses, safe to
+    re-run any time the proxy numbers change."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.distributed.auto_parallel import planner
+
+    path = path or os.path.join(REPO, "MULTICHIP_SCALING.json")
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("results", [])
+    consts = planner.calibrate(entries)
+    annotated = 0
+    for e in entries:
+        if not e.get("ok", True) or "step_s" not in e:
+            continue
+        mc = planner._entry_model(e, planner.ModelConfig())
+        topo = planner.Topology(
+            n_devices=int(e["n"]),
+            num_slices=2 if e.get("two_slice") else 1)
+        measured = planner.score(
+            planner._entry_candidate(e), mc, topo, consts)
+        block = {
+            "predicted_step_s": round(measured.predicted_step_s, 4),
+            "measured_step_s": e["step_s"],
+            "rel_error": round(
+                abs(measured.predicted_step_s - e["step_s"])
+                / max(e["step_s"], 1e-12), 4),
+        }
+        try:
+            best = planner.plan(mc, topo, constants=consts).best
+            block["best"] = {
+                "mesh": best.mesh_dict(), "schedule": best.schedule,
+                "virtual_pp_degree": best.virtual_pp_degree,
+                "microbatches": best.microbatches,
+                "predicted_step_s": round(best.predicted_step_s, 4),
+            }
+        except ValueError:
+            block["best"] = None
+        e["planner"] = block
+        annotated += 1
+    doc["planner_calibration"] = {
+        "fixed_s": consts.fixed_s,
+        "sec_per_flop": consts.sec_per_flop,
+        "sec_per_byte": consts.sec_per_byte,
+        "sec_per_collective": consts.sec_per_collective,
+        "sec_per_dp_over_byte": consts.sec_per_dp_over_byte,
+        "source": consts.source,
+        "max_rel_error": round(consts.max_rel_error, 4),
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"written": path, "planner_entries": annotated,
+                      "calibration_max_rel_error":
+                      round(consts.max_rel_error, 4)}))
+    return doc
+
+
 def main():
     results = {}
     for name in CONFIGS:
@@ -270,6 +331,10 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--planner-only" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        record_planner_blocks()
+        sys.exit(0)
     child = os.environ.pop("SCALING_MODEL_CHILD", None)
     if child:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)

@@ -128,6 +128,56 @@ def test_serving_metric_from_engine_allowed(tmp_path):
     assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
 
 
+_AUTOPLAN_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.set_gauge("autoplan_candidates", 54.0)
+"""
+
+_CACHE_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.inc("compile_cache_hits_total")
+"""
+
+
+def test_autoplan_metric_from_wrong_file_rejected(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_AUTOPLAN_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "fleet", "__init__.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+
+
+def test_autoplan_metric_from_planner_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_AUTOPLAN_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "auto_parallel",
+                       "planner.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_compile_cache_metric_from_wrong_file_rejected(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_CACHE_SRC))
+    rel = os.path.join("paddle_tpu", "jit", "__init__.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+
+
+def test_compile_cache_metric_from_cache_module_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_CACHE_SRC))
+    rel = os.path.join("paddle_tpu", "runtime", "compile_cache.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_autoplan_and_cache_owner_dirs_are_scanned():
+    assert os.path.join("paddle_tpu", "runtime") in check_observability.SCAN_DIRS
+    assert "autoplan_" in check_observability.OWNED_PREFIXES
+    assert "compile_cache_" in check_observability.OWNED_PREFIXES
+
+
 def test_inference_dir_is_scanned():
     assert os.path.join("paddle_tpu", "inference") in check_observability.SCAN_DIRS
     assert "serving_" in check_observability.OWNED_PREFIXES
