@@ -79,6 +79,14 @@ class ModelConfig:
     global_batch: int = 16
     dtype_bytes: int = 4           # f32 master math on the proxy
     remat: str = "none"            # none | selective | full
+    # assumed WIRE dtypes per collective family (f32 | bf16 | int8): what
+    # actually crosses the mesh when grad_comm / mp_comm quantize the
+    # exchange. Defaults are the exact f32 program, so the calibration
+    # entries (measured unquantized) fit the same features as before;
+    # ``apply_auto_plan`` fills them from the resolved strategy configs.
+    mp_wire: str = "f32"           # mp activation recombination (mp_comm)
+    grad_wire: str = "f32"         # dp grad exchange (grad_comm)
+    zero_gather_wire: str = "f32"  # ZeRO param all-gather (mp_comm floor)
 
     @property
     def params(self) -> int:
@@ -130,6 +138,8 @@ class Candidate:
     # filled by score()
     predicted_step_s: float = 0.0
     breakdown: Dict[str, float] = field(default_factory=dict)
+    # the wire dtypes the byte model priced each axis at (ModelConfig)
+    wire_dtypes: Dict[str, str] = field(default_factory=dict)
 
     @property
     def ndev(self) -> int:
@@ -198,28 +208,41 @@ def _choose_microbatches(batch: int, requested: int) -> int:
     return m
 
 
+_WIRE_ITEMSIZE = {"f32": 4.0, "bf16": 2.0, "int8": 1.0}
+
+
 def _axis_bytes(cand: Candidate, mc: ModelConfig) -> Dict[str, float]:
     """Per-device wire bytes per step, by mesh axis — the analytic mirror
     of the ``comm_analysis`` ``per_axis`` attribution. Ring collective of
-    size k moves 2·(k−1)/k of the payload per participant."""
+    size k moves 2·(k−1)/k of the payload per participant. Each axis is
+    priced at ITS wire dtype (ModelConfig.mp_wire/grad_wire/
+    zero_gather_wire): a quantized exchange moves the wire itemsize, not
+    f32 — with the f32 defaults the model is byte-identical to the
+    pre-wire-aware one, so the calibration fit is unchanged."""
 
     def ring(k: int) -> float:
         return 2.0 * (k - 1) / k if k > 1 else 0.0
 
-    pbytes = mc.params * mc.dtype_bytes
+    it_mp = _WIRE_ITEMSIZE[mc.mp_wire]
+    it_dp = _WIRE_ITEMSIZE[mc.grad_wire]
+    it_zg = _WIRE_ITEMSIZE[mc.zero_gather_wire]
     local_batch = mc.global_batch / max(1, cand.dp * cand.sharding)
-    act = local_batch * mc.seq_len * mc.hidden * mc.dtype_bytes
+    act_elems = local_batch * mc.seq_len * mc.hidden
     out: Dict[str, float] = {}
-    # mp: 2 fwd + 2 bwd activation all-reduces per layer (attn out + mlp out)
-    out["mp"] = 4.0 * mc.layers * act * ring(cand.mp)
-    # sharding (ZeRO): all-gather params fwd + reduce-scatter grads bwd over
+    # mp: 2 fwd + 2 bwd activation recombinations per layer (attn out +
+    # mlp out), each moving act_elems at the activation wire dtype
+    out["mp"] = 4.0 * mc.layers * act_elems * it_mp * ring(cand.mp)
+    # sharding (ZeRO): all-gather params fwd (activation-wire gathered,
+    # bf16-floored by mp_comm) + reduce-scatter grads bwd (grad wire) over
     # the model-parallel shard each device owns
-    shard_pbytes = pbytes / max(1, cand.mp * cand.pp)
-    out["sharding"] = 2.0 * shard_pbytes * ring(cand.sharding)
-    # dp: gradient all-reduce of the per-device grad shard
-    grad_pd = pbytes / max(1, cand.mp * cand.pp * cand.sharding)
+    shard_params = mc.params / max(1, cand.mp * cand.pp)
+    out["sharding"] = shard_params * (it_zg + it_dp) * ring(cand.sharding)
+    # dp: gradient all-reduce of the per-device grad shard at the grad wire
+    grad_pd = mc.params * it_dp / max(1, cand.mp * cand.pp * cand.sharding)
     out["dp"] = grad_pd * ring(cand.dp)
     # pp: boundary activations per microbatch, fwd + bwd, × virtual chunks
+    # (point-to-point sends stay at the compute dtype — not quantized)
+    act = act_elems * mc.dtype_bytes
     if cand.pp > 1:
         out["pp"] = 2.0 * act * cand.virtual_pp_degree
     else:
@@ -320,6 +343,10 @@ def score(cand: Candidate, mc: ModelConfig, topo: Topology,
     names = ("fixed_s", "compute_s", "comm_s", "latency_s", "dp_over_s")
     out = replace(cand)
     out.breakdown = {k: float(fi * vi) for k, fi, vi in zip(names, f, v)}
+    # record what the byte model assumed crossed each axis's wire, so a
+    # plan explains WHY a quantized layout ranked where it did
+    out.wire_dtypes = {"mp": mc.mp_wire, "dp": mc.grad_wire,
+                       "zero_gather": mc.zero_gather_wire}
     out.predicted_step_s = float(f @ v)
     return out
 
@@ -582,6 +609,18 @@ def apply_auto_plan(strategy, ndev: int,
         if not explicit_batch:
             # weak-scaling default: 2 sequences per device, like the proxy
             mc = replace(mc, global_batch=max(mc.global_batch, 2 * ndev))
+        # price the wires the strategy will actually run with: grad_comm's
+        # dp gradient wire and mp_comm's activation/ZeRO-gather wires
+        from .. import grad_comm as _gc
+        from .. import mp_comm as _mpc
+
+        gcfg = _gc.resolve_config(strategy)
+        wcfg = _mpc.resolve_config(strategy)
+        mc = replace(
+            mc,
+            grad_wire=(gcfg.wire_dtype if gcfg.enable else mc.grad_wire),
+            mp_wire=wcfg.act_wire or mc.mp_wire,
+            zero_gather_wire=wcfg.param_gather_wire or mc.zero_gather_wire)
         topo = topology or Topology(
             n_devices=ndev,
             num_slices=int(os.environ.get("PADDLE_TPU_NUM_SLICES", "1")))

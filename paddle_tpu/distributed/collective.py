@@ -26,7 +26,8 @@ TPU-native design — two execution contexts, one API:
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import functools
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -105,19 +106,132 @@ def psum_scatter_f32safe(v, ax, scatter_dimension=0, tiled=True):
                             tiled=tiled)
 
 
-def psum_quantized(v, ax, wire_dtype="bf16"):
+def psum_quantized(v, ax, wire_dtype="bf16", via="simulate"):
     """Reduced-precision all-reduce: each contributor's value passes
     through the wire dtype (bf16 round-trip, or int8 with a per-call
-    absmax scale) and the accumulation runs in f32. On emulated CPU
-    meshes this SIMULATES the wire — the compiled HLO still moves f32
-    bytes — but the numerics match a real reduced-precision exchange
-    with per-contributor quantization. ``distributed.grad_comm`` is the
-    production caller (its buckets inline the same two steps); exposed
-    here as the single audited primitive for tests and benches."""
+    absmax scale) and the accumulation runs in f32.
+
+    ``via="simulate"`` (the historical default) quantize-round-trips the
+    contribution but still moves f32 bytes in the compiled HLO — the
+    numerics of a reduced wire without the bytes. ``via="gather"``
+    exchanges the REAL reduced payload: each shard's int8/bf16 value plus
+    its f32 scale is all-gathered at wire dtype and the sum runs in f32
+    after dequant, so ``comm_analysis`` sees s8/bf16 collective operands.
+    ``distributed.grad_comm`` (dp gradient buckets, simulate) and
+    ``distributed.mp_comm`` (mp activation wire, gather) are the
+    production callers; exposed here as the single audited primitive for
+    tests and benches."""
     from .grad_comm import quantize_roundtrip
 
+    if via == "gather":
+        return _psum_gather_wire(v, ax, wire_dtype)
     q = quantize_roundtrip(v.astype(jnp.float32), wire_dtype)
     return lax.psum(q, ax).astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _psum_gather_wire(v, ax, wire_dtype):
+    """psum through a real reduced-precision exchange: stack-gather the
+    wire payload (+ per-shard absmax scale for int8), dequantize, sum in
+    f32. Backward is the straight-through psum of the wire-round-tripped
+    cotangent — symmetric with the forward wire."""
+    from .grad_comm import quantize_absmax
+
+    dt = v.dtype
+    v32 = v.astype(jnp.float32)
+    if wire_dtype == "int8":
+        q, scale = quantize_absmax(v32)
+        gq = lax.all_gather(q, ax, axis=0, tiled=False).astype(jnp.float32)
+        gs = lax.all_gather(scale, ax, axis=0, tiled=False)
+        return jnp.sum(gq * gs, axis=0).astype(dt)
+    if wire_dtype == "bf16":
+        g = lax.all_gather(v32.astype(jnp.bfloat16), ax, axis=0,
+                           tiled=False).astype(jnp.float32)
+        return jnp.sum(g, axis=0).astype(dt)
+    return lax.psum(v, ax)
+
+
+def _psum_gather_wire_fwd(v, ax, wire_dtype):
+    return _psum_gather_wire(v, ax, wire_dtype), None
+
+
+def _psum_gather_wire_bwd(ax, wire_dtype, _res, ct):
+    from .grad_comm import quantize_roundtrip
+
+    ctq = quantize_roundtrip(ct.astype(jnp.float32), wire_dtype)
+    return (psum_f32safe(ctq, ax).astype(ct.dtype),)
+
+
+_psum_gather_wire.defvjp(_psum_gather_wire_fwd, _psum_gather_wire_bwd)
+
+
+def all_gather_quantized(v, ax, *, wire_dtype="int8",
+                         segments: Optional[Tuple[int, ...]] = None,
+                         grad_wire: Optional[str] = None):
+    """All-gather a flat f32 vector through a reduced-precision wire.
+
+    int8: the shard payload crosses the mesh as s8 with per-segment f32
+    absmax scales (``segments`` are the flat element counts of the leaves
+    packed into ``v`` — one scale per leaf; one global scale when
+    omitted); bf16: a plain bf16 gather. Dequantization and all
+    downstream math run in f32. The backward transposes to a
+    ``psum_scatter`` of the wire-round-tripped cotangent (``grad_wire``,
+    defaulting to the forward wire) — the quantized-symmetric cotangent
+    collective. Contract: ``v`` is 1-D and gathers tiled on axis 0,
+    matching the packed-leaf layout of ``grad_comm.gather_leaves``."""
+    if wire_dtype not in ("bf16", "int8"):
+        return lax.all_gather(v, ax, axis=0, tiled=True)
+    segs: Tuple[int, ...]
+    if segments is None:
+        segs = (int(v.shape[0]),)
+    else:
+        segs = tuple(int(s) for s in segments)
+        if sum(segs) != int(v.shape[0]):
+            raise ValueError(
+                f"all_gather_quantized: segments sum {sum(segs)} != "
+                f"payload length {int(v.shape[0])}")
+    return _agq(v, ax, wire_dtype, segs, grad_wire or wire_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _agq(v, ax, wire_dtype, segs, grad_wire):
+    v32 = v.astype(jnp.float32)
+    if wire_dtype == "bf16":
+        return lax.all_gather(v32.astype(jnp.bfloat16), ax, axis=0,
+                              tiled=True).astype(jnp.float32)
+    from .grad_comm import _INT8_LEVELS
+
+    offs = np.concatenate([[0], np.cumsum(segs)]).astype(np.int64)
+    qs, scales = [], []
+    for i, n in enumerate(segs):
+        seg = v32[int(offs[i]):int(offs[i]) + int(n)]
+        s = jnp.maximum(jnp.max(jnp.abs(seg)) / _INT8_LEVELS, 1e-12)
+        qs.append(jnp.clip(jnp.round(seg / s), -_INT8_LEVELS,
+                           _INT8_LEVELS).astype(jnp.int8))
+        scales.append(s)
+    q = jnp.concatenate(qs)
+    svec = jnp.stack(scales)
+    gq = lax.all_gather(q, ax, axis=0, tiled=True)
+    gs = lax.all_gather(svec, ax, axis=0, tiled=False)  # [n_shards, n_segs]
+    n_total = int(sum(segs))
+    blocks = gq.reshape((-1, n_total)).astype(jnp.float32)
+    sexp = jnp.repeat(gs, repeats=np.asarray(segs), axis=1,
+                      total_repeat_length=n_total)
+    return (blocks * sexp).reshape(-1)
+
+
+def _agq_fwd(v, ax, wire_dtype, segs, grad_wire):
+    return _agq(v, ax, wire_dtype, segs, grad_wire), None
+
+
+def _agq_bwd(ax, wire_dtype, segs, grad_wire, _res, ct):
+    from .grad_comm import quantize_roundtrip
+
+    ctq = quantize_roundtrip(ct.astype(jnp.float32), grad_wire)
+    return (lax.psum_scatter(ctq, ax, scatter_dimension=0, tiled=True),)
+
+
+_agq.defvjp(_agq_fwd, _agq_bwd)
 
 
 # ---------------------------------------------------------------- all_reduce

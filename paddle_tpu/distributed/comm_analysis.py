@@ -189,6 +189,33 @@ def axis_traffic_summary(colls: List[Dict]) -> Dict[str, int]:
     return agg
 
 
+def axis_wire_summary(colls: List[Dict]) -> Dict[str, Dict]:
+    """Per axis-combination wire-dtype split — the activation-collective
+    analogue of ``bucket_traffic``'s dp-bucket accounting. For every axis
+    combo: payload bytes as they cross the wire, their f32 equivalent
+    (what the same exchange would move unquantized), the quantized
+    fraction, and the wire dtypes seen. mp_comm's blocked recombination
+    shows up here as s8/bf16 payload on mp-involving axes; an exact
+    program shows quantized_fraction == 0 everywhere."""
+    agg: Dict[str, Dict] = {}
+    for c in colls:
+        key = "+".join(c["axes"])
+        e = agg.setdefault(key, {
+            "payload_bytes": 0, "payload_bytes_f32": 0,
+            "wire_bytes_per_device": 0, "wire_dtypes": []})
+        it = _DTYPE_BYTES.get(c["wire_dtype"] or "f32", 4)
+        e["payload_bytes"] += c["payload_bytes"]
+        e["payload_bytes_f32"] += c["payload_bytes"] * 4 // it
+        e["wire_bytes_per_device"] += c["wire_bytes_per_device"]
+        if c["wire_dtype"] and c["wire_dtype"] not in e["wire_dtypes"]:
+            e["wire_dtypes"].append(c["wire_dtype"])
+    for e in agg.values():
+        p32 = e["payload_bytes_f32"]
+        e["quantized_fraction"] = (
+            1.0 - e["payload_bytes"] / p32 if p32 else 0.0)
+    return agg
+
+
 def axis_payload_summary(colls: List[Dict]) -> Dict[str, int]:
     """Total raw payload bytes per axis combination (pre-algorithm): what
     a hierarchical multi-slice schedule would move across the slice cut

@@ -58,6 +58,63 @@ def _reshard(x, spec: P):
     return _mesh.sharding_constraint(x, spec)
 
 
+# ------------------------------------------------------- activation wire
+# Quantized mp collectives (distributed/mp_comm.py): when the activation
+# wire is on, the parallel layers route their contraction through the
+# blocked recombination — per-shard partial sums cross the mesh at
+# bf16/int8 with f32 accumulation — instead of GSPMD's implicit f32
+# all-reduce. Resolved per trace so `PADDLE_TPU_MP_COMM` and the engine's
+# `activation_wire_disabled()` scope both take effect without rebuilds.
+
+def _mp_wire_cfg(world_size: int):
+    if world_size <= 1 or not _has_mp():
+        return None
+    from ... import mp_comm as _mp_comm
+
+    cfg = _mp_comm.resolve_config()
+    return cfg if cfg.quantized else None
+
+
+def _wire_out_dtype(*vals):
+    return jnp.result_type(*[v.dtype for v in vals])
+
+
+@defop(name="mp_wire_row_linear")
+def _row_linear_wire(x, w, g: int, wire_dtype: str):
+    from ... import mp_comm as _mp_comm
+
+    out = _mp_comm.row_parallel_matmul(x, w, g, wire_dtype, _data_axes())
+    return out.astype(_wire_out_dtype(x, w))
+
+
+@defop(name="mp_wire_col_linear")
+def _col_linear_wire(x, w, g: int, wire_dtype: str):
+    from ... import mp_comm as _mp_comm
+
+    out = _mp_comm.column_parallel_linear(x, w, g, wire_dtype, _data_axes())
+    return out.astype(_wire_out_dtype(x, w))
+
+
+@defop(name="mp_wire_vocab_embedding")
+def _vocab_embed_wire(w, ids, g: int, wire_dtype: str):
+    from ... import mp_comm as _mp_comm
+
+    out = _mp_comm.vocab_parallel_embedding(w, ids, g, wire_dtype,
+                                            _data_axes())
+    return out.astype(w.dtype)
+
+
+def mp_wire_linear(x, w, world_size: int):
+    """Column-form linear for the tied LM head (``w [H, V]`` with the
+    output/vocab dim mp-sharded): identical to ``F.linear(x, w)`` when the
+    activation wire is off; with it on, the backward dx recombination —
+    the layer's one mp collective — rides the quantized blocked wire."""
+    cfg = _mp_wire_cfg(world_size)
+    if cfg is None or int(w.shape[-1]) % world_size != 0:
+        return F.linear(x, w)
+    return _col_linear_wire(x, w, world_size, cfg.wire_dtype)
+
+
 def mark_activation(x, *, last_mp: bool = False, seq_mp: bool = False, seq_dim: int = 1):
     """Constrain an activation's layout: batch on (dp, sharding), optionally
     hidden on mp (column-parallel output) or sequence on mp (Megatron-SP)."""
@@ -117,6 +174,15 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        cfg = _mp_wire_cfg(self.world_size)
+        if cfg is not None:
+            # fwd is collective-free (y stays mp-sharded); the wire rides
+            # the backward dx recombination
+            y = _col_linear_wire(x, self.weight, self.world_size,
+                                 cfg.wire_dtype)
+            if self.bias is not None:
+                y = y + self.bias
+            return mark_activation(y, last_mp=not self.gather_output)
         y = F.linear(x, self.weight, self.bias)
         return mark_activation(y, last_mp=not self.gather_output)
 
@@ -165,6 +231,15 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.input_is_parallel:
             x = mark_activation(x, last_mp=True)
+        cfg = _mp_wire_cfg(self.world_size)
+        if cfg is not None:
+            # the fwd all-reduce is THE row-parallel collective: recombine
+            # the per-shard partials through the quantized blocked wire
+            y = _row_linear_wire(x, self.weight, self.world_size,
+                                 cfg.wire_dtype)
+            if self.bias is not None:
+                y = y + self.bias
+            return mark_activation(y)
         y = F.linear(x, self.weight, self.bias)
         # GSPMD: contraction over the mp-sharded dim → partial-sum → allreduce
         return mark_activation(y)
@@ -200,6 +275,13 @@ class VocabParallelEmbedding(Layer):
         self.weight.split_axis = 0
 
     def forward(self, x):
+        cfg = _mp_wire_cfg(self.world_size)
+        if cfg is not None:
+            # one-hot-matmul lowering of the sharded-table gather; the
+            # mask+allreduce recombination rides the quantized wire
+            y = _vocab_embed_wire(self.weight, x, self.world_size,
+                                  cfg.wire_dtype)
+            return mark_activation(y)
         y = F.embedding(x, self.weight)
         return mark_activation(y)
 

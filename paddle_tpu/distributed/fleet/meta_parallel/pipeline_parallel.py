@@ -688,6 +688,12 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
                 leaves[i] = v
         return tuple(leaves)
 
+    # mp_comm activation wire: the per-layer ZeRO parameter gather is a
+    # forward payload — ride the quantized all-gather (floored at bf16,
+    # see MpCommConfig.param_gather_wire) when the wire is on
+    from ... import mp_comm as _mp_comm
+    _param_gather_wire = _mp_comm.resolve_config().param_gather_wire
+
     def _prep_layer(leaves):
         """Gather the ZeRO-sharded leaves of ONE layer (inside remat, so
         residuals stay sharded slices)."""
@@ -696,7 +702,8 @@ def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
         out = list(leaves)
         for i, full in _grad_comm.gather_leaves(
                 [leaves[i] for i in z_layout.indices], z_layout, "sharding",
-                wire_dtype=cfg.wire_dtype if cfg.quantized else None):
+                wire_dtype=cfg.wire_dtype if cfg.quantized else None,
+                act_wire=_param_gather_wire):
             out[i] = full
         return tuple(out)
 

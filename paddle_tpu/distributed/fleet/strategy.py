@@ -104,6 +104,18 @@ class DistributedStrategy:
             wire_dtype="f32", error_feedback=False,
             zero_update=True, pipeline_batch_shard=True, overlap=True,
         )
+        # activation wire (distributed/mp_comm.py): quantized mp/sharding
+        # activation collectives — blocked recombination of Row/Column/
+        # Vocab-parallel partial sums at bf16/int8 with f32 accumulation,
+        # quantized ZeRO parameter all-gathers (floored at bf16), and the
+        # decode logit recombination with exact-argmax verify. Same env
+        # grammar as grad_comm under PADDLE_TPU_MP_COMM; off by default —
+        # the exact GSPMD collectives remain the baseline.
+        self.mp_comm = False
+        self.mp_comm_configs: _SubConfig = _SubConfig(
+            wire_dtype="f32", error_feedback=False,
+            zero_gather=True, logit_verify=True,
+        )
         self.nccl_comm_num = 1
         self.find_unused_parameters = False
         self.without_graph_optimization = False

@@ -132,33 +132,38 @@ def _strategy_config(strategy) -> GradCommConfig:
     )
 
 
-def resolve_config(strategy=None) -> GradCommConfig:
-    """Strategy knobs overridden by ``PADDLE_TPU_GRAD_COMM``.
+def _parse_bool(env_var: str, key: str, v: str) -> bool:
+    """Strict boolean values: anything outside the on/off vocabulary is a
+    hard error — ``ef=maybe`` must never silently parse as False."""
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise ValueError(
+        f"{env_var}: {key}={v!r} is not a boolean "
+        f"(want one of {tuple(sorted(_TRUE | _FALSE))})")
 
-    Env grammar (case-insensitive):
-      ``off``/``0``            disable bucketing/quantization (the
-                               zero_update / batch-shard fixes keep their
-                               defaults; use explicit keys to kill them)
-      ``on``/``1``/``f32``     enable with f32 wire
-      ``bf16`` / ``int8``      enable with that wire dtype
-      comma list of ``k=v``    fine-grained: ``wire=int8,bucket_mb=8,``
-                               ``error_feedback=1,zero=0,batch_shard=0,``
-                               ``overlap=0,enable=1``
-    """
-    if strategy is None:
-        from . import fleet as _fleet
 
-        strategy = _fleet.fleet_strategy()
-    cfg = _strategy_config(strategy)
-    raw = os.environ.get("PADDLE_TPU_GRAD_COMM", "").strip().lower()
+def _bool_key(env_var: str, field: str):
+    def apply(cfg, v):
+        return replace(cfg, **{field: _parse_bool(env_var, field, v)})
+    return apply
+
+
+def parse_wire_env(env_var: str, cfg, extra_keys=None):
+    """The shared ``off/on/f32/bf16/int8`` + ``k=v`` comm-wire env grammar
+    — ONE implementation behind both prefixes (``PADDLE_TPU_GRAD_COMM``
+    here, ``PADDLE_TPU_MP_COMM`` in ``mp_comm``).
+
+    ``cfg`` is any frozen dataclass with ``enable`` and ``wire_dtype``
+    fields; ``extra_keys`` maps prefix-specific key names to
+    ``f(cfg, value) -> cfg`` appliers. Unknown bare tokens, unknown keys,
+    and non-boolean values for boolean keys are all hard errors — a typo
+    must never silently run the f32 wire."""
+    raw = os.environ.get(env_var, "").strip().lower()
     if not raw:
         return cfg
-    if raw in _FALSE:
-        return replace(cfg, enable=False)
-    if raw in _TRUE or raw == "f32":
-        return replace(cfg, enable=True, wire_dtype="f32")
-    if raw in ("bf16", "int8"):
-        return replace(cfg, enable=True, wire_dtype=raw)
+    extra_keys = extra_keys or {}
     for part in raw.split(","):
         part = part.strip()
         if not part:
@@ -173,30 +178,54 @@ def resolve_config(strategy=None) -> GradCommConfig:
                 cfg = replace(cfg, enable=True, wire_dtype=part)
             else:
                 raise ValueError(
-                    f"PADDLE_TPU_GRAD_COMM: bad token {part!r} (want k=v, or "
+                    f"{env_var}: bad token {part!r} (want k=v, or "
                     f"a mode from {('off', 'on', 'f32', 'bf16', 'int8')})")
             continue
         k, v = (s.strip() for s in part.split("=", 1))
         if k in ("wire", "wire_dtype"):
             if v not in WIRE_DTYPES:
                 raise ValueError(
-                    f"PADDLE_TPU_GRAD_COMM wire={v!r} not in {WIRE_DTYPES}")
+                    f"{env_var} wire={v!r} not in {WIRE_DTYPES}")
             cfg = replace(cfg, wire_dtype=v, enable=True)
-        elif k == "bucket_mb":
-            cfg = replace(cfg, bucket_mb=float(v), enable=True)
-        elif k in ("ef", "error_feedback"):
-            cfg = replace(cfg, error_feedback=v in _TRUE)
-        elif k in ("zero", "zero_update"):
-            cfg = replace(cfg, zero_update=v in _TRUE)
-        elif k in ("batch_shard", "pipeline_batch_shard"):
-            cfg = replace(cfg, pipeline_batch_shard=v in _TRUE)
-        elif k == "overlap":
-            cfg = replace(cfg, overlap=v in _TRUE)
         elif k == "enable":
-            cfg = replace(cfg, enable=v in _TRUE)
+            cfg = replace(cfg, enable=_parse_bool(env_var, k, v))
+        elif k in extra_keys:
+            cfg = extra_keys[k](cfg, v)
         else:
-            raise ValueError(f"PADDLE_TPU_GRAD_COMM: unknown key {k!r}")
+            raise ValueError(f"{env_var}: unknown key {k!r}")
     return cfg
+
+
+def resolve_config(strategy=None) -> GradCommConfig:
+    """Strategy knobs overridden by ``PADDLE_TPU_GRAD_COMM``.
+
+    Env grammar (case-insensitive, shared with ``PADDLE_TPU_MP_COMM`` —
+    see :func:`parse_wire_env`):
+      ``off``/``0``            disable bucketing/quantization (the
+                               zero_update / batch-shard fixes keep their
+                               defaults; use explicit keys to kill them)
+      ``on``/``1``/``f32``     enable with f32 wire
+      ``bf16`` / ``int8``      enable with that wire dtype
+      comma list of ``k=v``    fine-grained: ``wire=int8,bucket_mb=8,``
+                               ``error_feedback=1,zero=0,batch_shard=0,``
+                               ``overlap=0,enable=1``
+    """
+    if strategy is None:
+        from . import fleet as _fleet
+
+        strategy = _fleet.fleet_strategy()
+    cfg = _strategy_config(strategy)
+    var = "PADDLE_TPU_GRAD_COMM"
+    return parse_wire_env(var, cfg, {
+        "bucket_mb": lambda c, v: replace(c, bucket_mb=float(v), enable=True),
+        "ef": _bool_key(var, "error_feedback"),
+        "error_feedback": _bool_key(var, "error_feedback"),
+        "zero": _bool_key(var, "zero_update"),
+        "zero_update": _bool_key(var, "zero_update"),
+        "batch_shard": _bool_key(var, "pipeline_batch_shard"),
+        "pipeline_batch_shard": _bool_key(var, "pipeline_batch_shard"),
+        "overlap": _bool_key(var, "overlap"),
+    })
 
 
 # --------------------------------------------------------------- bucketing --
@@ -431,14 +460,29 @@ def unpack_gathered(flat, layout: ShardLayout):
 
 
 def gather_leaves(local_leaves, layout: ShardLayout, axis_name: str,
-                  wire_dtype: Optional[str] = None):
+                  wire_dtype: Optional[str] = None,
+                  act_wire: Optional[str] = None):
     """Inside a manual region: one tiled all_gather reassembling the full
     leaves from every rank's shard block (ZeRO-3 parameter gather; its
     autodiff transpose is the reduce_scatter that keeps gradients
     sharded). ``local_leaves`` are this rank's shard slices, in layout
     order. ``wire_dtype`` wire-casts the gathered buffer so the transposed
-    reduce_scatter carries a quantized cotangent payload."""
+    reduce_scatter carries a quantized cotangent payload.
+
+    ``act_wire`` (mp_comm activation wire) additionally quantizes the
+    FORWARD payload itself — per-leaf absmax scales, a REAL
+    reduced-precision all_gather in the compiled HLO, not just the
+    cotangent cast (``collective.all_gather_quantized``)."""
     flat = jnp.concatenate([v.reshape(-1) for v in local_leaves])
+    if act_wire in ("bf16", "int8"):
+        from .collective import all_gather_quantized
+
+        gathered = all_gather_quantized(
+            flat, axis_name, wire_dtype=act_wire,
+            segments=tuple(int(np.prod(v.shape)) if v.shape else 1
+                           for v in local_leaves),
+            grad_wire=wire_dtype)
+        return unpack_gathered(gathered, layout)
     gathered = lax.all_gather(flat, axis_name, axis=0, tiled=True)
     if wire_dtype is not None:
         gathered = wire_cast(gathered, wire_dtype)
@@ -655,6 +699,12 @@ def build_explicit_dp_step(cfg: GradCommConfig, plan: DpPlan, mesh, *,
     # residual update is state escaping a vjp) — both keep the
     # post-backward issue.
     overlap_tail = plan.overlap_tail and not ef
+    # mp_comm activation wire: the ZeRO parameter all-gather is a forward
+    # payload, so it rides the quantized gather (floored at bf16 — see
+    # MpCommConfig.param_gather_wire) when the activation wire is on
+    from . import mp_comm as _mp_comm
+
+    param_gather_wire = _mp_comm.resolve_config().param_gather_wire
 
     def _overlapped(shapes):
         @jax.custom_vjp
@@ -774,7 +824,8 @@ def build_explicit_dp_step(cfg: GradCommConfig, plan: DpPlan, mesh, *,
         new_p = list(new_p)
         for lay in plan.zero_layouts:
             local = [new_p[i] for i in lay.indices]
-            for i, full in gather_leaves(local, lay, "sharding"):
+            for i, full in gather_leaves(local, lay, "sharding",
+                                         act_wire=param_gather_wire):
                 new_p[i] = full
         return loss, tuple(new_p), tuple(new_b), list(new_st), new_res
 

@@ -1,0 +1,396 @@
+"""Quantized tensor-parallel (activation) collectives — the mp/sharding
+analogue of the dp gradient wire in ``grad_comm``.
+
+Motivation (ROADMAP open item 1): ``comm_analysis`` shows the bytes are
+NOT on the dp gradient exchange — mp-involving axes dominate ``per_axis``
+traffic in MULTICHIP_SCALING.json. EQuARX (arXiv 2506.17615) demonstrates
+that quantized all-reduce/all-gather with full-precision accumulation
+preserves quality at large wire savings; Mesh-TensorFlow
+(arXiv 1811.02084) is the canonical statement of why mp activation
+collectives sit on the critical path of every layer.
+
+Design — GSPMD has no "quantize this collective" hook, so the mp
+all-reduce a Row-parallel matmul implies cannot be re-dtyped in place.
+Instead each wire site restructures the contraction with an EXPLICIT
+block dim of extent G = mp degree carrying per-shard f32 partial sums,
+constrained sharded over ``mp`` (shard-local by construction):
+
+    quantize per (row, block) absmax  →  int8 payload + f32 scales
+    sharding-constraint to replicated →  XLA emits an s8 all-gather
+    dequantize, sum the block dim     →  exact f32 accumulation
+
+The recombination is associatively identical to GSPMD's per-shard-partial
++ all-reduce, but the bytes that cross the mesh are the wire dtype's —
+an HLO-measurable drop, not a simulation (``comm_analysis`` prices the
+s8/bf16 operands directly). The backward is a straight-through
+``custom_vjp`` whose cotangent is wire-round-tripped symmetrically
+(the ``grad_comm.wire_cast`` idiom).
+
+Inside fully-manual shard_map regions (the explicit dp step, pipeline
+regions) the same wire rides ``collective.all_gather_quantized`` — a real
+reduced-precision ``lax.all_gather`` with per-leaf absmax scales.
+
+Config: ``DistributedStrategy.mp_comm`` / ``mp_comm_configs``, overridden
+by ``PADDLE_TPU_MP_COMM`` — the SAME ``off/on/f32/bf16/int8`` + ``k=v``
+grammar as ``PADDLE_TPU_GRAD_COMM`` (one parser,
+``grad_comm.parse_wire_env``, two prefixes). ``mp_comm_*`` metrics are
+recorded ONLY from this module (``scripts/check_observability.py``).
+
+See docs/GRAD_COMM.md ("activation wire") and docs/SERVING.md §5 (the
+decode logit recombination + exact-argmax verify rule).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import observability as _obs
+from . import mesh as _mesh
+from .grad_comm import (WIRE_DTYPES, _bool_key, parse_wire_env,
+                        quantize_absmax, quantize_roundtrip)
+
+
+@dataclass(frozen=True)
+class MpCommConfig:
+    """Resolved activation-wire knobs (docs/GRAD_COMM.md "activation
+    wire"). ``enable`` + a sub-f32 ``wire_dtype`` turn the blocked
+    quantized recombination on; everything else is refinement."""
+
+    enable: bool = False
+    wire_dtype: str = "f32"
+    # accepted for grammar parity with PADDLE_TPU_GRAD_COMM; activation
+    # collectives are stateless (a fresh tensor every step), so there is
+    # no residual to carry — documented honestly in docs/GRAD_COMM.md
+    error_feedback: bool = False
+    # quantize the ZeRO-3 parameter all-gathers inside manual regions
+    # (floored at bf16: int8 weights without error feedback would bias
+    # the model every step)
+    zero_gather: bool = True
+    # decode logit recombination: exchange per-shard (max, argmax)
+    # exactly alongside the quantized payload so greedy argmax is
+    # bit-equal to the unsharded engine (docs/SERVING.md §5)
+    logit_verify: bool = True
+
+    @property
+    def quantized(self) -> bool:
+        return self.enable and self.wire_dtype in ("bf16", "int8")
+
+    @property
+    def act_wire(self) -> Optional[str]:
+        return self.wire_dtype if self.quantized else None
+
+    @property
+    def param_gather_wire(self) -> Optional[str]:
+        if not (self.quantized and self.zero_gather):
+            return None
+        return "bf16"
+
+    @property
+    def wire_itemsize(self) -> int:
+        return {"f32": 4, "bf16": 2, "int8": 1}[self.wire_dtype]
+
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def activation_wire_disabled():
+    """Force the in-model activation wire OFF for anything traced inside.
+
+    The decode engine wraps its program traces with this: model-internal
+    mp collectives must stay exact so the greedy bit-equality contract
+    holds — serving quantizes ONLY the logit recombination, whose argmax
+    is restored exactly by the verify exchange."""
+    prev = getattr(_TLS, "disabled", False)
+    _TLS.disabled = True
+    try:
+        yield
+    finally:
+        _TLS.disabled = prev
+
+
+def _strategy_config(strategy) -> MpCommConfig:
+    cfg = MpCommConfig()
+    if strategy is None:
+        return cfg
+    enable = bool(getattr(strategy, "mp_comm", False))
+    sub = dict(getattr(strategy, "mp_comm_configs", {}) or {})
+    wire = str(sub.get("wire_dtype", cfg.wire_dtype)).lower()
+    if wire not in WIRE_DTYPES:
+        raise ValueError(
+            f"mp_comm_configs.wire_dtype={wire!r} not in {WIRE_DTYPES}")
+    return replace(
+        cfg,
+        enable=enable,
+        wire_dtype=wire,
+        error_feedback=bool(sub.get("error_feedback", cfg.error_feedback)),
+        zero_gather=bool(sub.get("zero_gather", cfg.zero_gather)),
+        logit_verify=bool(sub.get("logit_verify", cfg.logit_verify)),
+    )
+
+
+def resolve_config(strategy=None) -> MpCommConfig:
+    """Strategy knobs overridden by ``PADDLE_TPU_MP_COMM`` — the same
+    grammar as ``PADDLE_TPU_GRAD_COMM`` (``grad_comm.parse_wire_env``):
+    bare modes ``off``/``on``/``f32``/``bf16``/``int8`` plus ``k=v`` keys
+    ``wire``, ``enable``, ``ef``/``error_feedback``, ``zero_gather``,
+    ``verify``/``logit_verify``."""
+    if getattr(_TLS, "disabled", False):
+        return MpCommConfig()
+    if strategy is None:
+        from . import fleet as _fleet
+
+        strategy = _fleet.fleet_strategy()
+    cfg = _strategy_config(strategy)
+    var = "PADDLE_TPU_MP_COMM"
+    return parse_wire_env(var, cfg, {
+        "ef": _bool_key(var, "error_feedback"),
+        "error_feedback": _bool_key(var, "error_feedback"),
+        "zero_gather": _bool_key(var, "zero_gather"),
+        "verify": _bool_key(var, "logit_verify"),
+        "logit_verify": _bool_key(var, "logit_verify"),
+    })
+
+
+# ----------------------------------------------------------- telemetry ----
+# trace-time accumulators behind mp_comm_quantized_fraction: analytic wire
+# vs f32 bytes across every blocked site built so far (static shapes only,
+# never tracers)
+_totals = {"f32": 0.0, "wire": 0.0}
+
+
+def _record_site(out_elems: int, g: int, wire_dtype: str,
+                 scale_elems: int) -> None:
+    it = {"bf16": 2, "int8": 1}.get(wire_dtype)
+    if it is None:
+        return
+    # baseline: ring all-reduce of the f32 output; wire: all-gather of the
+    # per-shard int8/bf16 partials + f32 scales
+    f32_b = 2.0 * (g - 1) / g * 4.0 * out_elems
+    wire_b = float(g - 1) * out_elems * it + (g - 1) / g * scale_elems * 4.0
+    _totals["f32"] += f32_b
+    _totals["wire"] += wire_b
+    _obs.inc("mp_comm_sites_total")
+    _obs.inc("mp_comm_wire_bytes_total", wire_b)
+    if _totals["f32"] > 0:
+        _obs.set_gauge("mp_comm_quantized_fraction",
+                       1.0 - _totals["wire"] / _totals["f32"])
+
+
+# ------------------------------------------- blocked GSPMD recombination ----
+def _blocked_recombine(z, wire_dtype: str, spec: P):
+    """Forward math of :func:`blocked_psum` (no vjp attached).
+
+    ``z [..., G, K]`` carries per-mp-shard f32 partial sums on the -2
+    block dim; ``spec`` is z's layout with ``"mp"`` at that dim (shard
+    j holds block j — no data movement to set up). The payload crosses
+    the mesh at ``wire_dtype`` (int8 with per-(row, block) absmax scales,
+    or bf16) and the block sum runs in f32 after dequantization."""
+    m = _mesh.get_global_mesh()
+    z = z.astype(jnp.float32)
+    nd = z.ndim
+    entries = list(spec) + [None] * (nd - len(spec))
+    bspec = P(*entries)
+    rep = P(*[None if i == nd - 2 else entries[i] for i in range(nd)])
+    z = _mesh.sharding_constraint(z, bspec, m)
+    if wire_dtype == "bf16":
+        # the payload crosses as a u16 BITCAST of the bf16 value: float
+        # normalization (and the algebraic simplifier) otherwise legalize
+        # a bf16 all-gather back to convert∘f32-gather∘convert and the
+        # wire silently moves f32 bytes again
+        u = jax.lax.bitcast_convert_type(z.astype(jnp.bfloat16), jnp.uint16)
+        u = _mesh.sharding_constraint(u, bspec, m)
+        zr = jax.lax.bitcast_convert_type(
+            _mesh.sharding_constraint(u, rep, m),
+            jnp.bfloat16).astype(jnp.float32)
+        scale_elems = 0
+    elif wire_dtype == "int8":
+        q, scale = quantize_absmax(z, axis=-1)
+        q = _mesh.sharding_constraint(q, bspec, m)
+        scale = _mesh.sharding_constraint(scale, bspec, m)
+        zr = (_mesh.sharding_constraint(q, rep, m).astype(jnp.float32)
+              * _mesh.sharding_constraint(scale, rep, m))
+        scale_elems = int(np.prod(scale.shape))
+    else:
+        zr = z
+        scale_elems = 0
+    out = jnp.sum(zr, axis=-2)
+    if wire_dtype in ("bf16", "int8"):
+        _record_site(int(np.prod(out.shape)), int(z.shape[-2]), wire_dtype,
+                     scale_elems)
+    out_spec = P(*[entries[i] for i in range(nd) if i != nd - 2])
+    return _mesh.sharding_constraint(out, out_spec, m)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def blocked_psum(z, wire_dtype: str, spec: P):
+    """Sum per-mp-shard partials carried on the -2 block dim of ``z``
+    through a reduced-precision wire with f32 accumulation. Numerically
+    the psum_quantized contract; physically a real int8/bf16 payload in
+    the compiled HLO. The backward is straight-through with the cotangent
+    wire-round-tripped symmetrically."""
+    return _blocked_recombine(z, wire_dtype, spec)
+
+
+def _blocked_psum_fwd(z, wire_dtype, spec):
+    return _blocked_recombine(z, wire_dtype, spec), z.shape[-2]
+
+
+def _blocked_psum_bwd(wire_dtype, spec, g, ct):
+    ct = quantize_roundtrip(ct.astype(jnp.float32), wire_dtype)
+    dz = jnp.broadcast_to(ct[..., None, :],
+                          ct.shape[:-1] + (g, ct.shape[-1]))
+    return (_mesh.sharding_constraint(dz, spec, _mesh.get_global_mesh()),)
+
+
+blocked_psum.defvjp(_blocked_psum_fwd, _blocked_psum_bwd)
+
+
+# ------------------------------------------------- mp layer contractions ----
+def _block_spec(nd: int, data_spec) -> P:
+    entries = [None] * nd
+    entries[0] = data_spec
+    entries[nd - 2] = "mp"
+    return P(*entries)
+
+
+def row_parallel_matmul(x, w, g: int, wire_dtype: str, data_spec=None):
+    """The RowParallelLinear contraction (``x [..., I]`` with I
+    mp-sharded, ``w [I, O]`` sharded on dim 0) restructured with an
+    explicit block dim so the per-shard partials recombine through
+    :func:`blocked_psum` instead of GSPMD's implicit f32 all-reduce."""
+    i, o = w.shape
+    xb = x.reshape(x.shape[:-1] + (g, i // g))
+    wb = w.reshape((g, i // g, o))
+    z = jnp.einsum("...gi,gio->...go", xb, wb)
+    return blocked_psum(z, wire_dtype, _block_spec(z.ndim, data_spec))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def column_parallel_linear(x, w, g: int, wire_dtype: str, data_spec=None):
+    """``y = x @ w`` with w mp-sharded on the OUTPUT dim: the forward is
+    collective-free (y stays mp-sharded); the backward dx — the one mp
+    collective of a column-parallel layer — recombines through the
+    blocked quantized wire, symmetric with the row-parallel forward."""
+    return jnp.einsum("...i,io->...o", x, w)
+
+
+def _col_fwd(x, w, g, wire_dtype, data_spec):
+    return column_parallel_linear(x, w, g, wire_dtype, data_spec), (x, w)
+
+
+def _col_bwd(g, wire_dtype, data_spec, res, ct):
+    x, w = res
+    ct32 = ct.astype(jnp.float32)
+    dw = jnp.einsum("...i,...o->io", x.astype(jnp.float32), ct32)
+    i, o = w.shape
+    ctb = ct32.reshape(ct.shape[:-1] + (g, o // g))
+    wb = w.astype(jnp.float32).reshape((i, g, o // g))
+    z = jnp.einsum("...go,igo->...gi", ctb, wb)
+    dx = _blocked_recombine(z, wire_dtype, _block_spec(z.ndim, data_spec))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+column_parallel_linear.defvjp(_col_fwd, _col_bwd)
+
+
+def vocab_parallel_embedding(w, ids, g: int, wire_dtype: str, data_spec=None):
+    """Embedding lookup on an mp-vocab-sharded table ``w [V, H]`` with
+    the recombination all-reduce taken through the quantized blocked
+    wire. Uses the one-hot-matmul formulation (the TPU-native lowering
+    of a sharded-table gather) so each shard's masked partial is a plain
+    batched contraction the partitioner keeps shard-local."""
+    v, h = w.shape
+    vg = v // g
+    wb = w.reshape((g, vg, h))
+    rel = ids[..., None].astype(jnp.int32) - (
+        jnp.arange(g, dtype=jnp.int32) * vg)
+    inb = (rel >= 0) & (rel < vg)
+    oh = jax.nn.one_hot(jnp.clip(rel, 0, vg - 1), vg, dtype=w.dtype)
+    oh = oh * inb[..., None].astype(w.dtype)
+    z = jnp.einsum("...gv,gvh->...gh", oh, wb)
+    return blocked_psum(z, wire_dtype, _block_spec(z.ndim, data_spec))
+
+
+# ------------------------------------------- decode logit recombination ----
+def quantized_logit_gather(logits, wire_dtype: str, mesh=None):
+    """Replicate mp-vocab-sharded ``logits [..., V]`` with a
+    reduced-precision payload plus an EXACT per-shard (max, argmax) side
+    channel.
+
+    Returns ``(wire_logits, exact_argmax)``: ``wire_logits`` is the
+    replicated f32 dequantized payload (what sampled rows consume);
+    ``exact_argmax`` reproduces ``jnp.argmax`` over the EXACT logits —
+    per-block maxima and first-occurrence argmaxes are computed in f32
+    BEFORE quantization and exchanged exactly (a V/vocab-sized fraction
+    of the payload), then combined with first-occurrence tie-breaking
+    across blocks. Greedy decode therefore stays bit-equal to the
+    unsharded engine by construction (docs/SERVING.md §5).
+
+    Returns None when the layout can't take the quantized path (no mp
+    axis, vocab not divisible by the mp degree, or an f32 wire) — the
+    caller falls back to the exact all-gather."""
+    m = mesh or _mesh.get_global_mesh()
+    if m is None or getattr(m, "empty", False):
+        return None
+    g = _mesh.mesh_axis_size("mp", m)
+    v = logits.shape[-1]
+    if g <= 1 or v % g != 0 or wire_dtype not in ("bf16", "int8"):
+        return None
+    lead = logits.shape[:-1]
+    vg = v // g
+    bspec = P(*([None] * len(lead) + ["mp"]))
+    rep = P()
+    lb = _mesh.sharding_constraint(
+        logits.astype(jnp.float32).reshape(lead + (g, vg)), bspec, m)
+    # exact per-block winners BEFORE quantization (tiny f32/i32 payload)
+    bmax = _mesh.sharding_constraint(jnp.max(lb, axis=-1), bspec, m)
+    barg = _mesh.sharding_constraint(
+        jnp.argmax(lb, axis=-1).astype(jnp.int32), bspec, m)
+    bmax_r = _mesh.sharding_constraint(bmax, rep, m)
+    barg_r = _mesh.sharding_constraint(barg, rep, m)
+    # blocks are vocab-ordered, jnp.argmax picks the FIRST max block and
+    # the per-block argmax the first in-block index — together exactly
+    # jnp.argmax's first-occurrence rule on the exact logits
+    win = jnp.argmax(bmax_r, axis=-1)
+    exact = (win.astype(jnp.int32) * vg + jnp.take_along_axis(
+        barg_r, win[..., None], axis=-1)[..., 0]).astype(jnp.int32)
+    if wire_dtype == "bf16":
+        # see _blocked_recombine: the bf16 payload rides as a u16 bitcast
+        u = jax.lax.bitcast_convert_type(lb.astype(jnp.bfloat16), jnp.uint16)
+        u = _mesh.sharding_constraint(u, bspec, m)
+        wl = jax.lax.bitcast_convert_type(
+            _mesh.sharding_constraint(u, rep, m),
+            jnp.bfloat16).astype(jnp.float32)
+    else:
+        q, scale = quantize_absmax(lb, axis=-1)
+        q = _mesh.sharding_constraint(q, bspec, m)
+        scale = _mesh.sharding_constraint(scale, bspec, m)
+        wl = (_mesh.sharding_constraint(q, rep, m).astype(jnp.float32)
+              * _mesh.sharding_constraint(scale, rep, m))
+    return wl.reshape(lead + (v,)), exact
+
+
+def logit_wire_bytes(rows: int, vocab: int, g: int,
+                     wire_dtype: str) -> Tuple[float, float]:
+    """Analytic per-call wire payload of the logit recombination:
+    ``(f32_baseline_bytes, wire_bytes)`` for ``rows`` logit rows. The
+    wire side counts the quantized payload, the f32 scales (int8 only)
+    and the exact (max, argmax) verify exchange."""
+    it = {"f32": 4, "bf16": 2, "int8": 1}[wire_dtype]
+    frac = (g - 1) / g
+    base = frac * rows * vocab * 4.0
+    if wire_dtype == "f32":
+        return base, base
+    wire = frac * rows * vocab * it + frac * rows * g * 8.0
+    if wire_dtype == "int8":
+        wire += frac * rows * g * 4.0
+    return base, wire

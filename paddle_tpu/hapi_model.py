@@ -129,6 +129,9 @@ class Model:
         return {
             "collectives": colls,
             "per_axis": _ca.axis_traffic_summary(colls),
+            # wire-dtype split per axis: activation collectives quantized
+            # by mp_comm show payload_bytes < payload_bytes_f32 here
+            "per_axis_wire": _ca.axis_wire_summary(colls),
             "grad_exchange": _ca.bucket_traffic(colls),
         }
 

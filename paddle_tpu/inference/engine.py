@@ -140,6 +140,14 @@ class EngineConfig:
     donate: Optional[bool] = None
     #: base seed for requests that don't carry their own
     seed: int = 0
+    #: wire dtype of the mp-sharded logit recombination (docs/SERVING.md
+    #: §5): None resolves from the mp_comm activation-wire config
+    #: (PADDLE_TPU_MP_COMM / DistributedStrategy.mp_comm), "off"/"f32"
+    #: pins today's exact f32 all-gather byte-for-byte, "bf16"/"int8"
+    #: quantize the replication payload while a per-shard (max, argmax)
+    #: exchange keeps greedy decode bit-equal to the single-device
+    #: engine. Ignored (always exact) when the mesh has no mp axis.
+    logit_wire: Optional[str] = None
     #: jax.sharding.Mesh to run the compiled programs on. An ``mp`` axis
     #: with degree > 1 shards the KV pools (and int8 scales) over kv
     #: heads — GQA groups stay whole per shard, so mp must divide
@@ -462,11 +470,15 @@ def _replicate_out(x):
     return _mesh.sharding_constraint(x, _mesh.P(), m)
 
 
-def _sample_tokens(logits, keys, temperature, top_k, top_p, greedy):
+def _sample_tokens(logits, keys, temperature, top_k, top_p, greedy,
+                   exact_argmax=None):
     """On-device sampling for N rows: logits [N, V] f32, keys [N, ks],
     temperature/top_p f32 [N], top_k i32 [N], greedy bool [N]. Per-row
     keys keep every request's sample stream independent of co-scheduling.
-    top_k <= 0 and top_p >= 1.0 disable their filters."""
+    top_k <= 0 and top_p >= 1.0 disable their filters. ``exact_argmax``
+    [N] i32, when given, replaces the local argmax for greedy rows — the
+    quantized logit wire passes the verify exchange's exact winner here
+    so greedy output never sees quantization (docs/SERVING.md §5)."""
     v = logits.shape[-1]
     x = logits / temperature[:, None]
     sorted_x = jnp.sort(x, axis=-1)[:, ::-1]
@@ -479,8 +491,9 @@ def _sample_tokens(logits, keys, temperature, top_k, top_p, greedy):
     thr = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
     x = jnp.where((top_p[:, None] < 1.0) & (probs < thr), -jnp.inf, x)
     sampled = jax.vmap(lambda xr, kr: jax.random.categorical(kr, xr))(x, keys)
-    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
-                     sampled).astype(jnp.int32)
+    arg = (jnp.argmax(logits, axis=-1) if exact_argmax is None
+           else exact_argmax)
+    return jnp.where(greedy, arg, sampled).astype(jnp.int32)
 
 
 class DecodeEngine:
@@ -538,6 +551,25 @@ class DecodeEngine:
                     f"mp={self._mp_degree} must divide num_kv_heads="
                     f"{ad.num_kv_heads}: the KV pool shards by whole kv "
                     "heads (GQA groups stay intact per shard)")
+        # resolve the logit-recombination wire (docs/SERVING.md §5): the
+        # explicit config wins; None inherits the ambient mp_comm
+        # activation wire. f32 keeps the exact all-gather byte-for-byte.
+        from ..distributed import mp_comm as _mp_comm
+
+        lw, self._logit_verify = cfg.logit_wire, True
+        if lw is None:
+            wcfg = _mp_comm.resolve_config()
+            lw = wcfg.wire_dtype if wcfg.quantized else "f32"
+            self._logit_verify = wcfg.logit_verify
+        elif lw in ("off", "f32"):
+            lw = "f32"
+        elif lw not in ("bf16", "int8"):
+            raise ValueError(
+                f"logit_wire must be one of (None, 'off', 'f32', 'bf16', "
+                f"'int8'), got {cfg.logit_wire!r}")
+        if self._mp_degree <= 1:
+            lw = "f32"
+        self._logit_wire = lw
         shape = (ad.num_layers, self._num_pages, ad.num_kv_heads,
                  cfg.page_size, ad.head_dim)
         self._kc = jnp.zeros(shape, store)
@@ -1438,14 +1470,22 @@ class DecodeEngine:
     def _mesh_ctx(self):
         """Activate the engine's mesh for a compiled-program call, so the
         sharding-constraint hints inside F.paged_attention and the pure
-        bodies see it at trace time (thread-local; restored after)."""
-        if self._mesh is None:
-            import contextlib
+        bodies see it at trace time (thread-local; restored after). Also
+        forces the mp_comm activation wire OFF for the traced body:
+        model-internal mp collectives must stay exact for the greedy
+        bit-equality contract — only the logit recombination quantizes,
+        explicitly, via ``_wire_logits``."""
+        import contextlib
 
+        if self._mesh is None:
             return contextlib.nullcontext()
+        from ..distributed import mp_comm as _mp_comm
         from ..distributed.mesh import global_mesh
 
-        return global_mesh(self._mesh)
+        stack = contextlib.ExitStack()
+        stack.enter_context(global_mesh(self._mesh))
+        stack.enter_context(_mp_comm.activation_wire_disabled())
+        return stack
 
     def _run_counted(self, name, fn, *args):
         first = name not in self._compiled
@@ -1499,6 +1539,8 @@ class DecodeEngine:
             "speculate_k": cfg.speculate_k,
             "donate": self._donate,
             "adapter": type(self.adapter).__name__,
+            "logit_wire": self._logit_wire,
+            "logit_verify": self._logit_verify,
         }
 
     # -- compiled programs --------------------------------------------------
@@ -1508,6 +1550,31 @@ class DecodeEngine:
     # the jit.TracedLayer idiom), so parameters stay jit arguments rather
     # than baked-in constants, and the paged KV pool flows through as
     # donated inputs/outputs. Page tables arrive as plain int32 arguments.
+
+    def _wire_logits(self, logits):
+        """Route mp-vocab-sharded logits [..., V] through the quantized
+        recombination (docs/SERVING.md §5). Returns ``(logits_for_
+        sampling, exact_argmax, replicated_out)``: with the f32 wire all
+        three degrade to ``(logits, None, None)`` so callers trace
+        exactly the historical program (mp_comm=off is byte-for-byte);
+        quantized, sampling sees the dequantized wire payload while
+        greedy rows take the exact verify winner."""
+        if self._logit_wire == "f32":
+            return logits, None, None
+        from ..distributed import mp_comm as _mp_comm
+
+        r = _mp_comm.quantized_logit_gather(logits, self._logit_wire,
+                                            self._mesh)
+        if r is None:
+            return logits, None, None
+        wl, exact = r
+        rows = int(np.prod(logits.shape[:-1]))
+        _, wire_b = _mp_comm.logit_wire_bytes(
+            rows, int(logits.shape[-1]), self._mp_degree, self._logit_wire)
+        _obs.set_gauge("serving_logit_wire_bytes", wire_b)
+        if not self._logit_verify:
+            exact = None
+        return wl, exact, wl
 
     def _build_prefill(self, tb: int):
         ad, state, int8 = self.adapter, self._state, self._int8
@@ -1554,11 +1621,14 @@ class DecodeEngine:
             # position true_len uses fold_in(key, true_len), matching what
             # the decode step would use — scheduling-invariant
             step_key = jax.random.fold_in(key, true_len)
-            nxt = _sample_tokens(logits, step_key[None], temp[None],
-                                 top_k[None], top_p[None], greedy[None])
+            s_logits, exact_arg, wired = self._wire_logits(logits)
+            nxt = _sample_tokens(s_logits, step_key[None], temp[None],
+                                 top_k[None], top_p[None], greedy[None],
+                                 exact_argmax=exact_arg)
             kc, vc, ksc, vsc = _pin_pool_shardings(kc, vc, ksc, vsc)
-            return (kc, vc, ksc, vsc, _replicate_out(nxt[0]),
-                    _replicate_out(logits[0]))
+            out_logits = (_replicate_out(logits[0]) if wired is None
+                          else wired[0])
+            return (kc, vc, ksc, vsc, _replicate_out(nxt[0]), out_logits)
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)
@@ -1597,11 +1667,12 @@ class DecodeEngine:
                 for t_, v_ in zip(state, originals):
                     t_._value = v_
             step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
-            nxt = _sample_tokens(logits, step_keys, temp, top_k, top_p,
-                                 greedy)
+            s_logits, exact_arg, wired = self._wire_logits(logits)
+            nxt = _sample_tokens(s_logits, step_keys, temp, top_k, top_p,
+                                 greedy, exact_argmax=exact_arg)
             kc, vc, ksc, vsc = _pin_pool_shardings(kc, vc, ksc, vsc)
-            return (kc, vc, ksc, vsc, _replicate_out(nxt),
-                    _replicate_out(logits))
+            out_logits = _replicate_out(logits) if wired is None else wired
+            return (kc, vc, ksc, vsc, _replicate_out(nxt), out_logits)
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)
@@ -1646,14 +1717,17 @@ class DecodeEngine:
                     t_._value = v_
             step_keys = jax.vmap(jax.vmap(
                 jax.random.fold_in, in_axes=(None, 0)))(keys, pos2 + 1)
-            flat = logits.reshape(s * k1, -1)
+            s_logits, exact_arg, wired = self._wire_logits(logits)
+            flat = s_logits.reshape(s * k1, -1)
             rep = lambda a: jnp.repeat(a, k1, axis=0)
             targets = _sample_tokens(
                 flat, step_keys.reshape(s * k1, -1), rep(temp), rep(top_k),
-                rep(top_p), rep(greedy)).reshape(s, k1)
+                rep(top_p), rep(greedy),
+                exact_argmax=(None if exact_arg is None
+                              else exact_arg.reshape(s * k1))).reshape(s, k1)
             kc, vc, ksc, vsc = _pin_pool_shardings(kc, vc, ksc, vsc)
-            return (kc, vc, ksc, vsc, _replicate_out(targets),
-                    _replicate_out(logits))
+            out_logits = _replicate_out(logits) if wired is None else wired
+            return (kc, vc, ksc, vsc, _replicate_out(targets), out_logits)
 
         donate = (1, 2, 3, 4) if self._donate else ()
         return jax.jit(pure, donate_argnums=donate)

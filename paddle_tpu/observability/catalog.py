@@ -86,6 +86,16 @@ METRICS = {
     "grad_comm_overlap_ratio": (
         "gauge", "Share of exchanged bytes outside the last-issued bucket "
                  "— the part that can overlap remaining backward compute"),
+    # -- mp activation communication (distributed/mp_comm.py) ---------------
+    "mp_comm_sites_total": (
+        "counter", "Quantized mp recombination sites traced (one per "
+                   "row/column/embedding/logit wire build)"),
+    "mp_comm_wire_bytes_total": (
+        "counter", "Per-device wire bytes the traced mp recombinations "
+                   "move at the wire dtype (payload + f32 scales)"),
+    "mp_comm_quantized_fraction": (
+        "gauge", "Fraction of f32 mp-activation bytes removed by the "
+                 "reduced-precision wire across all traced sites"),
     # -- pipeline schedules (fleet/meta_parallel/pipeline_parallel.py) ------
     "pp_bubble_fraction": (
         "gauge", "Idle-cell fraction of the compiled pipeline schedule "
@@ -135,6 +145,11 @@ METRICS = {
     "serving_spec_accept_ratio": (
         "gauge", "Accepted / proposed draft tokens of speculative decode "
                  "since engine start (0..1)"),
+    "serving_logit_wire_bytes": (
+        "gauge", "Per-device wire bytes of one sharded-decode logit "
+                 "recombination at the configured logit wire (f32 = the "
+                 "exact all-gather; int8 adds scales + exact-argmax "
+                 "verify sidecar)"),
     "serving_admission_wait_seconds": (
         "histogram", "Bounded-backoff sleep taken when waiting requests "
                      "cannot be admitted (no free slot/pages) — replaces "

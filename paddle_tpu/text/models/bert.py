@@ -98,8 +98,10 @@ class BertSelfAttention(nn.Layer):
 
     def forward(self, x, attn_mask=None):
         b, t, h = x.shape
-        qkv = self.qkv_proj(x).reshape([b, t, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # head-major fused layout [H, 3, d] — keeps the mp-sharded 3h dim
+        # reshape shard-local (see GPTAttention.forward)
+        qkv = self.qkv_proj(x).reshape([b, t, self.num_heads, 3, self.head_dim])
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
             is_causal=False, training=self.training,

@@ -32,6 +32,7 @@ from ...distributed.fleet.layers.mpu import (
     RowParallelLinear,
     VocabParallelEmbedding,
     mark_activation,
+    mp_wire_linear,
 )
 from ...distributed.fleet.utils import recompute as _recompute
 
@@ -147,8 +148,11 @@ class GPTAttention(nn.Layer):
     def forward(self, x):
         b, t, h = x.shape
         qkv = self.qkv_proj(x)  # [b, t, 3h] (hidden mp-sharded)
-        qkv = qkv.reshape([b, t, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, t, H, d]
+        # head-major fused layout [H, 3, d]: an mp shard of the flat 3h dim
+        # is a whole group of heads, so the reshape keeps the activation
+        # sharded instead of forcing a GSPMD re-replication all-gather
+        qkv = qkv.reshape([b, t, self.num_heads, 3, self.head_dim])
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]  # [b, t, H, d]
         out = F.scaled_dot_product_attention(
             q, k, v, dropout_p=self.dropout_p, is_causal=True, training=self.training
         )
@@ -268,8 +272,11 @@ class GPTForCausalLM(nn.Layer):
 
     def _logits(self, hidden):
         if self.config.tie_word_embeddings:
-            w = self.gpt.embeddings.word_embeddings.weight  # [V, h], mp-sharded on V
-            logits = F.linear(hidden, w.t())
+            emb = self.gpt.embeddings.word_embeddings
+            w = emb.weight  # [V, h], mp-sharded on V
+            # column-form tied head: rides the quantized backward wire
+            # when the mp_comm activation wire is on (exact F.linear off)
+            logits = mp_wire_linear(hidden, w.t(), emb.world_size)
             return mark_activation(logits, last_mp=True)
         return self.lm_head(hidden)
 
@@ -295,8 +302,8 @@ from .llama import _cache_write, _decode_attention  # noqa: E402
 def _gpt_qkv(attn: "GPTAttention", x):
     """The SAME projection+split GPTAttention.forward performs (one place)."""
     b, t, _ = x.shape
-    qkv = attn.qkv_proj(x).reshape([b, t, 3, attn.num_heads, attn.head_dim])
-    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    qkv = attn.qkv_proj(x).reshape([b, t, attn.num_heads, 3, attn.head_dim])
+    return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
 
 def _gpt_attn_cached(attn: "GPTAttention", x, cache, pos):

@@ -259,6 +259,120 @@ def run_cold_start(args):
     }
 
 
+def _logit_wire_child(args):
+    """Fresh 2-virtual-device process: the SAME greedy/sampled workload
+    through the single-device engine, the mp2 engine with the exact f32
+    logit all-gather, and the mp2 engine with the int8 absmax logit wire
+    + exact-argmax verify. Asserts all three token streams are BIT-EQUAL
+    (docs/SERVING.md §5) and prints one JSON line with the measured wall
+    times and analytic per-step logit wire bytes."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mp_comm as _mpc
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.inference.engine import (DecodeEngine, EngineConfig,
+                                             SamplingParams)
+
+    paddle.seed(args.seed)
+    model = build_model(args)
+    rng = np.random.default_rng(args.seed)
+    prefix = rng.integers(1, args.vocab, size=32, dtype=np.int64)
+    reqs = []
+    for i, tail in enumerate((9, 17, 5, 12)):
+        prompt = np.concatenate(
+            [prefix, rng.integers(1, args.vocab, size=tail, dtype=np.int64)])
+        reqs.append((prompt, SamplingParams(
+            max_new_tokens=16, do_sample=(i % 2 == 1), temperature=0.8,
+            top_k=8, seed=100 + i)))
+
+    def timed(cfg):
+        eng = DecodeEngine(model, cfg)
+        rids = [eng.submit(p, sp) for p, sp in reqs]
+        eng.run()  # warm every program
+        warm = [np.asarray(eng.result(r)) for r in rids]
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, sp) for p, sp in reqs]
+        eng.run()
+        dt = time.perf_counter() - t0
+        outs = [np.asarray(eng.result(r)) for r in rids]
+        for a, b in zip(warm, outs):
+            np.testing.assert_array_equal(a, b)
+        return eng, outs, dt
+
+    mesh = build_mesh((1, 2), ("dp", "mp"), devices=jax.devices()[:2])
+    base = dict(num_slots=4, max_length=args.max_length,
+                page_size=args.page_size, prefix_cache=True,
+                speculate_k=args.speculate_k)
+    _ref, ref_out, _ = timed(EngineConfig(**base))
+    f32_eng, f32_out, f32_s = timed(
+        EngineConfig(**base, mesh=mesh, logit_wire="off"))
+    int8_eng, int8_out, int8_s = timed(
+        EngineConfig(**base, mesh=mesh, logit_wire="int8"))
+    for a, b in zip(ref_out, f32_out):
+        np.testing.assert_array_equal(
+            a, b, err_msg="mp2 f32 logit path diverged from single-device")
+    # greedy requests are the bit-equality CONTRACT (exact-argmax verify);
+    # sampled requests draw from the dequantized logits, so their streams
+    # may legitimately differ — reported as a match fraction, not gated
+    sampled_tok = sampled_hit = 0
+    for (a, b), (_p, sp) in zip(zip(ref_out, int8_out), reqs):
+        if sp.do_sample:
+            sampled_tok += len(a)
+            sampled_hit += int((a == b).sum())
+        else:
+            np.testing.assert_array_equal(
+                a, b, err_msg="mp2 int8 logit wire broke greedy "
+                              "bit-equality")
+    # analytic per-decode-step wire bytes (what engine.py's
+    # serving_logit_wire_bytes gauge records at trace time)
+    rows = base["num_slots"]
+    f32_b, _ = _mpc.logit_wire_bytes(rows, args.vocab, 2, "f32")
+    _, int8_b = _mpc.logit_wire_bytes(rows, args.vocab, 2, "int8")
+    print(json.dumps({
+        "mp_degree": 2,
+        "f32_seconds": round(f32_s, 4),
+        "int8_seconds": round(int8_s, 4),
+        "f32_logit_wire_bytes_per_step": f32_b,
+        "int8_logit_wire_bytes_per_step": int8_b,
+        "wire_reduction": round(1.0 - int8_b / f32_b, 4),
+        "greedy_bit_equal": True,
+        "sampled_token_match_fraction": round(
+            sampled_hit / max(sampled_tok, 1), 4),
+    }))
+
+
+def run_logit_wire(args):
+    """Quantized logit-recombination scenario (ISSUE 13): run the mp2
+    engine A/B in a subprocess pinned to 2 virtual devices (this process
+    may already have initialized jax single-device)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    kept = [t for t in env.get("XLA_FLAGS", "").split()
+            if not t.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=2"])
+    env["BENCH_SERVING_LOGIT_CHILD"] = "1"
+    print("logit-wire: mp2 f32 vs int8 recombination...", file=sys.stderr)
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--max-length", str(args.max_length),
+            "--hidden", str(args.hidden), "--layers", str(args.layers),
+            "--heads", str(args.heads), "--vocab", str(args.vocab),
+            "--seed", str(args.seed), "--page-size", str(args.page_size),
+            "--speculate-k", str(args.speculate_k)]
+    p = subprocess.run(argv, env=env, capture_output=True, text=True,
+                       timeout=900)
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    if p.returncode or not lines:
+        raise RuntimeError(f"logit-wire child failed rc={p.returncode}: "
+                           f"{(p.stderr or '')[-400:]}")
+    return json.loads(lines[-1])
+
+
 def _free_port():
     import socket
 
@@ -737,6 +851,12 @@ def main(argv=None):
                     help="run only the router scenario (faster iteration)")
     ap.add_argument("--skip-naive", action="store_true",
                     help="run only the churn scenario (faster iteration)")
+    ap.add_argument("--logit-wire-only", action="store_true",
+                    help="run only the mp2 quantized-logit-recombination "
+                         "scenario and merge the logit_wire block into the "
+                         "existing BENCH_SERVING.json")
+    ap.add_argument("--skip-logit-wire", action="store_true",
+                    help="skip the logit-wire scenario in the full run")
     ap.add_argument("--cold-start-only", action="store_true",
                     help="run only the fresh-process cold-start scenario "
                          "(warm vs cold AOT compile cache) and merge the "
@@ -751,6 +871,21 @@ def main(argv=None):
 
     if os.environ.get("BENCH_SERVING_COLD_CHILD"):
         _cold_start_child(args)
+        return 0
+    if os.environ.get("BENCH_SERVING_LOGIT_CHILD"):
+        _logit_wire_child(args)
+        return 0
+    if args.logit_wire_only:
+        block = run_logit_wire(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["logit_wire"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"logit_wire": block}, indent=2))
         return 0
     if args.cold_start_only:
         block = run_cold_start(args)
@@ -857,6 +992,8 @@ def main(argv=None):
     }
     inference.disable_decode_engine(model)
     report["churn"] = run_churn(args, model)
+    if not args.skip_logit_wire:
+        report["logit_wire"] = run_logit_wire(args)
     if not args.skip_cold_start:
         report["cold_start"] = run_cold_start(args)
     if not args.skip_router:

@@ -74,6 +74,7 @@ SPAN_RECORDERS = {"span", "start_span", "record_span"}
 #: silently turn them into a mixed-meaning series.
 OWNED_PREFIXES = {
     "grad_comm_": os.path.join("paddle_tpu", "distributed", "grad_comm.py"),
+    "mp_comm_": os.path.join("paddle_tpu", "distributed", "mp_comm.py"),
     "serving_": os.path.join("paddle_tpu", "inference", "engine.py"),
     "serving_router_": os.path.join("paddle_tpu", "serving", "router.py"),
     "serving_transport_": os.path.join("paddle_tpu", "serving",

@@ -49,6 +49,12 @@ CONFIGS = {
         {"PADDLE_TPU_PP_SCHEDULE": "1f1b,virtual=2"}),
     "2slice_dp2_mp4": ({"dp_degree": 2, "mp_degree": 4}, {},
                        {"PADDLE_TPU_NUM_SLICES": "2"}),
+    # quantized-wire A/B of dp2_mp4: int8 activation recombination
+    # (mp_comm) + int8 gradient wire (grad_comm) — the per_axis_wire
+    # block prices what actually crosses each axis vs the f32 row above
+    "dp2_mp4_int8": ({"dp_degree": 2, "mp_degree": 4}, {},
+                     {"PADDLE_TPU_MP_COMM": "int8",
+                      "PADDLE_TPU_GRAD_COMM": "int8"}),
 }
 
 
@@ -102,6 +108,7 @@ def run_config(name):
     colls = comm_analysis.collective_traffic(hlo, mesh)
     per_axis = comm_analysis.axis_traffic_summary(colls)
     per_axis_payload = comm_analysis.axis_payload_summary(colls)
+    per_axis_wire = comm_analysis.axis_wire_summary(colls)
 
     cost = comp.cost_analysis()
     if isinstance(cost, (list, tuple)):
@@ -145,6 +152,7 @@ def run_config(name):
         "n_collectives": len(colls),
         "per_axis_wire_bytes_per_device": per_axis,
         "per_axis_payload_bytes": per_axis_payload,
+        "per_axis_wire": per_axis_wire,
         "flops_per_device_per_step": flops,
         "pipeline": pipeline,
         "cross_slice": [

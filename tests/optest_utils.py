@@ -88,6 +88,12 @@ SKIP = {
                               "test_loss_parity::mp2",
     "sharded_embedding_lookup": "needs a sharding mesh; covered by "
                                 "test_loss_parity",
+    "mp_wire_row_linear": "quantized mp recombination needs live mesh "
+                          "axes; fwd+vjp covered by test_mp_comm.py",
+    "mp_wire_col_linear": "same blocked-wire mesh requirement; vjp "
+                          "covered by test_mp_comm.py",
+    "mp_wire_vocab_embedding": "same blocked-wire mesh requirement; "
+                               "grad covered by test_mp_comm.py",
     # --- numerically-hostile domains at f32 central differences ---------
     "spectral_norm_weight": "power-iteration fixed point: analytic grad "
                             "treats u/v as constants by design (reference "

@@ -167,6 +167,41 @@ def test_apply_auto_plan_respects_manual_pins():
     assert s.pipeline_configs["schedule"] == "1f1b"
 
 
+def test_axis_bytes_priced_at_wire_dtype():
+    """ISSUE 13 satellite: the per-axis byte model prices quantized axes
+    at the wire itemsize, and the plan records which dtypes it assumed."""
+    mc32 = planner.ModelConfig()
+    mcq = planner.ModelConfig(mp_wire="int8", grad_wire="bf16",
+                              zero_gather_wire="bf16")
+    cand = planner.Candidate(dp=2, mp=2, sharding=2)
+    ax32 = planner._axis_bytes(cand, mc32)
+    axq = planner._axis_bytes(cand, mcq)
+    assert axq["mp"] == ax32["mp"] / 4          # int8 wire: 1/4 the bytes
+    assert axq["dp"] == ax32["dp"] / 2          # bf16 grads: half
+    # ZeRO legs: gather bf16 + scatter bf16 vs f32+f32
+    assert axq["sharding"] == ax32["sharding"] / 2
+    scored = planner.score(cand, mcq, planner.Topology(),
+                           planner.CostConstants())
+    assert scored.wire_dtypes == {
+        "mp": "int8", "dp": "bf16", "zero_gather": "bf16"}
+    # a quantized-wire model must never predict MORE comm time
+    s32 = planner.score(cand, mc32, planner.Topology(),
+                        planner.CostConstants())
+    assert scored.breakdown["comm_s"] <= s32.breakdown["comm_s"]
+
+
+def test_apply_auto_plan_prices_strategy_wires(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_MP_COMM", "int8")
+    monkeypatch.delenv("PADDLE_TPU_GRAD_COMM", raising=False)
+    s = DistributedStrategy()
+    result = planner.apply_auto_plan(s, ndev=8)
+    assert result is not None
+    assert result.best.wire_dtypes["mp"] == "int8"
+    # ZeRO param gathers are floored at bf16 on an int8 activation wire
+    assert result.best.wire_dtypes["zero_gather"] == "bf16"
+    monkeypatch.delenv("PADDLE_TPU_MP_COMM", raising=False)
+
+
 def test_apply_auto_plan_never_raises():
     s = DistributedStrategy()
     s.hybrid_configs["mp_degree"] = 3  # divides neither heads nor devices

@@ -106,6 +106,45 @@ def test_owned_metric_from_owner_allowed(tmp_path):
     assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
 
 
+_MP_COMM_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.inc("mp_comm_wire_bytes_total", 4096.0)
+"""
+
+
+def test_mp_comm_metric_from_wrong_file_rejected(tmp_path):
+    # the mp_comm_* family describes the traced activation wire; a second
+    # writer (grad_comm, a bench script) would mix meanings
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_MP_COMM_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "grad_comm.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "mp_comm_" in v[0][1]
+
+
+def test_mp_comm_metric_from_owner_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_MP_COMM_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "mp_comm.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_logit_wire_gauge_owned_by_engine(tmp_path):
+    # serving_logit_wire_bytes rides the serving_* family: engine.py only
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        from paddle_tpu import observability as _obs
+        def f():
+            _obs.set_gauge("serving_logit_wire_bytes", 1024.0)
+    """))
+    rel = os.path.join("paddle_tpu", "distributed", "mp_comm.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+    rel = os.path.join("paddle_tpu", "inference", "engine.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
 _SERVING_SRC = """
     from paddle_tpu import observability as _obs
     def f():

@@ -108,13 +108,21 @@ def test_scaling_model_artifact_committed():
     doc = json.load(open(path))
     assert "assumptions" in doc["meta"]
     for name in ("dp8", "mp8", "dp2_mp4", "sharding8_z1", "dp2_pp2_mp2",
-                 "2slice_dp2_mp4"):
+                 "2slice_dp2_mp4", "dp2_mp4_int8"):
         cfg = doc["configs"][name]
         assert "per_axis_wire_bytes_per_device" in cfg, name
         assert "projection" in cfg, name
     # committed artifact must itself satisfy the DCN design claim
     cross = doc["configs"]["2slice_dp2_mp4"]["cross_slice"]
     assert cross and all(c["axes"] == ["dp"] for c in cross)
+    # quantized-wire A/B: the int8 activation wire (mp_comm) must move
+    # strictly fewer mp-axis bytes than the f32 row of the same mesh,
+    # and the wire-dtype census must show the s8 payload
+    f32_mp = doc["configs"]["dp2_mp4"]["per_axis_wire"]["mp"]
+    int8_mp = doc["configs"]["dp2_mp4_int8"]["per_axis_wire"]["mp"]
+    assert int8_mp["wire_bytes_per_device"] < f32_mp["wire_bytes_per_device"]
+    assert "s8" in int8_mp["wire_dtypes"]
+    assert int8_mp["quantized_fraction"] > 0.5
     # mp traffic per device must be degree-invariant in the projection
     proj = doc["configs"]["mp8"]["projection"]
     assert proj["8"]["ici_bytes_per_chip"] == proj["256"]["ici_bytes_per_chip"]

@@ -115,6 +115,93 @@ def test_mp_must_divide_kv_heads(model):
             num_slots=2, max_length=64, mesh=_mp_mesh(8)))
 
 
+@pytest.fixture(scope="module")
+def model64():
+    """Vocab-64 twin of ``model``: the quantized logit recombination needs
+    vocab divisible by the mp degree (61 deliberately is not)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.fleet.topology import (
+        get_hybrid_communicate_group, set_hybrid_communicate_group)
+
+    prev = get_hybrid_communicate_group()
+    prev_mesh = _mesh.get_global_mesh()
+    set_hybrid_communicate_group(None)
+    _mesh.set_global_mesh(None)
+    try:
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        m.eval()
+        yield m
+        inference.disable_decode_engine(m)
+    finally:
+        set_hybrid_communicate_group(prev)
+        _mesh.set_global_mesh(prev_mesh)
+
+
+def _workload64():
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, 64, size=32, dtype=np.int64)
+    reqs = []
+    for i, tail in enumerate((9, 17, 5)):
+        prompt = np.concatenate(
+            [prefix, rng.integers(1, 64, size=tail, dtype=np.int64)])
+        reqs.append((prompt, SamplingParams(
+            max_new_tokens=10, do_sample=(i % 2 == 1), temperature=0.8,
+            top_k=8, seed=100 + i)))
+    return reqs
+
+
+def test_logit_wire_config_resolution(model64, monkeypatch):
+    # pinned "off" and "f32" both mean the exact-path program
+    eng = DecodeEngine(model64, EngineConfig(**CFG, mesh=_mp_mesh(2),
+                                             logit_wire="off"))
+    assert eng._logit_wire == "f32"
+    # explicit int8 sticks; without an mp axis the wire is forced exact
+    eng2 = DecodeEngine(model64, EngineConfig(**CFG, mesh=_mp_mesh(2),
+                                              logit_wire="int8"))
+    assert eng2._logit_wire == "int8" and eng2._logit_verify
+    single = DecodeEngine(model64, EngineConfig(**CFG, logit_wire="int8"))
+    assert single._logit_wire == "f32"
+    # None resolves from the ambient mp_comm config (env grammar)
+    monkeypatch.setenv("PADDLE_TPU_MP_COMM", "int8,verify=off")
+    amb = DecodeEngine(model64, EngineConfig(**CFG, mesh=_mp_mesh(2)))
+    assert amb._logit_wire == "int8" and not amb._logit_verify
+    with pytest.raises(ValueError, match="logit_wire"):
+        DecodeEngine(model64, EngineConfig(**CFG, logit_wire="fp8"))
+
+
+@pytest.mark.slow
+def test_mp2_int8_logit_wire_bit_equal(model64, monkeypatch, tmp_path):
+    """ISSUE 13: int8 absmax logit recombination + exact-argmax verify
+    keeps the mp-sharded engine greedy BIT-EQUAL to the single-device
+    engine (the PR 9 contract), and the wire gauge is recorded."""
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    from paddle_tpu import observability as _obs
+
+    _obs.reset()
+    reqs = _workload64()
+    ref = DecodeEngine(model64, EngineConfig(**CFG))
+    want = _drain(ref, reqs)
+
+    eng = DecodeEngine(model64, EngineConfig(**CFG, mesh=_mp_mesh(2),
+                                             logit_wire="int8"))
+    got = _drain(eng, reqs)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert _obs.gauge("serving_logit_wire_bytes").value() > 0
+
+    # mp_comm=off restores the exact program byte-for-byte
+    off = DecodeEngine(model64, EngineConfig(**CFG, mesh=_mp_mesh(2),
+                                             logit_wire="off"))
+    got_off = _drain(off, reqs)
+    for w, g in zip(want, got_off):
+        np.testing.assert_array_equal(w, g)
+
+
 def test_admission_backoff_replaces_hot_spin(model):
     """A pages-starved engine must back off (bounded sleep + histogram),
     not hot-spin: admission_waits advances while the waiting request
