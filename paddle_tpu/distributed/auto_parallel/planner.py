@@ -640,3 +640,196 @@ def apply_auto_plan(strategy, ndev: int,
     strategy.pipeline = best.pp > 1
     _obs.inc("autoplan_applied_total", ndev=ndev)
     return result
+
+
+# ---------------------------------------------------------------------------
+# MPMD stage plans: per-stage width candidates
+# ---------------------------------------------------------------------------
+# The SPMD planner above picks ONE (dp, mp, pp, sharding) for the whole
+# program, so every pipeline stage gets the same data-parallel width. The
+# MPMD executor (distributed/mpmd.py) lifts that restriction: each stage
+# is its own compiled program on its own device subset, so a stack whose
+# layers are unevenly expensive can give the heavy stage more devices.
+# ``plan_mpmd_stages`` enumerates those per-stage widths, prices the
+# bottleneck-stage tick with the same calibrated constants, and charges
+# boundary respec traffic through ``reshard.plan_boundary`` at the
+# RESOLVED wire dtype — the moved bytes of an int8 boundary are a quarter
+# of an f32 one, which is exactly what the tensor-queue transport ships.
+
+@dataclass
+class StagePlan:
+    """One MPMD layout candidate: per-stage widths + the layer split the
+    runtime will actually use (contiguous, remainder to the front — the
+    mirror of ``mpmd._partition``)."""
+
+    widths: List[int] = field(default_factory=list)
+    layer_split: List[Tuple[int, int]] = field(default_factory=list)
+    microbatches: int = 1
+    wire: str = "f32"
+    # filled by _score_stage_plan()
+    predicted_step_s: float = 0.0
+    breakdown: Dict[str, float] = field(default_factory=dict)
+    boundary_bytes: float = 0.0          # wire bytes per step, all boundaries
+    stage_tick_s: List[float] = field(default_factory=list)
+
+    @property
+    def equal_width(self) -> bool:
+        return len(set(self.widths)) <= 1
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "widths": list(self.widths),
+            "layer_split": [list(s) for s in self.layer_split],
+            "microbatches": self.microbatches,
+            "wire": self.wire,
+            "predicted_step_s": self.predicted_step_s,
+            "boundary_bytes": self.boundary_bytes,
+            "breakdown": dict(self.breakdown),
+        }
+
+
+@dataclass
+class MpmdPlan:
+    best: StagePlan
+    best_equal: Optional[StagePlan]
+    candidates: List[StagePlan]
+    constants: CostConstants
+    plan_seconds: float
+
+
+def _split_layers(n_layers: int, n_stages: int) -> List[Tuple[int, int]]:
+    """Contiguous layer ranges per stage, remainder to the FRONT stages —
+    must stay in lockstep with ``mpmd._partition`` so the planner prices
+    the split the executor actually builds."""
+    base, rem = divmod(n_layers, n_stages)
+    out, lo = [], 0
+    for s in range(n_stages):
+        hi = lo + base + (1 if s < rem else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def _stage_compositions(n_devices: int, n_stages: int) -> List[List[int]]:
+    """All ways to split ``n_devices`` into ``n_stages`` positive widths
+    (order matters: stage 0's width is the first entry)."""
+    if n_stages == 1:
+        return [[n_devices]]
+    out: List[List[int]] = []
+    for w in range(1, n_devices - n_stages + 2):
+        for rest in _stage_compositions(n_devices - w, n_stages - 1):
+            out.append([w] + rest)
+    return out
+
+
+def _score_stage_plan(sp: StagePlan, mc: ModelConfig, topo: Topology,
+                      consts: CostConstants,
+                      layer_costs: List[float]) -> StagePlan:
+    """Fill ``predicted_step_s`` on a copy of ``sp``.
+
+    Tick model: ``sec_per_flop`` is calibrated in host-aggregate units
+    (all ``n_devices`` participating), so a stage running its share on
+    ``dp_i`` devices ticks at ``sec_per_flop · n · flops_i / dp_i``. A
+    1f1b step is ``M + S − 1`` ticks of the BOTTLENECK stage — widening
+    the heavy stage shrinks the max, which is the whole point of MPMD.
+    Boundary traffic is priced per microbatch through
+    ``reshard.plan_boundary`` (activation forward + cotangent backward)
+    at the wire itemsize, plus a per-send collective-launch charge."""
+    from ..reshard import plan_boundary as _plan_boundary
+
+    S = len(sp.widths)
+    M = max(1, sp.microbatches)
+    total_cost = sum(layer_costs) or 1.0
+    it = _WIRE_ITEMSIZE[sp.wire]
+    # the host-serialized calibration can fit sec_per_flop to exactly 0
+    # (compute gets attributed to the fixed/byte terms); widths would
+    # then be indistinguishable, so fall back to the default proxy-scale
+    # flop rate for the WIDTH decision — relative stage weights are what
+    # matter here, not the absolute seconds
+    spf = consts.sec_per_flop or CostConstants().sec_per_flop
+    out = replace(sp)
+    out.stage_tick_s = []
+    for (lo, hi), dp in zip(sp.layer_split, sp.widths):
+        frac = sum(layer_costs[lo:hi]) / total_cost
+        flops_mb = mc.flops * frac / M
+        out.stage_tick_s.append(
+            spf * topo.n_devices * flops_mb / max(1, dp))
+    tick = max(out.stage_tick_s) if out.stage_tick_s else 0.0
+    compute_s = (M + S - 1) * tick
+    mb_shape = (max(1, mc.global_batch // M), mc.seq_len, mc.hidden)
+    boundary_b = 0.0
+    for b in range(S - 1):
+        lp = _plan_boundary(
+            mb_shape, "float32", sp.widths[b], sp.widths[b + 1],
+            wire_itemsize=int(it), key=f"act{b}")
+        # activation fwd + cotangent bwd, every microbatch
+        boundary_b += 2.0 * M * lp.moved_bytes
+    boundary_s = consts.sec_per_byte * boundary_b
+    latency_s = consts.sec_per_collective * 2.0 * M * (S - 1)
+    out.boundary_bytes = float(boundary_b)
+    out.breakdown = {"fixed_s": consts.fixed_s,
+                     "compute_s": float(compute_s),
+                     "boundary_s": float(boundary_s),
+                     "latency_s": float(latency_s)}
+    out.predicted_step_s = float(
+        consts.fixed_s + compute_s + boundary_s + latency_s)
+    return out
+
+
+def plan_mpmd_stages(model_config: Optional[ModelConfig] = None,
+                     topology: Optional[Topology] = None, *,
+                     num_stages: int = 2,
+                     wire: str = "f32",
+                     layer_costs: Optional[List[float]] = None,
+                     microbatches: Optional[int] = None,
+                     constants: Optional[CostConstants] = None) -> MpmdPlan:
+    """Enumerate per-stage width compositions for an MPMD pipeline and
+    rank them by predicted step time.
+
+    ``layer_costs`` gives each layer's relative compute weight (default
+    uniform). On a balanced stack the equal-width composition wins; on an
+    unbalanced one the planner shifts devices onto the bottleneck stage —
+    ``MpmdPlan.best_equal`` keeps the best equal-width candidate around
+    so callers (scripts/scaling_model.py) can record the A/B delta."""
+    t0 = time.perf_counter()
+    mc = model_config or ModelConfig()
+    topo = topology or Topology()
+    consts = constants or load_calibration(mc=None)
+    if wire not in _WIRE_ITEMSIZE:
+        raise ValueError(f"unknown wire {wire!r}; want one of "
+                         f"{sorted(_WIRE_ITEMSIZE)}")
+    if not 1 <= num_stages <= topo.n_devices:
+        raise ValueError(
+            f"num_stages={num_stages} needs 1..{topo.n_devices} stages")
+    if num_stages > mc.layers:
+        raise ValueError(
+            f"num_stages={num_stages} exceeds {mc.layers} layers")
+    costs = list(layer_costs) if layer_costs else [1.0] * mc.layers
+    if len(costs) != mc.layers:
+        raise ValueError(
+            f"layer_costs has {len(costs)} entries for {mc.layers} layers")
+    M = _choose_microbatches(mc.global_batch,
+                             microbatches or 2 * num_stages)
+    split = _split_layers(mc.layers, num_stages)
+    cands = [
+        _score_stage_plan(
+            StagePlan(widths=w, layer_split=split, microbatches=M,
+                      wire=wire),
+            mc, topo, consts, costs)
+        for w in _stage_compositions(topo.n_devices, num_stages)
+    ]
+    # ties break toward balanced widths (smaller spread), then lexicographic
+    cands.sort(key=lambda sp: (sp.predicted_step_s,
+                               max(sp.widths) - min(sp.widths),
+                               tuple(sp.widths)))
+    best = cands[0]
+    equal = [sp for sp in cands if sp.equal_width]
+    best_equal = equal[0] if equal else None
+    dt = time.perf_counter() - t0
+    _obs.observe("autoplan_plan_seconds", dt)
+    _obs.event("autoplan", variant="mpmd", widths=list(best.widths),
+               microbatches=M, wire=wire,
+               predicted_step_s=round(best.predicted_step_s, 6),
+               candidates=len(cands), calibration=consts.source)
+    return MpmdPlan(best=best, best_equal=best_equal, candidates=cands,
+                    constants=consts, plan_seconds=dt)

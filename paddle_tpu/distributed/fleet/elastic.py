@@ -28,7 +28,7 @@ import atexit
 import os
 import shutil
 import sys
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ... import observability as _obs
 from ..checkpoint import (
@@ -257,6 +257,92 @@ class ElasticManager:
 
 
 # ---------------------------------------------------------------------------
+# MPMD per-stage checkpoint shards + stage-local live resize
+# ---------------------------------------------------------------------------
+# An MPMD pipeline is S independent programs; its fault/elastic unit is ONE
+# stage, not the world. Each stage checkpoints its own shard directory and
+# resizes alone — the whole-fleet ElasticManager machinery above stays the
+# SPMD path's driver.
+
+def stage_shard_dir(base_dir: str, stage_id: int, step: int) -> str:
+    return os.path.join(os.path.abspath(base_dir),
+                        f"stage_{int(stage_id)}", f"step_{int(step)}")
+
+
+def save_stage_shard(base_dir: str, stage_id: int, step: int,
+                     state: Dict) -> str:
+    """One stage's flat state (params/opt leaves by name) into its own
+    commit-manifested shard dir — same atomic body->manifest->rename
+    discipline as the whole-model checkpoints, so a SIGKILL mid-save
+    leaves a torn dir that restore discovery skips."""
+    path = stage_shard_dir(base_dir, stage_id, step)
+    save_state_dict(dict(state), path)
+    return path
+
+
+def latest_stage_step(base_dir: str, stage_id: int) -> Optional[int]:
+    """Newest COMMITTED shard step for one stage, or None."""
+    root = os.path.join(os.path.abspath(base_dir), f"stage_{int(stage_id)}")
+    if not os.path.isdir(root):
+        return None
+    steps = [int(n[5:]) for n in os.listdir(root)
+             if n.startswith("step_") and n[5:].isdigit()
+             and is_complete_checkpoint(os.path.join(root, n))]
+    return max(steps) if steps else None
+
+
+def latest_common_step(base_dir: str, num_stages: int) -> Optional[int]:
+    """Newest step for which EVERY stage has a committed shard — the
+    consistent restore point after a stage worker dies (the surviving
+    stages may have saved one step further than the victim)."""
+    steps = None
+    for s in range(int(num_stages)):
+        root = os.path.join(os.path.abspath(base_dir), f"stage_{s}")
+        if not os.path.isdir(root):
+            return None
+        have = {int(n[5:]) for n in os.listdir(root)
+                if n.startswith("step_") and n[5:].isdigit()
+                and is_complete_checkpoint(os.path.join(root, n))}
+        steps = have if steps is None else (steps & have)
+        if not steps:
+            return None
+    return max(steps)
+
+
+def load_stage_shard(base_dir: str, stage_id: int, step: int) -> Dict:
+    from ..checkpoint import load_state_dict
+
+    return load_state_dict(stage_shard_dir(base_dir, stage_id, step))
+
+
+def stage_live_resize(stage_id: int, state: Dict, target_shardings: Dict):
+    """Reshard ONE stage's live state onto its new placements (a width
+    change for that stage alone). Every leaf moves via the planned
+    ``reshard_array`` path (deadline-guarded device_put, stall telemetry);
+    nothing outside this stage's state is touched — the other stages'
+    arrays, executables and compile-cache entries survive as-is."""
+    import time as _time
+
+    from ..reshard import record_plan_metrics, reshard_array
+
+    t0 = _time.perf_counter()
+    out, plans = {}, []
+    for name, arr in state.items():
+        dst = target_shardings.get(name)
+        if dst is None:
+            out[name] = arr
+            continue
+        moved, plan = reshard_array(arr, dst, key=name)
+        out[name] = moved
+        plans.append(plan)
+    record_plan_metrics(plans, what="mpmd_stage",
+                        seconds=_time.perf_counter() - t0)
+    _obs.event("elastic_stage_resize", stage=int(stage_id),
+               leaves=len(plans))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # store-signaled fleet resize (the scale-event channel)
 # ---------------------------------------------------------------------------
 _RESIZE_KEY = "paddle_tpu/elastic/resize"
@@ -286,5 +372,36 @@ def clear_resize(store) -> None:
     """Acknowledge a completed resize (coordinator-side)."""
     try:
         store.delete_key(_RESIZE_KEY)
+    except TimeoutError:
+        pass
+
+
+# stage-scoped variant: resize ONE pipeline stage's width, every other
+# stage keeps running its compiled programs untouched
+_STAGE_RESIZE_KEY = "paddle_tpu/elastic/stage_resize"
+
+
+def request_stage_resize(store, stage_id: int, dp: int) -> None:
+    """Publish a stage-local width change (``stage_id`` -> new dp). The
+    MPMD driver picks it up at the next step fence and resizes only that
+    stage (see distributed/mpmd.py)."""
+    store.set(_STAGE_RESIZE_KEY, f"{int(stage_id)}:{int(dp)}")
+
+
+def poll_stage_resize(store) -> Optional[Tuple[int, int]]:
+    """Pending (stage_id, new_dp) stage resize, or None."""
+    try:
+        if not store.check(_STAGE_RESIZE_KEY):
+            return None
+        v = store.get(_STAGE_RESIZE_KEY)
+        s, dp = (v.decode() if isinstance(v, bytes) else str(v)).split(":")
+        return int(s), int(dp)
+    except (TimeoutError, ValueError):
+        return None
+
+
+def clear_stage_resize(store) -> None:
+    try:
+        store.delete_key(_STAGE_RESIZE_KEY)
     except TimeoutError:
         pass

@@ -478,6 +478,66 @@ def _choose_microbatches(batch: int, requested: int, warn: bool = True) -> int:
     return m
 
 
+def phased_stage_table(S: int, V: int, M: int, schedule: str = "1f1b"):
+    """Host-side mirror of the phased schedule decode (the arithmetic in
+    ``spmd_fn_scheduled.decode``): per stage, the ordered list of
+    ``(tick, kind, mb_idx, chunk)`` ops, where kind is ``"F"`` or ``"B"``.
+
+    This is the MPMD per-stage tick driver (``distributed/mpmd.py``): a
+    stage runner replays exactly this table against its queues, so 1F1B
+    ordering and micro-batch accounting carry over from the SPMD compiled
+    schedules unchanged. Forward ops come out in tick order; backward ops
+    in the order the SPMD custom-vjp executes them:
+
+    * ``gpipe`` / default phased order — reverse tick order (the compiled
+      backward replays the ring backwards, so gradient accumulation per
+      stage runs micro-batches last-to-first);
+    * ``1f1b`` streaming order — after a warmup of ``min(M, S - s)``
+      forwards, stage s alternates one backward (ascending mb) with one
+      forward, capping in-flight stashes at ``S - s`` instead of M.
+
+    Both orders accumulate the same gradient sum (reassociation only;
+    the MPMD-vs-SPMD trajectory gate pins the numerics <=1e-5).
+    """
+    if schedule not in PP_SCHEDULES:
+        raise ValueError(f"schedule={schedule!r} not in {PP_SCHEDULES}")
+    groups = -(-M // S)
+    n_steps = groups * S * V + S - 1
+    fwd = {s: [] for s in range(S)}
+    for t in range(n_steps):
+        for s in range(S):
+            rel_total = t - s
+            if rel_total < 0:
+                continue
+            g = rel_total // (S * V)
+            rel = rel_total - g * S * V
+            k_raw = rel // S
+            m_local = rel % S
+            if g >= groups or g * S + m_local >= M or k_raw >= V:
+                continue
+            fwd[s].append((t, "F", g * S + m_local, k_raw))
+    table = {}
+    for s in range(S):
+        f_ops = fwd[s]
+        b_ops = [(2 * n_steps - 1 - t, "B", mb, k)
+                 for (t, _, mb, k) in reversed(f_ops)]
+        if schedule == "1f1b" and V == 1:
+            # warmup then strict 1B1F, backward ascending by micro-batch
+            w = min(M, S - s)
+            b_asc = sorted(b_ops, key=lambda op: op[2])
+            ops, fi, bi = list(f_ops[:w]), w, 0
+            while bi < len(b_asc):
+                ops.append(b_asc[bi])
+                bi += 1
+                if fi < len(f_ops):
+                    ops.append(f_ops[fi])
+                    fi += 1
+            table[s] = ops
+        else:
+            table[s] = f_ops + b_ops
+    return table
+
+
 @defop(name="spmd_pipeline")
 def _pipeline_forward(x, *stacked_vals, pipe: SpmdPipeline, n_extra: int = 0):
     m = _mesh.get_global_mesh()

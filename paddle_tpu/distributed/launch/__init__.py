@@ -58,6 +58,13 @@ def build_parser():
                         "serving.worker registers there (a relaunch after "
                         "--max_restarts joins as a FRESH engine index — the "
                         "router fails over the dead one's work meanwhile)")
+    p.add_argument("--mpmd_stages", type=str, default=None,
+                   help="comma-separated per-stage device widths for the "
+                        "MPMD pipeline executor (e.g. '3,1'); exported as "
+                        "PADDLE_TPU_MPMD_STAGES so distributed.mpmd."
+                        "MpmdPipeline picks the stage widths up without "
+                        "a script change — and a relaunch after a stage "
+                        "failure re-enters with the SAME stage layout")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -196,6 +203,14 @@ def launch(argv=None):
     if args.serving_master:
         # serving.worker's --master defaults to this env var
         os.environ["PADDLE_SERVING_MASTER"] = args.serving_master
+    if args.mpmd_stages:
+        # validate here so a typo fails the LAUNCH, not the Nth relaunch
+        widths = [int(w) for w in args.mpmd_stages.split(",") if w.strip()]
+        if not widths or any(w < 1 for w in widths):
+            raise SystemExit(
+                f"--mpmd_stages={args.mpmd_stages!r}: want comma-separated "
+                "positive per-stage widths, e.g. '2,2' or '3,1'")
+        os.environ["PADDLE_TPU_MPMD_STAGES"] = ",".join(str(w) for w in widths)
     cmd = [sys.executable, args.training_script] + list(args.training_script_args)
     env = os.environ.copy()
     # the worker is a fresh interpreter: propagate the launcher's import

@@ -1,0 +1,975 @@
+"""MPMD pipeline execution: one compiled program per stage, async queues between.
+
+The SPMD pipeline (`fleet.meta_parallel.SpmdPipeline`) compiles the whole
+1F1B schedule — every stage's forward, backward and the inter-stage
+`ppermute` — into ONE XLA program spanning ALL devices. That is the right
+default on a homogeneous slice, but it hard-wires two costs:
+
+* **global recompile**: resizing one stage (dp width change after a
+  stragglers/elastic event) invalidates the single program, so all S
+  stages pay the 4.7-7 s compile (MULTICHIP_SCALING.json `compile_s`);
+* **uniform width**: one mesh means every stage gets the same dp x mp
+  layout, even when the layer stack is unbalanced (a fat embedding stage
+  next to thin decoder stages).
+
+This module is the MPMD path (arXiv:2412.14374 — "Scaling Deep Learning
+Training with MPMD Pipeline Parallelism"): each stage owns
+
+* a **device subset** and its own `Mesh` (widths may differ per stage),
+* its own **AOT-compiled programs** — `fwd` for non-last stages,
+  `bwd` (recompute-in-backward vjp + gradient accumulation) and a
+  fused `loss_grad` on the last stage — cached per stage in the
+  persistent compile cache under `key_for(..., stage=...)`, so a
+  stage-local resize recompiles exactly ONE stage's programs
+  (`runtime/compile_cache.py`),
+* a pair of **async boundary queues** (activations downstream,
+  cotangents upstream) built on the PR 11 streaming-transport frame
+  protocol: length-prefixed `tq` frames, per-channel seq dedup
+  (`transport.SeqChannels`, channels `act<i>`/`cot<i>`), cumulative
+  `tq_ack` watermarks, sender-side replay of unacked frames after a
+  reconnect, every blocking socket op under `deadline_guard`
+  (scripts/check_robustness.py rule 6).
+
+The per-stage tick driver is the PR 8 **phased schedule table**
+(`pipeline_parallel.phased_stage_table`): each stage runner replays
+exactly the (tick, F/B, microbatch) op list the SPMD compiled schedule
+executes, so 1F1B ordering, warmup depth and microbatch accounting carry
+over unchanged — and the MPMD trajectory matches the SPMD one to the
+reassociation-only tolerance the tests pin (<=1e-5; bit-equal between
+local and TCP transports on the `raw`/`f32` wire).
+
+Boundary tensors are **respec'd, not assumed aligned**: a stage gathers
+its output to host, ships it at the configured wire dtype
+(PADDLE_TPU_MPMD_WIRE: raw | f32 | bf16 | int8), and the receiver
+`device_put`s onto ITS OWN mesh's batch sharding — unequal widths
+(dp2 -> dp1, dp1 -> dp3, ...) need no collective bridge program; the
+byte cost is priced by `reshard.plan_boundary` and fed to the
+auto-parallel planner's per-stage width search.
+
+Failure unit = one stage. Each stage checkpoints its own shard
+(`fleet.elastic.save_stage_shard`); after a SIGKILL the driver restores
+every stage at `latest_common_step` and replays queues from the last
+acked microbatch (`SeqChannels.seek`). docs/PIPELINE.md §MPMD has the
+stage program contract, queue/ack semantics and the failure matrix.
+
+This module is the single writer of the ``mpmd_*`` metric/span families
+(scripts/check_observability.py enforces that).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import queue as _queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .. import observability as _obs
+from ..framework.core import Tensor, no_grad
+from ..framework.op import raw
+from ..runtime.compile_cache import resolve as _resolve_cache
+from ..serving import transport as _transport
+from ..serving.protocol import deadline_guard
+from ..testing import chaos
+from . import reshard as _reshard
+
+__all__ = [
+    "MpmdPipeline", "MpmdStage", "BoundaryEndpoint",
+    "local_boundary", "tcp_boundary",
+    "ENV_WIRE", "ENV_STAGES", "resolve_wire",
+]
+
+#: wire dtype for boundary tensors (transport.TENSOR_WIRES); `raw`/`f32`
+#: are bit-preserving for f32 activations — the trajectory gate's wire
+ENV_WIRE = "PADDLE_TPU_MPMD_WIRE"
+
+#: launch CLI exports the per-stage width plan ("dp0,dp1,...") here so a
+#: relaunched worker rebuilds the same stage layout it died with
+ENV_STAGES = "PADDLE_TPU_MPMD_STAGES"
+
+#: bound on every blocking queue wait (seconds); the deadline guard on
+#: the underlying socket ops is the watchdog of last resort
+_QUEUE_TIMEOUT = float(os.environ.get("PADDLE_TPU_MPMD_TIMEOUT", "120"))
+
+
+def resolve_wire(wire: Optional[str] = None) -> str:
+    w = wire or os.environ.get(ENV_WIRE, "raw")
+    if w not in _transport.TENSOR_WIRES:
+        raise ValueError(
+            f"{ENV_WIRE}={w!r} not in {_transport.TENSOR_WIRES}")
+    return w
+
+
+def parse_stage_widths(spec: Optional[str] = None) -> Optional[List[int]]:
+    """Decode the launch CLI's ENV_STAGES export ("2,2" -> [2, 2])."""
+    s = spec if spec is not None else os.environ.get(ENV_STAGES, "")
+    if not s:
+        return None
+    return [int(tok) for tok in s.replace(" ", "").split(",") if tok]
+
+
+# ---------------------------------------------------------------------------
+# Boundary queues: tq frames + per-channel seq + ack/replay
+# ---------------------------------------------------------------------------
+class _LocalChan:
+    """In-process frame pipe (thread-safe), same send/poll surface as the
+    TCP chans so the endpoint logic is transport-agnostic."""
+
+    def __init__(self, tx: _queue.Queue, rx: _queue.Queue):
+        self._tx, self._rx = tx, rx
+
+    def send(self, frame: dict) -> bool:
+        self._tx.put(frame)
+        return True
+
+    def poll(self) -> List[dict]:
+        out: List[dict] = []
+        while True:
+            try:
+                out.append(self._rx.get_nowait())
+            except _queue.Empty:
+                return out
+
+
+def _local_chan_pair() -> Tuple[_LocalChan, _LocalChan]:
+    a, b = _queue.Queue(), _queue.Queue()
+    return _LocalChan(a, b), _LocalChan(b, a)
+
+
+class _ServerChan:
+    """Downstream side of a TCP boundary: owns the listener. A new
+    connection id means the peer redialed — surfaced as a synthetic
+    ``_reconnect`` frame so the endpoint replays its unacked tail."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = _transport.TransportServer(host, port)
+        self._cid: Optional[int] = None
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    def poll(self) -> List[dict]:
+        out: List[dict] = []
+        for cid, fr in self._server.poll():
+            if cid != self._cid:
+                self._cid = cid
+                out.append({"t": "_reconnect"})
+            out.append(fr)
+        return out
+
+    def send(self, frame: dict) -> bool:
+        if self._cid is None:
+            return False
+        return self._server.send(self._cid, frame)
+
+
+class _ClientChan:
+    """Upstream side of a TCP boundary: persistent dialer with jittered
+    backoff (transport.TransportClient); a completed redial is surfaced
+    as a ``_reconnect`` frame."""
+
+    def __init__(self, addr: str, seed: int = 0):
+        self._client = _transport.TransportClient(addr, seed=seed)
+        self._seen_reconnects = self._client.reconnects
+
+    def poll(self) -> List[dict]:
+        frames = self._client.poll()
+        if self._client.reconnects != self._seen_reconnects:
+            self._seen_reconnects = self._client.reconnects
+            frames = [{"t": "_reconnect"}] + frames
+        return frames
+
+    def send(self, frame: dict) -> bool:
+        return self._client.send(frame)
+
+
+def _payload_nbytes(frame: dict) -> int:
+    payload = frame.get("x", {})
+    n = 0
+    for k in ("x", "scale"):
+        v = payload.get(k)
+        if isinstance(v, np.ndarray):
+            n += v.nbytes
+    return n
+
+
+class BoundaryEndpoint:
+    """One side of a stage boundary: sends on one tq channel, receives on
+    the other, over any chan with a send/poll surface.
+
+    Reliability contract (docs/PIPELINE.md §MPMD):
+
+    * outgoing frames carry per-channel seqs (`SeqChannels.next_seq`) and
+      are retained in an unacked buffer until the peer's cumulative
+      `tq_ack` watermark covers them;
+    * incoming frames dedup against the per-channel cursor — a
+      retransmit of a consumed microbatch is dropped, never re-applied;
+    * a reconnect (new conn id / redial) replays the whole unacked tail
+      in seq order — the receiver's dedup makes that idempotent;
+    * `seek()` fast-forwards the consume cursor after a checkpoint
+      restore, so replay starts at the last acked microbatch.
+
+    Every chan op sits under ``deadline_guard`` — rule 6 of
+    scripts/check_robustness.py enforces it statically.
+    """
+
+    def __init__(self, chan, send_channel: str, recv_channel: str, *,
+                 wire: str = "raw", timeout: Optional[float] = None):
+        self._chan = chan
+        self._send_ch = send_channel
+        self._recv_ch = recv_channel
+        self.wire = wire
+        self._seqs = _transport.SeqChannels()
+        self._unacked: "collections.OrderedDict[int, dict]" = \
+            collections.OrderedDict()
+        self._need_replay = False
+        self._timeout = _QUEUE_TIMEOUT if timeout is None else float(timeout)
+
+    # -- sender side --------------------------------------------------------
+    def send(self, arr: np.ndarray, *, mb: int, meta: Optional[dict] = None
+             ) -> int:
+        meta = dict(meta or ())
+        meta["mb"] = int(mb)
+        seq = self._seqs.next_seq(self._send_ch)
+        frame = _transport.encode_tq_frame(
+            self._send_ch, seq, np.asarray(arr), wire=self.wire, meta=meta)
+        self._unacked[seq] = frame
+        with deadline_guard(f"mpmd tq send {self._send_ch}", self._timeout):
+            if not self._chan.send(frame):
+                self._need_replay = True
+        _obs.inc("mpmd_boundary_bytes_total", _payload_nbytes(frame),
+                 channel=self._send_ch)
+        return seq
+
+    def unacked(self) -> int:
+        return len(self._unacked)
+
+    # -- receiver side ------------------------------------------------------
+    def seek(self, seq: int) -> None:
+        """Checkpoint-restore replay point: consume cursor jumps to the
+        last acked microbatch's seq; older retransmits become duplicates."""
+        self._seqs.seek(self._recv_ch, int(seq))
+
+    def acked_watermark(self) -> int:
+        """Next seq this side will consume (== cumulative ack sent)."""
+        return self._seqs.cursor(self._recv_ch)
+
+    def _pump(self) -> None:
+        with deadline_guard(f"mpmd tq poll {self._recv_ch}", self._timeout):
+            frames = self._chan.poll()
+        for fr in frames:
+            t = fr.get("t")
+            if t == "_reconnect":
+                self._need_replay = True
+            elif t == "tq" and fr.get("ch") == self._recv_ch:
+                self._seqs.stash(self._recv_ch, int(fr["seq"]), fr)
+            elif t == "tq_ack" and fr.get("ch") == self._send_ch:
+                upto = int(fr["seq"])
+                for s in [s for s in self._unacked if s <= upto]:
+                    del self._unacked[s]
+        if self._need_replay:
+            if not self._unacked:
+                self._need_replay = False
+                return
+            ok = True
+            for fr in list(self._unacked.values()):
+                with deadline_guard(
+                        f"mpmd tq replay {self._send_ch}", self._timeout):
+                    ok = self._chan.send(fr)
+                if not ok:
+                    break
+            if ok:
+                _obs.inc("mpmd_queue_replay_total", channel=self._send_ch)
+                _obs.event("mpmd_queue_replay", channel=self._send_ch,
+                           frames=len(self._unacked))
+                self._need_replay = False
+
+    def recv(self, *, timeout: Optional[float] = None) -> Tuple[np.ndarray,
+                                                                dict]:
+        """Next in-order frame on the recv channel (bounded block); sends
+        the cumulative ack for it before returning."""
+        limit = self._timeout if timeout is None else float(timeout)
+        t_end = time.monotonic() + limit
+        while True:
+            fr = self._seqs.pop_next(self._recv_ch)
+            if fr is not None:
+                seq = int(fr["seq"])
+                _, _, arr, meta = _transport.decode_tq_frame(fr)
+                ack = _transport.encode_tq_ack(self._recv_ch, seq)
+                with deadline_guard(
+                        f"mpmd tq ack {self._recv_ch}", self._timeout):
+                    self._chan.send(ack)  # best-effort; ack is cumulative
+                return arr, (meta or {})
+            if time.monotonic() > t_end:
+                raise TimeoutError(
+                    f"mpmd recv on {self._recv_ch!r} timed out after "
+                    f"{limit:.0f}s — upstream stage dead or wedged")
+            self._pump()
+            time.sleep(0.0002)
+
+
+def local_boundary(bid: int, *, wire: str = "raw"
+                   ) -> Tuple[BoundaryEndpoint, BoundaryEndpoint]:
+    """(upstream, downstream) endpoints over in-process queues. Boundary
+    ``bid`` connects stage bid -> bid+1; activations ride ``act<bid>``,
+    cotangents ``cot<bid>``."""
+    up_chan, down_chan = _local_chan_pair()
+    up = BoundaryEndpoint(up_chan, f"act{bid}", f"cot{bid}", wire=wire)
+    down = BoundaryEndpoint(down_chan, f"cot{bid}", f"act{bid}", wire=wire)
+    return up, down
+
+
+def tcp_boundary(bid: int, *, wire: str = "raw"
+                 ) -> Tuple[BoundaryEndpoint, BoundaryEndpoint]:
+    """Same pair over a real loopback TCP connection (the multi-process
+    wire path: frames cross the transport's length-prefixed codec, seq
+    dedup and reconnect replay are live)."""
+    server_chan = _ServerChan()
+    down = BoundaryEndpoint(server_chan, f"cot{bid}", f"act{bid}", wire=wire)
+    client_chan = _ClientChan(server_chan.addr, seed=bid)
+    up = BoundaryEndpoint(client_chan, f"act{bid}", f"cot{bid}", wire=wire)
+    return up, down
+
+
+# ---------------------------------------------------------------------------
+# Per-stage compiled programs
+# ---------------------------------------------------------------------------
+class MpmdStage:
+    """One pipeline stage: a contiguous layer slice, a private mesh over a
+    device subset, and lazily AOT-compiled programs.
+
+    Programs (the stage program contract, docs/PIPELINE.md §MPMD):
+
+    * ``fwd(params, bufs, x) -> y`` — non-last stages; batch dim sharded
+      over this stage's ``dp`` axis, params/buffers replicated; buffers
+      are non-differentiated inputs.
+    * ``bwd(params, bufs, x, gy, acc) -> (dx, acc')`` —
+      recompute-in-backward vjp of fwd; ``acc`` carries the running
+      gradient sum so microbatch accumulation stays on-device.
+    * ``loss_grad(params, head, bufs, x, acc, head_acc, 1/M[, y]) ->
+      (loss_mb, dx, acc', head_acc')`` — last stage: forward through the
+      head + loss, grads scaled by 1/M so summing cotangents over
+      microbatches reproduces the full-batch mean loss.
+
+    Compilation follows the engine's AOT idiom: lower once per
+    (program, shapes), fingerprint with ``CompileCache.key_for(...,
+    stage={id, layers, dp})`` and ``load_or_compile`` when the cache is
+    enabled. The ``stage`` key part is what makes a resize stage-local:
+    other stages' keys — and their on-disk entries — do not change.
+    """
+
+    def __init__(self, stage_id: int, apply_layer: Callable, positions:
+                 Sequence[int], devices: Sequence, *, head_apply:
+                 Optional[Callable] = None, loss_fn: Optional[Callable] =
+                 None, cache=None, where: str = "mpmd"):
+        self.stage_id = int(stage_id)
+        self._apply_layer = apply_layer          # (leaf_vals, x) -> y
+        self.positions = tuple(int(p) for p in positions)
+        self._head_apply = head_apply            # (head_leaves, y) -> out
+        self._loss_fn = loss_fn
+        self._cache = cache
+        self._where = where
+        self.compile_count = 0
+        self.cache_hits = 0
+        self._programs: Dict[tuple, object] = {}
+        self._set_devices(devices)
+
+    # -- mesh / placement ---------------------------------------------------
+    def _set_devices(self, devices: Sequence) -> None:
+        devices = list(devices)
+        if not devices:
+            raise ValueError(f"stage {self.stage_id} got an empty "
+                             "device subset")
+        self.devices = devices
+        self.dp = len(devices)
+        self.mesh = Mesh(np.asarray(devices), ("dp",))
+        self._repl = NamedSharding(self.mesh, P())
+        self._batch = NamedSharding(self.mesh, P("dp"))
+
+    def resize(self, devices: Sequence) -> None:
+        """Stage-local width change: new mesh over a new device subset,
+        THIS stage's programs dropped. Nothing else in the pipeline is
+        touched — the acceptance gate asserts the other stages' compile
+        counts and cache entries survive."""
+        old = self.dp
+        self._set_devices(devices)
+        self._programs.clear()
+        _obs.event("mpmd_stage_resize", stage=self.stage_id, old_dp=old,
+                   new_dp=self.dp)
+
+    def put_batch(self, arr) -> jax.Array:
+        """Batch tensor onto this stage's mesh: dp-sharded along dim 0
+        when divisible, replicated otherwise (a width that does not
+        divide the microbatch rows cannot shard them — unequal-width
+        stacks hit this on purpose)."""
+        arr = np.asarray(arr)
+        sh = self._batch if (arr.ndim and arr.shape[0] % self.dp == 0) \
+            else self._repl
+        return jax.device_put(arr, sh)
+
+    def put_leaves(self, leaves):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), self._repl), leaves)
+
+    # -- stage functions (traced) -------------------------------------------
+    # ``params``/``bufs`` are tuples over this stage's layers of leaf
+    # tuples; buffers ride as non-differentiated program inputs (an int
+    # mask buffer must never meet jax.vjp).
+    def _forward_only(self, params, bufs, x):
+        with no_grad():
+            h = x
+            for lp, lb in zip(params, bufs):
+                h = self._apply_layer(tuple(lp) + tuple(lb), h)
+            return h
+
+    def _forward_loss(self, params, head_leaves, bufs, x, *extra):
+        with no_grad():
+            h = x
+            for lp, lb in zip(params, bufs):
+                h = self._apply_layer(tuple(lp) + tuple(lb), h)
+            if self._head_apply is not None:
+                h = self._head_apply(head_leaves, h)
+            if self._loss_fn is None:
+                raise ValueError("last stage needs loss_fn")
+            return self._loss_fn(h, *extra)
+
+    # -- AOT build ----------------------------------------------------------
+    def _build(self, kind: str, fn, example_args) -> object:
+        key = None
+        lowered = jax.jit(fn).lower(*example_args)
+        if self._cache is not None:
+            key = self._cache.key_for(
+                lowered,
+                config={"kind": kind},
+                mesh=self.mesh,
+                stage={"id": self.stage_id, "layers": list(self.positions),
+                       "dp": self.dp},
+            )
+            compiled, hit = self._cache.load_or_compile(
+                lowered, key, where=self._where)
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.compile_count += 1
+        else:
+            compiled, hit = lowered.compile(), False
+            self.compile_count += 1
+        _obs.inc("mpmd_stage_compile_total", stage=self.stage_id,
+                 program=kind, hit=str(hit).lower())
+        return compiled
+
+    def cache_key(self, kind: str, fn, example_args) -> Optional[str]:
+        """The compile-cache key this stage would use for ``kind`` (test
+        hook for the resize gate: unresized stages' keys must not move)."""
+        if self._cache is None:
+            return None
+        lowered = jax.jit(fn).lower(*example_args)
+        return self._cache.key_for(
+            lowered, config={"kind": kind}, mesh=self.mesh,
+            stage={"id": self.stage_id, "layers": list(self.positions),
+                   "dp": self.dp})
+
+    def _program(self, kind: str, shapes: tuple, builder) -> object:
+        key = (kind, shapes, self.dp)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = builder()
+            self._programs[key] = prog
+        return prog
+
+    # -- public ops (device_put'd args -> committed outputs) ----------------
+    def fwd(self, params, bufs, x):
+        prog = self._program(
+            "fwd", (tuple(np.shape(x)),),
+            lambda: self._build("fwd", self._forward_only,
+                                (params, bufs, x)))
+        return prog(params, bufs, x)
+
+    def bwd(self, params, bufs, x, gy, acc):
+        def fn(pv, bv, xv, g, ac):
+            _, pull = jax.vjp(
+                lambda p_, x_: self._forward_only(p_, bv, x_), pv, xv)
+            dl, dx = pull(g)
+            return dx, jax.tree_util.tree_map(jnp.add, ac, dl)
+
+        prog = self._program(
+            "bwd", (tuple(np.shape(x)), tuple(np.shape(gy))),
+            lambda: self._build("bwd", fn, (params, bufs, x, gy, acc)))
+        return prog(params, bufs, x, gy, acc)
+
+    def loss_grad(self, params, head_leaves, bufs, x, acc, head_acc,
+                  inv_m, *extra):
+        def fn(pv, hv, bv, xv, ac, hac, inv, *ex):
+            loss, (dl, dh, dx) = jax.value_and_grad(
+                self._forward_loss, argnums=(0, 1, 3))(pv, hv, bv, xv, *ex)
+            scale = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda g: g * inv, t)
+            return (loss,
+                    dx * inv,
+                    jax.tree_util.tree_map(jnp.add, ac, scale(dl)),
+                    jax.tree_util.tree_map(jnp.add, hac, scale(dh)))
+
+        prog = self._program(
+            "loss_grad",
+            (tuple(np.shape(x)), tuple(tuple(np.shape(e)) for e in extra)),
+            lambda: self._build(
+                "loss_grad", fn,
+                (params, head_leaves, bufs, x, acc, head_acc, inv_m)
+                + extra))
+        return prog(params, head_leaves, bufs, x, acc, head_acc, inv_m,
+                    *extra)
+
+
+# ---------------------------------------------------------------------------
+# The pipeline driver
+# ---------------------------------------------------------------------------
+def _partition(n_items: int, n_parts: int) -> List[Tuple[int, int]]:
+    """Contiguous [lo, hi) slices, remainder spread from the front."""
+    base, rem = divmod(n_items, n_parts)
+    out, lo = [], 0
+    for i in range(n_parts):
+        hi = lo + base + (1 if i < rem else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+class MpmdPipeline:
+    """MPMD driver over an existing ``SpmdPipeline``'s parameters.
+
+    Construction does NOT copy or re-own parameters: the stacked
+    parameters of the SpmdPipeline (plus an optional head layer) stay the
+    single source of truth, so the caller's optimizer — the exact object
+    the SPMD path trains with — updates the same state. ``train_batch``
+    computes the full pipeline loss MPMD-style (per-stage programs, async
+    boundary queues) and leaves the accumulated gradients on ``p.grad``,
+    mirroring ``loss.backward()``; the caller runs ``opt.step()`` as
+    usual. That is what makes the SPMD-vs-MPMD trajectory gate a
+    three-line test.
+
+    ``widths`` picks each stage's dp independently (unequal allowed);
+    device subsets are consecutive slices of ``jax.devices()``. V>1
+    (interleaved virtual stages) stays SPMD-only — MPMD boundaries are
+    per physical stage.
+    """
+
+    def __init__(self, spmd, widths: Optional[Sequence[int]] = None, *,
+                 head=None, loss_fn: Optional[Callable] = None,
+                 num_microbatches: Optional[int] = None,
+                 schedule: str = "1f1b", transport: str = "local",
+                 wire: Optional[str] = None, devices=None,
+                 cache_dir: Optional[str] = None,
+                 shard_dir: Optional[str] = None,
+                 layer_split: Optional[Sequence[int]] = None):
+        from .fleet.meta_parallel.pipeline_parallel import (
+            PP_SCHEDULES, phased_stage_table)
+
+        if getattr(spmd, "num_virtual_stages", 1) != 1:
+            raise ValueError("MPMD supports V=1 only; interleaved virtual "
+                             "stages stay on the SPMD path")
+        if schedule not in PP_SCHEDULES:
+            raise ValueError(f"schedule={schedule!r} not in {PP_SCHEDULES}")
+        widths = list(widths or parse_stage_widths() or
+                      [1] * max(spmd.num_stages, 1))
+        self._spmd = spmd
+        self._table_fn = phased_stage_table
+        self.schedule = schedule
+        self.num_stages = len(widths)
+        self.num_microbatches = int(num_microbatches or
+                                    getattr(spmd, "num_microbatches", None)
+                                    or 4)
+        self.wire = resolve_wire(wire)
+        self.transport = transport
+        self.head = head
+        self._loss_fn = loss_fn or (lambda y, *e: (y ** 2).mean())
+        self.step_index = 0
+        self.shard_dir = shard_dir
+        self.last_step_stats: Dict[int, Dict[str, float]] = {}
+        cache = _resolve_cache(cache_dir)
+
+        devices = list(devices if devices is not None else jax.devices())
+        need = sum(widths)
+        if need > len(devices):
+            raise ValueError(f"stage widths {widths} need {need} devices, "
+                             f"have {len(devices)}")
+        # layer slice + device slice per stage
+        L = spmd.num_layers
+        order = list(getattr(spmd, "_layer_order", range(L)))
+        if layer_split is not None:
+            # explicit per-stage layer COUNTS — an unbalanced stack puts
+            # more layers on one stage and compensates with its width
+            sizes = [int(n) for n in layer_split]
+            if (len(sizes) != self.num_stages or any(n < 1 for n in sizes)
+                    or sum(sizes) != L):
+                raise ValueError(
+                    f"layer_split={list(layer_split)} must be "
+                    f"{self.num_stages} positive counts summing to {L}")
+            self._slices, lo = [], 0
+            for n in sizes:
+                self._slices.append((lo, lo + n))
+                lo += n
+        else:
+            self._slices = _partition(L, self.num_stages)
+        head_params = ([p for _, p in head.named_parameters()]
+                       if head is not None else [])
+        self._head_params = head_params
+
+        # stage functional forms reuse the SPMD template-rebind apply
+        apply_layer = spmd._apply_block
+
+        def head_apply(head_leaves, y):
+            originals = [p._value for p in head_params]
+            try:
+                for p, v in zip(head_params, head_leaves):
+                    p._value = v
+                return raw(head(Tensor(y)))
+            finally:
+                for p, v in zip(head_params, originals):
+                    p._value = v
+
+        self.stages: List[MpmdStage] = []
+        dev_lo = 0
+        for s, ((lo, hi), dp) in enumerate(zip(self._slices, widths)):
+            last = s == self.num_stages - 1
+            self.stages.append(MpmdStage(
+                s, apply_layer, [order.index(l) for l in range(lo, hi)],
+                devices[dev_lo:dev_lo + dp],
+                head_apply=head_apply if (last and head is not None)
+                else None,
+                loss_fn=self._loss_fn if last else None,
+                cache=cache))
+            dev_lo += dp
+        self._build_boundaries()
+
+    # -- boundaries ---------------------------------------------------------
+    def _build_boundaries(self) -> None:
+        make = tcp_boundary if self.transport == "tcp" else local_boundary
+        self._up: List[BoundaryEndpoint] = []     # owned by stage i
+        self._down: List[BoundaryEndpoint] = []   # owned by stage i+1
+        for i in range(self.num_stages - 1):
+            up, down = make(i, wire=self.wire)
+            self._up.append(up)
+            self._down.append(down)
+
+    # -- parameter plumbing -------------------------------------------------
+    def parameters(self):
+        ps = list(self._spmd.parameters())
+        if self.head is not None:
+            ps += list(self.head.parameters())
+        return ps
+
+    def _stage_leaves(self, s: int):
+        """(params, bufs) per-layer leaf tuples for stage s, sliced out of
+        the stacked leaves at that stage's stacked positions."""
+        stage = self.stages[s]
+        p_stk = [np.asarray(raw(p)) for p in self._spmd._stacked]
+        b_stk = [np.asarray(raw(b)) for b in self._spmd._stacked_bufs]
+        params = tuple(tuple(leaf[pos] for leaf in p_stk)
+                       for pos in stage.positions)
+        bufs = tuple(tuple(leaf[pos] for leaf in b_stk)
+                     for pos in stage.positions)
+        return params, bufs
+
+    def compile_counts(self) -> Dict[int, int]:
+        return {s.stage_id: s.compile_count for s in self.stages}
+
+    def resize_stage(self, s: int, dp: int, devices=None) -> None:
+        """Change ONE stage's width. Device subsets are re-derived only if
+        the caller does not pin them; every other stage keeps its mesh,
+        its compiled programs and its compile-cache entries."""
+        if devices is None:
+            # reuse the stage's current leading device, extend from the
+            # global pool avoiding other stages' devices
+            taken = {id(d) for st in self.stages if st.stage_id != s
+                     for d in st.devices}
+            pool = [d for d in jax.devices() if id(d) not in taken]
+            devices = pool[:dp]
+        if len(devices) < dp:
+            raise ValueError(f"resize_stage({s}, dp={dp}): only "
+                             f"{len(devices)} free devices")
+        self.stages[s].resize(devices[:dp])
+
+    # -- one training step --------------------------------------------------
+    def train_batch(self, x, y=None) -> float:
+        """Forward+backward over all microbatches via the per-stage
+        programs; accumulated grads land on ``p.grad`` (like
+        ``loss.backward()``), loss returned as a float."""
+        t_step = time.perf_counter()
+        M, S = self.num_microbatches, self.num_stages
+        xv = np.asarray(raw(x) if isinstance(x, Tensor) else x)
+        if xv.shape[0] % M:
+            raise ValueError(f"batch {xv.shape[0]} not divisible by "
+                             f"M={M} microbatches")
+        mbs = np.split(xv, M)
+        ymbs = None
+        if y is not None:
+            yv = np.asarray(raw(y) if isinstance(y, Tensor) else y)
+            ymbs = np.split(yv, M)
+
+        table = self._table_fn(S, 1, M, self.schedule)
+        # leaves + grad accumulators, committed per stage (main thread:
+        # template-rebind tracing is not thread-safe, so every program is
+        # also built here before the runners start)
+        params, bufs = [], []
+        for st in self.stages:
+            p, b = self._stage_leaves(st.stage_id)
+            params.append(st.put_leaves(p))
+            bufs.append(st.put_leaves(b))
+        accs = [jax.tree_util.tree_map(jnp.zeros_like, pv) for pv in params]
+        last = self.stages[-1]
+        head_leaves = last.put_leaves(
+            tuple(np.asarray(raw(p)) for p in self._head_params))
+        head_acc = jax.tree_util.tree_map(jnp.zeros_like, head_leaves)
+        inv_m = jax.device_put(np.float32(1.0 / M), last._repl)
+        self._precompile(params, bufs, head_leaves, accs, head_acc, inv_m,
+                         mbs[0], ymbs[0] if ymbs else ())
+
+        losses: Dict[int, object] = {}
+        out_accs: List[object] = list(accs)
+        out_head = [head_acc]
+        errors: List[BaseException] = []
+        with _obs.span("mpmd_step", step=self.step_index, stages=S,
+                       microbatches=M, schedule=self.schedule,
+                       transport=self.transport, wire=self.wire):
+            threads = [
+                threading.Thread(
+                    target=self._run_stage,
+                    args=(s, table[s], mbs, ymbs, params, bufs,
+                          head_leaves, inv_m, out_accs, out_head, losses,
+                          errors),
+                    name=f"mpmd-stage-{s}", daemon=True)
+                for s in range(S)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=_QUEUE_TIMEOUT * 2)
+            if any(t.is_alive() for t in threads):
+                raise TimeoutError("mpmd stage runner wedged")
+            if errors:
+                raise errors[0]
+        self._scatter_grads(out_accs, out_head[0])
+        loss = float(sum(np.asarray(losses[m]) for m in range(M)) / M)
+        self.step_index += 1
+        _obs.observe("mpmd_step_seconds", time.perf_counter() - t_step)
+        if self.shard_dir:
+            self.save_shards(self.shard_dir)
+        return loss
+
+    def _precompile(self, params, bufs, head_leaves, accs, head_acc,
+                    inv_m, x0, extra) -> None:
+        if isinstance(extra, (tuple, list)):
+            extra = tuple(extra)
+        else:
+            extra = (extra,)
+        probe = np.asarray(x0)
+        for s, st in enumerate(self.stages):
+            x_d = st.put_batch(np.zeros_like(probe))
+            if s == self.num_stages - 1:
+                ex = tuple(st.put_batch(e) for e in extra)
+                st.loss_grad(params[s], head_leaves, bufs[s], x_d,
+                             accs[s], head_acc, inv_m, *ex)
+                break
+            y_aval = jax.eval_shape(st._forward_only, params[s], bufs[s],
+                                    x_d)
+            st.fwd(params[s], bufs[s], x_d)
+            g_d = st.put_batch(np.zeros(y_aval.shape, y_aval.dtype))
+            # NB: bwd consumes gy shaped like THIS stage's output
+            st.bwd(params[s], bufs[s], x_d, g_d, accs[s])
+            probe = np.zeros(y_aval.shape, y_aval.dtype)
+
+    def _run_stage(self, s, ops, mbs, ymbs, params, bufs, head_leaves,
+                   inv_m, out_accs, out_head, losses, errors) -> None:
+        try:
+            st = self.stages[s]
+            last = s == self.num_stages - 1
+            up = self._up[s] if s < self.num_stages - 1 else None
+            down = self._down[s - 1] if s > 0 else None
+            acc = out_accs[s]
+            head_acc = out_head[0]
+            stash: Dict[int, object] = {}
+            busy = 0.0
+            t0 = time.perf_counter()
+            for op_i, (tick, kind, mb, _k) in enumerate(ops):
+                chaos.mpmd_fence(s, op_i)
+                if kind == "F":
+                    if s == 0:
+                        x_mb = st.put_batch(mbs[mb])
+                    else:
+                        arr, meta = down.recv()
+                        if meta.get("mb") != mb:
+                            raise RuntimeError(
+                                f"stage {s} expected act mb={mb}, got "
+                                f"{meta.get('mb')} — schedule skew")
+                        x_mb = st.put_batch(arr)
+                    stash[mb] = x_mb
+                    if not last:
+                        # block inside the busy window: dispatch is async,
+                        # so timing the call alone would book the compute
+                        # as idle (the very next np.asarray forces it
+                        # anyway — this only moves WHERE it is counted)
+                        t1 = time.perf_counter()
+                        y_mb = jax.block_until_ready(
+                            st.fwd(params[s], bufs[s], x_mb))
+                        busy += time.perf_counter() - t1
+                        up.send(np.asarray(y_mb), mb=mb)
+                else:  # "B"
+                    x_mb = stash.pop(mb)
+                    if last:
+                        ex = ((st.put_batch(ymbs[mb]),) if ymbs is not None
+                              else ())
+                        t1 = time.perf_counter()
+                        loss_mb, dx, acc, head_acc = jax.block_until_ready(
+                            st.loss_grad(
+                                params[s], head_leaves, bufs[s], x_mb, acc,
+                                head_acc, inv_m, *ex))
+                        busy += time.perf_counter() - t1
+                        losses[mb] = loss_mb
+                    else:
+                        g_arr, meta = up.recv()
+                        if meta.get("mb") != mb:
+                            raise RuntimeError(
+                                f"stage {s} expected cot mb={mb}, got "
+                                f"{meta.get('mb')} — schedule skew")
+                        gy = st.put_batch(g_arr)
+                        t1 = time.perf_counter()
+                        dx, acc = jax.block_until_ready(
+                            st.bwd(params[s], bufs[s], x_mb, gy, acc))
+                        busy += time.perf_counter() - t1
+                    if s > 0:
+                        down.send(np.asarray(dx), mb=mb)
+                _obs.inc("mpmd_tick_total", stage=s, kind=kind)
+            out_accs[s] = acc
+            if last:
+                out_head[0] = head_acc
+            wall = max(time.perf_counter() - t0, 1e-9)
+            idle = max(0.0, 1.0 - busy / wall)
+            self.last_step_stats[s] = {"busy_s": busy, "wall_s": wall,
+                                       "idle_fraction": idle}
+            _obs.set_gauge("mpmd_stage_idle_fraction", idle, stage=s)
+        except BaseException as exc:  # noqa: BLE001 — surfaced to driver
+            errors.append(exc)
+
+    # -- grads back onto the shared parameters ------------------------------
+    def _scatter_grads(self, out_accs, head_acc) -> None:
+        n_params = len(self._spmd._stacked)
+        stacked_grads = [np.zeros(np.shape(raw(p)), np.asarray(raw(p)).dtype)
+                         for p in self._spmd._stacked]
+        for st, acc in zip(self.stages, out_accs):
+            for layer_i, pos in enumerate(st.positions):
+                layer_grads = acc[layer_i]
+                for leaf_i in range(n_params):
+                    stacked_grads[leaf_i][pos] = np.asarray(
+                        layer_grads[leaf_i])
+        for p, g in zip(self._spmd._stacked, stacked_grads):
+            p.grad = g
+        for p, g in zip(self._head_params, head_acc):
+            p.grad = np.asarray(g)
+
+    # -- per-stage checkpoint shards ----------------------------------------
+    def save_shards(self, base_dir: str, optimizer=None) -> None:
+        """Each stage commits its own shard: its layers' slices of the
+        stacked params (+opt accumulator leaves when given), the head
+        riding in the last stage's shard."""
+        from .fleet.elastic import save_stage_shard
+
+        acc_by_pid = {}
+        if optimizer is not None:
+            for i, p in enumerate(optimizer._parameter_list):
+                acc_by_pid[id(p)] = (optimizer, i)
+        for st in self.stages:
+            state: Dict[str, np.ndarray] = {}
+            pos = list(st.positions)
+            for pi, p in enumerate(self._spmd._stacked):
+                v = np.asarray(raw(p))
+                state[f"p{pi}"] = v[pos]
+                state.update(self._opt_slices(acc_by_pid.get(id(p)),
+                                              f"p{pi}", pos, v.shape[0]))
+            if st.stage_id == self.num_stages - 1:
+                for hi, p in enumerate(self._head_params):
+                    state[f"h{hi}"] = np.asarray(raw(p))
+                    state.update(self._opt_slices(
+                        acc_by_pid.get(id(p)), f"h{hi}", None, -1))
+            save_stage_shard(base_dir, st.stage_id, self.step_index, state)
+
+    @staticmethod
+    def _opt_slices(ref, prefix, pos, stacked_len) -> Dict[str, np.ndarray]:
+        if ref is None:
+            return {}
+        opt, i = ref
+        st = opt._accumulators[i]
+        if not st:
+            return {}
+        out = {}
+        for k, v in st.items():
+            v = np.asarray(v)
+            if pos is not None and v.ndim >= 1 and v.shape[0] == stacked_len:
+                out[f"{prefix}.opt.{k}"] = v[pos]
+            else:
+                out[f"{prefix}.opt.{k}"] = v
+        return out
+
+    def restore_shards(self, base_dir: str, optimizer=None
+                       ) -> Optional[int]:
+        """Rebind params (and opt accumulators) from the newest step every
+        stage committed; queue cursors restart clean because a restored
+        step replays from its first microbatch. Returns the restored step
+        or None (nothing committed)."""
+        from .fleet.elastic import latest_common_step, load_stage_shard
+
+        step = latest_common_step(base_dir, self.num_stages)
+        if step is None:
+            return None
+        shards = [load_stage_shard(base_dir, s, step)
+                  for s in range(self.num_stages)]
+        for pi, p in enumerate(self._spmd._stacked):
+            full = np.asarray(raw(p)).copy()
+            opt_full: Dict[str, np.ndarray] = {}
+            for st, shard in zip(self.stages, shards):
+                pos = list(st.positions)
+                full[pos] = np.asarray(shard[f"p{pi}"])
+                for k, v in shard.items():
+                    if k.startswith(f"p{pi}.opt."):
+                        name = k.split(".opt.", 1)[1]
+                        v = np.asarray(v)
+                        if v.ndim >= 1 and v.shape[0] == len(pos):
+                            tgt = opt_full.setdefault(
+                                name, np.zeros(full.shape, v.dtype)
+                                if v.shape[1:] == full.shape[1:] else v)
+                            if tgt.shape == full.shape:
+                                tgt[pos] = v
+                        else:
+                            opt_full[name] = v
+            p._rebind(Tensor(jnp.asarray(full)))
+            self._load_opt(optimizer, p, opt_full)
+        last_shard = shards[-1]
+        for hi, p in enumerate(self._head_params):
+            p._rebind(Tensor(jnp.asarray(np.asarray(last_shard[f"h{hi}"]))))
+            opt_full = {k.split(".opt.", 1)[1]: np.asarray(v)
+                        for k, v in last_shard.items()
+                        if k.startswith(f"h{hi}.opt.")}
+            self._load_opt(optimizer, p, opt_full)
+        self.step_index = step
+        return step
+
+    @staticmethod
+    def _load_opt(optimizer, p, leaves: Dict[str, np.ndarray]) -> None:
+        if optimizer is None or not leaves:
+            return
+        for i, q in enumerate(optimizer._parameter_list):
+            if q is p:
+                st = dict(optimizer._accumulators[i] or {})
+                for k, v in leaves.items():
+                    # keep 0-dim leaves as f32 arrays: .item() would promote
+                    # beta*_pow to a python f64 and the bias-correction chain
+                    # would round differently than an unrestored run
+                    st[k] = jnp.asarray(v)
+                optimizer._accumulators[i] = st
+                return

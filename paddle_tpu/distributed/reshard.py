@@ -346,6 +346,37 @@ def plan_cross_mesh(shape, dtype, src_spec, src_axis_sizes,
     return plan
 
 
+def plan_boundary(shape, dtype, src_dp: int, dst_dp: int, *,
+                  wire_itemsize: Optional[int] = None,
+                  key: str = "?") -> LeafPlan:
+    """MPMD stage-boundary respec: one activation/cotangent micro-batch
+    crossing from a stage of width ``src_dp`` onto a stage of width
+    ``dst_dp`` (batch dim 0 data-sharded on both sides, widths chosen
+    independently per stage).
+
+    The boundary is a cross-mesh move — the tensor leaves the source
+    stage's mesh entirely, rides the tensor-queue wire, and is laid out
+    fresh on the destination mesh — so the whole tensor crosses exactly
+    once whatever the two widths are; ``wire_itemsize`` prices it at the
+    resolved wire dtype (f32/bf16/int8), which is what the auto-parallel
+    planner charges for unequal-width candidates. Peak per device is the
+    larger side's local block plus the wire copy being assembled.
+    """
+    ndim = len(shape)
+    spec_src = _norm_spec(PartitionSpec("dp"), ndim)
+    spec_dst = _norm_spec(PartitionSpec("dp"), ndim)
+    in_b = _local_bytes(shape, dtype, spec_src, {"dp": max(int(src_dp), 1)})
+    out_b = _local_bytes(shape, dtype, spec_dst, {"dp": max(int(dst_dp), 1)})
+    it = int(wire_itemsize) if wire_itemsize else np.dtype(dtype).itemsize
+    wire_b = int(np.prod([int(d) for d in shape])) * it
+    plan = LeafPlan(key=key, shape=tuple(int(d) for d in shape),
+                    dtype=str(dtype), transfer=True)
+    plan.steps = [PlanStep("transfer", "dp", spec_dst, in_b, out_b)]
+    plan.peak_bytes = max(in_b, out_b) + wire_b
+    plan.moved_bytes = wire_b
+    return plan
+
+
 def naive_gather_bytes(shape, dtype) -> int:
     """The bound the planner beats: unshard-everything puts one full copy
     of the leaf on every device."""

@@ -244,6 +244,26 @@ METRICS = {
     "compile_cache_load_seconds": (
         "histogram", "Wall time to read+deserialize+load one cached "
                      "executable (the price of a hit)"),
+    # -- MPMD pipeline execution (distributed/mpmd.py) ----------------------
+    "mpmd_stage_compile_total": (
+        "counter", "Per-stage MPMD program builds (labels: stage, "
+                   "program = fwd|bwd|loss_grad, hit = compile-cache "
+                   "outcome) — the stage-local-recompile gate reads this"),
+    "mpmd_tick_total": (
+        "counter", "Schedule-table ops executed by stage runners "
+                   "(labels: stage, kind = F|B)"),
+    "mpmd_boundary_bytes_total": (
+        "counter", "Activation/cotangent bytes shipped over inter-stage "
+                   "queues at the resolved wire dtype (labels: channel)"),
+    "mpmd_queue_replay_total": (
+        "counter", "Unacked boundary-frame tails replayed after a "
+                   "reconnect (labels: channel)"),
+    "mpmd_stage_idle_fraction": (
+        "gauge", "1 - busy/wall per stage runner in the last step — the "
+                 "bubble each stage actually saw (labels: stage)"),
+    "mpmd_step_seconds": (
+        "histogram", "Wall time of one MPMD train_batch (all stages, all "
+                     "microbatches, grads scattered)"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
@@ -278,6 +298,9 @@ EVENTS = {
     "serving_router_retransmit",   # unacked wire dispatches re-sent + mirrored
     "autoplan",           # planner chose a layout (mesh, schedule, cost)
     "compile_cache_corrupt",  # a cache entry failed to load and was evicted
+    "mpmd_queue_replay",  # boundary queue replayed its unacked tail
+    "mpmd_stage_resize",  # one MPMD stage changed width (old/new dp)
+    "elastic_stage_resize",  # per-stage live resize moved a stage's leaves
 }
 
 
@@ -372,6 +395,11 @@ SPANS = {
     "reshard_exec": (
         "paddle_tpu/distributed/reshard.py",
         "One reshard plan+execute over all leaves (attrs: what, leaves)"),
+    "mpmd_step": (
+        "paddle_tpu/distributed/mpmd.py",
+        "One MPMD pipelined train step: stage runners start through grad "
+        "scatter (attrs: step, stages, microbatches, schedule, "
+        "transport, wire)"),
 }
 
 

@@ -103,18 +103,28 @@ class CompileCache:
 
     # -- keying -------------------------------------------------------------
     def key_for(self, lowered=None, *, config: Any = None, mesh=None,
-                schedule: Any = None, extra: Any = None) -> str:
-        """Fingerprint of (config, topology, schedule, versions, module).
+                schedule: Any = None, stage: Any = None,
+                extra: Any = None) -> str:
+        """Fingerprint of (config, topology, schedule, stage, versions,
+        module).
 
         ``lowered`` is a ``jax.stages.Lowered``; its StableHLO text is
         hashed into the key so distinct programs can never collide even
         when the explicit parts are under-specified.
+
+        ``stage`` scopes the entry to ONE pipeline stage of an MPMD
+        program set (stage id + that stage's layer slice and width). An
+        MPMD resize rebuilds only the resized stage's programs, so every
+        other stage's key — and its on-disk entry — survives untouched;
+        a shared key would evict S-1 perfectly good executables on every
+        width change.
         """
         parts: Dict[str, Any] = {
             "versions": _version_parts(),
             "topology": _topology_parts(mesh),
             "config": config,
             "schedule": schedule,
+            "stage": stage,
             "extra": extra,
         }
         if lowered is not None:

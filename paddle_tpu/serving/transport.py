@@ -28,6 +28,21 @@ a ``t`` tag:
                                              decode engine
     kv        {"t","rid","rec",...}          prefill->decode KV-page stream
                                              (``encode_kv``/``decode_kv``)
+    tq        {"t","ch","seq","x",...}       generic tensor-queue frame: one
+                                             tensor on named channel ``ch``
+                                             (MPMD inter-stage activations/
+                                             cotangents ride these)
+    tq_ack    {"t","ch","seq"}               receiver consumed everything on
+                                             ``ch`` up to and incl. ``seq``
+                                             (sender drops its replay copy)
+
+Seq namespaces are PER CHANNEL, not per connection. Dispatch records
+and tensor-queue frames interleave on one socket, each stream numbering
+its own frames from 0 — a shared per-connection counter would make the
+receiver's dedup cursor treat channel B's seq 0 as a stale duplicate of
+channel A's. ``SeqChannels`` keeps one send counter and one in-order
+dedup cursor per channel name; the worker's dispatch stream is channel
+``"dispatch"``, MPMD boundaries use ``act<i>``/``cot<i>``.
 
 Failure model: frames are best-effort; a lost ``dispatch`` is retransmitted
 by the router when the worker's acked_seq stalls (idempotent — workers skip
@@ -59,8 +74,10 @@ from ..testing import chaos
 from .protocol import deadline_guard, pack, unpack
 
 __all__ = [
-    "TransportServer", "TransportClient", "FrameDecoder",
+    "TransportServer", "TransportClient", "FrameDecoder", "SeqChannels",
     "encode_frame", "encode_kv", "decode_kv",
+    "encode_tensor", "decode_tensor",
+    "encode_tq_frame", "decode_tq_frame", "encode_tq_ack",
 ]
 
 _HDR = struct.Struct(">I")
@@ -394,3 +411,150 @@ def decode_kv(payload: dict) -> dict:
         out["k_scale"] = payload["k_scale"]
         out["v_scale"] = payload["v_scale"]
     return out
+
+
+# ---------------------------------------------------------------------------
+# Per-channel seq namespaces (shared by dispatch + tensor-queue streams)
+# ---------------------------------------------------------------------------
+
+class SeqChannels:
+    """Per-channel seq namespaces for one frame stream.
+
+    One instance serves both directions of a connection: ``next_seq(ch)``
+    numbers outgoing frames per channel, ``stash(ch, seq, item)`` dedups
+    incoming ones against a per-channel in-order cursor, and
+    ``pop_next(ch)`` consumes in seq order. Channels never see each
+    other's counters, so interleaved streams (dispatch records next to
+    tensor-queue frames) cannot false-dedup — the bug a single
+    per-connection namespace bakes in.
+    """
+
+    def __init__(self):
+        self._next_send: Dict[str, int] = {}
+        self._cursor: Dict[str, int] = {}
+        self._stash: Dict[str, Dict[int, object]] = {}
+
+    # -- sender side --------------------------------------------------------
+    def next_seq(self, channel: str) -> int:
+        n = self._next_send.get(channel, 0)
+        self._next_send[channel] = n + 1
+        return n
+
+    # -- receiver side ------------------------------------------------------
+    def cursor(self, channel: str) -> int:
+        """Next seq this side will consume on ``channel`` — doubles as the
+        ack watermark (everything below it has been consumed)."""
+        return self._cursor.get(channel, 0)
+
+    def seek(self, channel: str, seq: int):
+        """Fast-forward the consume cursor (checkpoint restore: replay
+        starts at the last acked microbatch, not at zero)."""
+        self._cursor[channel] = int(seq)
+        stash = self._stash.get(channel)
+        if stash:
+            for s in [s for s in stash if s < seq]:
+                del stash[s]
+
+    def stash(self, channel: str, seq: int, item) -> bool:
+        """Admit an incoming item; False = duplicate (retransmit of an
+        already-consumed or already-stashed seq on THIS channel)."""
+        seq = int(seq)
+        if seq < self.cursor(channel):
+            return False
+        stash = self._stash.setdefault(channel, {})
+        if seq in stash:
+            return False
+        stash[seq] = item
+        return True
+
+    def pop_next(self, channel: str):
+        """In-order consume: the item at the cursor, advancing it — or
+        None when the next seq has not arrived yet."""
+        stash = self._stash.get(channel)
+        if not stash:
+            return None
+        cur = self.cursor(channel)
+        if cur not in stash:
+            return None
+        self._cursor[channel] = cur + 1
+        return stash.pop(cur)
+
+    def advance(self, channel: str):
+        """Advance the cursor past an item consumed out-of-band (the
+        worker's store-mirror fallback delivers the same stream through
+        the store when a socket frame was lost)."""
+        self._cursor[channel] = self.cursor(channel) + 1
+
+    def pending(self, channel: str) -> int:
+        return len(self._stash.get(channel, ()))
+
+
+# ---------------------------------------------------------------------------
+# Generic tensor-queue frames (MPMD inter-stage activation/cotangent wire)
+# ---------------------------------------------------------------------------
+
+#: tensor wire formats: ``raw`` ships dtype bytes untouched (bit-equal),
+#: ``bf16`` halves f32 payloads, ``int8`` absmax-quantizes like the dp
+#: gradient wire. Resolution mirrors grad_comm/mp_comm wire grammar.
+TENSOR_WIRES = ("raw", "f32", "bf16", "int8")
+
+
+def encode_tensor(arr: np.ndarray, wire: str = "raw") -> dict:
+    """One tensor as a wire payload. ``raw``/``f32`` are bit-equal for
+    f32 inputs (the MPMD trajectory-equality contract rides on that);
+    ``bf16`` round-trips through jnp.bfloat16; ``int8`` carries one
+    absmax scale per trailing row (axis=-1), matching the gradient
+    wire's granularity."""
+    if wire not in TENSOR_WIRES:
+        raise ValueError(f"tensor wire must be one of {TENSOR_WIRES}, "
+                         f"got {wire!r}")
+    arr = np.asarray(arr)
+    if wire == "int8" and arr.dtype != np.int8:
+        from ..distributed.grad_comm import quantize_absmax
+
+        q, scale = quantize_absmax(arr, axis=-1)
+        return {"wire": "int8", "dtype": str(arr.dtype),
+                "x": np.asarray(q, np.int8),
+                "scale": np.asarray(scale, np.float32)}
+    if wire == "bf16" and arr.dtype == np.float32:
+        import jax.numpy as jnp
+
+        return {"wire": "bf16", "dtype": str(arr.dtype),
+                "x": np.asarray(jnp.asarray(arr, jnp.bfloat16))}
+    return {"wire": "raw", "dtype": str(arr.dtype), "x": arr}
+
+
+def decode_tensor(payload: dict) -> np.ndarray:
+    """Inverse of ``encode_tensor``: back to the source dtype."""
+    wire = payload["wire"]
+    if wire == "int8":
+        from ..distributed.grad_comm import dequantize_absmax
+
+        out = np.asarray(dequantize_absmax(payload["x"], payload["scale"]))
+        return out.astype(payload["dtype"])
+    if wire == "bf16":
+        return np.asarray(payload["x"]).astype(payload["dtype"])
+    return payload["x"]
+
+
+def encode_tq_frame(channel: str, seq: int, arr: np.ndarray,
+                    wire: str = "raw", meta: Optional[dict] = None) -> dict:
+    """Tensor-queue frame: channel-scoped seq + encoded tensor. ``meta``
+    carries small scheduling facts (microbatch index, step) the receiver
+    needs without decoding the payload."""
+    frame = {"t": "tq", "ch": channel, "seq": int(seq),
+             "x": encode_tensor(arr, wire)}
+    if meta:
+        frame["meta"] = meta
+    return frame
+
+
+def decode_tq_frame(frame: dict) -> Tuple[str, int, np.ndarray, dict]:
+    return (frame["ch"], int(frame["seq"]), decode_tensor(frame["x"]),
+            frame.get("meta") or {})
+
+
+def encode_tq_ack(channel: str, seq: int) -> dict:
+    """Cumulative ack: everything on ``channel`` up to and including
+    ``seq`` was consumed — the sender may drop its replay copies."""
+    return {"t": "tq_ack", "ch": channel, "seq": int(seq)}

@@ -56,7 +56,8 @@ from ..inference.engine import DecodeEngine, EngineConfig, SamplingParams
 from ..testing import chaos
 from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl, k_done,
                        k_engine, k_occ, k_req, k_count, pack, unpack)
-from .transport import TransportClient, TransportServer, decode_kv, encode_kv
+from .transport import (SeqChannels, TransportClient, TransportServer,
+                        decode_kv, encode_kv)
 
 __all__ = ["EngineWorker", "main"]
 
@@ -106,7 +107,9 @@ class EngineWorker:
         }
         with deadline_guard("register engine"):
             self._store.set(k_engine(namespace, self.index), pack(record))
-        self._next_seq = 0  # next request seq to consume (wire OR store)
+        #: per-channel seq state: the dispatch stream is one channel of a
+        #: shared connection (tensor-queue frames number independently)
+        self._rx_seq = SeqChannels()
         self._beat = 0
         self._local_rid: Dict[int, int] = {}  # engine rid -> router rid
         self._last_occ_pub = 0.0
@@ -114,8 +117,6 @@ class EngineWorker:
         self._last_drain = -float("inf")
         self._last_store_drain = -float("inf")
         self._done_count = 0  # lifetime results published (rides the beat)
-        #: dispatch records that arrived over the wire ahead of their turn
-        self._wire_stash: Dict[int, dict] = {}
         #: connection ids that sent a router hello (done/occ frames go here)
         self._router_cids: set = set()
         #: prefill role: dispatch records awaiting export + KV handoff
@@ -143,9 +144,7 @@ class EngineWorker:
                 # its hello frame was lost (chaos half_open)
                 self._router_cids.add(cid)
                 for rec in frame.get("reqs", ()):
-                    seq = int(rec["seq"])
-                    if seq >= self._next_seq and seq not in self._wire_stash:
-                        self._wire_stash[seq] = rec
+                    self._rx_seq.stash("dispatch", int(rec["seq"]), rec)
             elif t == "kv":
                 self._kv_imports.append(frame)
         live = set(self._server.conn_ids())
@@ -165,21 +164,22 @@ class EngineWorker:
         lost to a socket failure, and the ONLY path on the legacy store
         dataplane (no router connection)."""
         while True:
-            rec = self._wire_stash.pop(self._next_seq, None)
+            rec = self._rx_seq.pop_next("dispatch")
             src = "wire"
             if rec is None:
                 now = time.monotonic()
                 if (self._router_cids
                         and now - self._last_store_drain < _STORE_MIRROR_S):
                     return
-                key = k_req(self._ns, self.name, self._next_seq)
+                key = k_req(self._ns, self.name,
+                            self._rx_seq.cursor("dispatch"))
                 with deadline_guard("recv request"):
                     if not self._store.check(key):
                         self._last_store_drain = now
                         return
                     rec = unpack(self._store.get(key))
                 src = "store"
-            self._next_seq += 1
+                self._rx_seq.advance("dispatch")
             self._consume(rec, src)
 
     def _consume(self, rec: dict, src: str):
@@ -380,7 +380,7 @@ class EngineWorker:
         self._last_occ_pub = now
         occ = self.engine.occupancy()
         occ["beat"] = self._beat
-        occ["acked_seq"] = self._next_seq
+        occ["acked_seq"] = self._rx_seq.cursor("dispatch")
         occ["done_count"] = self._done_count
         occ["name"] = self.name
         occ["role"] = self.role

@@ -185,6 +185,41 @@ def reshard_fence(index: int, what: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# MPMD stage faults (called by distributed/mpmd.py before each stage op)
+# ---------------------------------------------------------------------------
+def mpmd_fence(stage: int, index: int) -> None:
+    """Fault point before an MPMD stage runner executes its next schedule
+    op. ``index`` counts that stage's (F/B, microbatch) ops within one
+    step, so PADDLE_CHAOS_MPMD_AT + PADDLE_CHAOS_MPMD_STAGE can target
+    "stage s, mid-tick" precisely: some microbatches forwarded, boundary
+    queues holding unacked activations — the window per-stage shard
+    restore + queue replay must cover.
+
+    kill    — SIGKILL at the matching op; recovery restores every stage
+              at ``latest_common_step`` and replays the step bit-equal.
+    latency — sleep PADDLE_CHAOS_MPMD_LATENCY_MS at the matching op,
+              exercising the boundary-queue deadline watchdog.
+    """
+    if not armed():
+        return
+    mode = _env("PADDLE_CHAOS_MPMD_MODE")
+    if mode is None:
+        return
+    if int(_env("PADDLE_CHAOS_MPMD_STAGE", "0")) != stage:
+        return
+    if int(_env("PADDLE_CHAOS_MPMD_AT", "0")) != index:
+        return
+    if mode == "kill":
+        _fault("mpmd_kill", stage=stage, index=index)
+        _sigkill(f"kill injected at mpmd stage {stage} op {index}")
+    elif mode == "latency":
+        ms = float(_env("PADDLE_CHAOS_MPMD_LATENCY_MS", "0"))
+        _fault("mpmd_latency", stage=stage, index=index, ms=ms)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
 # Serving-engine faults (called by serving/worker.py before each step)
 # ---------------------------------------------------------------------------
 def engine_fence(step: int) -> None:

@@ -87,6 +87,7 @@ OWNED_PREFIXES = {
                               "planner.py"),
     "compile_cache_": os.path.join("paddle_tpu", "runtime",
                                    "compile_cache.py"),
+    "mpmd_": os.path.join("paddle_tpu", "distributed", "mpmd.py"),
 }
 
 

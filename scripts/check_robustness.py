@@ -34,6 +34,15 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
      store path. Convention: sockets in the transport are named
      ``*sock*`` (``_sock``, ``conn_sock``, ``listen_sock``); nothing
      else may use those names.
+  6. unguarded MPMD boundary-queue ops — in ``paddle_tpu/distributed/
+     mpmd.py`` every inter-stage queue op (``<chan>.send/poll/recv`` on a
+     receiver whose name mentions "chan") must sit lexically inside a
+     ``with deadline_guard(...)`` block: a stage whose upstream died
+     mid-step would otherwise block on its activation queue forever —
+     the exact hang the per-stage failure unit exists to rule out.
+     Convention: boundary channel objects are named ``*chan*``
+     (``_chan``, ``up_chan``, ``server_chan``); nothing else may use
+     those names.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -78,6 +87,15 @@ GUARDED_SOCKET_FILES = [
 #: (create_connection matches via its `socket.` receiver)
 SOCKET_OPS = {"send", "sendall", "recv", "recv_into", "accept", "connect",
               "connect_ex", "bind", "listen", "create_connection"}
+
+#: files whose inter-stage boundary-queue ops must run under
+#: deadline_guard (rule 6)
+GUARDED_CHAN_FILES = [
+    os.path.join("paddle_tpu", "distributed", "mpmd.py"),
+]
+
+#: channel methods that block on (or feed) the inter-stage wire
+CHAN_OPS = {"send", "poll", "recv"}
 
 
 def _py_files(root):
@@ -256,6 +274,48 @@ def check_guarded_socket_ops(path: str):
                    "the streaming dataplane hang silently (rule 5)")
 
 
+def _receiver_mentions_chan(func: ast.Attribute) -> bool:
+    """True when the call receiver is (or dereferences) a name containing
+    "chan": ``self._chan.send``, ``up_chan.poll``, ``server_chan.send``."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return "chan" in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return "chan" in value.attr.lower()
+    return False
+
+
+def check_guarded_chan_ops(path: str):
+    """Yield (line, message) for MPMD boundary-queue ops not lexically
+    inside a ``with deadline_guard(...)`` (rule 6)."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    parent = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in CHAN_OPS
+                and _receiver_mentions_chan(func)):
+            continue
+        anc, guarded = node, False
+        while anc in parent:
+            anc = parent[anc]
+            if isinstance(anc, ast.With) and _is_deadline_guard_with(anc):
+                guarded = True
+                break
+        if not guarded:
+            yield (node.lineno,
+                   f"boundary-queue op .{func.attr}(...) outside any "
+                   "`with deadline_guard(...)` — a dead upstream stage "
+                   "makes this stage hang on its queue forever (rule 6, "
+                   "MPMD path)")
+
+
 def main(argv=None):
     root = (argv or sys.argv[1:] or [REPO])[0]
     violations = []
@@ -280,6 +340,12 @@ def main(argv=None):
         if not os.path.isfile(path):
             continue
         for line, msg in check_guarded_socket_ops(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for rel in GUARDED_CHAN_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        for line, msg in check_guarded_chan_ops(path):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

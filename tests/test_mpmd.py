@@ -1,0 +1,281 @@
+"""MPMD pipeline execution (distributed/mpmd.py, docs/PIPELINE.md §MPMD).
+
+The contract under test, stage by stage:
+
+* trajectory parity — per-stage compiled programs connected by async
+  boundary queues produce the SAME AdamW trajectory as the eager SPMD
+  reference (atol 1e-5), for gpipe and 1f1b, equal and unequal widths,
+  local and TCP transports (f32 wire bit-equal to in-process);
+* stage-local recompile — resizing one stage recompiles only that
+  stage: other stages' executables and compile-cache keys survive;
+* boundary reliability — unacked frames replay after a reconnect and
+  the receiver's per-channel dedup makes the replay idempotent;
+* per-stage checkpoint shards — save/restore round-trips params + opt
+  state and resumes mid-run bit-equal;
+* planner — per-stage width enumeration prices boundary bytes at the
+  resolved wire dtype and shifts devices onto the bottleneck stage of
+  an unbalanced stack.
+
+Compile-cache note (tests/conftest.py): deserialized CPU executables can
+SIGABRT on their second execution, so the cache assertions here check
+key stability and on-disk survival WITHOUT ever executing a deserialized
+program.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet, mpmd
+from paddle_tpu.distributed.auto_parallel import planner
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+    SpmdPipeline)
+from paddle_tpu.distributed.mpmd import MpmdPipeline
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def _init(pp=2):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8 // pp, "mp_degree": 1,
+                        "pp_degree": pp}
+    fleet.init(is_collective=True, strategy=s)
+
+
+def _blocks(n, d=16, seed=0):
+    paddle.seed(seed)
+    return [nn.Sequential(nn.Linear(d, d), nn.Tanh()) for _ in range(n)]
+
+
+def _build(n_layers=8, microbatches=4, sched="1f1b", seed=0):
+    """(pipe, head, opt, x) — the shared model both executors train."""
+    _init(2)
+    pipe = SpmdPipeline(_blocks(n_layers, seed=seed), num_stages=2,
+                        num_microbatches=microbatches,
+                        num_virtual_stages=1, schedule=sched)
+    paddle.seed(seed + 100)
+    head = nn.Linear(16, 1)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=pipe.parameters() + head.parameters())
+    x = np.random.RandomState(seed).randn(8, 16).astype("float32")
+    return pipe, head, opt, x
+
+
+def _ref_losses(sched, steps=3, n_layers=8, seed=0):
+    pipe, head, opt, x = _build(n_layers, sched=sched, seed=seed)
+    xt = paddle.to_tensor(x)
+    out = []
+    for _ in range(steps):
+        loss = (head(pipe(xt)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out.append(float(_np(loss)))
+    return out
+
+
+def _mpmd_losses(sched, widths, steps=3, n_layers=8, seed=0, **kw):
+    pipe, head, opt, x = _build(n_layers, sched=sched, seed=seed)
+    mp = MpmdPipeline(pipe, widths, head=head, schedule=sched, **kw)
+    out = []
+    for _ in range(steps):
+        out.append(mp.train_batch(x))
+        opt.step()
+        opt.clear_grad()
+    return out, mp
+
+
+# -- trajectory parity vs the SPMD eager reference --------------------------
+@pytest.mark.parametrize("sched", ["1f1b", "gpipe"])
+def test_mpmd_matches_spmd_trajectory(sched):
+    ref = _ref_losses(sched)
+    got, mp = _mpmd_losses(sched, [2, 2])
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=0)
+    # stage 0 compiled fwd+bwd, the last stage one fused loss_grad
+    assert mp.compile_counts() == {0: 2, 1: 1}
+
+
+def test_unequal_widths_match_reference():
+    ref = _ref_losses("1f1b")
+    got, mp = _mpmd_losses("1f1b", [3, 1])
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=0)
+    assert [st.dp for st in mp.stages] == [3, 1]
+
+
+def test_tcp_f32_wire_bit_equal_to_local():
+    local, _ = _mpmd_losses("1f1b", [2, 2], steps=2, transport="local",
+                            wire="raw")
+    tcp, _ = _mpmd_losses("1f1b", [2, 2], steps=2, transport="tcp",
+                          wire="f32")
+    assert local == tcp  # f32 tq frames are bit-exact on the wire
+
+
+def test_custom_layer_split_matches_reference():
+    ref = _ref_losses("1f1b", n_layers=6)
+    got, mp = _mpmd_losses("1f1b", [3, 1], n_layers=6, layer_split=[5, 1])
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=0)
+    assert [len(st.positions) for st in mp.stages] == [5, 1]
+
+
+def test_layer_split_validation():
+    pipe, head, _opt, _x = _build(n_layers=6)
+    for bad in ([6], [4, 1], [0, 6], [2, 2, 2]):
+        with pytest.raises(ValueError, match="layer_split"):
+            MpmdPipeline(pipe, [2, 2], head=head, layer_split=bad)
+
+
+# -- stage-local recompile ---------------------------------------------------
+def test_resize_recompiles_only_that_stage():
+    got, mp = _mpmd_losses("1f1b", [2, 2], steps=1)
+    before = mp.compile_counts()
+    assert before == {0: 2, 1: 1}
+    mp.resize_stage(1, 1)
+    mp.train_batch(np.random.RandomState(0).randn(8, 16).astype("float32"))
+    after = mp.compile_counts()
+    assert after[0] == before[0], "unresized stage 0 recompiled"
+    assert after[1] > before[1], "resized stage 1 kept a stale program"
+
+
+def test_resize_moves_cache_key_only_for_that_stage(tmp_path):
+    pipe, head, opt, x = _build()
+    mp = MpmdPipeline(pipe, [2, 2], head=head, cache_dir=str(tmp_path))
+    mp.train_batch(x)  # all compiles are cache MISSES: nothing deserialized
+    st0, st1 = mp.stages
+
+    def keys():
+        import jax
+
+        x_d = st0.put_batch(np.zeros((2, 16), np.float32))
+        p0, b0 = mp._stage_leaves(0)
+        k0 = st0.cache_key("fwd", st0._forward_only,
+                           (st0.put_leaves(p0), st0.put_leaves(b0), x_d))
+        y = jax.eval_shape(st0._forward_only, st0.put_leaves(p0),
+                           st0.put_leaves(b0), x_d)
+        p1, b1 = mp._stage_leaves(1)
+        x1 = st1.put_batch(np.zeros(y.shape, y.dtype))
+        k1 = st1.cache_key("fwd", st1._forward_only,
+                           (st1.put_leaves(p1), st1.put_leaves(b1), x1))
+        return k0, k1
+
+    a0, a1 = keys()
+    assert (a0, a1) == keys(), "cache keys are not deterministic"
+    assert a0 != a1, "two stages share one cache key"
+    n_entries = len(list(tmp_path.glob("*")))
+    assert n_entries >= 3  # fwd+bwd for stage 0, loss_grad for stage 1
+    mp.resize_stage(1, 1)
+    b0_key, b1_key = keys()
+    assert b0_key == a0, "stage 0's cache key moved on a stage-1 resize"
+    assert b1_key != a1, "stage 1's key ignores its width"
+    # stage 0's on-disk entries survive: nothing was evicted by the resize
+    assert len(list(tmp_path.glob("*"))) >= n_entries
+
+
+# -- boundary replay + dedup -------------------------------------------------
+def test_boundary_replay_is_idempotent():
+    up, down = mpmd.local_boundary(0, wire="f32")
+    a = [np.full((2, 3), i, np.float32) for i in range(3)]
+    up.send(a[0], mb=0)
+    up.send(a[1], mb=1)
+    for i in range(2):
+        arr, meta = down.recv(timeout=5)
+        assert meta["mb"] == i
+        np.testing.assert_array_equal(arr, a[i])
+    up._pump()  # drain the cumulative acks
+    assert up.unacked() == 0 and down.acked_watermark() == 2
+    # an unacked frame + a reconnect: the tail replays, exactly once
+    up.send(a[2], mb=2)
+    up._chan._rx.put({"t": "_reconnect"})
+    up._pump()
+    arr, meta = down.recv(timeout=5)
+    assert meta["mb"] == 2
+    np.testing.assert_array_equal(arr, a[2])
+    with pytest.raises(TimeoutError):
+        down.recv(timeout=0.2)  # the replayed duplicate was deduped
+
+
+def test_boundary_seek_fast_forwards_consumer():
+    up, down = mpmd.local_boundary(1, wire="f32")
+    for i in range(3):
+        up.send(np.full((1,), i, np.float32), mb=i)
+    down.seek(2)  # checkpoint restore: mbs 0-1 already consumed pre-kill
+    arr, meta = down.recv(timeout=5)
+    assert meta["mb"] == 2 and float(arr[0]) == 2.0
+
+
+# -- per-stage checkpoint shards ---------------------------------------------
+def test_stage_shards_resume_bit_equal(tmp_path):
+    steps_a, steps_b = 2, 2
+    pipe, head, opt, x = _build(seed=3)
+    mp = MpmdPipeline(pipe, [2, 2], head=head)
+    for _ in range(steps_a):
+        mp.train_batch(x)
+        opt.step()
+        opt.clear_grad()
+    mp.save_shards(str(tmp_path), opt)
+    cont = []
+    for _ in range(steps_b):
+        cont.append(mp.train_batch(x))
+        opt.step()
+        opt.clear_grad()
+
+    # a fresh process-equivalent: same seeds, restore, replay
+    pipe2, head2, opt2, x2 = _build(seed=3)
+    mp2 = MpmdPipeline(pipe2, [2, 2], head=head2)
+    assert mp2.restore_shards(str(tmp_path), opt2) == steps_a
+    resumed = []
+    for _ in range(steps_b):
+        resumed.append(mp2.train_batch(x2))
+        opt2.step()
+        opt2.clear_grad()
+    assert resumed == cont  # bit-equal: same floats, not just close
+
+
+# -- env/launch plumbing -----------------------------------------------------
+def test_parse_stage_widths_and_wire_env(monkeypatch):
+    assert mpmd.parse_stage_widths("3,1") == [3, 1]
+    assert mpmd.parse_stage_widths("") is None
+    monkeypatch.setenv(mpmd.ENV_STAGES, "2, 2")
+    assert mpmd.parse_stage_widths() == [2, 2]
+    monkeypatch.setenv(mpmd.ENV_WIRE, "nope")
+    with pytest.raises(ValueError, match="nope"):
+        mpmd.resolve_wire()
+
+
+def test_launch_cli_exports_stage_widths(monkeypatch):
+    from paddle_tpu.distributed.launch import build_parser
+
+    args = build_parser().parse_args(["--mpmd_stages", "3,1", "x.py"])
+    assert args.mpmd_stages == "3,1"
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--mpmd_stages"])  # value required
+
+
+# -- planner: per-stage width candidates -------------------------------------
+def test_planner_balanced_stack_prefers_equal_widths():
+    r = planner.plan_mpmd_stages(
+        planner.ModelConfig(layers=4, global_batch=16),
+        planner.Topology(n_devices=4), num_stages=2)
+    assert r.best.widths == [2, 2]
+    assert r.best_equal is not None and r.best_equal.widths == [2, 2]
+
+
+def test_planner_unbalanced_stack_prefers_unequal_widths():
+    r = planner.plan_mpmd_stages(
+        planner.ModelConfig(layers=4, global_batch=16),
+        planner.Topology(n_devices=4), num_stages=2,
+        layer_costs=[4.0, 4.0, 1.0, 1.0])
+    assert not r.best.equal_width
+    assert r.best.widths[0] > r.best.widths[1]
+    assert r.best.predicted_step_s < r.best_equal.predicted_step_s
+
+
+def test_planner_prices_boundary_at_wire_dtype():
+    mc = planner.ModelConfig(layers=4, global_batch=16)
+    topo = planner.Topology(n_devices=4)
+    f32 = planner.plan_mpmd_stages(mc, topo, num_stages=2, wire="f32")
+    i8 = planner.plan_mpmd_stages(mc, topo, num_stages=2, wire="int8")
+    assert i8.best.boundary_bytes * 4 == f32.best.boundary_bytes
+    with pytest.raises(ValueError, match="wire"):
+        planner.plan_mpmd_stages(mc, topo, num_stages=2, wire="f64")

@@ -340,3 +340,34 @@ def test_registered_literals_allowed(tmp_path):
             _obs.observe("store_op_seconds", dt, op="get")
             _obs.event("rank_stalled", rank=3)
     """)
+
+
+# -- mpmd_* ownership: distributed/mpmd.py is the single writer -------------
+_MPMD_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.inc("mpmd_tick_total", stage=0, kind="F")
+"""
+
+
+def test_mpmd_metric_from_owner_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_MPMD_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "mpmd.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_mpmd_metric_from_pipeline_parallel_rejected(tmp_path):
+    # the SPMD pipeline must not write the MPMD executor's series — a
+    # mixed-writer mpmd_* family would blur which executor a tick was
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_MPMD_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "fleet",
+                       "meta_parallel", "pipeline_parallel.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "mpmd_" in v[0][1]
+
+
+def test_mpmd_prefix_registered():
+    assert "mpmd_" in check_observability.OWNED_PREFIXES
+    assert check_observability.OWNED_PREFIXES["mpmd_"].endswith("mpmd.py")

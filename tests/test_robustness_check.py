@@ -217,3 +217,55 @@ def test_live_transport_module_is_guarded():
         assert os.path.isfile(target), rel
         assert not list(
             check_robustness.check_guarded_socket_ops(target)), rel
+
+
+# -- rule 6: MPMD boundary channel ops run under deadline_guard -------------
+def _chan_violations(tmp_path, src):
+    f = tmp_path / "mpmd_mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_guarded_chan_ops(str(f)))
+
+
+def test_unguarded_chan_send_rejected(tmp_path):
+    v = _chan_violations(tmp_path, """
+        def f(chan, frame):
+            chan.send(frame)
+    """)
+    assert len(v) == 1 and "deadline_guard" in v[0][1]
+
+
+def test_unguarded_attr_chan_poll_rejected(tmp_path):
+    # self._chan.<op> counts: the receiver dereferences a *chan* name
+    v = _chan_violations(tmp_path, """
+        class E:
+            def pump(self):
+                for fr in self._chan.poll():
+                    yield fr
+    """)
+    assert len(v) == 1
+
+
+def test_guarded_chan_op_allowed(tmp_path):
+    assert not _chan_violations(tmp_path, """
+        from paddle_tpu.serving.protocol import deadline_guard
+
+        def f(chan, frame):
+            with deadline_guard("boundary send"):
+                chan.send(frame)
+    """)
+
+
+def test_non_chan_receiver_ignored(tmp_path):
+    # a socket/queue that doesn't mention chan is rule 5's business
+    assert not _chan_violations(tmp_path, """
+        def f(pipe_end, frame):
+            pipe_end.send(frame)
+            return pipe_end.recv()
+    """)
+
+
+def test_live_mpmd_module_is_guarded():
+    for rel in check_robustness.GUARDED_CHAN_FILES:
+        target = os.path.join(REPO, rel)
+        assert os.path.isfile(target), rel
+        assert not list(check_robustness.check_guarded_chan_ops(target)), rel

@@ -208,3 +208,58 @@ def test_kv_wire_int8_tolerance_and_size():
     raw_bytes = len(encode_frame({"kv": encode_kv(k, v, "raw")}))
     int8_bytes = len(encode_frame({"kv": payload}))
     assert int8_bytes < raw_bytes / 3  # ~4x smaller minus scale slabs
+
+
+# -- per-channel seq namespaces (dispatch + tensor queues, one conn) --------
+def test_seq_channels_do_not_cross_dedup():
+    """The regression SeqChannels exists for: dispatch and tensor-queue
+    frames share one connection, and each channel numbers from 0 — a
+    shared cursor would drop channel B's seq 0 as a stale duplicate of
+    channel A's."""
+    from paddle_tpu.serving.transport import SeqChannels
+
+    ch = SeqChannels()
+    assert [ch.next_seq("dispatch") for _ in range(3)] == [0, 1, 2]
+    # a fresh channel starts at 0 again — independent send counter
+    assert ch.next_seq("act0") == 0
+    # consuming dispatch seq 0 must not poison act0's seq 0
+    assert ch.stash("dispatch", 0, "d0")
+    assert ch.pop_next("dispatch") == "d0"
+    assert ch.stash("act0", 0, "a0")
+    assert ch.pop_next("act0") == "a0"
+    # true duplicate on the SAME channel still dedups
+    assert not ch.stash("dispatch", 0, "d0-again")
+
+
+def test_seq_channels_reorder_and_seek():
+    from paddle_tpu.serving.transport import SeqChannels
+
+    ch = SeqChannels()
+    assert ch.stash("cot0", 1, "late")
+    assert ch.pop_next("cot0") is None        # 0 not here yet
+    assert ch.stash("cot0", 0, "early")
+    assert ch.pop_next("cot0") == "early"
+    assert ch.pop_next("cot0") == "late"      # in-order delivery
+    # replay: seek rewinds the cursor and drops stale stash entries
+    ch.stash("cot0", 5, "future")
+    ch.seek("cot0", 2)
+    assert ch.cursor("cot0") == 2
+    assert ch.pending("cot0") == 1            # seq 5 survives a seek to 2
+    ch.seek("cot0", 6)
+    assert ch.pending("cot0") == 0            # seq 5 < 6 is stale now
+
+
+def test_tq_frame_codec_roundtrip_f32_bit_equal():
+    from paddle_tpu.serving.transport import (decode_tq_frame,
+                                              encode_tq_ack,
+                                              encode_tq_frame)
+
+    arr = np.random.default_rng(0).standard_normal((3, 5)).astype(np.float32)
+    frame = encode_tq_frame("act1", 7, arr, "f32", meta={"mb": 2})
+    assert frame["t"] == "tq"
+    ch, seq, got, meta = decode_tq_frame(frame)
+    assert (ch, seq) == ("act1", 7)
+    assert meta["mb"] == 2
+    np.testing.assert_array_equal(got, arr)   # f32 wire is bit-equal
+    ack = encode_tq_ack("act1", 7)
+    assert ack["t"] == "tq_ack" and ack["ch"] == "act1" and ack["seq"] == 7
