@@ -759,6 +759,7 @@ class MpmdPipeline:
         loss = float(sum(np.asarray(losses[m]) for m in range(M)) / M)
         self.step_index += 1
         _obs.observe("mpmd_step_seconds", time.perf_counter() - t_step)
+        self.export_stage_stats()
         if self.shard_dir:
             self.save_shards(self.shard_dir)
         return loss
@@ -856,6 +857,19 @@ class MpmdPipeline:
             _obs.set_gauge("mpmd_stage_idle_fraction", idle, stage=s)
         except BaseException as exc:  # noqa: BLE001 — surfaced to driver
             errors.append(exc)
+
+    def export_stage_stats(self) -> Dict[str, Dict[str, float]]:
+        """Publish the last step's per-stage busy/idle stats to the live
+        telemetry plane (observability/live.py) so the fleet aggregator
+        can watch stage imbalance while the job runs. The gauges already
+        export the same numbers post-hoc; this is the streaming hook.
+        One env lookup when the live plane is off. Returns the exported
+        mapping (stage id -> stats) for callers that want it."""
+        stats = {str(s): rec for s, rec in self.last_step_stats.items()}
+        from ..observability import live as _live
+
+        _live.note_stage_stats(stats)
+        return stats
 
     # -- grads back onto the shared parameters ------------------------------
     def _scatter_grads(self, out_accs, head_acc) -> None:

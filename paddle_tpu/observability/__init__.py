@@ -70,6 +70,7 @@ __all__ = [
     "span", "start_span", "end_span", "record_span", "new_trace_id",
     "flush", "snapshot", "reset",
     "fleet_sync", "merge_snapshots",
+    "start_live_monitor", "stop_live_monitor",
 ]
 
 _registry = MetricsRegistry(catalog=catalog.METRICS)
@@ -260,4 +261,9 @@ def reset() -> None:
 # best-effort final export; a no-op when telemetry was never enabled
 atexit.register(flush)
 
-from .fleet import fleet_sync, merge_snapshots  # noqa: E402,F401
+from .fleet import (  # noqa: E402,F401
+    fleet_sync,
+    merge_snapshots,
+    start_live_monitor,
+    stop_live_monitor,
+)

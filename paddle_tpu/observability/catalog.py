@@ -178,6 +178,13 @@ METRICS = {
     "serving_router_request_seconds": (
         "histogram", "Router-side request latency: submit() through result "
                      "harvest (includes queueing, dispatch, decode)"),
+    "serving_router_engine_outstanding_tokens": (
+        "gauge", "Placement load signal per live engine: reported "
+                 "outstanding tokens + dispatched-but-unacked work "
+                 "(labels: engine)"),
+    "serving_router_admission_queue_length": (
+        "gauge", "Admitted-but-undispatched requests per SLO class queue "
+                 "(labels: slo)"),
     # -- streaming dataplane (serving/transport.py) --------------------------
     "serving_transport_frames_total": (
         "counter", "Frames moved over the streaming router<->worker "
@@ -264,6 +271,30 @@ METRICS = {
     "mpmd_step_seconds": (
         "histogram", "Wall time of one MPMD train_batch (all stages, all "
                      "microbatches, grads scattered)"),
+    # -- live telemetry plane (observability/live.py) ------------------------
+    # Single-writer families: live_* and slo_* may only be recorded from
+    # observability/live.py (static gate rule 5).
+    "live_ship_batches_total": (
+        "counter", "Telemetry payload batches collected by a LiveShipper "
+                   "for the tele frame (before redundancy re-sends)"),
+    "live_ship_spans_total": (
+        "counter", "Span records tailed from the local sink and shipped "
+                   "in tele payloads"),
+    "live_ingest_total": (
+        "counter", "Fresh tele payloads accepted by the LiveAggregator"),
+    "live_ingest_dup_total": (
+        "counter", "Tele payloads dropped as duplicates/stale by the "
+                   "(source, seq) dedup — redundant beat re-sends and "
+                   "retransmits collapsing as designed"),
+    "live_health_writes_total": (
+        "counter", "Atomic fleet_health.json writes by the aggregator"),
+    "live_window_requests": (
+        "gauge", "Completed requests inside the aggregator's sliding "
+                 "window (labels: slo)"),
+    "slo_burn_rate": (
+        "gauge", "Windowed error-budget burn rate vs the declared "
+                 "objective (labels: slo, objective=latency|availability; "
+                 "1.0 = budget consumed exactly as fast as it accrues)"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
@@ -301,6 +332,9 @@ EVENTS = {
     "mpmd_queue_replay",  # boundary queue replayed its unacked tail
     "mpmd_stage_resize",  # one MPMD stage changed width (old/new dp)
     "elastic_stage_resize",  # per-stage live resize moved a stage's leaves
+    "slo_burn",           # windowed burn rate crossed 1.0 (live plane)
+    "rank_straggler",     # step-time EWMA z-score flagged a rank (live plane)
+    "stage_imbalance",    # MPMD busy/idle spread crossed threshold (live)
 }
 
 

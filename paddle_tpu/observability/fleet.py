@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -76,16 +77,30 @@ def _scalar_views(metric_name: str, data: dict):
     return [(ls, s["mean"]) for ls, s in data.get("series", {}).items()]
 
 
+def _sample_counts(data: dict):
+    """(label_str, count) pairs for histogram series — the weights the
+    straggler merge needs so a nearly-idle rank cannot dilute the fleet
+    mean (see merge_snapshots)."""
+    if data.get("type") in ("counter", "gauge"):
+        return []
+    return [(ls, int(s.get("count", 0) or 0))
+            for ls, s in data.get("series", {}).items()]
+
+
 def merge_snapshots(snaps: Dict[int, dict], world_size: int) -> dict:
     """Merge per-rank snapshots (as returned by ``observability.snapshot``)
     into the fleet_metrics document. Pure function — no store, no files."""
     aggregate: dict = {}
+    counts: dict = {}  # name -> label_str -> rank -> histogram samples
     for r, snap in sorted(snaps.items()):
         for name, data in snap.get("metrics", {}).items():
             for label_str, value in _scalar_views(name, data):
                 slot = aggregate.setdefault(name, {}).setdefault(
                     label_str, {"per_rank": {}})
                 slot["per_rank"][str(r)] = value
+            for label_str, n in _sample_counts(data):
+                counts.setdefault(name, {}).setdefault(
+                    label_str, {})[str(r)] = n
     for name, by_label in aggregate.items():
         for label_str, slot in by_label.items():
             vals = slot["per_rank"]
@@ -104,14 +119,31 @@ def merge_snapshots(snaps: Dict[int, dict], world_size: int) -> dict:
     factor = straggler_threshold()
     for name in _STRAGGLER_METRICS:
         for label_str, slot in aggregate.get(name, {}).items():
-            mean = slot.get("mean")
-            if mean is None or mean <= 0 or len(slot["per_rank"]) < 2:
+            nums = {r: v for r, v in slot["per_rank"].items()
+                    if isinstance(v, (int, float))}
+            if len(nums) < 2:
                 continue
-            for r, v in slot["per_rank"].items():
+            # Weight each rank's mean by its SAMPLE COUNT: the unweighted
+            # mean-of-means let a nearly-idle rank (3 fast steps) drag the
+            # fleet mean down and flag healthy ranks — or dilute a real
+            # straggler below the threshold. The weighted mean is the
+            # true mean over all recorded steps.
+            weights = counts.get(name, {}).get(label_str, {})
+            wtotal = sum(weights.get(r, 0) for r in nums)
+            if wtotal > 0:
+                mean = sum(v * weights.get(r, 0)
+                           for r, v in nums.items()) / wtotal
+            else:
+                mean = sum(nums.values()) / len(nums)
+            if mean <= 0:
+                continue
+            slot["weighted_mean"] = mean
+            for r, v in nums.items():
                 if v > mean * factor:
                     stragglers.append({
                         "rank": int(r), "metric": name, "labels": label_str,
                         "mean_seconds": v, "fleet_mean_seconds": mean,
+                        "samples": weights.get(r, 0),
                         "slowdown": v / mean})
     stragglers.sort(key=lambda s: -s["slowdown"])
 
@@ -140,6 +172,18 @@ def _write_fleet_metrics(doc: dict) -> str:
     return path
 
 
+def _slo_objectives() -> Optional[dict]:
+    """The declared SLO objectives from serving/protocol.py, or None if
+    the serving package is unimportable in this context. Passed into the
+    post-hoc trace summary so its per-class burn rates use the same
+    table the live plane burns against."""
+    try:
+        from ..serving.protocol import SLO_OBJECTIVES
+        return SLO_OBJECTIVES
+    except Exception:
+        return None
+
+
 def _write_trace_summary() -> Optional[str]:
     """Merge this host's span files into ``fleet_trace_summary.json``
     (rank 0, alongside fleet_metrics.json). Skipped when no rank wrote
@@ -152,7 +196,7 @@ def _write_trace_summary() -> Optional[str]:
     if d is None:
         return None
     try:
-        doc = tracing.summarize_dir(d)
+        doc = tracing.summarize_dir(d, objectives=_slo_objectives())
         if doc is None:
             return None
         path = os.path.join(d, "fleet_trace_summary.json")
@@ -275,3 +319,54 @@ def fleet_sync_atexit() -> None:
     except Exception as e:  # exit path: diagnose, never mask the exit code
         print(f"[telemetry] exit-time fleet sync failed: {e!r}",
               file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# rank-0 live monitor (observability/live.py consumer for training fleets)
+# ---------------------------------------------------------------------------
+_live_monitor = None
+_live_stop = None
+
+
+def start_live_monitor(interval_s: float = 1.0, **agg_kwargs):
+    """Start the rank-0 live-telemetry loop: a daemon thread ticking a
+    ``LiveAggregator`` that tails every ``spans_rank*.jsonl`` in the
+    shared telemetry dir (single-host fleets write into one dir, so rank
+    0 sees the whole fleet without any extra wire) and periodically
+    writes ``fleet_health.json`` + burn/straggler/imbalance events.
+    Serving routers embed their own aggregator instead (serving/router
+    feeds it tele frames from remote workers).
+
+    Returns the aggregator, or None when the live plane is off or this
+    is not rank 0. Idempotent — a second call returns the running
+    monitor."""
+    global _live_monitor, _live_stop
+    from .live import LiveAggregator, live_enabled
+
+    if not live_enabled() or _env_int("PADDLE_TRAINER_ID", 0) != 0:
+        return None
+    if _live_monitor is not None:
+        return _live_monitor
+    agg = LiveAggregator(tail_local=True, **agg_kwargs)
+    stop = _live_stop = threading.Event()
+
+    def _loop():
+        while not stop.wait(interval_s):
+            agg.tick()
+        agg.tick()  # final flush so a clean stop commits the last window
+
+    t = threading.Thread(
+        target=_loop, name="paddle-tpu-live-monitor", daemon=True)
+    t.start()
+    _live_monitor = agg
+    return agg
+
+
+def stop_live_monitor() -> None:
+    """Stop the rank-0 live loop (leaves the last fleet_health.json in
+    place). Safe to call when no monitor is running."""
+    global _live_monitor, _live_stop
+    if _live_stop is not None:
+        _live_stop.set()
+    _live_monitor = None
+    _live_stop = None

@@ -68,6 +68,7 @@ from typing import Dict, List, Optional
 __all__ = [
     "span", "start_span", "end_span", "record_span", "new_trace_id",
     "load_spans", "summarize_spans", "summarize_dir", "validate_trees",
+    "SpanTailer", "compute_burn",
 ]
 
 _io_lock = threading.Lock()
@@ -319,6 +320,89 @@ def load_spans(directory: str) -> List[dict]:
     return out
 
 
+class SpanTailer:
+    """Incremental reader of ONE growing ``spans_rank*.jsonl`` file.
+
+    ``poll()`` returns the span records appended since the last poll
+    without re-reading consumed bytes: the cursor only ever advances past
+    COMPLETE lines (ending in a newline), so a torn tail — a writer
+    SIGKILLed mid-line, or simply a line still being appended — is left
+    in place and re-read on the next poll once its newline lands. The
+    same skip discipline as the batch ``load_spans`` path applies to
+    complete-but-unparseable or foreign lines. A file that shrinks or is
+    replaced (a test reset the directory) resets the cursor to zero
+    rather than erroring. Stdlib-only, shared by the live-telemetry
+    shipper (observability/live.py) and ``scripts/trace_report.py
+    --follow``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+
+    def poll(self) -> List[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.offset:  # truncated/replaced: start over
+            self.offset = 0
+        if size == self.offset:
+            return []
+        out: List[dict] = []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                chunk = f.read(size - self.offset)
+        except OSError:
+            return []
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []  # only a torn tail so far; keep the cursor put
+        consumed = chunk[:end + 1]
+        self.offset += len(consumed)
+        for raw in consumed.split(b"\n"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw.decode("utf-8", "replace"))
+            except ValueError:
+                continue  # unparseable complete line: skip like load_spans
+            if isinstance(rec, dict) and rec.get("kind") == "span":
+                out.append(rec)
+        return out
+
+
+def compute_burn(total: int, over_target: int, bad: int,
+                 admitted: int, objective: dict) -> dict:
+    """Error-budget burn rates for one SLO class against one declared
+    objective record (``serving/protocol.SLO_OBJECTIVES`` shape). Used
+    verbatim by BOTH the post-hoc trace summary and the live aggregator
+    (observability/live.py), so the two planes are definitionally
+    comparable:
+
+    * latency burn = fraction of completed requests over
+      ``latency_target_s``, divided by the latency error budget
+      ``1 - latency_slo``;
+    * availability burn = fraction of admitted requests that did not
+      complete (shed or failed), divided by ``1 - availability_slo``.
+
+    1.0 = burning budget exactly as fast as it accrues; > 1.0 sustained
+    = eventual SLO violation."""
+    lat_budget = max(1.0 - float(objective.get("latency_slo", 0.95)), 1e-9)
+    avail_budget = max(1.0 - float(objective.get("availability_slo", 0.999)),
+                       1e-9)
+    frac_over = (over_target / total) if total else 0.0
+    frac_bad = (bad / admitted) if admitted else 0.0
+    return {
+        "latency_target_s": float(objective.get("latency_target_s", 0.0)),
+        "frac_over_target": round(frac_over, 6),
+        "burn_rate_latency": round(frac_over / lat_budget, 6),
+        "frac_unavailable": round(frac_bad, 6),
+        "burn_rate_availability": round(frac_bad / avail_budget, 6),
+    }
+
+
 def validate_trees(spans: List[dict]) -> List[str]:
     """Structural problems across the merged span set: a trace with no
     (or more than one) root, or a parent_id that resolves to no span in
@@ -351,12 +435,18 @@ def _pct(values: List[float], q: float) -> float:
     return vs[idx]
 
 
-def summarize_spans(spans: List[dict]) -> dict:
+def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
+                    ) -> dict:
     """Per-SLO-class latency attribution over the serving trees: for each
     ``srv_request`` root, child spans are bucketed into the phases of
     ``PHASE_OF`` and expressed as shares of the root duration
     (``other`` absorbs the untracked remainder, so every request's
-    shares sum to exactly 1.0). Pure function over loaded records."""
+    shares sum to exactly 1.0). Pure function over loaded records.
+
+    ``objectives`` (the ``serving/protocol.SLO_OBJECTIVES`` table, passed
+    by callers that can reach it — this module stays standalone) adds an
+    exact post-hoc ``objectives`` block per class via ``compute_burn``,
+    the reconciliation target for the live plane's windowed burn rates."""
     by_trace: Dict[str, List[dict]] = {}
     for s in spans:
         by_trace.setdefault(s.get("trace_id", "?"), []).append(s)
@@ -373,7 +463,7 @@ def summarize_spans(spans: List[dict]) -> dict:
         attrs = root.get("attrs") or {}
         slo = str(attrs.get("slo", "unknown"))
         cls = per_class.setdefault(slo, {
-            "requests": 0, "resubmitted": 0, "shed": 0,
+            "requests": 0, "resubmitted": 0, "shed": 0, "failed": 0,
             "latency": [], "shares": {p: [] for p in PHASES}})
         status = attrs.get("status")
         if status == "shed":
@@ -382,6 +472,8 @@ def summarize_spans(spans: List[dict]) -> dict:
         if status not in ("done", "failed"):
             unfinished += 1
             continue
+        if status == "failed":
+            cls["failed"] += 1
         dur = float(root.get("dur_s", 0.0))
         if dur <= 0.0:
             continue
@@ -422,6 +514,15 @@ def summarize_spans(spans: List[dict]) -> dict:
                 for p, v in cls["shares"].items()
             },
         }
+        obj = (objectives or {}).get(slo)
+        if obj:
+            lat = cls["latency"]
+            target = float(obj.get("latency_target_s", 0.0))
+            over = sum(1 for v in lat if v > target)
+            admitted = cls["requests"] + cls["shed"]
+            bad = cls["shed"] + cls["failed"]
+            classes[slo]["objectives"] = compute_burn(
+                len(lat), over, bad, admitted, obj)
     return {
         "schema": 1,
         "ts": round(time.time(), 6),
@@ -433,7 +534,8 @@ def summarize_spans(spans: List[dict]) -> dict:
     }
 
 
-def summarize_dir(directory: Optional[str]) -> Optional[dict]:
+def summarize_dir(directory: Optional[str],
+                  objectives: Optional[dict] = None) -> Optional[dict]:
     """``summarize_spans`` over a telemetry dir; None when the dir holds
     no span files (so fleet aggregation skips the write entirely)."""
     if not directory:
@@ -441,4 +543,4 @@ def summarize_dir(directory: Optional[str]) -> Optional[dict]:
     spans = load_spans(directory)
     if not spans:
         return None
-    return summarize_spans(spans)
+    return summarize_spans(spans, objectives=objectives)

@@ -71,6 +71,33 @@ SLO_CLASSES = ("batch", "standard", "interactive")
 #: still queued past its deadline is shed, not dispatched late.
 DEFAULT_DEADLINES = {"interactive": 30.0, "standard": 120.0, "batch": 600.0}
 
+#: Declared per-class service objectives — the targets the live telemetry
+#: plane (observability/live.py) burns error budget against. Two
+#: objectives per class:
+#:
+#:   * latency: ``latency_slo`` of requests must finish within
+#:     ``latency_target_s`` (e.g. interactive: 95% under 2s). The error
+#:     budget is ``1 - latency_slo``; the burn rate is the observed
+#:     over-target fraction divided by that budget — 1.0 means the budget
+#:     is being consumed exactly as fast as it accrues, >1.0 means an
+#:     eventual SLO violation if sustained.
+#:   * availability: ``availability_slo`` of admitted requests must
+#:     complete (not shed, not failed). Same burn-rate convention.
+#:
+#: Tuned for the proxy fleet the benches drive (tiny models, CPU XLA);
+#: a real deployment would override these per product surface. Every
+#: class in SLO_CLASSES has an entry — observability/live.py and the
+#: post-hoc trace summary both key off this table, so the live and
+#: batch burn rates are definitionally comparable.
+SLO_OBJECTIVES = {
+    "interactive": {"latency_target_s": 2.0, "latency_slo": 0.95,
+                    "availability_slo": 0.999},
+    "standard": {"latency_target_s": 10.0, "latency_slo": 0.95,
+                 "availability_slo": 0.995},
+    "batch": {"latency_target_s": 60.0, "latency_slo": 0.90,
+              "availability_slo": 0.99},
+}
+
 
 def k_count(ns: str) -> str:
     return f"{ns}/count"

@@ -67,6 +67,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import live as _live
 from ..inference.engine import PrefixRegistry, SamplingParams
 from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
                        deadline_guard, k_ctl, k_done, k_engine, k_occ,
@@ -214,6 +215,10 @@ class Router:
                          "dispatched": 0, "failover_resubmits": 0,
                          "affinity_hits": 0, "engines_lost": 0,
                          "retransmits": 0, "disagg_dispatches": 0}
+        #: live-telemetry aggregator (observability/live.py), created
+        #: lazily on the first pump with the plane enabled; stays None —
+        #: one env dict lookup per pump — when it is off
+        self._live_agg: Optional[_live.LiveAggregator] = None
 
     @property
     def _streaming(self) -> bool:
@@ -380,6 +385,13 @@ class Router:
                     # their decode engine; the decode side owns them now
                     for rid in frame.get("rids", ()):
                         est.inflight.pop(rid, None)
+                elif t == "tele":
+                    # live-telemetry batch riding the heartbeat; ingest
+                    # dedups (src, seq) so the redundant re-sends and any
+                    # locally tailed copies of the same spans collapse
+                    if self._live_agg is not None:
+                        for pay in frame.get("pays", ()):
+                            self._live_agg.ingest(pay)
 
     def _read_occupancy(self):
         now = time.monotonic()
@@ -748,6 +760,37 @@ class Router:
 
     # -- driving -------------------------------------------------------------
 
+    def _export_load_gauges(self):
+        """Per-engine outstanding-token and per-class admission-queue
+        gauges — the placement signals, exported so the live plane (and
+        any scraper) sees the same numbers the dispatcher acts on."""
+        if not _obs.enabled():
+            return
+        for est in self._engines.values():
+            if est.alive:
+                _obs.set_gauge("serving_router_engine_outstanding_tokens",
+                               self._load_tokens(est), engine=est.name)
+        for cls, queue in self._queues.items():
+            _obs.set_gauge("serving_router_admission_queue_length",
+                           len(queue), slo=cls)
+
+    def _live_tick(self):
+        """Drive the live aggregator (lazily created so tests can flip
+        the env per-case): hand it the queue depths, then let it poll
+        local tails and write ``fleet_health.json`` at its own cadence.
+        One env dict lookup per pump when the plane is off."""
+        if self._live_agg is None:
+            if not _live.live_enabled():
+                return
+            self._live_agg = _live.LiveAggregator()
+        self._live_agg.note_queues({
+            "admission": {c: len(q) for c, q in self._queues.items()},
+            "engine_outstanding_tokens": {
+                e.name: self._load_tokens(e)
+                for e in self._engines.values() if e.alive},
+        })
+        self._live_agg.tick()
+
     def pump(self):
         """One scheduling round: discover new engines, drain the wire,
         refresh the store occupancy mirror, fail over dead workers,
@@ -761,6 +804,8 @@ class Router:
         self._harvest_done()
         self._dispatch()
         _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
+        self._export_load_gauges()
+        self._live_tick()
 
     def pending(self) -> int:
         """Requests admitted but not yet finished (queued + in flight)."""

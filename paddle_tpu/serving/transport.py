@@ -35,6 +35,15 @@ a ``t`` tag:
     tq_ack    {"t","ch","seq"}               receiver consumed everything on
                                              ``ch`` up to and incl. ``seq``
                                              (sender drops its replay copy)
+    tele      {"t","pays":[...]}             live-telemetry batches riding the
+                                             occupancy beat: each payload is
+                                             (src, seq)-numbered and re-sent
+                                             for a few beats, so the router's
+                                             aggregator dedups duplicates and
+                                             a chaos-dropped frame is healed
+                                             by the next beat (advisory plane:
+                                             loss never blocks the request
+                                             path — see observability/live.py)
 
 Seq namespaces are PER CHANNEL, not per connection. Dispatch records
 and tensor-queue frames interleave on one socket, each stream numbering

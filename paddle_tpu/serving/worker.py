@@ -52,6 +52,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import live as _live
 from ..inference.engine import DecodeEngine, EngineConfig, SamplingParams
 from ..testing import chaos
 from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl, k_done,
@@ -125,6 +126,9 @@ class EngineWorker:
         self._kv_imports: deque = deque()
         #: prefill role: persistent links to decode workers, by address
         self._kv_links: Dict[str, TransportClient] = {}
+        #: live-telemetry shipper, created lazily on the first beat with
+        #: the plane enabled (one env lookup per beat when it is off)
+        self._live_shipper: Optional[_live.LiveShipper] = None
         self.publish_occupancy()
 
     # -- transport I/O ------------------------------------------------------
@@ -386,6 +390,16 @@ class EngineWorker:
         occ["role"] = self.role
         occ["prefill_queue"] = len(self._prefill_jobs)
         self._send_routers({"t": "occ", "occ": occ, "ts": time.time()})
+        # live-telemetry piggyback: the tele batch rides the SAME links at
+        # the SAME cadence — no extra socket, no extra thread. Only collect
+        # once a router is attached, so the span tail is not consumed
+        # before anyone can receive it (the ring only re-sends ~3 beats).
+        if self._router_cids and _live.live_enabled():
+            if self._live_shipper is None:
+                self._live_shipper = _live.LiveShipper(self.name)
+            pays = self._live_shipper.collect()
+            if pays:
+                self._send_routers({"t": "tele", "pays": pays})
         if (force_store or not self._router_cids
                 or now - self._last_occ_store >= _STORE_MIRROR_S):
             self._last_occ_store = now

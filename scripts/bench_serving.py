@@ -707,6 +707,203 @@ def _traced_router_phase(args, store, master, dataplane, ns):
     }
 
 
+def _live_phase(args, store, master, ns, tdir, live_on):
+    """One traced 2-worker routed phase for the live-plane A/B. Both
+    sides trace spans to ``tdir`` (the baseline is the traced bench, so
+    the delta prices ONLY the live plane, not tracing itself); the
+    live_on side additionally ships tele frames and aggregates
+    ``fleet_health.json`` on the router. Returns (best wall seconds,
+    new tokens, outputs, health doc or None, root count)."""
+    import numpy as np
+
+    from paddle_tpu.serving import Router
+
+    extra = {"PADDLE_TPU_TELEMETRY_DIR": tdir}
+    if live_on:
+        extra["PADDLE_TPU_LIVE_TELEMETRY"] = "1"
+    procs = [_spawn_router_worker(
+        args, master, ns,
+        extra_env=dict(extra, PADDLE_TRAINER_ID=str(i + 1)))
+        for i in range(2)]
+    os.environ.update(extra)  # router = rank 0
+    health = None
+    try:
+        router = Router(store, namespace=ns, queue_limit=256,
+                        dataplane=args.dataplane,
+                        engine_grace_s=120.0, page_size=args.page_size,
+                        seed=args.seed, affinity_slack_tokens=128,
+                        max_inflight_per_engine=64,
+                        deadlines={"interactive": 600.0,
+                                   "standard": 600.0, "batch": 600.0})
+        if live_on:
+            from paddle_tpu.observability import live
+            # wide window so slow boxes can't age the first trial's
+            # roots out before the reconcile read; tight health cadence
+            # so the post-drain pump converges quickly
+            router._live_agg = live.LiveAggregator(window_s=600.0,
+                                                   health_interval_s=0.5)
+        deadline = time.monotonic() + 300.0
+        while router._known_engines < 2:
+            if time.monotonic() > deadline:
+                raise RuntimeError("router bench: live-plane workers "
+                                   "never registered")
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError("router bench: live-plane worker "
+                                       f"died rc={p.returncode}")
+            router.pump()
+            time.sleep(0.05)
+        rng = np.random.default_rng(args.seed + 4)
+        sub = _router_traffic(args, rng)[::3]
+        for prompt, slo, new in sub:  # warmup: store path + any residual
+            router.submit(prompt, slo=slo, max_new_tokens=new)
+        if not router.drain(timeout=600.0, poll=0.02):
+            raise RuntimeError("router bench: live-plane warmup "
+                               f"undrained {router.stats()}")
+        trials = []
+        all_rids = []
+        for _trial in range(2):
+            t0 = time.perf_counter()
+            rids = [router.submit(p, slo=slo, max_new_tokens=new)
+                    for p, slo, new in sub]
+            if not router.drain(timeout=600.0, poll=0.02):
+                raise RuntimeError("router bench: live-plane phase "
+                                   f"undrained {router.stats()}")
+            trials.append((time.perf_counter() - t0, rids))
+            all_rids.extend(rids)
+        wall, rids = min(trials, key=lambda t: t[0])
+        new_tokens = sum(len(router.result(r)) - len(p)
+                         for r, (p, _s, _n) in zip(rids, sub))
+        outputs = [np.asarray(router.result(r)) for r in all_rids]
+        roots = 3 * len(sub)  # warmup round + two timed trials
+        if live_on:
+            # keep pumping until every root's tele frame has landed in
+            # the aggregate and a health doc covering them is on disk
+            hp = os.path.join(tdir, "fleet_health.json")
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline:
+                router.pump()
+                time.sleep(0.02)
+                if not os.path.exists(hp):
+                    continue
+                with open(hp) as f:
+                    health = json.load(f)
+                total = sum(c["requests"]
+                            for c in health.get("classes", {}).values())
+                if total >= roots:
+                    break
+            else:
+                raise RuntimeError(
+                    "router bench: fleet_health.json never converged "
+                    f"({health and health.get('classes')})")
+        router.shutdown()
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for k in extra:
+            os.environ.pop(k, None)
+    return wall, int(new_tokens), outputs, health, roots
+
+
+def run_live_plane(args):
+    """Live-telemetry-plane A/B: the SAME traced 2-worker workload with
+    the live plane off and on. Gates that the plane is (a) free at the
+    request path — tokens/s within ``--max-live-overhead`` of live-off
+    and greedy outputs BIT-EQUAL — and (b) honest: the streamed
+    ``fleet_health.json`` burn rates reconcile with the post-hoc span
+    summary to within 5%."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving.protocol import SLO_OBJECTIVES
+    from paddle_tpu.runtime import TCPStore
+
+    port = _free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=60.0)
+    master = f"127.0.0.1:{port}"
+    try:
+        print("router: live-plane A/B, live OFF (traced baseline)...",
+              file=sys.stderr)
+        off_dir = tempfile.mkdtemp(prefix="bench_live_off_")
+        off_wall, off_tokens, off_out, _h, _r = _live_phase(
+            args, store, master, "__benchl0", off_dir, live_on=False)
+        print("router: live-plane A/B, live ON...", file=sys.stderr)
+        on_dir = tempfile.mkdtemp(prefix="bench_live_on_")
+        on_wall, on_tokens, on_out, health, roots = _live_phase(
+            args, store, master, "__benchl1", on_dir, live_on=True)
+    finally:
+        store.close()
+    for a, b in zip(off_out, on_out):
+        np.testing.assert_array_equal(
+            a, b, err_msg="token streams changed with the live "
+                          "telemetry plane enabled")
+    spans = tracing.load_spans(on_dir)
+    posthoc = tracing.summarize_spans(spans,
+                                      objectives=dict(SLO_OBJECTIVES))
+    reconcile = {}
+    worst = 0.0
+    for cls, ent in sorted(health["classes"].items()):
+        post = posthoc["classes"][cls]
+        row = {"requests_live": ent["requests"],
+               "requests_posthoc": post["requests"]}
+        for key in ("frac_over_target", "burn_rate_latency",
+                    "frac_unavailable", "burn_rate_availability"):
+            lv = ent["objectives"][key]
+            pv = post["objectives"][key]
+            if max(abs(lv), abs(pv)) > 1e-9:
+                worst = max(worst, abs(lv - pv) / max(abs(pv), 1e-9))
+            row[key] = {"live": lv, "posthoc": pv}
+        lp = ent["latency_seconds"]["p95"]
+        pp = post["latency_seconds"]["p95"]
+        row["latency_p95_seconds"] = {"live": lp, "posthoc": pp}
+        reconcile[cls] = row
+    requests_match = all(
+        r["requests_live"] == r["requests_posthoc"]
+        for r in reconcile.values())
+    off_tps = off_tokens / off_wall
+    on_tps = on_tokens / on_wall
+    return {
+        "workers": 2,
+        "requests_per_phase": roots,
+        "live_off": {"seconds": round(off_wall, 4),
+                     "new_tokens": off_tokens,
+                     "tokens_per_second": round(off_tps, 2)},
+        "live_on": {"seconds": round(on_wall, 4),
+                    "new_tokens": on_tokens,
+                    "tokens_per_second": round(on_tps, 2),
+                    "spans": len(spans),
+                    "health_sources": len(health.get("sources", {}))},
+        "overhead_frac": round(1.0 - on_tps / off_tps, 4),
+        "greedy_bit_equal": True,
+        "burn_reconcile": reconcile,
+        "burn_reconcile_requests_match": requests_match,
+        "burn_reconcile_worst_rel_diff": round(worst, 4),
+    }
+
+
+def _gate_live_plane(args, block):
+    rc = 0
+    if (args.max_live_overhead
+            and block["overhead_frac"] > args.max_live_overhead):
+        print(f"FAIL: live-plane overhead {block['overhead_frac']:.4f} "
+              f"> max {args.max_live_overhead} of live-off tokens/s",
+              file=sys.stderr)
+        rc = 1
+    if not block["burn_reconcile_requests_match"]:
+        print("FAIL: live health request counts diverged from the "
+              "post-hoc trace summary", file=sys.stderr)
+        rc = 1
+    if block["burn_reconcile_worst_rel_diff"] > 0.05:
+        print(f"FAIL: live burn rates off by "
+              f"{block['burn_reconcile_worst_rel_diff']:.4f} rel from "
+              "the post-hoc summary (max 0.05)", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def run_disagg(args, store, master):
     """Disaggregated prefill/decode sub-scenario: the SAME long-prompt-
     heavy workload through 1 unified worker and through 1 prefill + 1
@@ -864,6 +1061,17 @@ def main(argv=None):
                          "BENCH_SERVING.json")
     ap.add_argument("--skip-cold-start", action="store_true",
                     help="skip the cold-start scenario in the full run")
+    ap.add_argument("--live-plane-only", action="store_true",
+                    help="run only the live-telemetry-plane A/B (traced "
+                         "2-worker workload, live off vs on) and merge "
+                         "the live_plane block into the existing "
+                         "BENCH_SERVING.json")
+    ap.add_argument("--skip-live-plane", action="store_true",
+                    help="skip the live-plane scenario in the full run")
+    ap.add_argument("--max-live-overhead", type=float, default=0.02,
+                    help="fail if enabling the live telemetry plane "
+                         "costs more than this fraction of live-off "
+                         "tokens/s (0 disables)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_SERVING.json"))
@@ -887,6 +1095,18 @@ def main(argv=None):
             f.write("\n")
         print(json.dumps({"logit_wire": block}, indent=2))
         return 0
+    if args.live_plane_only:
+        block = run_live_plane(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["live_plane"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"live_plane": block}, indent=2))
+        return _gate_live_plane(args, block)
     if args.cold_start_only:
         block = run_cold_start(args)
         report = {}
@@ -998,6 +1218,8 @@ def main(argv=None):
         report["cold_start"] = run_cold_start(args)
     if not args.skip_router:
         report["router"] = run_router(args)
+    if not args.skip_live_plane:
+        report["live_plane"] = run_live_plane(args)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
@@ -1009,6 +1231,8 @@ def main(argv=None):
     rc = _gate_churn(args, report["churn"])
     if not args.skip_router:
         rc = rc or _gate_router(args, report["router"])
+    if not args.skip_live_plane:
+        rc = rc or _gate_live_plane(args, report["live_plane"])
     return rc
 
 

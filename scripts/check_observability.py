@@ -22,7 +22,15 @@ classes of telemetry rot:
      STRING-LITERAL name declared in ``catalog.SPANS``, and may only be
      recorded from that name's declared owning file: a merged trace
      where two subsystems emit the same span name is unreadable, so
-     span families are single-writer by construction.
+     span families are single-writer by construction;
+  4. (rule 5, live plane) undeclared SLO class names — any ``slo=``
+     keyword whose value is a string literal must name a class declared
+     in ``serving/protocol.SLO_CLASSES`` (loaded from its file path,
+     like the catalog): the live burn-rate plane keys its windows and
+     objectives by class name, so a typo'd class would silently fork a
+     series that no objective ever covers. The ``live_*`` and ``slo_*``
+     metric families are single-writer, owned by
+     ``paddle_tpu/observability/live.py``.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — the catalog is loaded
@@ -88,6 +96,8 @@ OWNED_PREFIXES = {
     "compile_cache_": os.path.join("paddle_tpu", "runtime",
                                    "compile_cache.py"),
     "mpmd_": os.path.join("paddle_tpu", "distributed", "mpmd.py"),
+    "live_": os.path.join("paddle_tpu", "observability", "live.py"),
+    "slo_": os.path.join("paddle_tpu", "observability", "live.py"),
 }
 
 
@@ -113,6 +123,19 @@ def _load_catalog(root):
     return mod
 
 
+def _load_slo_classes(root):
+    """Declared SLO class names from serving/protocol.py, loaded from its
+    file path (protocol.py is stdlib-only by contract)."""
+    path = os.path.join(root, "paddle_tpu", "serving", "protocol.py")
+    spec = importlib.util.spec_from_file_location("_srv_protocol", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return frozenset(mod.SLO_CLASSES)
+
+
+SLO_CLASSES = _load_slo_classes(REPO)
+
+
 def _py_files(root):
     for d in SCAN_DIRS:
         base = os.path.join(root, d)
@@ -124,10 +147,13 @@ def _py_files(root):
                     yield os.path.join(dirpath, fn)
 
 
-def check_file(path: str, catalog, rel: str = None):
+def check_file(path: str, catalog, rel: str = None, slo_classes=None):
     """Yield (line, message) violations for one file. `catalog` is the
     loaded catalog module (METRICS dict + EVENTS set); `rel` is the
-    repo-relative path (ownership rule)."""
+    repo-relative path (ownership rule); `slo_classes` overrides the
+    declared SLO class names (rule 5)."""
+    if slo_classes is None:
+        slo_classes = SLO_CLASSES
     with open(path, "rb") as f:
         src = f.read()
     tree = ast.parse(src, filename=path)
@@ -135,6 +161,19 @@ def check_file(path: str, catalog, rel: str = None):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
+        # rule 5: a literal slo= keyword anywhere in the scanned layers
+        # must name a declared SLO class — the live plane keys windows
+        # and objectives by class name, so a typo forks an uncovered
+        # series instead of erroring
+        for kw in node.keywords:
+            if (kw.arg == "slo" and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                    and kw.value.value not in slo_classes):
+                yield (node.lineno,
+                       f"SLO class {kw.value.value!r} is not declared in "
+                       "serving/protocol.py SLO_CLASSES — burn-rate "
+                       "objectives and live windows are keyed by declared "
+                       "class names only")
         # rule 1: bare print to stdout
         if isinstance(func, ast.Name) and func.id == "print":
             if rel in PRINT_EXEMPT:

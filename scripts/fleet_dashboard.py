@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Terminal / one-shot-HTML view of the live fleet-health signal.
+
+Renders the ``fleet_health.json`` document the live telemetry plane's
+aggregator (``paddle_tpu/observability/live.py``) writes under the
+telemetry dir: windowed per-SLO-class latency quantiles and error-budget
+burn rates, per-rank step-time straggler z-scores, MPMD stage busy/idle
+imbalance, router queue depths, transport reconnect storms, and the
+compile-cache hit rate — the same numbers an autoscaler would key on,
+made human-readable.
+
+Stdlib-only by construction (no paddle_tpu / jax import): the document
+is plain JSON, so this runs anywhere the telemetry dir is mounted.
+
+Usage::
+
+    python scripts/fleet_dashboard.py TELEMETRY_DIR            # one shot
+    python scripts/fleet_dashboard.py TELEMETRY_DIR --watch    # live loop
+    python scripts/fleet_dashboard.py TELEMETRY_DIR --html out.html
+    python scripts/fleet_dashboard.py --selftest
+
+Burn-rate reading: 1.0 means the error budget is being consumed exactly
+as fast as it accrues; sustained > 1.0 means the SLO will be violated
+over the window — the dashboard marks those rows ``BURN``.
+"""
+from __future__ import annotations
+
+import argparse
+import html as _html
+import json
+import os
+import sys
+import tempfile
+import time
+
+#: burn-rate threshold at which a class row gets flagged in the render
+#: (matches the aggregator's slo_burn event threshold)
+BURN_FLAG = 1.0
+
+
+def load_health(path):
+    """The health doc from a telemetry dir or a direct .json path; None
+    when missing/torn (the writer is atomic, so torn means not-written)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "fleet_health.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_s(v):
+    """Seconds, scaled for humans: µs under 1ms, ms under 1s."""
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return "-"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.2f}s"
+
+
+def _fmt_burn(v):
+    if v is None:
+        return "-"
+    return f"{float(v):.2f}" + (" BURN" if float(v) > BURN_FLAG else "")
+
+
+def _table(rows, header):
+    """Fixed-width text table (no external deps)."""
+    cols = [header] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in cols) for i in range(len(header))]
+    out = []
+    for j, r in enumerate(cols):
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if j == 0:
+            out.append("  ".join("-" * w for w in widths))
+    return "\n".join(out)
+
+
+def class_rows(doc):
+    rows = []
+    for slo, e in sorted((doc.get("classes") or {}).items()):
+        lat = e.get("latency_seconds") or {}
+        obj = e.get("objectives") or {}
+        rows.append([
+            slo, e.get("requests", 0), e.get("shed", 0), e.get("failed", 0),
+            _fmt_s(lat.get("p50")), _fmt_s(lat.get("p95")),
+            _fmt_s(lat.get("p99")),
+            _fmt_s(obj.get("latency_target_s")),
+            _fmt_burn(obj.get("burn_rate_latency")),
+            _fmt_burn(obj.get("burn_rate_availability")),
+        ])
+    return rows
+
+
+_CLASS_HEADER = ["class", "done", "shed", "fail", "p50", "p95", "p99",
+                 "target", "burn(lat)", "burn(avail)"]
+
+
+def render_text(doc, now=None):
+    """The terminal view: one string, ready to print."""
+    if doc is None:
+        return "[fleet_dashboard] no fleet_health.json yet " \
+               "(is PADDLE_TPU_LIVE_TELEMETRY=1 set on the fleet?)"
+    now = time.time() if now is None else now
+    age = now - float(doc.get("ts", now))
+    lines = [f"fleet health  (window {doc.get('window_s', '?')}s, "
+             f"written {age:.1f}s ago)", ""]
+    rows = class_rows(doc)
+    if rows:
+        lines.append(_table(rows, _CLASS_HEADER))
+    else:
+        lines.append("(no completed requests in the window yet)")
+    stragglers = doc.get("stragglers") or []
+    if stragglers:
+        lines += ["", _table(
+            [[r.get("rank"), _fmt_s(r.get("ewma_step_seconds")),
+              r.get("z"), "STRAGGLER" if r.get("flagged") else ""]
+             for r in stragglers],
+            ["rank", "ewma step", "z", ""])]
+    stages = doc.get("stages") or {}
+    if stages.get("idle_fraction"):
+        flag = "  IMBALANCED" if stages.get("flagged") else ""
+        lines += ["", "stage idle fractions "
+                  f"(spread {stages.get('imbalance')}{flag}): "
+                  + ", ".join(f"{s}={v}" for s, v in
+                              sorted(stages["idle_fraction"].items()))]
+    queues = doc.get("queues") or {}
+    adm = queues.get("admission") or {}
+    if adm:
+        lines += ["", "admission queues: "
+                  + ", ".join(f"{c}={n}" for c, n in sorted(adm.items()))]
+    eng = queues.get("engine_outstanding_tokens") or {}
+    if eng:
+        lines += ["engine outstanding tokens: "
+                  + ", ".join(f"{e}={n}" for e, n in sorted(eng.items()))]
+    tr = doc.get("transport") or {}
+    if tr:
+        storm = "  RECONNECT STORM" if tr.get("storm") else ""
+        lines += ["", f"transport: {tr.get('reconnect_total', 0):.0f} "
+                  f"reconnects ({tr.get('reconnect_rate_per_min', 0)}"
+                  f"/min){storm}"]
+    cc = doc.get("compile_cache") or {}
+    if cc.get("hit_rate") is not None:
+        lines += [f"compile cache: {cc.get('hits', 0):.0f} hits / "
+                  f"{cc.get('misses', 0):.0f} misses "
+                  f"(hit rate {cc['hit_rate']:.2f})"]
+    sources = doc.get("sources") or {}
+    if sources:
+        lines += ["", "sources (s since last payload): "
+                  + ", ".join(f"{s}={a}" for s, a in sorted(sources.items()))]
+    return "\n".join(lines)
+
+
+def render_html(doc, now=None):
+    """One-shot static HTML (no JS, no external assets): the same
+    content as the terminal view, with flagged cells highlighted."""
+    now = time.time() if now is None else now
+    if doc is None:
+        body = "<p>no fleet_health.json yet</p>"
+    else:
+        age = now - float(doc.get("ts", now))
+        parts = [f"<p>window {_html.escape(str(doc.get('window_s', '?')))}s"
+                 f", written {age:.1f}s ago</p>"]
+        rows = class_rows(doc)
+        if rows:
+            cells = "".join(
+                "<tr>" + "".join(
+                    "<td class='{}'>{}</td>".format(
+                        "burn" if "BURN" in str(c) else "",
+                        _html.escape(str(c)))
+                    for c in r) + "</tr>" for r in rows)
+            head = "".join(f"<th>{_html.escape(h)}</th>"
+                           for h in _CLASS_HEADER)
+            parts.append(f"<table><tr>{head}</tr>{cells}</table>")
+        pre = render_text(doc, now=now)
+        parts.append(f"<pre>{_html.escape(pre)}</pre>")
+        body = "\n".join(parts)
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>fleet health</title><style>"
+            "body{font-family:monospace;margin:2em}"
+            "table{border-collapse:collapse}"
+            "td,th{border:1px solid #999;padding:2px 8px}"
+            "td.burn{background:#fbb}"
+            "</style></head><body><h1>fleet health</h1>"
+            f"{body}</body></html>")
+
+
+def selftest():
+    doc = {
+        "schema": 1, "ts": 1000.0, "window_s": 60.0,
+        "classes": {
+            "interactive": {
+                "requests": 40, "admitted": 42, "shed": 1, "failed": 1,
+                "latency_seconds": {"p50": 0.12, "p95": 0.8, "p99": 1.4,
+                                    "mean": 0.2},
+                "phase_seconds_p95": {"decode": 0.5},
+                "objectives": {"latency_target_s": 2.0,
+                               "frac_over_target": 0.0,
+                               "burn_rate_latency": 0.0,
+                               "frac_unavailable": 0.047,
+                               "burn_rate_availability": 47.6}},
+            "batch": {
+                "requests": 5, "admitted": 5, "shed": 0, "failed": 0,
+                "latency_seconds": {"p50": 3.0, "p95": 9.0, "p99": 9.5,
+                                    "mean": 4.0},
+                "phase_seconds_p95": {},
+                "objectives": {"latency_target_s": 60.0,
+                               "frac_over_target": 0.0,
+                               "burn_rate_latency": 0.0,
+                               "frac_unavailable": 0.0,
+                               "burn_rate_availability": 0.0}},
+        },
+        "stragglers": [
+            {"rank": 0, "ewma_step_seconds": 0.1, "z": -0.5,
+             "flagged": False},
+            {"rank": 1, "ewma_step_seconds": 0.9, "z": 3.4,
+             "flagged": True}],
+        "stages": {"idle_fraction": {"0": 0.05, "1": 0.4},
+                   "imbalance": 0.35, "flagged": True},
+        "queues": {"admission": {"interactive": 2, "batch": 7},
+                   "engine_outstanding_tokens": {"engine0": 512}},
+        "transport": {"reconnect_total": 3.0,
+                      "reconnect_rate_per_min": 1.0, "storm": False},
+        "compile_cache": {"hits": 9.0, "misses": 1.0, "hit_rate": 0.9},
+        "sources": {"engine0": 0.4},
+    }
+    text = render_text(doc, now=1001.0)
+    for needle in ("interactive", "batch", "p95", "BURN", "STRAGGLER",
+                   "IMBALANCED", "engine0=512", "hit rate 0.90"):
+        assert needle in text, (needle, text)
+    # burn < 1 is NOT flagged; the flagged one is availability/interactive
+    assert "0.00 BURN" not in text
+    page = render_html(doc, now=1001.0)
+    assert "<table>" in page and "class='burn'" in page
+    assert "STRAGGLER" in page
+    # missing file / torn doc degrade to a hint, not a crash
+    assert "no fleet_health.json" in render_text(None)
+    with tempfile.TemporaryDirectory() as d:
+        assert load_health(d) is None
+        p = os.path.join(d, "fleet_health.json")
+        with open(p, "w") as f:
+            f.write('{"torn')
+        assert load_health(d) is None
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        assert load_health(d)["classes"]["batch"]["requests"] == 5
+    print("fleet_dashboard selftest ok")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("fleet_dashboard")
+    ap.add_argument("telemetry_dir", nargs="?",
+                    help="dir holding fleet_health.json (or the file)")
+    ap.add_argument("--html", default=None, metavar="OUT",
+                    help="write a one-shot static HTML page instead of "
+                         "printing the terminal view")
+    ap.add_argument("--watch", action="store_true",
+                    help="redraw the terminal view every --interval s")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.telemetry_dir:
+        ap.error("telemetry_dir is required (or --selftest)")
+    if args.html:
+        page = render_html(load_health(args.telemetry_dir))
+        tmp = f"{args.html}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(page)
+        os.replace(tmp, args.html)
+        print(f"[fleet_dashboard] wrote {args.html}", file=sys.stderr)
+        return 0
+    if args.watch:
+        try:
+            while True:
+                print("\x1b[2J\x1b[H"
+                      + render_text(load_health(args.telemetry_dir)),
+                      flush=True)
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    print(render_text(load_health(args.telemetry_dir)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,17 @@ Usage::
 
     python scripts/trace_report.py TELEMETRY_DIR \
         [--trace-out trace.json] [--summary-out fleet_trace_summary.json]
+    python scripts/trace_report.py TELEMETRY_DIR --follow \
+        [--poll-interval 1.0] [--max-polls 0]
     python scripts/trace_report.py --selftest
+
+``--follow`` keeps the report live against a running job: each span file
+is tailed incrementally through ``tracing.SpanTailer`` (byte-offset
+resume — a poll only reads bytes appended since the last one, and never
+consumes a torn tail line; the writer's next flush completes it), and
+the outputs are atomically rewritten whenever new spans arrive.
+``--max-polls N`` bounds the loop (0 = until interrupted) so tests and
+one-shot refreshes can drive it deterministically.
 
 ``--selftest`` synthesizes a 2-rank span set (including a failover
 retry tree and a torn tail line), merges it, and asserts the tree,
@@ -50,6 +60,21 @@ def _load_tracing():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_objectives():
+    """``serving/protocol.SLO_OBJECTIVES`` loaded from its file path
+    (protocol.py is stdlib-only by contract) so the summary carries the
+    exact post-hoc ``objectives`` block — the document the live plane's
+    windowed burn rates are reconciled against."""
+    path = os.path.join(_REPO, "paddle_tpu", "serving", "protocol.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_srv_protocol", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return dict(mod.SLO_OBJECTIVES)
+    except Exception:
+        return None  # report still works without burn rates
 
 
 def to_perfetto(spans):
@@ -112,12 +137,77 @@ def run_report(telemetry_dir, trace_out, summary_out):
     for p in problems:
         print(f"[trace_report] WARNING: {p}", file=sys.stderr)
     _write_json(to_perfetto(spans), trace_out)
-    summary = tracing.summarize_spans(spans)
+    summary = tracing.summarize_spans(spans, objectives=_load_objectives())
     _write_json(summary, summary_out)
     print(f"[trace_report] {len(spans)} spans, {summary['traces']} traces, "
           f"{summary['requests']} request trees "
           f"({len(problems)} tree problems) -> {trace_out}, {summary_out}")
     return 0
+
+
+class FollowReporter:
+    """Incremental report state for ``--follow``: one ``SpanTailer`` per
+    span file (created as files appear), an accumulated span list, and
+    atomic output rewrites only when a poll actually surfaced new spans.
+    ``poll()`` returns how many new spans it ingested, so callers (and
+    the pinned test) can assert byte-offset resume — a quiet poll reads
+    nothing and rewrites nothing."""
+
+    def __init__(self, telemetry_dir, trace_out, summary_out, tracing=None):
+        self.dir = telemetry_dir
+        self.trace_out = trace_out
+        self.summary_out = summary_out
+        self.tracing = tracing or _load_tracing()
+        self.objectives = _load_objectives()
+        self.spans = []
+        self._tailers = {}
+        self.polls = 0
+        self.writes = 0
+
+    def poll(self):
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            names = []
+        fresh = 0
+        for fn in names:
+            if not (fn.startswith("spans_rank") and fn.endswith(".jsonl")):
+                continue
+            path = os.path.join(self.dir, fn)
+            t = self._tailers.get(path)
+            if t is None:
+                t = self._tailers[path] = self.tracing.SpanTailer(path)
+            new = t.poll()
+            if new:
+                self.spans.extend(new)
+                fresh += len(new)
+        self.polls += 1
+        if fresh:
+            _write_json(to_perfetto(self.spans), self.trace_out)
+            _write_json(self.tracing.summarize_spans(
+                self.spans, objectives=self.objectives), self.summary_out)
+            self.writes += 1
+        return fresh
+
+
+def run_follow(telemetry_dir, trace_out, summary_out, poll_interval,
+               max_polls):
+    import time
+
+    rep = FollowReporter(telemetry_dir, trace_out, summary_out)
+    try:
+        while True:
+            fresh = rep.poll()
+            if fresh:
+                print(f"[trace_report] +{fresh} spans "
+                      f"({len(rep.spans)} total) -> {trace_out}, "
+                      f"{summary_out}", file=sys.stderr)
+            if max_polls and rep.polls >= max_polls:
+                break
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        pass
+    return 0 if rep.spans else 1
 
 
 # ---------------------------------------------------------------------------
@@ -203,11 +293,17 @@ def selftest():
         assert {m["args"]["name"] for m in metas
                 if m["name"] == "process_name"} == {"rank 0", "rank 1"}
 
-        summary = tracing.summarize_spans(spans)
+        summary = tracing.summarize_spans(spans,
+                                          objectives=_load_objectives())
         assert summary["requests"] == 4
         cls = summary["classes"]
         assert set(cls) == {"interactive", "standard", "batch"}
         assert cls["standard"]["resubmitted"] == 1
+        # declared objectives ride along: every class gets an exact
+        # burn-rate block (all 1.0s-latency trees are under target here)
+        for c in cls.values():
+            assert c["objectives"]["burn_rate_latency"] == 0.0
+            assert c["objectives"]["burn_rate_availability"] == 0.0
         # the dataplane split is visible in attribution: standard trees
         # carry wire transit (one with a KV stream), the batch tree rode
         # the legacy store dataplane
@@ -234,6 +330,14 @@ def main(argv=None):
     ap.add_argument("--summary-out", default=None,
                     help="attribution table output path "
                          "(default: TELEMETRY_DIR/fleet_trace_summary.json)")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep polling the span files incrementally and "
+                         "rewrite the outputs as new spans arrive")
+    ap.add_argument("--poll-interval", type=float, default=1.0,
+                    help="--follow poll cadence in seconds")
+    ap.add_argument("--max-polls", type=int, default=0,
+                    help="--follow: stop after this many polls "
+                         "(0 = until interrupted)")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
     if args.selftest:
@@ -241,9 +345,13 @@ def main(argv=None):
     if not args.telemetry_dir:
         ap.error("telemetry_dir is required (or --selftest)")
     d = args.telemetry_dir
-    return run_report(
-        d, args.trace_out or os.path.join(d, "trace.json"),
-        args.summary_out or os.path.join(d, "fleet_trace_summary.json"))
+    trace_out = args.trace_out or os.path.join(d, "trace.json")
+    summary_out = (args.summary_out
+                   or os.path.join(d, "fleet_trace_summary.json"))
+    if args.follow:
+        return run_follow(d, trace_out, summary_out, args.poll_interval,
+                          args.max_polls)
+    return run_report(d, trace_out, summary_out)
 
 
 if __name__ == "__main__":

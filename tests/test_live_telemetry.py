@@ -1,0 +1,504 @@
+"""Live telemetry plane invariants (docs/OBSERVABILITY.md §10).
+
+Tier-1 units for ``paddle_tpu/observability/live.py``:
+
+* mergeable-histogram quantiles stay within ONE bucket width of the
+  exact nearest-rank order statistic (the bound the ±5% live-vs-post-hoc
+  reconciliation budget rests on), and merge is lossless vector addition;
+* aggregator windowing (sub-bucket expiry), burn-rate math (byte-equal
+  to ``tracing.compute_burn`` over the same counts), out-of-order phase
+  attribution, (src, seq) payload dedup, straggler z-scores, and stage
+  imbalance;
+* ``tele``-frame exactly-once counting under ``net_fence`` drop /
+  half-open chaos on a REAL transport pair — redundant ring re-sends
+  heal the lost frame, the aggregator's dedup collapses the duplicates;
+* the disabled path of every entry point stays under the 20µs/call
+  budget (the PR 10 one-env-lookup contract).
+
+The slow 2-worker e2e at the bottom asserts the acceptance criterion:
+``fleet_health.json`` burn rates and p95 reconcile (±5%) with the
+post-hoc ``fleet_trace_summary.json`` for the same run.
+"""
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import live, tracing
+from paddle_tpu.serving.protocol import SLO_OBJECTIVES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_IDS = itertools.count(1)
+
+
+def _span(name, tid=None, dur=0.1, rank=0, parent=None, **attrs):
+    rec = {"kind": "span", "name": name,
+           "trace_id": tid or f"t{next(_IDS):08x}",
+           "span_id": f"s{next(_IDS):08x}",
+           "parent_id": parent, "ts": 0.0, "dur_s": float(dur),
+           "rank": rank, "pid": 0}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _root(slo, dur, status="done", tid=None):
+    return _span("srv_request", tid=tid, dur=dur, slo=slo, status=status)
+
+
+def _agg(**kw):
+    kw.setdefault("tail_local", False)
+    return live.LiveAggregator(**kw)
+
+
+# ---------------------------------------------------------------------------
+# mergeable histogram
+# ---------------------------------------------------------------------------
+def test_histogram_quantile_within_one_bucket_of_exact():
+    rng = np.random.default_rng(0)
+    samples = np.concatenate([
+        rng.lognormal(mean=-3.0, sigma=1.2, size=4000),  # ms..s spread
+        rng.uniform(0.0, 5e-5, size=50),                 # bucket-0 tail
+    ]).tolist()
+    h = live.MergeableHistogram()
+    for v in samples:
+        h.add(v)
+    srt = sorted(samples)
+    for q in (0.50, 0.90, 0.95, 0.99):
+        # tracing._pct's nearest-rank convention — the reconcile target
+        exact = srt[int(round(q * (len(srt) - 1)))]
+        est = h.quantile(q)
+        b = live._bucket_index(exact)
+        hi = live.BOUNDS[b + 1] if b + 1 < len(live.BOUNDS) else h.max
+        width = hi - live.BOUNDS[b]
+        assert abs(est - exact) <= width + 1e-12, (q, est, exact, width)
+        if exact >= live._B0:
+            # geometric ladder: one bucket width is <5% relative error,
+            # inside the ±5% reconciliation budget
+            assert est == pytest.approx(exact, rel=0.05)
+
+
+def test_histogram_merge_is_lossless_vector_addition():
+    rng = np.random.default_rng(1)
+    va = rng.lognormal(-2.0, 1.0, 500).tolist()
+    vb = rng.lognormal(-1.0, 0.5, 300).tolist()
+    a, b, whole = (live.MergeableHistogram() for _ in range(3))
+    for v in va:
+        a.add(v)
+        whole.add(v)
+    for v in vb:
+        b.add(v)
+        whole.add(v)
+    a.merge(b)
+    assert a.counts == whole.counts
+    assert a.count == whole.count == 800
+    assert a.sum == pytest.approx(whole.sum)
+    assert (a.min, a.max) == (whole.min, whole.max)
+    for q in (0.5, 0.95, 0.99):
+        assert a.quantile(q) == whole.quantile(q)
+
+
+def test_histogram_empty_and_single_sample():
+    h = live.MergeableHistogram()
+    assert h.quantile(0.95) == 0.0 and h.mean == 0.0
+    h.add(0.25)
+    # min/max clamping pins a single sample exactly
+    assert h.quantile(0.5) == pytest.approx(0.25)
+    assert h.quantile(0.99) == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# aggregator units
+# ---------------------------------------------------------------------------
+def test_aggregator_burn_rates_match_compute_burn():
+    agg = _agg()
+    t0 = 1_000_000.0
+    spans = ([_root("interactive", 0.5) for _ in range(10)]
+             + [_root("interactive", 3.0) for _ in range(2)]  # > 2s target
+             + [_root("interactive", 0.0, status="shed")]
+             + [_root("interactive", 1.0, status="failed")])
+    assert agg.ingest_spans(spans, now=t0) == len(spans)
+    # span-id dedup: replaying the same batch is a no-op
+    assert agg.ingest_spans(spans, now=t0 + 1.0) == 0
+    ent = agg.health(now=t0 + 2.0)["classes"]["interactive"]
+    assert ent["requests"] == 13 and ent["admitted"] == 14
+    assert ent["shed"] == 1 and ent["failed"] == 1
+    # the SAME formula the post-hoc summary uses, over the same counts:
+    # 13 completed, 2 over target, 2 bad (shed+failed), 14 admitted
+    want = tracing.compute_burn(13, 2, 2, 14, SLO_OBJECTIVES["interactive"])
+    assert ent["objectives"] == want
+    assert want["burn_rate_latency"] == pytest.approx((2 / 13) / 0.05,
+                                                      rel=1e-4)
+    assert want["burn_rate_availability"] == pytest.approx((2 / 14) / 0.001,
+                                                           rel=1e-4)
+    # quantiles come from the mergeable histogram: p50 near 0.5s
+    assert ent["latency_seconds"]["p50"] == pytest.approx(0.5, rel=0.05)
+
+
+def test_aggregator_window_expiry_rolls_old_buckets_out():
+    agg = _agg(window_s=60.0, bucket_s=5.0)
+    t0 = 1_000_000.0
+    agg.ingest_spans([_root("standard", 0.3) for _ in range(4)], now=t0)
+    assert agg.health(now=t0)["classes"]["standard"]["requests"] == 4
+    agg.ingest_spans([_root("standard", 0.3)], now=t0 + 58.0)
+    # t0's sub-bucket has aged past the window; the recent one survives
+    assert agg.health(now=t0 + 66.0)["classes"]["standard"]["requests"] == 1
+    # everything expired
+    assert agg.health(now=t0 + 130.0)["classes"] == {}
+
+
+def test_aggregator_phase_attribution_out_of_order():
+    agg = _agg()
+    t0 = 1_000_000.0
+    tid = "trace-x"
+    # decode lands BEFORE its root: pended, attached when the root closes
+    agg.ingest_spans([_span("srv_decode", tid=tid, dur=0.4)], now=t0)
+    assert agg.health(now=t0)["classes"] == {}
+    agg.ingest_spans([_root("standard", 1.0, tid=tid)], now=t0 + 1.0)
+    # queue lands AFTER the root: class mapping already known
+    agg.ingest_spans([_span("srv_queue", tid=tid, dur=0.2)], now=t0 + 2.0)
+    ent = agg.health(now=t0 + 3.0)["classes"]["standard"]
+    assert ent["phase_seconds_p95"]["decode"] == pytest.approx(0.4, rel=0.05)
+    assert ent["phase_seconds_p95"]["queue"] == pytest.approx(0.2, rel=0.05)
+
+
+def test_aggregator_payload_seq_dedup_and_counters():
+    agg = _agg()
+    p1 = {"v": 1, "src": "w0", "seq": 1,
+          "spans": [_root("batch", 0.5)],
+          "counters": {"compile_cache_hits_total": 3.0}}
+    assert agg.ingest(p1, now=1.0)
+    assert not agg.ingest(p1, now=1.5)                      # ring re-send
+    assert not agg.ingest({"src": "w0", "seq": 0}, now=1.6)  # stale
+    p2 = {"v": 1, "src": "w0", "seq": 2, "spans": [],
+          "counters": {"compile_cache_hits_total": 4.0,
+                       "compile_cache_miss_total": 1.0}}
+    assert agg.ingest(p2, now=2.0)
+    doc = agg.health(now=2.5)
+    assert doc["classes"]["batch"]["requests"] == 1
+    # counters are absolute totals: the latest value wins, not a sum
+    assert doc["compile_cache"]["hits"] == 4.0
+    assert doc["compile_cache"]["hit_rate"] == pytest.approx(0.8)
+    assert doc["sources"]["w0"] == pytest.approx(0.5, abs=0.01)
+    # malformed payloads are rejected, never raised
+    assert not agg.ingest("garbage")
+    assert not agg.ingest({"src": "w1", "seq": "nan"})
+
+
+def test_aggregator_straggler_zscores_flag_slow_rank():
+    agg = _agg(straggler_z=2.0)
+    t0 = 1_000_000.0
+    spans = []
+    for r in range(8):
+        spans += [_span("train_step", dur=0.1, rank=r) for _ in range(4)]
+    spans += [_span("train_step", dur=1.0, rank=8) for _ in range(4)]
+    agg.ingest_spans(spans, now=t0)
+    by_rank = {r["rank"]: r for r in agg.health(now=t0)["stragglers"]}
+    assert set(by_rank) == set(range(9))
+    assert by_rank[8]["flagged"] and by_rank[8]["z"] > 2.0
+    assert by_rank[8]["ewma_step_seconds"] == pytest.approx(1.0)
+    assert not any(by_rank[r]["flagged"] for r in range(8))
+
+
+def test_aggregator_stage_imbalance_and_queue_depths():
+    agg = _agg(stage_imbalance_threshold=0.25)
+    assert agg.ingest({"src": "w0", "seq": 1, "stages": {
+        "0": {"idle_fraction": 0.05}, "1": {"idle_fraction": 0.55}}},
+        now=1.0)
+    agg.note_queues({"admission": {"interactive": 3},
+                     "engine_outstanding_tokens": {"engine0": 128}})
+    doc = agg.health(now=2.0)
+    assert doc["stages"]["flagged"]
+    assert doc["stages"]["imbalance"] == pytest.approx(0.5)
+    assert doc["queues"]["admission"]["interactive"] == 3
+    assert doc["queues"]["engine_outstanding_tokens"]["engine0"] == 128
+
+
+def test_aggregator_writes_atomic_health_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_LIVE_TELEMETRY", "1")
+    obs.reset()
+    try:
+        agg = _agg()
+        agg.ingest_spans([_root("interactive", 0.4)], now=100.0)
+        path = agg.write_health(now=101.0)
+        assert path == str(tmp_path / "fleet_health.json")
+        doc = json.load(open(path))
+        assert doc["schema"] == 1
+        assert doc["classes"]["interactive"]["requests"] == 1
+        # no tmp litter left behind the atomic replace
+        assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    finally:
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# tele-frame dedup under transport chaos
+# ---------------------------------------------------------------------------
+def _append_spans(path, recs):
+    with open(path, "a") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_tele_frames_exactly_once_under_net_chaos(tmp_path, monkeypatch):
+    """A real server/client pair beats tele frames through ``net_fence``
+    drop and half-open faults: the shipper's redundant ring re-sends
+    heal the lost frame on a later beat, and the aggregator's
+    (src, seq) dedup counts every span exactly once."""
+    from paddle_tpu.serving import transport
+    from paddle_tpu.serving.transport import TransportClient, TransportServer
+
+    tdir = tmp_path / "tele"
+    tdir.mkdir()
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tdir))
+    monkeypatch.setenv("PADDLE_TPU_LIVE_TELEMETRY", "1")
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    obs.reset()
+    span_file = tdir / "spans_rank0.jsonl"
+
+    server = TransportServer()
+    client = TransportClient(server.addr)
+    agg = _agg()
+    # a deep ring so healing survives however long the post-drop redial
+    # takes on this machine; cadence is driven by explicit now values
+    shipper = live.LiveShipper("w0", interval_s=0.0, redundancy=64)
+    clock = itertools.count(1)
+    accepted = attempts = 0
+
+    def beat():
+        pays = shipper.collect(now=float(next(clock)))
+        if pays:
+            client.send({"t": "tele", "pays": pays})
+
+    def pump():
+        nonlocal accepted, attempts
+        for _cid, frame in server.poll():
+            assert frame["t"] == "tele"
+            for pay in frame["pays"]:
+                attempts += 1
+                accepted += bool(agg.ingest(pay))
+
+    def requests_seen():
+        doc = agg.health(now=float(next(clock)))
+        cls = doc["classes"].get("interactive")
+        return cls["requests"] if cls else 0
+
+    def beat_until(want, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while requests_seen() < want:
+            assert time.monotonic() < deadline, \
+                (want, requests_seen(), accepted, attempts)
+            beat()
+            pump()
+            time.sleep(0.01)
+
+    try:
+        # seq 1 delivered clean
+        _append_spans(span_file, [_root("interactive", 0.5)
+                                  for _ in range(3)])
+        beat_until(3)
+        assert accepted == 1
+
+        # seq 2's first send is DROPPED (connection severed); the ring
+        # re-sends it every beat until the redial lands
+        _append_spans(span_file, [_root("interactive", 0.5)
+                                  for _ in range(2)])
+        monkeypatch.setenv("PADDLE_CHAOS", "1")
+        monkeypatch.setenv("PADDLE_CHAOS_NET_MODE", "drop")
+        monkeypatch.setenv("PADDLE_CHAOS_NET_AT", "0")
+        monkeypatch.setattr(transport, "_send_index", 0)
+        assert not client.send(
+            {"t": "tele", "pays": shipper.collect(now=float(next(clock)))})
+        monkeypatch.delenv("PADDLE_CHAOS_NET_MODE")
+        beat_until(5)
+        assert accepted == 2
+
+        # seq 3 is swallowed HALF-OPEN (sender believes it went out);
+        # the next beat's ring re-send heals it
+        _append_spans(span_file, [_root("interactive", 0.5)])
+        monkeypatch.setenv("PADDLE_CHAOS_NET_MODE", "half_open")
+        monkeypatch.setenv("PADDLE_CHAOS_NET_AT", "0")
+        monkeypatch.setattr(transport, "_send_index", 0)
+        assert client.send(
+            {"t": "tele", "pays": shipper.collect(now=float(next(clock)))})
+        monkeypatch.delenv("PADDLE_CHAOS_NET_MODE")
+        beat_until(6)
+        assert accepted == 3
+
+        # the ring re-sent each payload on many beats, yet every payload
+        # was counted exactly once — the duplicates were all rejected
+        assert attempts > accepted
+    finally:
+        client.close()
+        server.close()
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead gate
+# ---------------------------------------------------------------------------
+def test_disabled_path_stays_under_budget(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_LIVE_TELEMETRY", raising=False)
+    shipper = live.LiveShipper("w0")
+    agg = _agg()
+    entry_points = [
+        ("live_enabled", live.live_enabled),
+        ("shipper.collect", shipper.collect),
+        ("aggregator.tick", agg.tick),
+        ("note_stage_stats", lambda: live.note_stage_stats({})),
+    ]
+    n = 20_000
+    for name, fn in entry_points:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 20e-6, f"{name}: {per_call * 1e6:.2f}us/call"
+
+
+def test_live_enabled_needs_both_env_vars(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_LIVE_TELEMETRY", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_TELEMETRY_DIR", raising=False)
+    assert not live.live_enabled()
+    monkeypatch.setenv("PADDLE_TPU_LIVE_TELEMETRY", "1")
+    assert not live.live_enabled()          # no telemetry dir yet
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", "/tmp/t")
+    assert live.live_enabled()
+    for off in ("0", "false", "no", "off", ""):
+        monkeypatch.setenv("PADDLE_TPU_LIVE_TELEMETRY", off)
+        assert not live.live_enabled()
+
+
+# ---------------------------------------------------------------------------
+# slow 2-worker e2e: live health reconciles with the post-hoc summary
+# ---------------------------------------------------------------------------
+VOCAB = 61
+MODEL_ARGS = ["--model-seed", "7", "--vocab", str(VOCAB), "--hidden", "32",
+              "--layers", "2", "--heads", "4", "--max-positions", "128"]
+ENGINE_ARGS = ["--slots", "2", "--max-length", "64", "--page-size", "16"]
+
+
+def _spawn_worker(master, rank, tdir):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "PADDLE_TPU_TELEMETRY_DIR": str(tdir),
+        "PADDLE_TPU_LIVE_TELEMETRY": "1",
+        "PADDLE_TRAINER_ID": str(rank),
+    })
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.worker",
+         "--master", master, "--poll-interval", "0.002",
+         *MODEL_ARGS, *ENGINE_ARGS],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+@pytest.mark.slow
+def test_live_health_reconciles_with_posthoc_summary(tmp_path, monkeypatch):
+    from conftest import free_port
+    from paddle_tpu.runtime import TCPStore
+    from paddle_tpu.serving import Router
+
+    tdir = tmp_path / "tele"
+    tdir.mkdir()
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tdir))
+    monkeypatch.setenv("PADDLE_TPU_LIVE_TELEMETRY", "1")
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    obs.reset()
+
+    port = free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=30.0)
+    procs = [_spawn_worker(f"127.0.0.1:{port}", rank, tdir)
+             for rank in (1, 2)]
+    router = Router(store, queue_limit=32, engine_grace_s=120.0, seed=13,
+                    deadlines={"interactive": 240.0, "standard": 240.0,
+                               "batch": 600.0})
+    # a wide window so a slow CI box cannot age early requests out of
+    # the live doc before the reconcile reads it (the lazy creation in
+    # _live_tick keeps this pre-seeded instance)
+    router._live_agg = live.LiveAggregator(window_s=600.0,
+                                           health_interval_s=0.5)
+    health = None
+    try:
+        deadline = time.monotonic() + 120.0
+        while router._known_engines < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            for p in procs:
+                assert p.poll() is None, p.stderr.read()[-2000:]
+            router.pump()
+            time.sleep(0.05)
+
+        rng = np.random.default_rng(8)
+        slos = ("interactive", "standard", "batch", "interactive",
+                "standard", "interactive", "batch", "standard",
+                "interactive")
+        rids = [router.submit(
+            rng.integers(1, VOCAB, size=int(n)).astype(np.int64),
+            slo=slo, max_new_tokens=8)
+            for slo, n in zip(slos, (14, 23, 31, 11, 19, 9, 27, 17, 13))]
+        assert router.drain(timeout=240.0), router.stats()
+        st = router.stats()
+        assert st["done"] == len(rids) and st["shed"] == 0
+
+        # keep pumping so the workers' final tele beats land and the
+        # aggregator writes a health doc covering every request
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            router.pump()
+            health = json.load(open(tdir / "fleet_health.json")) \
+                if (tdir / "fleet_health.json").exists() else None
+            if health and sum(c["requests"]
+                              for c in health["classes"].values()) \
+                    >= len(rids):
+                break
+            time.sleep(0.05)
+    finally:
+        router.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=20)
+        store.close()
+        obs.reset()
+
+    assert health is not None, "fleet_health.json never covered the run"
+    assert sum(c["requests"] for c in health["classes"].values()) \
+        == len(rids)
+    # the wire path really delivered: at least one worker shipped tele
+    assert health["sources"], health
+    assert set(health["queues"].get("engine_outstanding_tokens", {})) \
+        and set(health["queues"].get("admission", {}))
+
+    # post-hoc ground truth over the same span files
+    report = os.path.join(REPO, "scripts", "trace_report.py")
+    proc = subprocess.run([sys.executable, report, str(tdir)],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.load(open(tdir / "fleet_trace_summary.json"))
+    assert summary["requests"] == len(rids)
+
+    # ACCEPTANCE: live burn rates and p95 reconcile ±5% with post-hoc
+    for slo, s_ent in summary["classes"].items():
+        h_ent = health["classes"][slo]
+        assert h_ent["requests"] == s_ent["requests"], slo
+        s_obj, h_obj = s_ent["objectives"], h_ent["objectives"]
+        for k in ("frac_over_target", "burn_rate_latency",
+                  "frac_unavailable", "burn_rate_availability"):
+            assert h_obj[k] == pytest.approx(s_obj[k], rel=0.05,
+                                             abs=1e-9), (slo, k)
+        assert h_ent["latency_seconds"]["p95"] == pytest.approx(
+            s_ent["latency_seconds"]["p95"], rel=0.05), slo

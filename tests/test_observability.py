@@ -220,10 +220,11 @@ def test_disabled_adds_no_measurable_overhead(monkeypatch):
 # ---------------------------------------------------------------------------
 # fleet merge + single-process sync
 # ---------------------------------------------------------------------------
-def _snap(rank, step_mean):
-    series = {"count": 4, "sum": 4 * step_mean, "min": step_mean,
+def _snap(rank, step_mean, count=4):
+    series = {"count": count, "sum": count * step_mean, "min": step_mean,
               "max": step_mean, "mean": step_mean, "p50": step_mean,
-              "p90": step_mean, "p99": step_mean, "values": [step_mean] * 4}
+              "p90": step_mean, "p99": step_mean,
+              "values": [step_mean] * min(count, 4)}
     return {"rank": rank, "ts": 0.0, "metrics": {
         "train_step_seconds": {"type": "histogram", "help": "",
                                "series": {"": series}},
@@ -261,6 +262,45 @@ def test_merge_snapshots_no_false_stragglers():
     doc = merge_snapshots({0: _snap(0, 0.01), 1: _snap(1, 0.011)},
                           world_size=2)
     assert doc["stragglers"] == [] and doc["missing_ranks"] == []
+
+
+def test_merge_weights_straggler_mean_by_sample_count():
+    """The straggler fleet mean is weighted by each rank's histogram
+    sample count: a nearly-idle rank (2 fast steps against 100-step
+    peers) must not drag the mean down and flag healthy ranks."""
+    doc = merge_snapshots({0: _snap(0, 0.1, count=100),
+                           1: _snap(1, 0.1, count=100),
+                           2: _snap(2, 0.01, count=2)}, world_size=3)
+    slot = doc["aggregate"]["train_step_seconds"][""]
+    # unweighted mean-of-means would be 0.07 and flag ranks 0+1 at the
+    # default 1.2x; the sample-weighted mean is the true per-step mean
+    want = (100 * 0.1 + 100 * 0.1 + 2 * 0.01) / 202
+    assert slot["weighted_mean"] == pytest.approx(want)
+    assert doc["stragglers"] == []
+
+
+def test_merge_skewed_counts_still_flag_real_straggler():
+    # a genuine 2x straggler with equal weight stays flagged, and the
+    # record carries its sample count + the weighted fleet mean
+    doc = merge_snapshots({0: _snap(0, 0.1, count=100),
+                           1: _snap(1, 0.1, count=100),
+                           2: _snap(2, 0.2, count=100)}, world_size=3)
+    assert [s["rank"] for s in doc["stragglers"]] == [2]
+    s = doc["stragglers"][0]
+    want = (100 * 0.1 + 100 * 0.1 + 100 * 0.2) / 300
+    assert s["fleet_mean_seconds"] == pytest.approx(want)
+    assert s["samples"] == 100
+    assert s["slowdown"] == pytest.approx(0.2 / want)
+
+
+def test_merge_zero_sample_counts_fall_back_unweighted():
+    # snapshots whose series carry no counts (all zero) keep the old
+    # unweighted mean instead of dividing by zero
+    doc = merge_snapshots({0: _snap(0, 0.01, count=0),
+                           1: _snap(1, 0.02, count=0)}, world_size=2)
+    slot = doc["aggregate"]["train_step_seconds"][""]
+    assert slot["weighted_mean"] == pytest.approx(0.015)
+    assert [s["rank"] for s in doc["stragglers"]] == [1]
 
 
 def test_straggler_threshold_env_override(monkeypatch, capsys):

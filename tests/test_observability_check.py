@@ -371,3 +371,94 @@ def test_mpmd_metric_from_pipeline_parallel_rejected(tmp_path):
 def test_mpmd_prefix_registered():
     assert "mpmd_" in check_observability.OWNED_PREFIXES
     assert check_observability.OWNED_PREFIXES["mpmd_"].endswith("mpmd.py")
+
+
+# -- rule 5: SLO class literals + live_*/slo_* ownership --------------------
+def test_undeclared_slo_class_literal_rejected(tmp_path):
+    v = _violations(tmp_path, """
+        from paddle_tpu import observability as _obs
+        def f(n):
+            _obs.set_gauge("live_window_requests", n, slo="interactiv")
+    """)
+    assert any("SLO class 'interactiv'" in msg for _line, msg in v)
+
+
+def test_declared_slo_class_literals_allowed(tmp_path):
+    rel = os.path.join("paddle_tpu", "observability", "live.py")
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        from paddle_tpu import observability as _obs
+        def f(n):
+            _obs.set_gauge("live_window_requests", n, slo="interactive")
+            _obs.set_gauge("live_window_requests", n, slo="standard")
+            _obs.set_gauge("live_window_requests", n, slo="batch")
+    """))
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_variable_slo_keyword_not_checked(tmp_path):
+    # rule 5 only judges string LITERALS: a class name flowing through a
+    # variable (the router's per-queue loop) is out of scope
+    assert not _violations(tmp_path, """
+        def g(slo):
+            pass
+        def f(cls):
+            g(slo=cls)
+    """)
+
+
+def test_slo_literal_checked_on_any_call_not_just_facade(tmp_path):
+    # the typo'd literal is a bug wherever it appears in the scanned
+    # layers — event() helpers, router submit wrappers, tests' drivers
+    v = _violations(tmp_path, """
+        def submit(prompt, slo="standard"):
+            pass
+        def f():
+            submit([1], slo="interactve")
+    """)
+    assert len(v) == 1 and "SLO_CLASSES" in v[0][1]
+
+
+def test_slo_classes_override_parameter(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        def f(g):
+            g(slo="gold")
+    """))
+    assert list(check_observability.check_file(str(f), CATALOG))
+    assert not list(check_observability.check_file(
+        str(f), CATALOG, slo_classes=frozenset({"gold"})))
+
+
+_LIVE_SRC = """
+    from paddle_tpu import observability as _obs
+    def f(burn):
+        _obs.set_gauge("slo_burn_rate", burn, slo="interactive",
+                       objective="latency")
+        _obs.inc("live_ingest_total")
+"""
+
+
+def test_live_and_slo_families_owned_by_live_module(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_LIVE_SRC))
+    rel = os.path.join("paddle_tpu", "observability", "live.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_live_metrics_from_other_files_rejected(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_LIVE_SRC))
+    for rel in (os.path.join("paddle_tpu", "serving", "router.py"),
+                os.path.join("paddle_tpu", "observability", "fleet.py")):
+        v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+        assert len(v) == 2, (rel, v)
+        assert all("single-writer" in msg for _line, msg in v)
+
+
+def test_rule5_prefixes_and_classes_registered():
+    assert check_observability.OWNED_PREFIXES["live_"].endswith("live.py")
+    assert check_observability.OWNED_PREFIXES["slo_"].endswith("live.py")
+    # loaded from serving/protocol.py, the single source of truth
+    assert check_observability.SLO_CLASSES == \
+        frozenset({"batch", "standard", "interactive"})

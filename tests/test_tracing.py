@@ -280,6 +280,83 @@ def test_trace_report_cli_writes_perfetto_and_summary(tdir, monkeypatch):
     assert sum(v["mean"] for v in sh.values()) == pytest.approx(1.0)
 
 
+def _load_trace_report():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_trace_report", REPORT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_follow_incremental_resume(tdir, monkeypatch):
+    """--follow is pinned: byte-offset resume (a quiet poll reads and
+    rewrites nothing), torn-tail lines stay unconsumed until completed,
+    and span files appearing mid-follow get picked up."""
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    tr = _load_trace_report()
+    trace_out = tdir / "trace.json"
+    summary_out = tdir / "fleet_trace_summary.json"
+    rep = tr.FollowReporter(str(tdir), str(trace_out), str(summary_out))
+    assert rep.poll() == 0 and rep.writes == 0
+    assert not trace_out.exists()
+
+    tid = tracing.new_trace_id()
+    root = tracing.record_span("srv_request", trace_id=tid, dur_s=1.0,
+                               slo="interactive", status="done",
+                               resubmits=0)
+    assert rep.poll() == 1 and rep.writes == 1
+    summary = json.load(open(summary_out))
+    assert summary["requests"] == 1
+    # declared objectives ride along in the follow output too
+    assert summary["classes"]["interactive"]["objectives"][
+        "burn_rate_latency"] == 0.0
+
+    # quiet poll: nothing read, outputs untouched
+    before = trace_out.stat().st_mtime_ns
+    assert rep.poll() == 0 and rep.writes == 1
+    assert trace_out.stat().st_mtime_ns == before
+
+    # a torn tail line is left in place, then ingested once its
+    # newline lands — exactly once, no partial parse
+    line = json.dumps({"kind": "span", "name": "srv_decode",
+                       "trace_id": tid, "span_id": "deadbeef",
+                       "parent_id": root, "ts": 0.0, "dur_s": 0.5,
+                       "rank": 0, "pid": 1})
+    span_path = tdir / "spans_rank0.jsonl"
+    with open(span_path, "a") as f:
+        f.write(line[:17])
+    assert rep.poll() == 0 and rep.writes == 1
+    with open(span_path, "a") as f:
+        f.write(line[17:] + "\n")
+    assert rep.poll() == 1 and rep.writes == 2
+    assert len(rep.spans) == 2
+
+    # a new rank's file appearing mid-follow grows a tailer on the fly
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    tracing.record_span("srv_prefill", trace_id=tid, parent_id=root,
+                        dur_s=0.1)
+    assert rep.poll() == 1 and rep.writes == 3
+    assert {s["rank"] for s in rep.spans} == {0, 1}
+
+
+def test_trace_report_follow_cli_bounded_polls(tdir, monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    tracing.record_span("compile", dur_s=0.5, where="x")
+    proc = subprocess.run(
+        [sys.executable, REPORT, str(tdir), "--follow",
+         "--poll-interval", "0.01", "--max-polls", "3"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "+1 spans" in proc.stderr
+    assert (tdir / "trace.json").exists()
+    # an empty dir that never produces spans exits 1, like the one-shot
+    proc = subprocess.run(
+        [sys.executable, REPORT, str(tdir / "nothing_here"), "--follow",
+         "--poll-interval", "0.01", "--max-polls", "2"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+
+
 def test_trace_report_cli_empty_dir_is_rc1(tmp_path):
     proc = subprocess.run([sys.executable, REPORT, str(tmp_path)],
                           capture_output=True, text=True, cwd=REPO)
