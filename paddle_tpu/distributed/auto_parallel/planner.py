@@ -117,6 +117,8 @@ class Topology:
     dcn_bw: float = 3.1e9          # bytes/s per chip across slices
     peak_flops: float = 197e12     # bf16 per chip
     hbm_bytes: float = 32e9        # per chip
+    hbm_bw: float = 1.2e12         # bytes/s per chip HBM (decode is bound
+    #                                by it — prices the attn kernel choice)
     host_serialized: bool = True
 
     @property
@@ -833,3 +835,93 @@ def plan_mpmd_stages(model_config: Optional[ModelConfig] = None,
                candidates=len(cands), calibration=consts.source)
     return MpmdPlan(best=best, best_equal=best_equal, candidates=cands,
                     constants=consts, plan_seconds=dt)
+
+
+# ---------------------------------------------------------------------------
+# attention-kernel pricing (docs/AUTOPLAN.md §attention kernel,
+# docs/SERVING.md §kernel plane)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AttnKernelPlan:
+    """Analytic HBM-traffic comparison of the two paged-attention
+    implementations for ONE batched decode/verify step (all layers).
+
+    ``einsum_bytes`` models the XLA oracle: (int8 only) a whole-pool
+    dequant pass per layer, the gathered K/V pages written and re-read as
+    f32, and the dense logits tensor round-tripped through HBM.
+    ``pallas_bytes`` models the fused kernel: the gathered pages stream
+    HBM→VMEM once at their STORED dtype (+ absmax scales when int8);
+    logits, softmax stats, and the accumulator never leave VMEM.
+    Decode is HBM-bound, so predicted step times are bytes / hbm_bw."""
+
+    choice: str                    # cheaper side: "pallas" | "einsum"
+    selected: Optional[str]        # what the engine actually resolved
+    einsum_bytes: float
+    pallas_bytes: float
+    einsum_step_s: float
+    pallas_step_s: float
+    bytes_saved: float             # einsum_bytes - pallas_bytes
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+def plan_attn_kernel(*, num_slots: int, max_pages: int, kv_heads: int,
+                     query_heads: int, page_size: int, head_dim: int,
+                     layers: int, kv_dtype: str = "f32", t: int = 1,
+                     num_pages: Optional[int] = None,
+                     selected: Optional[str] = None,
+                     topology: Optional[Topology] = None) -> AttnKernelPlan:
+    """Price the engine's paged-attention kernel choice per decode step.
+
+    Mirrors the serving geometry (EngineConfig + decode adapter): S slots
+    each gathering ``max_pages`` pages of ``page_size`` tokens over
+    ``kv_heads`` kv heads (GQA: ``query_heads`` fold onto them, free in
+    both paths), ``t`` query rows per slot (1 = decode, k+1 = verify).
+    ``num_pages`` sizes the int8 whole-pool dequant pass the einsum path
+    pays (default: the slots' worst-case footprint). Emits the standard
+    ``autoplan`` event with ``variant="attn_kernel"`` so the decision —
+    and what the engine actually selected — lands in telemetry."""
+    if kv_dtype not in _WIRE_ITEMSIZE:
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}; want one of "
+                         f"{sorted(_WIRE_ITEMSIZE)}")
+    topo = topology or Topology()
+    it = _WIRE_ITEMSIZE[kv_dtype]
+    int8 = kv_dtype == "int8"
+    pool_pages = num_pages if num_pages is not None else (
+        1 + num_slots * max_pages)
+    gathered = num_slots * max_pages * page_size * kv_heads * head_dim
+    logits = num_slots * query_heads * t * max_pages * page_size
+
+    # oracle: (int8) dequant pass reads the stored pool and writes it
+    # f32; the gather writes + the einsum re-reads gathered f32 K AND V;
+    # the masked logits round-trip HBM (write + softmax read)
+    dequant = (2 * pool_pages * kv_heads * page_size * head_dim * (it + 4.0)
+               if int8 else 0.0)
+    gather_src = 4.0 if int8 else it   # gathers read the dequantized pool
+    einsum_bytes = layers * (
+        dequant
+        + 2 * gathered * (gather_src + 2 * 4.0)
+        + 2 * logits * 4.0)
+    # fused kernel: pages stream once at stored width (+ scale vectors)
+    scales = (2 * num_slots * max_pages * page_size * kv_heads * 4.0
+              if int8 else 0.0)
+    pallas_bytes = layers * (2 * gathered * it + scales)
+
+    plan_ = AttnKernelPlan(
+        choice="pallas" if pallas_bytes <= einsum_bytes else "einsum",
+        selected=selected,
+        einsum_bytes=float(einsum_bytes),
+        pallas_bytes=float(pallas_bytes),
+        einsum_step_s=float(einsum_bytes / topo.hbm_bw),
+        pallas_step_s=float(pallas_bytes / topo.hbm_bw),
+        bytes_saved=float(einsum_bytes - pallas_bytes),
+    )
+    _obs.event("autoplan", variant="attn_kernel", choice=plan_.choice,
+               selected=selected, kv_dtype=kv_dtype,
+               einsum_bytes=plan_.einsum_bytes,
+               pallas_bytes=plan_.pallas_bytes,
+               predicted_einsum_step_s=round(plan_.einsum_step_s, 9),
+               predicted_pallas_step_s=round(plan_.pallas_step_s, 9))
+    return plan_

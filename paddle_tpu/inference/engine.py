@@ -148,6 +148,14 @@ class EngineConfig:
     #: exchange keeps greedy decode bit-equal to the single-device
     #: engine. Ignored (always exact) when the mesh has no mp axis.
     logit_wire: Optional[str] = None
+    #: paged-attention kernel for the decode/verify/prefill programs:
+    #: None inherits PADDLE_TPU_ATTN_KERNEL (default "auto"), "pallas"
+    #: pins the fused Pallas kernel (page gather + online softmax + int8
+    #: dequant in one pass, docs/SERVING.md §kernel plane), "einsum" pins
+    #: the XLA reference oracle, "auto" picks pallas on TPU. An mp-
+    #: sharded pool always serves einsum (the GSPMD annotations live
+    #: there) and counts an attn_kernel_fallback_total.
+    attn_kernel: Optional[str] = None
     #: jax.sharding.Mesh to run the compiled programs on. An ``mp`` axis
     #: with degree > 1 shards the KV pools (and int8 scales) over kv
     #: heads — GQA groups stay whole per shard, so mp must divide
@@ -570,6 +578,37 @@ class DecodeEngine:
         if self._mp_degree <= 1:
             lw = "f32"
         self._logit_wire = lw
+        # resolve the paged-attention kernel once — it shapes every
+        # compiled program (and so belongs in the AOT cache key). The
+        # fused Pallas kernel cannot express the mp GSPMD sharding, so a
+        # sharded pool falls back to the einsum oracle and says so.
+        self._attn_kernel = F.resolve_attn_kernel(cfg.attn_kernel)
+        if self._attn_kernel == "pallas":
+            from ..ops.pallas import paged_attention as _pa_kernel
+
+            if self._mp_degree > 1 or not _pa_kernel.available():
+                self._attn_kernel = "einsum"
+                _obs.inc("attn_kernel_fallback_total")
+        _obs.set_gauge("attn_kernel_active",
+                       1.0 if self._attn_kernel == "pallas" else 0.0)
+        # einsum + int8 materializes both dequantized [N, Hkv, P, D] f32
+        # pools per layer per step; the fused path never does — account
+        # the avoided traffic per decode/verify step
+        self._fused_dequant_bytes_step = (
+            2 * ad.num_layers * self._num_pages * ad.num_kv_heads
+            * cfg.page_size * ad.head_dim * 4
+            if self._attn_kernel == "pallas" and self._int8 else 0)
+        try:  # price the choice in the auto-planner's cost model
+            from ..distributed.auto_parallel.planner import plan_attn_kernel
+
+            plan_attn_kernel(
+                num_slots=cfg.num_slots, max_pages=self._mp,
+                kv_heads=ad.num_kv_heads, query_heads=ad.num_heads,
+                page_size=cfg.page_size, head_dim=ad.head_dim,
+                layers=ad.num_layers, kv_dtype=cfg.kv_dtype,
+                selected=self._attn_kernel)
+        except Exception:  # noqa: BLE001 — pricing never gates serving
+            pass
         shape = (ad.num_layers, self._num_pages, ad.num_kv_heads,
                  cfg.page_size, ad.head_dim)
         self._kc = jnp.zeros(shape, store)
@@ -791,6 +830,9 @@ class DecodeEngine:
         nxt_host = np.asarray(nxt)  # the per-token host transfer: [S] int32
         dt = time.perf_counter() - t0
         _obs.observe("serving_decode_step_seconds", dt)
+        if self._fused_dequant_bytes_step:
+            _obs.inc("attn_kernel_fused_dequant_bytes_total",
+                     self._fused_dequant_bytes_step)
         if warm:  # a compile-laden first step would poison the estimate
             self._t_decode_ema = self._ema(self._t_decode_ema, dt)
         self._steps_since_probe += 1
@@ -843,6 +885,9 @@ class DecodeEngine:
         targets_host = np.asarray(targets)  # [S, k+1] int32
         dt = time.perf_counter() - t0
         _obs.observe("serving_decode_step_seconds", dt)
+        if self._fused_dequant_bytes_step:
+            _obs.inc("attn_kernel_fused_dequant_bytes_total",
+                     self._fused_dequant_bytes_step)
         if warm:
             self._t_verify_ema = self._ema(self._t_verify_ema, dt)
         self._steps_since_probe = 0
@@ -1052,6 +1097,7 @@ class DecodeEngine:
             "spec_accepted": self.spec_accepted,
             "admission_waits": self.admission_waits,
             "admission_wait_s": self.admission_wait_s,
+            "attn_kernel": self._attn_kernel,
         }
 
     def occupancy(self) -> dict:
@@ -1399,7 +1445,8 @@ class DecodeEngine:
             _obs.record_span(
                 "srv_prefill", trace_id=req.trace_id,
                 parent_id=req.trace_parent, dur_s=req.prefill_s,
-                rid=req.req_id, bucket=int(tb), cached_len=int(cached_len))
+                rid=req.req_id, bucket=int(tb), cached_len=int(cached_len),
+                kernel=self._attn_kernel)
         req.slot = slot
         req.status = "running"
         self._running[slot] = req
@@ -1435,7 +1482,7 @@ class DecodeEngine:
                 "srv_decode", trace_id=req.trace_id,
                 parent_id=req.trace_parent, dur_s=decode_s,
                 rid=req.req_id, steps=req.decode_steps_n,
-                tokens=len(req.tokens))
+                tokens=len(req.tokens), kernel=self._attn_kernel)
             if req.verify_steps_n:
                 # the speculative share of the decode window, parented to
                 # the srv_decode span it partitions
@@ -1541,6 +1588,7 @@ class DecodeEngine:
             "adapter": type(self.adapter).__name__,
             "logit_wire": self._logit_wire,
             "logit_verify": self._logit_verify,
+            "attn_kernel": self._attn_kernel,
         }
 
     # -- compiled programs --------------------------------------------------
@@ -1576,6 +1624,26 @@ class DecodeEngine:
             exact = None
         return wl, exact, wl
 
+    def _attend(self, q, kc, vc, ksc, vsc, l, tables, positions):
+        """One layer of paged attention on the resolved kernel. The fused
+        Pallas path hands the kernel the STORED pool slices — plus the
+        absmax scale slabs when int8, so dequant happens against the
+        VMEM-resident page inside the kernel; the einsum oracle
+        dequantizes the layer's pool up front (``_layer_kv``). The
+        kernel is pinned explicitly so an ambient PADDLE_TPU_ATTN_KERNEL
+        cannot diverge a program from the engine's resolved (and
+        AOT-cache-keyed) choice."""
+        if self._attn_kernel == "pallas":
+            return F.paged_attention(
+                q, kc[l], vc[l], tables, positions,
+                k_scales=None if ksc is None else ksc[l],
+                v_scales=None if vsc is None else vsc[l],
+                kernel="pallas")
+        return F.paged_attention(
+            q, _layer_kv(kc, ksc, l, self._int8),
+            _layer_kv(vc, vsc, l, self._int8), tables, positions,
+            kernel="einsum")
+
     def _build_prefill(self, tb: int):
         ad, state, int8 = self.adapter, self._state, self._int8
         layers = ad.num_layers
@@ -1601,9 +1669,8 @@ class DecodeEngine:
                         vc, vsc = _block_page_write(
                             vc, vsc, l, _shard_kv_heads(raw(v)), row,
                             cached_len, true_len, int8, psz)
-                        o = F.paged_attention(
-                            q, _layer_kv(kc, ksc, l, int8),
-                            _layer_kv(vc, vsc, l, int8), table, start)
+                        o = self._attend(q, kc, vc, ksc, vsc, l, table,
+                                         start)
                         x = x + ad.attn_out(l, o)
                         x = x + ad.mlp(l, x)
                     x = ad.final_norm(x)
@@ -1656,9 +1723,8 @@ class DecodeEngine:
                         vc, vsc = _token_page_write(
                             vc, vsc, l, _shard_kv_heads(raw(v)), tables,
                             pos2, int8, psz)
-                        o = F.paged_attention(
-                            q, _layer_kv(kc, ksc, l, int8),
-                            _layer_kv(vc, vsc, l, int8), tables, positions)
+                        o = self._attend(q, kc, vc, ksc, vsc, l, tables,
+                                         positions)
                         x = x + ad.attn_out(l, o)
                         x = x + ad.mlp(l, x)
                     x = ad.final_norm(x)
@@ -1705,9 +1771,8 @@ class DecodeEngine:
                         vc, vsc = _token_page_write(
                             vc, vsc, l, _shard_kv_heads(raw(v)), tables,
                             pos2, int8, psz)
-                        o = F.paged_attention(
-                            q, _layer_kv(kc, ksc, l, int8),
-                            _layer_kv(vc, vsc, l, int8), tables, positions)
+                        o = self._attend(q, kc, vc, ksc, vsc, l, tables,
+                                         positions)
                         x = x + ad.attn_out(l, o)
                         x = x + ad.mlp(l, x)
                     x = ad.final_norm(x)

@@ -15,6 +15,15 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.op import defop, raw
+from ...ops.pallas.paged_attention import mask_fill_value
+
+#: masked-logit fill for the f32 decode/paged logits, shared with the
+#: Pallas kernel (ops/pallas/paged_attention.py) so masked-key semantics
+#: cannot drift between the oracle and the fused path
+_MASK_FILL = mask_fill_value(jnp.float32)
+
+#: accepted values for the paged-attention kernel knob
+ATTN_KERNELS = ("auto", "pallas", "einsum")
 
 _USE_PALLAS = True
 _PALLAS_PROBE: dict = {}  # backend name -> bool (Mosaic compile probe result)
@@ -229,7 +238,7 @@ def _decode_attention_op(q, ck, cv, cache_position, scale):
     logits = jnp.einsum("shgd,shtd->shgt", qf, ck.astype(jnp.float32)) * sc
     mask = jnp.arange(t)[None, None, None, :] \
         <= cache_position[:, None, None, None]
-    logits = jnp.where(mask, logits, -1e30)
+    logits = jnp.where(mask, logits, _MASK_FILL)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("shgt,shtd->shgd", probs, cv.astype(jnp.float32))
     out = out.reshape(s_, 1, h, d).astype(q.dtype)
@@ -247,8 +256,51 @@ def decode_attention(query, cache_k, cache_v, cache_position, scale=None,
                                 scale)
 
 
+def resolve_attn_kernel(kernel=None) -> str:
+    """Resolve the paged-attention kernel knob to ``'pallas'`` or
+    ``'einsum'``.
+
+    Precedence: explicit ``kernel`` arg (engine config) >
+    ``PADDLE_TPU_ATTN_KERNEL`` env > ``'auto'``. ``auto`` routes to the
+    fused Pallas kernel on a real TPU backend and to the einsum oracle
+    everywhere else — off-TPU the kernel runs in Pallas interpret mode,
+    orders of magnitude slower than the fused XLA einsum path.
+    ``PADDLE_TPU_PALLAS_INTERPRET=1`` (the kernel-routing test hook)
+    makes ``auto`` pick the kernel in interpret mode.
+    """
+    mode = str(kernel or os.environ.get("PADDLE_TPU_ATTN_KERNEL")
+               or "auto").lower()
+    if mode not in ATTN_KERNELS:
+        raise ValueError(
+            f"unknown attention kernel {mode!r}; expected one of "
+            f"{ATTN_KERNELS} (PADDLE_TPU_ATTN_KERNEL / engine attn_kernel)")
+    if mode != "auto":
+        return mode
+    if os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1":
+        return "pallas"
+    return "pallas" if jax.default_backend() == "tpu" else "einsum"
+
+
+@defop(amp="white", name="paged_attention_pallas_op")
+def _paged_attention_pallas_op(q, pk, pv, k_scales, v_scales, page_table,
+                               start_position, scale):
+    """Fused-kernel twin of :func:`_paged_attention_op`: the pool streams
+    HBM→VMEM at its stored dtype (int8 dequant fused against the absmax
+    scales inside the kernel) and the softmax runs online — no gathered
+    f32 K/V and no dense logits tensor in HBM. Oracle contract: greedy
+    argmax bit-equal to the einsum op, raw outputs within f32 tolerance
+    (tests/test_pallas_attention.py)."""
+    from ...ops.pallas import paged_attention as _pa
+
+    out = _pa.paged_attention(
+        q, pk, pv, page_table, start_position, scale=scale,
+        k_scales=k_scales, v_scales=v_scales)
+    return out.astype(q.dtype)
+
+
 @defop(amp="white", name="paged_attention_op")
-def _paged_attention_op(q, pk, pv, page_table, start_position, scale):
+def _paged_attention_op(q, pk, pv, k_scales, v_scales, page_table,
+                        start_position, scale):
     """KV-cached attention through a block/page-granular cache.
 
     q: [S, T, H, D] — T new tokens per slot (T=1 decode, T=k+1 speculative
@@ -268,6 +320,11 @@ def _paged_attention_op(q, pk, pv, page_table, start_position, scale):
     group = h // hkv
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     mesh, mp_deg = _mp_degree_for(hkv)
+    if k_scales is not None:
+        # int8 absmax pool: the oracle dequantizes up front (the fused
+        # Pallas path instead multiplies per-page inside the kernel)
+        pk = pk.astype(jnp.float32) * k_scales[..., None]
+        pv = pv.astype(jnp.float32) * v_scales[..., None]
 
     def gather(pool):
         if mesh is not None:
@@ -285,7 +342,7 @@ def _paged_attention_op(q, pk, pv, page_table, start_position, scale):
     logits = jnp.einsum("sthgd,shkd->shgtk", qf, k) * sc
     qpos = start_position[:, None] + jnp.arange(t)[None, :]       # [S, T]
     mask = jnp.arange(mp * p)[None, None, :] <= qpos[:, :, None]  # [S, T, K]
-    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    logits = jnp.where(mask[:, None, None, :, :], logits, _MASK_FILL)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("shgtk,shkd->sthgd", probs, v)
     out = out.reshape(s_, t, h, d).astype(q.dtype)
@@ -293,7 +350,8 @@ def _paged_attention_op(q, pk, pv, page_table, start_position, scale):
 
 
 def paged_attention(query, pool_k, pool_v, page_table, start_position,
-                    scale=None, name=None):
+                    scale=None, k_scales=None, v_scales=None, kernel=None,
+                    name=None):
     """Multi-token KV-cached attention against a paged cache (the
     page-granular companion of :func:`decode_attention`; see
     docs/SERVING.md §paged cache). ``query`` [S, T, H, D]; ``pool_k/v``
@@ -301,9 +359,25 @@ def paged_attention(query, pool_k, pool_v, page_table, start_position,
     ``start_position`` [S] int32 (global position of each slot's first
     query row). Serves the decode step (T=1), the speculative verify
     step (T=k+1), and the prefix-cached tail prefill (S=1, T=bucket)
-    with ONE op."""
-    return _paged_attention_op(query, pool_k, pool_v, page_table,
-                               start_position, scale)
+    with ONE op.
+
+    ``k_scales``/``v_scales`` ([N, Hkv, page_size] f32, both or neither)
+    mark the pools as int8 absmax-quantized. ``kernel`` picks the
+    implementation (see :func:`resolve_attn_kernel`): the fused Pallas
+    kernel streams pages at their stored dtype with dequant fused in;
+    the einsum oracle dequantizes up front. An mp-sharded pool always
+    takes the einsum path — the GSPMD sharding annotations live there."""
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    choice = resolve_attn_kernel(kernel)
+    if choice == "pallas":
+        _, mp_deg = _mp_degree_for(pool_k.shape[1])
+        if mp_deg == 1:
+            return _paged_attention_pallas_op(
+                query, pool_k, pool_v, k_scales, v_scales, page_table,
+                start_position, scale)
+    return _paged_attention_op(query, pool_k, pool_v, k_scales, v_scales,
+                               page_table, start_position, scale)
 
 
 @defop(name="sparse_attention_op")
@@ -322,7 +396,7 @@ def _sparse_attention(q, k, v, offset, columns, key_padding_mask, attn_mask):
     mask = mask.at[bi, hi, row, columns].set(True)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(d, q.dtype))
-    neg = jnp.asarray(-1e30, logits.dtype)
+    neg = jnp.asarray(mask_fill_value(logits.dtype), logits.dtype)
     logits = jnp.where(mask, logits, neg)
     if key_padding_mask is not None:
         logits = jnp.where(key_padding_mask[:, None, None, :] != 0, logits, neg)

@@ -154,6 +154,22 @@ METRICS = {
         "histogram", "Bounded-backoff sleep taken when waiting requests "
                      "cannot be admitted (no free slot/pages) — replaces "
                      "the old hot-spin; each observation is one backoff"),
+    # -- attention kernel plane (inference/engine.py, docs/SERVING.md
+    #    §kernel plane; single-writer: the engine owns the resolution) ------
+    "attn_kernel_active": (
+        "gauge", "1.0 when the fused Pallas paged-attention kernel serves "
+                 "the engine's compiled programs, 0.0 on the einsum "
+                 "reference oracle (PADDLE_TPU_ATTN_KERNEL / "
+                 "EngineConfig.attn_kernel)"),
+    "attn_kernel_fused_dequant_bytes_total": (
+        "counter", "f32 bytes NEVER materialized because int8 KV dequant "
+                   "ran fused inside the Pallas kernel instead of as a "
+                   "per-layer pool pass (2 pools × layers × pool bytes "
+                   "per decode/verify step)"),
+    "attn_kernel_fallback_total": (
+        "counter", "Engine resolutions that asked for the Pallas kernel "
+                   "but fell back to the einsum oracle (mp-sharded pool, "
+                   "or pallas TPU support missing)"),
     # -- serving router (serving/router.py) ---------------------------------
     "serving_router_requests_total": (
         "counter", "Requests submitted to the multi-engine router"),
@@ -390,11 +406,13 @@ SPANS = {
     "srv_prefill": (
         "paddle_tpu/inference/engine.py",
         "Bucketed prompt prefill that produced the first token (attrs: "
-        "bucket, cached_len; includes compile on a cold bucket)"),
+        "bucket, cached_len, kernel — the resolved attention kernel; "
+        "includes compile on a cold bucket)"),
     "srv_decode": (
         "paddle_tpu/inference/engine.py",
         "The request's decode window: first batched step it joined "
-        "through its finish (attrs: steps, tokens)"),
+        "through its finish (attrs: steps, tokens, kernel — the resolved "
+        "attention kernel)"),
     "srv_verify": (
         "paddle_tpu/inference/engine.py",
         "Speculative share of the decode window, child of srv_decode "

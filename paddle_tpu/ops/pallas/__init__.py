@@ -1,1 +1,2 @@
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401

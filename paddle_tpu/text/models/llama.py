@@ -341,24 +341,21 @@ def _cache_write(cache, kv, pos):
     return jax.lax.dynamic_update_slice_in_dim(cache, kv.astype(cache.dtype), pos, 1)
 
 
-@defop(name="decode_attention")
 def _decode_attention(q, ck, cv, pos):
     """One-step attention against the cache: q [B, 1, H, D] over
-    ck/cv [B, Tmax, Hkv, D], positions > pos masked out."""
-    import jax
+    ck/cv [B, Tmax, Hkv, D], positions > pos masked out.
+
+    Thin adapter over ``F.decode_attention`` — the single decode-shape
+    reference oracle (nn/functional/attention.py, GQA-native: no head
+    replication): swap the cache to its [B, Hkv, Tmax, D] layout and
+    broadcast the scalar position per slot. The head grouping (query
+    head h -> kv head h // group) is identical on both sides."""
     import jax.numpy as jnp
 
-    b, _, hq, d = q.shape
-    tmax, hkv = ck.shape[1], ck.shape[2]
-    group = hq // hkv
-    k = jnp.repeat(ck, group, axis=2)
-    v = jnp.repeat(cv, group, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(d)
-    mask = jnp.arange(tmax)[None, None, None, :] <= pos
-    s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+    b = raw(q).shape[0]
+    ckt = jnp.swapaxes(raw(ck), 1, 2)  # [B, Hkv, Tmax, D]
+    cvt = jnp.swapaxes(raw(cv), 1, 2)
+    return F.decode_attention(q, ckt, cvt, jnp.full((b,), pos, jnp.int32))
 
 
 def _attn_prefill(attn: "LlamaAttention", x, cache):

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Paged-attention kernel A/B: fused Pallas kernel vs the einsum oracle.
+
+Sweeps the decode-hot-loop shape grid — page_size x GQA group x
+int8/raw KV x T in {1, k+1} (decode / speculative verify) — through
+``F.paged_attention(kernel="einsum")`` and ``kernel="pallas"`` and
+writes BENCH_ATTENTION.json. Every cell asserts the kernel contract
+(docs/SERVING.md §kernel plane): f32 outputs within tolerance and
+greedy argmax BIT-EQUAL against the oracle.
+
+Off-TPU the Pallas kernel runs in interpret mode — a correctness
+vehicle, not a fast path — so CPU wall-times are reported but NOT
+gated. The per-cell analytic HBM traffic from the auto-planner
+(``plan_attn_kernel``) is recorded alongside: that is the number that
+justifies the kernel on real hardware (int8 pages streamed at 1 byte/
+elem with dequant fused vs the oracle's materialized f32 pool + the
+gather round-trip).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_attention_kernels.py
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _case(rng, *, s, t, hkv, group, page_size, max_pages, d, int8):
+    import numpy as np
+
+    h = hkv * group
+    n = 1 + s * max_pages  # page 0 reserved as the trash page
+    q = rng.standard_normal((s, t, h, d)).astype(np.float32)
+    ctx = rng.integers(t, max_pages * page_size + 1, size=s)
+    start = (ctx - t).astype(np.int32)
+    table = np.zeros((s, max_pages), np.int32)
+    perm = rng.permutation(np.arange(1, n))
+    nxt = 0
+    for i in range(s):
+        used = -(-int(ctx[i]) // page_size)
+        table[i, :used] = perm[nxt:nxt + used]
+        nxt += used
+    if int8:
+        kp = rng.integers(-127, 128, (n, hkv, page_size, d)).astype(np.int8)
+        vp = rng.integers(-127, 128, (n, hkv, page_size, d)).astype(np.int8)
+        ks = rng.uniform(0.005, 0.03, (n, hkv, page_size)).astype(np.float32)
+        vs = rng.uniform(0.005, 0.03, (n, hkv, page_size)).astype(np.float32)
+    else:
+        kp = rng.standard_normal((n, hkv, page_size, d)).astype(np.float32)
+        vp = rng.standard_normal((n, hkv, page_size, d)).astype(np.float32)
+        ks = vs = None
+    return q, kp, vp, ks, vs, table, start
+
+
+def bench_cell(args, *, page_size, group, int8, t):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.auto_parallel.planner import plan_attn_kernel
+    from paddle_tpu.framework.op import raw
+
+    rng = np.random.default_rng(
+        args.seed + page_size * 100 + group * 10 + int8 * 5 + t)
+    q, kp, vp, ks, vs, table, start = _case(
+        rng, s=args.slots, t=t, hkv=args.kv_heads, group=group,
+        page_size=page_size, max_pages=args.max_pages, d=args.head_dim,
+        int8=int8)
+    jargs = [jnp.asarray(a) for a in (q, kp, vp, table, start)]
+    jks = None if ks is None else jnp.asarray(ks)
+    jvs = None if vs is None else jnp.asarray(vs)
+
+    def make(kernel):
+        def f(q_, kp_, vp_, tb, sp):
+            return raw(F.paged_attention(q_, kp_, vp_, tb, sp,
+                                         k_scales=jks, v_scales=jvs,
+                                         kernel=kernel))
+        return jax.jit(f)
+
+    def timed(fn):
+        out = np.asarray(fn(*jargs))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            fn(*jargs)[0].block_until_ready()
+        return out, (time.perf_counter() - t0) / args.iters
+
+    ref, einsum_s = timed(make("einsum"))
+    got, pallas_s = timed(make("pallas"))
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-4)
+    bit_equal = bool((got.argmax(-1) == ref.argmax(-1)).all())
+    if not bit_equal:
+        raise SystemExit(
+            f"FAIL: greedy argmax diverged at page_size={page_size} "
+            f"group={group} int8={int8} t={t}")
+    plan = plan_attn_kernel(
+        num_slots=args.slots, max_pages=args.max_pages,
+        kv_heads=args.kv_heads, query_heads=args.kv_heads * group,
+        page_size=page_size, head_dim=args.head_dim, layers=args.layers,
+        kv_dtype="int8" if int8 else "f32", t=t)
+    return {
+        "page_size": page_size,
+        "gqa_group": group,
+        "kv_dtype": "int8" if int8 else "f32",
+        "t": t,
+        "einsum_seconds": round(einsum_s, 6),
+        "pallas_interpret_seconds": round(pallas_s, 6),
+        "max_abs_diff": float(np.abs(got - ref).max()),
+        "greedy_argmax_bit_equal": bit_equal,
+        "planner": plan.to_dict(),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--head-dim", type=int, default=16)
+    ap.add_argument("--max-pages", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2,
+                    help="layer count the planner prices (the functional "
+                    "A/B runs one layer slice)")
+    ap.add_argument("--speculate-k", type=int, default=3,
+                    help="verify rows T = k+1 in the sweep")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_ATTENTION.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    cells = []
+    for page_size in (8, 16):
+        for group in (1, 4):
+            for int8 in (False, True):
+                for t in (1, args.speculate_k + 1):
+                    print(f"cell page_size={page_size} group={group} "
+                          f"int8={int8} t={t}...", file=sys.stderr)
+                    cells.append(bench_cell(args, page_size=page_size,
+                                            group=group, int8=int8, t=t))
+    report = {
+        "backend": jax.default_backend(),
+        "pallas_mode": ("compiled" if jax.default_backend() == "tpu"
+                        else "interpret"),
+        "shape": {"slots": args.slots, "kv_heads": args.kv_heads,
+                  "head_dim": args.head_dim, "max_pages": args.max_pages,
+                  "planner_layers": args.layers},
+        "iters": args.iters,
+        "greedy_argmax_bit_equal": all(
+            c["greedy_argmax_bit_equal"] for c in cells),
+        "cells": cells,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

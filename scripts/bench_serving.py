@@ -206,6 +206,72 @@ def _cold_start_child(args):
     }))
 
 
+def run_attn_kernel(args):
+    """Kernel-selection A/B (docs/SERVING.md §kernel plane): the same
+    speculative paged workload through ``attn_kernel="einsum"`` and
+    ``attn_kernel="pallas"`` engines, f32 and int8 KV pools. Greedy
+    token streams must be BIT-EQUAL per pool dtype — that is the gate.
+    Off-TPU the Pallas kernel runs in interpret mode, so wall-times are
+    reported for the record but not gated (the HBM-traffic case for the
+    kernel is priced by the auto-planner and recorded in
+    BENCH_ATTENTION.json via scripts/bench_attention_kernels.py)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+
+    paddle.seed(args.seed)
+    model = build_model(args)
+    rng = np.random.default_rng(args.seed + 3)
+    prompts = [rng.integers(1, args.vocab, n, dtype=np.int64)
+               for n in (6, 13, 21, 9, 17, 6)]
+    new_tokens = 10
+
+    def drain(eng):
+        rids = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        eng.run()
+        return [np.asarray(eng.result(r)) for r in rids]
+
+    def timed(kernel, kv_dtype):
+        eng = DecodeEngine(model, EngineConfig(
+            num_slots=4, max_length=64, page_size=args.page_size,
+            speculate_k=args.speculate_k, spec_adaptive=False,
+            attn_kernel=kernel, kv_dtype=kv_dtype))
+        outs = drain(eng)  # compile + warm
+        t0 = time.perf_counter()
+        outs2 = drain(eng)
+        dt = time.perf_counter() - t0
+        for a, b in zip(outs, outs2):
+            np.testing.assert_array_equal(a, b)
+        emitted = sum(len(o) for o in outs)
+        return eng, outs, emitted / dt
+
+    block = {}
+    for kv_dtype, key in (("f32", "f32"), ("int8", "int8")):
+        ref_eng, ref, ref_tps = timed("einsum", kv_dtype)
+        eng, got, tps = timed("pallas", kv_dtype)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"pallas kernel diverged from the einsum "
+                f"oracle on the {kv_dtype} pool")
+        assert eng.stats()["attn_kernel"] == "pallas", eng.stats()
+        block[key] = {
+            "einsum_tokens_per_second": round(ref_tps, 2),
+            "pallas_tokens_per_second": round(tps, 2),
+            "greedy_bit_equal": True,
+            "verify_steps": eng.stats()["verify_steps"],
+            "fused_dequant_bytes_per_step":
+                eng._fused_dequant_bytes_step,
+        }
+    import jax
+
+    block["pallas_mode"] = ("compiled" if jax.default_backend() == "tpu"
+                            else "interpret")
+    block["requests"] = len(prompts)
+    block["new_tokens_per_request"] = new_tokens
+    return block
+
+
 def run_cold_start(args):
     """Cold-start scenario: the same fresh-process engine bring-up three
     times — no compile cache, cold cache (populates it), warm cache (a
@@ -1054,6 +1120,12 @@ def main(argv=None):
                          "existing BENCH_SERVING.json")
     ap.add_argument("--skip-logit-wire", action="store_true",
                     help="skip the logit-wire scenario in the full run")
+    ap.add_argument("--attn-kernel-only", action="store_true",
+                    help="run only the attention kernel-selection A/B "
+                    "(einsum oracle vs fused Pallas kernel, f32 + int8 "
+                    "pools, greedy bit-equal gate)")
+    ap.add_argument("--skip-attn-kernel", action="store_true",
+                    help="skip the attention-kernel A/B in the full run")
     ap.add_argument("--cold-start-only", action="store_true",
                     help="run only the fresh-process cold-start scenario "
                          "(warm vs cold AOT compile cache) and merge the "
@@ -1107,6 +1179,18 @@ def main(argv=None):
             f.write("\n")
         print(json.dumps({"live_plane": block}, indent=2))
         return _gate_live_plane(args, block)
+    if args.attn_kernel_only:
+        block = run_attn_kernel(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["attn_kernel"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"attn_kernel": block}, indent=2))
+        return 0
     if args.cold_start_only:
         block = run_cold_start(args)
         report = {}
@@ -1212,6 +1296,8 @@ def main(argv=None):
     }
     inference.disable_decode_engine(model)
     report["churn"] = run_churn(args, model)
+    if not args.skip_attn_kernel:
+        report["attn_kernel"] = run_attn_kernel(args)
     if not args.skip_logit_wire:
         report["logit_wire"] = run_logit_wire(args)
     if not args.skip_cold_start:

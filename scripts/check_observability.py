@@ -87,6 +87,7 @@ OWNED_PREFIXES = {
     "serving_router_": os.path.join("paddle_tpu", "serving", "router.py"),
     "serving_transport_": os.path.join("paddle_tpu", "serving",
                                        "transport.py"),
+    "attn_kernel_": os.path.join("paddle_tpu", "inference", "engine.py"),
     "reshard_": os.path.join("paddle_tpu", "distributed", "reshard.py"),
     "pp_": os.path.join("paddle_tpu", "distributed", "fleet",
                         "meta_parallel", "pipeline_parallel.py"),

@@ -43,6 +43,13 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
      Convention: boundary channel objects are named ``*chan*``
      (``_chan``, ``up_chan``, ``server_chan``); nothing else may use
      those names.
+  7. Pallas call sites without an interpret-mode fallback — in
+     ``paddle_tpu/ops/pallas`` every ``pl.pallas_call(...)`` must pass an
+     ``interpret=`` keyword: the kernel plane's contract is that tier-1
+     runs everywhere (docs/SERVING.md §kernel plane), and a call site
+     that hardcodes compiled mode silently breaks every CPU run the
+     moment it is reached. The keyword's VALUE is the author's choice
+     (typically ``backend != "tpu"``); declaring it is not.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -96,6 +103,11 @@ GUARDED_CHAN_FILES = [
 
 #: channel methods that block on (or feed) the inter-stage wire
 CHAN_OPS = {"send", "poll", "recv"}
+
+#: directories whose pallas_call sites must declare interpret= (rule 7)
+PALLAS_DIRS = [
+    os.path.join("paddle_tpu", "ops", "pallas"),
+]
 
 
 def _py_files(root):
@@ -316,6 +328,40 @@ def check_guarded_chan_ops(path: str):
                    "MPMD path)")
 
 
+def check_pallas_interpret(path: str):
+    """Yield (line, message) for ``pallas_call`` sites that do not declare
+    an ``interpret=`` keyword (rule 7). Matches bare ``pallas_call(...)``
+    and any attribute form (``pl.pallas_call``); a ``**kwargs`` splat
+    does NOT count — the fallback must be visible at the call site."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute) else None)
+        if name != "pallas_call":
+            continue
+        if not any(kw.arg == "interpret" for kw in node.keywords):
+            yield (node.lineno,
+                   "pallas_call without an explicit interpret= keyword — "
+                   "every kernel-plane call site must declare its "
+                   "interpret-mode CPU fallback (rule 7)")
+
+
+def _pallas_files(root):
+    for d in PALLAS_DIRS:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
 def main(argv=None):
     root = (argv or sys.argv[1:] or [REPO])[0]
     violations = []
@@ -346,6 +392,10 @@ def main(argv=None):
         if not os.path.isfile(path):
             continue
         for line, msg in check_guarded_chan_ops(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for path in _pallas_files(root):
+        rel = os.path.relpath(path, root)
+        for line, msg in check_pallas_interpret(path):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

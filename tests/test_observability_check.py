@@ -462,3 +462,30 @@ def test_rule5_prefixes_and_classes_registered():
     # loaded from serving/protocol.py, the single source of truth
     assert check_observability.SLO_CLASSES == \
         frozenset({"batch", "standard", "interactive"})
+
+
+_ATTN_KERNEL_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.set_gauge("attn_kernel_active", 1.0)
+        _obs.inc("attn_kernel_fused_dequant_bytes_total", 4096)
+        _obs.inc("attn_kernel_fallback_total")
+"""
+
+
+def test_attn_kernel_metrics_from_wrong_file_rejected(tmp_path):
+    # the attn_kernel_* family is single-writer: only the engine, which
+    # resolves the kernel choice, may record it — a bench script writing
+    # the same names would fork the series' meaning
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_ATTN_KERNEL_SRC))
+    rel = os.path.join("paddle_tpu", "serving", "worker.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 3 and all("single-writer" in m for _, m in v)
+
+
+def test_attn_kernel_metrics_from_engine_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_ATTN_KERNEL_SRC))
+    rel = os.path.join("paddle_tpu", "inference", "engine.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))

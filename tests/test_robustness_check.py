@@ -269,3 +269,46 @@ def test_live_mpmd_module_is_guarded():
         target = os.path.join(REPO, rel)
         assert os.path.isfile(target), rel
         assert not list(check_robustness.check_guarded_chan_ops(target)), rel
+
+
+def _pallas_violations(tmp_path, src):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_pallas_interpret(str(f)))
+
+
+def test_pallas_call_without_interpret_rejected(tmp_path):
+    v = _pallas_violations(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+        def run(kernel, x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+    """)
+    assert len(v) == 1 and "interpret" in v[0][1]
+
+
+def test_pallas_call_with_interpret_allowed(tmp_path):
+    assert not _pallas_violations(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+        def run(kernel, x, interpret):
+            return pl.pallas_call(kernel, out_shape=x,
+                                  interpret=interpret)(x)
+    """)
+
+
+def test_pallas_kwargs_splat_not_sufficient(tmp_path):
+    # the fallback must be VISIBLE at the call site, not hidden in **kw
+    v = _pallas_violations(tmp_path, """
+        from jax.experimental import pallas as pl
+        def run(kernel, x, **kw):
+            return pl.pallas_call(kernel, out_shape=x, **kw)(x)
+    """)
+    assert len(v) == 1
+
+
+def test_live_pallas_plane_declares_interpret():
+    files = list(check_robustness._pallas_files(REPO))
+    assert files, "kernel plane missing"
+    for path in files:
+        assert not list(check_robustness.check_pallas_interpret(path)), path
