@@ -311,6 +311,28 @@ METRICS = {
         "gauge", "Windowed error-budget burn rate vs the declared "
                  "objective (labels: slo, objective=latency|availability; "
                  "1.0 = budget consumed exactly as fast as it accrues)"),
+    # -- fleet supervisor (distributed/fleet/supervisor.py) ------------------
+    # Single-writer family: supervisor_* may only be recorded from the
+    # supervisor module (static gate), the way live_*/slo_* are owned.
+    "supervisor_flips_total": (
+        "counter", "Committed role flips executed by the fleet supervisor "
+                   "(labels: direction = to_training|to_serving; "
+                   "roll-forward recoveries count — the commit fence was "
+                   "journaled)"),
+    "supervisor_flip_duration_seconds": (
+        "histogram", "Wall time of one committed flip transaction, plan "
+                     "fence through finalize (drain wait included)"),
+    "supervisor_rollbacks_total": (
+        "counter", "Flip transactions rolled back — an executor failure "
+                   "before the commit fence, or crash recovery of a "
+                   "pre-commit journal"),
+    "supervisor_fleet_roles": (
+        "gauge", "Fleet inventory by role from the durable roles doc "
+                 "(labels: role = serving|training)"),
+    "supervisor_breaker_open": (
+        "gauge", "1.0 while the flip-storm circuit breaker is open "
+                 "(too many commits inside the breaker window; the "
+                 "supervisor only observes until it cools)"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
@@ -349,6 +371,11 @@ EVENTS = {
     "mpmd_stage_resize",  # one MPMD stage changed width (old/new dp)
     "elastic_stage_resize",  # per-stage live resize moved a stage's leaves
     "slo_burn",           # windowed burn rate crossed 1.0 (live plane)
+    "flip_commit",        # supervisor committed a role flip (or rolled one
+                          # forward in crash recovery)
+    "flip_rollback",      # supervisor rolled a flip back (pre-commit
+                          # failure or crash recovery)
+    "supervisor_breaker",  # flip-storm circuit breaker opened
     "rank_straggler",     # step-time EWMA z-score flagged a rank (live plane)
     "stage_imbalance",    # MPMD busy/idle spread crossed threshold (live)
 }
@@ -452,6 +479,12 @@ SPANS = {
         "One MPMD pipelined train step: stage runners start through grad "
         "scatter (attrs: step, stages, microbatches, schedule, "
         "transport, wire)"),
+    "flip": (
+        "paddle_tpu/distributed/fleet/supervisor.py",
+        "One supervisor role-flip transaction, plan fence through "
+        "finalize/rollback (attrs: id, direction, engine, outcome); "
+        "trace_report attributes flip wall time against the drain/"
+        "resize it covers"),
 }
 
 

@@ -44,6 +44,8 @@ Key schema (all under one namespace, default ``__srv``)::
                           (written BEFORE the occupancy ack, so failover
                           can harvest finished work from a dead engine)
     {ns}/ctl              router shutdown broadcast
+    {ns}/ctl/{name}       per-engine control record (fleet supervisor
+                          drain/resume orders for role flips)
 
 Values are pickled python dicts (``pack``/``unpack``): the store is a
 trusted same-job coordination plane, exactly like the launch rendezvous
@@ -121,6 +123,15 @@ def k_done(ns: str, rid: int) -> str:
 
 def k_ctl(ns: str) -> str:
     return f"{ns}/ctl"
+
+
+def k_ctl_engine(ns: str, name: str) -> str:
+    """Per-engine control record (fleet supervisor drain/resume orders).
+    A worker polls it at the slow ctl cadence; ``{"drain": True}`` makes
+    it stop admitting new dispatches while finishing in-flight work (its
+    occupancy beat then advertises ``draining``/``drained`` so the
+    router and the supervisor can watch the drain converge)."""
+    return f"{ns}/ctl/{name}"
 
 
 def pack(obj) -> bytes:

@@ -177,6 +177,12 @@ class _EngineState:
         return self.record.get("role", "unified")
 
     @property
+    def draining(self) -> bool:
+        """Fleet-supervisor drain order in effect (engine-advertised via
+        its occupancy beat): finish in-flight work, place nothing new."""
+        return bool(self.occ.get("draining"))
+
+    @property
     def addr(self) -> Optional[str]:
         return self.record.get("addr")
 
@@ -423,44 +429,66 @@ class Router:
             _obs.event("serving_router_engine_dead", name=est.name,
                        inflight=len(est.inflight))
             _obs.set_gauge("serving_router_engines", self._alive_count())
-            # harvest everything the dead engine already finished (done
-            # keys are written before the ack), then resubmit the rest to
-            # the FRONT of their class queues so failover does not add
-            # queueing delay on top of the rerun
-            resubmit = []
-            for rid, req in list(est.inflight.items()):
-                with deadline_guard("harvest results"):
-                    finished = self._store.check(k_done(self._ns, rid))
-                if finished:
-                    self._finish_from_store(req)
-                else:
-                    resubmit.append(req)
-            est.inflight.clear()
-            for req in reversed(resubmit):
-                # a disaggregated request dies with EITHER of its engines:
-                # drop it from the partner's book too and rerun from
-                # scratch (fresh prefill — bit-equal, the seed is explicit)
-                self._resolve_inflight(req.rid)
-                req.status = "queued"
-                req.engine = None
-                req.seq = -1
-                req.kv_from = None
-                req.wire_engine = None
-                req.wire_rec = None
-                req.resubmits += 1
-                self._queues[req.slo].appendleft(req)
-                self.counters["failover_resubmits"] += 1
-                _obs.inc("serving_router_failover_total")
-                _obs.event("serving_router_failover", rid=req.rid,
-                           engine=est.name, slo=req.slo)
-                t = self._tspans.get(req.rid)
-                if t:
-                    # retry-flagged child under the SAME root: the window
-                    # from declared-dead through this request's redispatch
-                    t["retry"] = _obs.start_span(
-                        "srv_retry", trace_id=t["root"].trace_id,
-                        parent_id=t["root"].span_id, retry=True,
-                        engine=est.name, resubmit=req.resubmits)
+            self._reassign_inflight(est, why="dead")
+
+    def _reassign_inflight(self, est: _EngineState, why: str) -> int:
+        """Harvest everything the engine already finished (done keys are
+        written before the ack), then resubmit the rest to the FRONT of
+        their class queues so failover does not add queueing delay on
+        top of the rerun. Shared by dead-engine failover and supervisor
+        drain-timeout evacuation; returns how many were resubmitted."""
+        resubmit = []
+        for rid, req in list(est.inflight.items()):
+            with deadline_guard("harvest results"):
+                finished = self._store.check(k_done(self._ns, rid))
+            if finished:
+                self._finish_from_store(req)
+            else:
+                resubmit.append(req)
+        est.inflight.clear()
+        for req in reversed(resubmit):
+            # a disaggregated request dies with EITHER of its engines:
+            # drop it from the partner's book too and rerun from
+            # scratch (fresh prefill — bit-equal, the seed is explicit)
+            self._resolve_inflight(req.rid)
+            req.status = "queued"
+            req.engine = None
+            req.seq = -1
+            req.kv_from = None
+            req.wire_engine = None
+            req.wire_rec = None
+            req.resubmits += 1
+            self._queues[req.slo].appendleft(req)
+            self.counters["failover_resubmits"] += 1
+            _obs.inc("serving_router_failover_total")
+            _obs.event("serving_router_failover", rid=req.rid,
+                       engine=est.name, slo=req.slo, why=why)
+            t = self._tspans.get(req.rid)
+            if t:
+                # retry-flagged child under the SAME root: the window
+                # from declared-dead through this request's redispatch
+                t["retry"] = _obs.start_span(
+                    "srv_retry", trace_id=t["root"].trace_id,
+                    parent_id=t["root"].span_id, retry=True,
+                    engine=est.name, resubmit=req.resubmits)
+        return len(resubmit)
+
+    def evacuate(self, name: str) -> int:
+        """Hand a LIVE engine's in-flight requests off to the rest of
+        the fleet — the fleet supervisor's drain-timeout escape hatch.
+        The engine is not declared dead: it stays registered (its drain
+        order already excludes it from placement). Reruns are bit-equal
+        (router-assigned seeds), and a rid the drained engine still
+        finishes is harmless — done records are keyed by rid, so the
+        first finish wins and the duplicate write is identical."""
+        est = self._engines.get(name)
+        if est is None or not est.alive:
+            return 0
+        # adopt the drain locally: a wedged worker never refreshes its
+        # occupancy beat, and waiting for one would re-place the
+        # evacuated work right back on the engine being evacuated
+        est.occ = dict(est.occ, draining=True)
+        return self._reassign_inflight(est, why="evacuate")
 
     # -- results -------------------------------------------------------------
 
@@ -557,6 +585,7 @@ class Router:
         capacity. Prefill-role workers never decode and are excluded."""
         candidates = [e for e in self._engines.values()
                       if e.alive and e.role != "prefill"
+                      and not e.draining
                       and len(e.inflight) < self._engine_cap(e)]
         if not candidates:
             return None, False
@@ -593,6 +622,7 @@ class Router:
             return None
         candidates = [e for e in self._engines.values()
                       if e.alive and e.role == "prefill"
+                      and not e.draining
                       and len(e.inflight) < self._engine_cap(e)]
         if not candidates:
             return None

@@ -55,8 +55,9 @@ from .. import observability as _obs
 from ..observability import live as _live
 from ..inference.engine import DecodeEngine, EngineConfig, SamplingParams
 from ..testing import chaos
-from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl, k_done,
-                       k_engine, k_occ, k_req, k_count, pack, unpack)
+from .protocol import (DEFAULT_NAMESPACE, deadline_guard, k_ctl,
+                       k_ctl_engine, k_done, k_engine, k_occ, k_req,
+                       k_count, pack, unpack)
 from .transport import (SeqChannels, TransportClient, TransportServer,
                         decode_kv, encode_kv)
 
@@ -129,6 +130,13 @@ class EngineWorker:
         #: live-telemetry shipper, created lazily on the first beat with
         #: the plane enabled (one env lookup per beat when it is off)
         self._live_shipper: Optional[_live.LiveShipper] = None
+        #: fleet-supervisor drain order (per-engine ctl key): while True
+        #: the worker admits NO new dispatches but keeps stepping the
+        #: engine so in-flight requests finish; once idle its beat
+        #: advertises ``drained`` and the supervisor can flip its role
+        self.draining = False
+        self._idle = True
+        self._last_drain_ctl = -float("inf")
         self.publish_occupancy()
 
     # -- transport I/O ------------------------------------------------------
@@ -389,6 +397,8 @@ class EngineWorker:
         occ["name"] = self.name
         occ["role"] = self.role
         occ["prefill_queue"] = len(self._prefill_jobs)
+        occ["draining"] = self.draining
+        occ["drained"] = self.draining and self._idle
         self._send_routers({"t": "occ", "occ": occ, "ts": time.time()})
         # live-telemetry piggyback: the tele batch rides the SAME links at
         # the SAME cadence — no extra socket, no extra thread. Only collect
@@ -414,6 +424,26 @@ class EngineWorker:
             rec = unpack(self._store.get(ctl))
         return bool(rec.get("stop"))
 
+    def _check_drain_ctl(self):
+        """Adopt the fleet supervisor's drain/resume order for this
+        engine (per-engine ctl key), at the slow store-mirror cadence —
+        it is control plane, not request path. A beat is forced on every
+        EDGE so the router and the supervisor see the new ``draining``/
+        ``drained`` state within one mirror period."""
+        now = time.monotonic()
+        if now - self._last_drain_ctl < _STORE_MIRROR_S:
+            return
+        self._last_drain_ctl = now
+        key = k_ctl_engine(self._ns, self.name)
+        with deadline_guard("poll drain ctl"):
+            if not self._store.check(key):
+                return
+            rec = unpack(self._store.get(key))
+        want = bool(rec.get("drain"))
+        if want != self.draining:
+            self.draining = want
+            self.publish_occupancy(force_store=True)
+
     # -- scheduler ----------------------------------------------------------
 
     def poll_once(self) -> bool:
@@ -428,8 +458,14 @@ class EngineWorker:
         checks; an idle engine checks every poll so first dispatch lands
         fast. Returns True while the engine still holds work."""
         self._pump_transport()
+        self._check_drain_ctl()
         now = time.monotonic()
-        if not self._local_rid or now - self._last_drain >= 0.02:
+        if self.draining:
+            # drain order in effect: admit nothing new — undispatched
+            # seqs stay unconsumed for the router's evacuate/handoff;
+            # in-flight work below still runs to completion
+            pass
+        elif not self._local_rid or now - self._last_drain >= 0.02:
             self._last_drain = now
             self._drain_requests()
         if self.role == "prefill":
@@ -448,10 +484,12 @@ class EngineWorker:
             if rem > 0.0:
                 time.sleep(rem)
         published = self._publish_done()
+        working = (busy or bool(self._local_rid)
+                   or bool(self._prefill_jobs) or bool(self._kv_imports))
+        self._idle = not working
         if published or time.monotonic() - self._last_occ_pub >= 0.025:
             self.publish_occupancy(force_store=bool(published))
-        return (busy or bool(self._local_rid) or bool(self._prefill_jobs)
-                or bool(self._kv_imports))
+        return working
 
     def serve(self, poll_interval: float = 0.005,
               ctl_interval: float = 0.25):

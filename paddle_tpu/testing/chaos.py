@@ -44,6 +44,16 @@ Env knobs (all read lazily so tests can flip them per-case):
                                     fault fires before (serving/worker.py
                                     fences every scheduler step; default 0)
   PADDLE_CHAOS_ENGINE_LATENCY_MS=<ms>  sleep injected by the latency mode
+  PADDLE_CHAOS_FLIP_MODE=kill|latency
+  PADDLE_CHAOS_FLIP_AT=<fence>      which named supervisor flip fence the
+                                    fault fires at (fleet supervisor role
+                                    flips journal a fence before every
+                                    transition: plan|drain|quiesce|
+                                    resize|commit|finalize)
+  PADDLE_CHAOS_FLIP_SKIP=<n>        skip the first n matching flip fences
+                                    before firing (targets the n+1-th
+                                    flip of a run; default 0)
+  PADDLE_CHAOS_FLIP_LATENCY_MS=<ms> sleep injected by the latency mode
   PADDLE_CHAOS_NET_MODE=drop|half_open|latency
   PADDLE_CHAOS_NET_AT=<k>           which transport frame send the network
                                     fault fires at (serving/transport.py
@@ -115,9 +125,11 @@ def rng() -> random.Random:
 
 
 def reset() -> None:
-    """Drop cached rng state (tests flipping env knobs mid-process)."""
-    global _rng
+    """Drop cached rng/fence state (tests flipping env knobs
+    mid-process)."""
+    global _rng, _flip_fence_hits
     _rng = None
+    _flip_fence_hits = 0
 
 
 def _log(msg: str) -> None:
@@ -215,6 +227,51 @@ def mpmd_fence(stage: int, index: int) -> None:
     elif mode == "latency":
         ms = float(_env("PADDLE_CHAOS_MPMD_LATENCY_MS", "0"))
         _fault("mpmd_latency", stage=stage, index=index, ms=ms)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-supervisor flip faults (distributed/fleet/supervisor.py fences)
+# ---------------------------------------------------------------------------
+_flip_fence_hits = 0
+
+
+def flip_fence(fence: str) -> None:
+    """Fault point at a named supervisor flip-transition fence. The
+    supervisor journals each fence BEFORE calling this, so a kill here
+    leaves the flip journal durably recording exactly how far the
+    transaction got — the recovery contract (roll forward at/after
+    ``commit``, roll back before it) is what the soak exercises.
+
+    Fences are matched by NAME (``PADDLE_CHAOS_FLIP_AT``), not index:
+    plan | drain | quiesce | resize | commit | finalize.
+    ``PADDLE_CHAOS_FLIP_SKIP`` skips the first n matches so a soak can
+    target the same fence on a later flip (e.g. the to_serving leg).
+
+    kill    — SIGKILL at the matching fence; the relaunched supervisor
+              must recover a consistent fleet from the journal alone.
+    latency — sleep PADDLE_CHAOS_FLIP_LATENCY_MS at the matching fence,
+              exercising the flip deadline/drain-timeout guards.
+    """
+    global _flip_fence_hits
+    if not armed():
+        return
+    mode = _env("PADDLE_CHAOS_FLIP_MODE")
+    if mode is None:
+        return
+    if _env("PADDLE_CHAOS_FLIP_AT") != fence:
+        return
+    skip = int(_env("PADDLE_CHAOS_FLIP_SKIP", "0"))
+    _flip_fence_hits += 1
+    if _flip_fence_hits <= skip:
+        return
+    if mode == "kill":
+        _fault("flip_kill", fence=fence, hit=_flip_fence_hits)
+        _sigkill(f"kill injected at supervisor flip fence {fence!r}")
+    elif mode == "latency":
+        ms = float(_env("PADDLE_CHAOS_FLIP_LATENCY_MS", "0"))
+        _fault("flip_latency", fence=fence, ms=ms)
         if ms > 0:
             time.sleep(ms / 1000.0)
 

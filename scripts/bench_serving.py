@@ -970,6 +970,336 @@ def _gate_live_plane(args, block):
     return rc
 
 
+class _PacedTrainer:
+    """Emulated data-parallel training job riding the serving fleet:
+    fixed global batch, so the wall time of one optimizer step is
+    ``base_step_s / width`` and steps/s is proportional to the number
+    of devices currently lent to training. ``resize`` is the
+    supervisor executor's resize hook."""
+
+    def __init__(self, base_step_s):
+        self.base_step_s = base_step_s
+        self.width = 0
+        self.steps = 0
+        self._due = None
+
+    def resize(self, source_width, target_width):
+        self.width = int(target_width)
+        self._due = None
+
+    def tick(self):
+        if self.width < 1:
+            self._due = None
+            return
+        now = time.monotonic()
+        if self._due is None:
+            self._due = now + self.base_step_s / self.width
+        while now >= self._due:
+            self.steps += 1
+            self._due += self.base_step_s / self.width
+
+
+def _autoscale_traffic(args, rng):
+    """The bursty side of the colocation experiment: per burst,
+    ``--autoscale-burst`` latency-sensitive chat requests with unique
+    prompts (no shared prefix — affinity must not serialize the burst
+    onto one engine). Burst peaks need BOTH engines to stay inside the
+    interactive latency target; the lulls between bursts are the slack
+    the autoscaler should lend to training."""
+    import numpy as np
+
+    bursts = []
+    n_bursts = args.autoscale_cycles * 3
+    for _ in range(n_bursts):
+        burst = []
+        for _ in range(args.autoscale_burst):
+            plen = int(rng.integers(16, 25))
+            prompt = rng.integers(0, args.vocab, plen, dtype=np.int64)
+            burst.append((prompt, "interactive", 20))
+        bursts.append(burst)
+    return bursts
+
+
+def _autoscale_phase(args, mode, bursts):
+    """One colocation phase over the shared burst schedule. ``mode``:
+
+    * ``static_serving`` — both engines serve, no training (2+0);
+    * ``static_split``   — one serves, one trains all run (1+1);
+    * ``colocated``      — the fleet supervisor flips the second engine
+      between roles off the live plane's fleet_health.json.
+
+    Returns the per-mode measurement row plus the raw outputs for the
+    bit-equal gate."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet.supervisor import (
+        FleetSupervisor, StoreFleetExecutor, SupervisorConfig,
+        read_health)
+    from paddle_tpu.observability import live
+    from paddle_tpu.runtime import TCPStore
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.protocol import SLO_OBJECTIVES
+
+    ns = f"__bencha_{mode}"
+    tdir = tempfile.mkdtemp(prefix=f"bench_autoscale_{mode}_")
+    wargs = argparse.Namespace(**vars(args))
+    wargs.router_slots = 4
+    wargs.router_step_floor_ms = args.autoscale_step_floor_ms
+    port = _free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=60.0)
+    master = f"127.0.0.1:{port}"
+    extra = {"PADDLE_TPU_TELEMETRY_DIR": tdir,
+             "PADDLE_TPU_LIVE_TELEMETRY": "1"}
+    procs = [_spawn_router_worker(
+        wargs, master, ns, extra_env=dict(extra,
+                                          PADDLE_TRAINER_ID=str(i + 1)))
+        for i in range(2)]
+    os.environ.update(extra)
+    health_path = os.path.join(tdir, "fleet_health.json")
+    cycle_s = args.autoscale_cycle_s
+    burst_gap_s = 0.8
+    trainer = _PacedTrainer(args.autoscale_train_step_ms / 1000.0)
+    try:
+        # a tight inflight cap keeps burst overflow in the ADMISSION
+        # queue, where the live plane's queue gauge (and therefore the
+        # supervisor's backlog signal) can see it
+        router = Router(store, namespace=ns, queue_limit=512,
+                        dataplane=args.dataplane,
+                        engine_grace_s=120.0, page_size=args.page_size,
+                        seed=args.seed, affinity_slack_tokens=64,
+                        max_inflight_per_engine=6,
+                        deadlines={"interactive": 600.0,
+                                   "standard": 600.0, "batch": 600.0})
+        # short window so burst-era samples age out within one lull and
+        # the supervisor sees a calm fleet before the next cycle
+        router._live_agg = live.LiveAggregator(window_s=8.0,
+                                               health_interval_s=0.2)
+        deadline = time.monotonic() + 300.0
+        while router._known_engines < 2:
+            if time.monotonic() > deadline:
+                raise RuntimeError("autoscale bench: workers never "
+                                   "registered")
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError("autoscale bench: worker died "
+                                       f"rc={p.returncode}")
+            router.pump()
+            time.sleep(0.05)
+        names = sorted(router._engines)
+        executor = StoreFleetExecutor(
+            store, namespace=ns, router=router,
+            resize_fn=trainer.resize,
+            pump=lambda: (router.pump(), trainer.tick()), poll_s=0.02)
+        # store-path warmup with BOTH engines serving (workers already
+        # pre-compiled their buckets via --warmup). Batch class: the
+        # first requests pay one-off transport setup that would blow
+        # the interactive target and poison the burn window the
+        # supervisor steers by
+        wrng = np.random.default_rng(args.seed + 8)
+        for _ in range(6):
+            plen = int(wrng.integers(16, 25))
+            router.submit(wrng.integers(0, args.vocab, plen,
+                                        dtype=np.int64),
+                          slo="batch", max_new_tokens=20)
+        if not router.drain(timeout=120.0, poll=0.02):
+            raise RuntimeError("autoscale bench: warmup undrained "
+                               f"{router.stats()}")
+        sup = None
+        if mode != "static_serving":
+            # lend names[-1] to training before the clock starts
+            if not executor.drain(names[-1], deadline_s=10.0):
+                raise RuntimeError("autoscale bench: initial drain of "
+                                   f"{names[-1]} timed out")
+            trainer.resize(0, 1)
+        if mode == "colocated":
+            sup = FleetSupervisor(
+                os.path.join(tdir, "journal"), executor=executor,
+                config=SupervisorConfig(
+                    high_burn=1.0, low_burn=0.75, queue_high=6,
+                    hysteresis_s=0.25, cooldown_s=1.5,
+                    breaker_window_s=60.0, breaker_max_flips=10,
+                    min_serving=1, drain_timeout_s=5.0,
+                    namespace=ns),
+                health_path=health_path,
+                roles={names[0]: "serving", names[-1]: "training"},
+                training_width=1)
+        trainer.steps = 0
+        events = [c * cycle_s + b * burst_gap_s
+                  for c in range(args.autoscale_cycles)
+                  for b in range(3)]
+        t_end = args.autoscale_cycles * cycle_s
+        submitted = []
+        last_health, peak_burn, peak_backlog = {}, 0.0, 0
+        next_ctl = 0.0
+        ei = 0
+        t0 = time.monotonic()
+        while True:
+            now = time.monotonic() - t0
+            if ei < len(events) and now >= events[ei]:
+                for prompt, slo, new in bursts[ei]:
+                    rid = router.submit(prompt, slo=slo,
+                                        max_new_tokens=new)
+                    submitted.append((rid, prompt, slo))
+                ei += 1
+            if now >= next_ctl:
+                next_ctl = now + 0.1
+                last_health = read_health(health_path) or last_health
+                sig = FleetSupervisor._signals(last_health)
+                peak_burn = max(peak_burn, sig["max_burn"])
+                peak_backlog = max(peak_backlog, sig["admission_backlog"])
+                if sup is not None:
+                    sup.tick(last_health, time.monotonic())
+            router.pump()
+            trainer.tick()
+            time.sleep(0.01)
+            if now >= t_end and ei == len(events):
+                break
+        wall = time.monotonic() - t0
+        steps = trainer.steps
+        if not router.drain(timeout=120.0, poll=0.02):
+            raise RuntimeError(f"autoscale bench: {mode} undrained "
+                               f"{router.stats()}")
+        goodput_tokens = new_tokens = 0
+        misses = 0
+        outputs = []
+        for rid, prompt, slo in submitted:
+            req = router._requests[rid]
+            out = np.asarray(router.result(rid))
+            outputs.append(out)
+            produced = len(out) - len(prompt)
+            new_tokens += produced
+            target = SLO_OBJECTIVES[slo]["latency_target_s"]
+            if req.finish_t - req.submit_t <= target:
+                goodput_tokens += produced
+            else:
+                misses += 1
+        row = {
+            "new_tokens": int(new_tokens),
+            "goodput_tokens": int(goodput_tokens),
+            "seconds": round(wall, 4),
+            "goodput_tokens_per_second": round(goodput_tokens / wall, 2),
+            "slo_miss_frac": round(misses / max(1, len(submitted)), 4),
+            "train_steps": int(steps),
+            "train_steps_per_second": round(steps / wall, 2),
+            "final_max_burn": round(
+                FleetSupervisor._signals(last_health)["max_burn"], 3),
+            "peak_burn": round(peak_burn, 3),
+            "peak_admission_backlog": int(peak_backlog),
+            "failover_resubmits":
+                router.counters.get("failover_resubmits", 0),
+        }
+        if sup is not None:
+            doc = sup.roles_doc
+            hist = sup.journal.history()
+            if sup.journal.pending() is not None:
+                raise RuntimeError("autoscale bench: supervisor left a "
+                                   "pending flip in the journal")
+            row["flips_committed"] = int(doc.get("flips_committed", 0))
+            row["flip_directions"] = sorted(
+                {e.get("direction") for e in hist
+                 if e.get("outcome") == "committed"})
+            row["rollbacks"] = sum(
+                1 for e in hist if e.get("outcome") != "committed")
+        # lift any standing drain order so both workers see the
+        # shutdown broadcast promptly
+        executor.activate(names[-1], "serving")
+        for _ in range(20):
+            router.pump()
+            time.sleep(0.02)
+        router.shutdown()
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for k in extra:
+            os.environ.pop(k, None)
+        store.close()
+    return row, outputs
+
+
+def run_autoscale(args):
+    """Train/serve colocation A/B/C (docs/COLOCATION.md): the SAME
+    bursty interactive workload plus a width-paced training job under
+    (a) both engines statically serving, (b) a static 1+1 split, and
+    (c) the fleet supervisor autoscaling roles off fleet_health.json.
+
+    Score = SLO-goodput tokens/s normalized to the all-serving split
+    PLUS training steps/s normalized to the static 1+1 split — goodput,
+    because a response landing past its latency target is worthless to
+    the caller, which is exactly the cost the colocated fleet avoids by
+    borrowing the training engine at burst peaks and handing it back in
+    the lulls. Gates: the colocated score beats BOTH statics, its burn
+    ends under objective, and greedy outputs stay bit-equal."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed + 9)
+    bursts = _autoscale_traffic(args, rng)
+    rows, outputs = {}, {}
+    for mode in ("static_serving", "static_split", "colocated"):
+        print(f"autoscale: {mode} phase "
+              f"({args.autoscale_cycles} cycles x "
+              f"{args.autoscale_cycle_s:.0f}s)...", file=sys.stderr)
+        rows[mode], outputs[mode] = _autoscale_phase(args, mode, bursts)
+    for mode in ("static_split", "colocated"):
+        for a, b in zip(outputs["static_serving"], outputs[mode]):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"token streams changed under {mode} "
+                              "role management")
+    base_tps = rows["static_serving"]["goodput_tokens_per_second"]
+    base_sps = rows["static_split"]["train_steps_per_second"]
+    for row in rows.values():
+        row["score"] = round(
+            row["goodput_tokens_per_second"] / max(base_tps, 1e-9)
+            + row["train_steps_per_second"] / max(base_sps, 1e-9), 4)
+    best_static = max(rows["static_serving"]["score"],
+                      rows["static_split"]["score"])
+    colo = rows["colocated"]
+    return {
+        "workers": 2,
+        "cycles": args.autoscale_cycles,
+        "cycle_seconds": args.autoscale_cycle_s,
+        "bursts_per_cycle": 3,
+        "burst_requests": args.autoscale_burst,
+        "slo_class": "interactive",
+        "device_step_floor_ms": args.autoscale_step_floor_ms,
+        "train_base_step_ms": args.autoscale_train_step_ms,
+        "score_definition": ("goodput_tokens_per_second / static_serving"
+                            " + train_steps_per_second / static_split"),
+        "modes": rows,
+        "best_static_score": best_static,
+        "colocated_score": colo["score"],
+        "colocated_margin": round(colo["score"] - best_static, 4),
+        "greedy_bit_equal": True,
+        "burn_under_objective": colo["final_max_burn"] < 1.0,
+    }
+
+
+def _gate_autoscale(args, block):
+    rc = 0
+    colo = block["modes"]["colocated"]
+    if block["colocated_margin"] <= args.min_colocation_margin:
+        print(f"FAIL: colocated score {block['colocated_score']} does "
+              f"not beat best static split "
+              f"{block['best_static_score']} by more than "
+              f"{args.min_colocation_margin}", file=sys.stderr)
+        rc = 1
+    if not block["burn_under_objective"]:
+        print(f"FAIL: colocated fleet ended with burn "
+              f"{colo['final_max_burn']} >= 1.0 (over objective)",
+              file=sys.stderr)
+        rc = 1
+    if colo.get("flips_committed", 0) < 2 or sorted(
+            colo.get("flip_directions", [])) != ["to_serving",
+                                                 "to_training"]:
+        print("FAIL: supervisor never closed the loop in both "
+              f"directions ({colo.get('flips_committed')} flips, "
+              f"{colo.get('flip_directions')})", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def run_disagg(args, store, master):
     """Disaggregated prefill/decode sub-scenario: the SAME long-prompt-
     heavy workload through 1 unified worker and through 1 prefill + 1
@@ -1140,6 +1470,34 @@ def main(argv=None):
                          "BENCH_SERVING.json")
     ap.add_argument("--skip-live-plane", action="store_true",
                     help="skip the live-plane scenario in the full run")
+    ap.add_argument("--autoscale-only", action="store_true",
+                    help="run only the train/serve colocation autoscale "
+                         "A/B/C (static 2+0, static 1+1, supervisor-"
+                         "colocated) and merge the colocation block into "
+                         "the existing BENCH_SERVING.json")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="alias for --autoscale-only")
+    ap.add_argument("--skip-autoscale", action="store_true",
+                    help="skip the colocation autoscale scenario in the "
+                         "full run")
+    ap.add_argument("--autoscale-cycles", type=int, default=2,
+                    help="burst/lull cycles per colocation phase")
+    ap.add_argument("--autoscale-cycle-s", type=float, default=12.0,
+                    help="seconds per colocation cycle (3 bursts at the "
+                         "front, lull for the rest)")
+    ap.add_argument("--autoscale-burst", type=int, default=14,
+                    help="interactive requests per burst; sized so one "
+                         "engine blows the latency target and two hold it")
+    ap.add_argument("--autoscale-step-floor-ms", type=float, default=25.0,
+                    help="engine step pacing for the colocation phases "
+                         "(4 slots/worker; lower than the router "
+                         "scenario's so bursts drain inside the target)")
+    ap.add_argument("--autoscale-train-step-ms", type=float, default=50.0,
+                    help="emulated training step wall time at width 1 "
+                         "(fixed global batch: step time scales 1/width)")
+    ap.add_argument("--min-colocation-margin", type=float, default=0.0,
+                    help="fail unless the colocated score beats the best "
+                         "static split by more than this")
     ap.add_argument("--max-live-overhead", type=float, default=0.02,
                     help="fail if enabling the live telemetry plane "
                          "costs more than this fraction of live-off "
@@ -1179,6 +1537,18 @@ def main(argv=None):
             f.write("\n")
         print(json.dumps({"live_plane": block}, indent=2))
         return _gate_live_plane(args, block)
+    if args.autoscale_only or args.autoscale:
+        block = run_autoscale(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["colocation"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"colocation": block}, indent=2))
+        return _gate_autoscale(args, block)
     if args.attn_kernel_only:
         block = run_attn_kernel(args)
         report = {}
@@ -1306,6 +1676,8 @@ def main(argv=None):
         report["router"] = run_router(args)
     if not args.skip_live_plane:
         report["live_plane"] = run_live_plane(args)
+    if not args.skip_autoscale:
+        report["colocation"] = run_autoscale(args)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
@@ -1319,6 +1691,8 @@ def main(argv=None):
         rc = rc or _gate_router(args, report["router"])
     if not args.skip_live_plane:
         rc = rc or _gate_live_plane(args, report["live_plane"])
+    if not args.skip_autoscale:
+        rc = rc or _gate_autoscale(args, report["colocation"])
     return rc
 
 

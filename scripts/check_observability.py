@@ -99,6 +99,8 @@ OWNED_PREFIXES = {
     "mpmd_": os.path.join("paddle_tpu", "distributed", "mpmd.py"),
     "live_": os.path.join("paddle_tpu", "observability", "live.py"),
     "slo_": os.path.join("paddle_tpu", "observability", "live.py"),
+    "supervisor_": os.path.join("paddle_tpu", "distributed", "fleet",
+                                "supervisor.py"),
 }
 
 

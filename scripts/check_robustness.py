@@ -50,6 +50,16 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
      that hardcodes compiled mode silently breaks every CPU run the
      moment it is reached. The keyword's VALUE is the author's choice
      (typically ``backend != "tpu"``); declaring it is not.
+  8. supervisor durability — in ``paddle_tpu/distributed/fleet/
+     supervisor.py`` (a) every coordination-store op must sit inside a
+     ``with deadline_guard(...)`` block (same contract as rule 4: the
+     flip state machine blocks on the store during drain, and an
+     unguarded op against a dead store peer wedges the control loop);
+     and (b) every write-mode ``open(...)`` must live inside the single
+     ``_atomic_write_json`` chokepoint, which must itself call
+     ``os.replace``: the flip journal is what makes SIGKILL-at-any-
+     fence recoverable, so a stray in-place write would reintroduce
+     torn-journal states the two-phase protocol exists to rule out.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -108,6 +118,15 @@ CHAN_OPS = {"send", "poll", "recv"}
 PALLAS_DIRS = [
     os.path.join("paddle_tpu", "ops", "pallas"),
 ]
+
+#: files under the supervisor durability contract (rule 8): store ops
+#: guarded like rule 4, and journal writes atomic (tmp + os.replace)
+GUARDED_SUPERVISOR_FILES = [
+    os.path.join("paddle_tpu", "distributed", "fleet", "supervisor.py"),
+]
+
+#: the sole function allowed to open files for writing in rule-8 files
+ATOMIC_WRITE_FN = "_atomic_write_json"
 
 
 def _py_files(root):
@@ -351,6 +370,72 @@ def check_pallas_interpret(path: str):
                    "interpret-mode CPU fallback (rule 7)")
 
 
+def _open_mode_is_write(node: ast.Call) -> bool:
+    """True when an ``open(...)`` call's literal mode contains w/a/+.
+    A non-literal mode counts as a write — the fallback must be visible
+    at the call site, same spirit as rule 7."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # open(path) defaults to "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(c in mode.value for c in "wa+x")
+    return True
+
+
+def check_atomic_journal_writes(path: str):
+    """Yield (line, message) for rule 8b: write-mode ``open()`` calls in
+    a supervisor file outside ``_atomic_write_json``, and an
+    ``_atomic_write_json`` that never calls ``os.replace`` (i.e. is not
+    actually atomic)."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    parent = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+    atomic_fn_seen = False
+    atomic_fn_has_replace = False
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == ATOMIC_WRITE_FN):
+            atomic_fn_seen = True
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "replace"
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "os"):
+                    atomic_fn_has_replace = True
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open"
+                and _open_mode_is_write(node)):
+            continue
+        anc, inside_atomic = node, False
+        while anc in parent:
+            anc = parent[anc]
+            if (isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and anc.name == ATOMIC_WRITE_FN):
+                inside_atomic = True
+                break
+        if not inside_atomic:
+            yield (node.lineno,
+                   "write-mode open() outside _atomic_write_json — all "
+                   "supervisor journal/roles writes must go through the "
+                   "single tmp+os.replace chokepoint (rule 8): an in-place "
+                   "write torn by SIGKILL breaks flip recovery")
+    if atomic_fn_seen and not atomic_fn_has_replace:
+        yield (1,
+               "_atomic_write_json never calls os.replace — the write "
+               "chokepoint must publish via atomic rename (rule 8)")
+
+
 def _pallas_files(root):
     for d in PALLAS_DIRS:
         base = os.path.join(root, d)
@@ -396,6 +481,14 @@ def main(argv=None):
     for path in _pallas_files(root):
         rel = os.path.relpath(path, root)
         for line, msg in check_pallas_interpret(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for rel in GUARDED_SUPERVISOR_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        for line, msg in check_guarded_store_ops(path):
+            violations.append(f"{rel}:{line}: {msg}")
+        for line, msg in check_atomic_journal_writes(path):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

@@ -9,6 +9,13 @@ imbalance, router queue depths, transport reconnect storms, and the
 compile-cache hit rate — the same numbers an autoscaler would key on,
 made human-readable.
 
+With ``--journal DIR`` pointing at the fleet supervisor's journal dir
+(``docs/COLOCATION.md``), a fleet-roles panel is added: the current
+serving/training split, the breaker state, any in-flight flip (id +
+fence it last journaled), and the tail of the committed/rolled-back
+flip log — the autoscaler's actual decisions next to the signals that
+drove them.
+
 Stdlib-only by construction (no paddle_tpu / jax import): the document
 is plain JSON, so this runs anywhere the telemetry dir is mounted.
 
@@ -48,6 +55,26 @@ def load_health(path):
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def load_journal(path):
+    """The supervisor's journal dir as one dict: current roles, any
+    pending flip, and the closed-flip history. None when the dir holds
+    no supervisor state at all (panel is omitted)."""
+    roles = _load_json(os.path.join(path, "fleet_roles.json"))
+    pending = _load_json(os.path.join(path, "flip_current.json"))
+    log = _load_json(os.path.join(path, "flip_log.json"))
+    if roles is None and pending is None and log is None:
+        return None
+    return {"roles": roles, "pending": pending, "history": log or []}
 
 
 def _fmt_s(v):
@@ -101,12 +128,56 @@ _CLASS_HEADER = ["class", "done", "shed", "fail", "p50", "p95", "p99",
                  "target", "burn(lat)", "burn(avail)"]
 
 
-def render_text(doc, now=None):
+def roles_lines(journal, now=None):
+    """The fleet-roles panel from the supervisor journal dir: current
+    serving/training split, breaker state, any in-flight flip and the
+    fence it last journaled, plus the tail of the closed-flip log."""
+    if journal is None:
+        return []
+    now = time.time() if now is None else now
+    lines = []
+    roles_doc = journal.get("roles") or {}
+    roles = roles_doc.get("roles") or {}
+    counts = {}
+    for r in roles.values():
+        counts[r] = counts.get(r, 0) + 1
+    split = " ".join(f"{r}={n}" for r, n in sorted(counts.items())) or "(none)"
+    lines.append(
+        f"fleet roles: {split}  training_width="
+        f"{roles_doc.get('training_width', 0)}  "
+        f"flips_committed={roles_doc.get('flips_committed', 0)}")
+    if roles:
+        lines.append("  " + ", ".join(
+            f"{n}:{r}" for n, r in sorted(roles.items())))
+    open_until = float(roles_doc.get("breaker_open_until", 0) or 0)
+    if open_until > now:
+        lines.append(f"  BREAKER OPEN ({open_until - now:.0f}s left) — "
+                     "flip storm, autoscaler holding")
+    pending = journal.get("pending")
+    if pending:
+        lines.append(
+            f"  in-flight flip {pending.get('id')} "
+            f"{pending.get('direction')} {pending.get('engine')} "
+            f"@ fence {pending.get('fence')}")
+    for entry in (journal.get("history") or [])[-5:]:
+        age = now - float(entry.get("closed_ts", now))
+        lines.append(
+            f"  {entry.get('outcome', '?'):>14}  {entry.get('direction')} "
+            f"{entry.get('engine')}  ({entry.get('reason', '')}; "
+            f"{age:.0f}s ago)")
+    return lines
+
+
+def render_text(doc, now=None, journal=None):
     """The terminal view: one string, ready to print."""
-    if doc is None:
+    if doc is None and journal is None:
         return "[fleet_dashboard] no fleet_health.json yet " \
                "(is PADDLE_TPU_LIVE_TELEMETRY=1 set on the fleet?)"
     now = time.time() if now is None else now
+    if doc is None:
+        return "\n".join(
+            ["[fleet_dashboard] no fleet_health.json yet", ""]
+            + roles_lines(journal, now=now))
     age = now - float(doc.get("ts", now))
     lines = [f"fleet health  (window {doc.get('window_s', '?')}s, "
              f"written {age:.1f}s ago)", ""]
@@ -153,15 +224,21 @@ def render_text(doc, now=None):
     if sources:
         lines += ["", "sources (s since last payload): "
                   + ", ".join(f"{s}={a}" for s, a in sorted(sources.items()))]
+    rl = roles_lines(journal, now=now)
+    if rl:
+        lines += [""] + rl
     return "\n".join(lines)
 
 
-def render_html(doc, now=None):
+def render_html(doc, now=None, journal=None):
     """One-shot static HTML (no JS, no external assets): the same
     content as the terminal view, with flagged cells highlighted."""
     now = time.time() if now is None else now
-    if doc is None:
+    if doc is None and journal is None:
         body = "<p>no fleet_health.json yet</p>"
+    elif doc is None:
+        pre = "\n".join(roles_lines(journal, now=now))
+        body = f"<pre>{_html.escape(pre)}</pre>"
     else:
         age = now - float(doc.get("ts", now))
         parts = [f"<p>window {_html.escape(str(doc.get('window_s', '?')))}s"
@@ -177,7 +254,7 @@ def render_html(doc, now=None):
             head = "".join(f"<th>{_html.escape(h)}</th>"
                            for h in _CLASS_HEADER)
             parts.append(f"<table><tr>{head}</tr>{cells}</table>")
-        pre = render_text(doc, now=now)
+        pre = render_text(doc, now=now, journal=journal)
         parts.append(f"<pre>{_html.escape(pre)}</pre>")
         body = "\n".join(parts)
     return ("<!doctype html><html><head><meta charset='utf-8'>"
@@ -229,19 +306,39 @@ def selftest():
         "compile_cache": {"hits": 9.0, "misses": 1.0, "hit_rate": 0.9},
         "sources": {"engine0": 0.4},
     }
-    text = render_text(doc, now=1001.0)
+    journal = {
+        "roles": {"roles": {"engine0": "serving", "engine1": "training"},
+                  "training_width": 1, "flips_committed": 3,
+                  "breaker_open_until": 1020.0},
+        "pending": {"id": 77, "direction": "to_serving",
+                    "engine": "engine1", "fence": "quiesce"},
+        "history": [
+            {"id": 75, "outcome": "committed", "direction": "to_training",
+             "engine": "engine1", "reason": "burn=0.10 idle",
+             "closed_ts": 950.0},
+            {"id": 76, "outcome": "rolled_back", "direction": "to_serving",
+             "engine": "engine1", "reason": "burn=2.40 backlog=9",
+             "closed_ts": 980.0}],
+    }
+    text = render_text(doc, now=1001.0, journal=journal)
     for needle in ("interactive", "batch", "p95", "BURN", "STRAGGLER",
-                   "IMBALANCED", "engine0=512", "hit rate 0.90"):
+                   "IMBALANCED", "engine0=512", "hit rate 0.90",
+                   "serving=1 training=1", "engine0:serving",
+                   "BREAKER OPEN", "in-flight flip 77", "fence quiesce",
+                   "committed", "rolled_back"):
         assert needle in text, (needle, text)
     # burn < 1 is NOT flagged; the flagged one is availability/interactive
     assert "0.00 BURN" not in text
-    page = render_html(doc, now=1001.0)
+    page = render_html(doc, now=1001.0, journal=journal)
     assert "<table>" in page and "class='burn'" in page
-    assert "STRAGGLER" in page
+    assert "STRAGGLER" in page and "in-flight flip 77" in page
+    # roles panel renders alone when only the journal exists yet
+    assert "fleet roles" in render_text(None, journal=journal)
     # missing file / torn doc degrade to a hint, not a crash
     assert "no fleet_health.json" in render_text(None)
     with tempfile.TemporaryDirectory() as d:
         assert load_health(d) is None
+        assert load_journal(d) is None
         p = os.path.join(d, "fleet_health.json")
         with open(p, "w") as f:
             f.write('{"torn')
@@ -249,6 +346,10 @@ def selftest():
         with open(p, "w") as f:
             json.dump(doc, f)
         assert load_health(d)["classes"]["batch"]["requests"] == 5
+        with open(os.path.join(d, "fleet_roles.json"), "w") as f:
+            json.dump(journal["roles"], f)
+        j = load_journal(d)
+        assert j["roles"]["training_width"] == 1 and j["pending"] is None
     print("fleet_dashboard selftest ok")
     return 0
 
@@ -257,6 +358,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser("fleet_dashboard")
     ap.add_argument("telemetry_dir", nargs="?",
                     help="dir holding fleet_health.json (or the file)")
+    ap.add_argument("--journal", default=None, metavar="DIR",
+                    help="fleet supervisor journal dir (fleet_roles.json, "
+                         "flip_current.json, flip_log.json) — adds the "
+                         "fleet-roles panel")
     ap.add_argument("--html", default=None, metavar="OUT",
                     help="write a one-shot static HTML page instead of "
                          "printing the terminal view")
@@ -269,8 +374,13 @@ def main(argv=None):
         return selftest()
     if not args.telemetry_dir:
         ap.error("telemetry_dir is required (or --selftest)")
+
+    def _journal():
+        return load_journal(args.journal) if args.journal else None
+
     if args.html:
-        page = render_html(load_health(args.telemetry_dir))
+        page = render_html(load_health(args.telemetry_dir),
+                           journal=_journal())
         tmp = f"{args.html}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(page)
@@ -281,12 +391,13 @@ def main(argv=None):
         try:
             while True:
                 print("\x1b[2J\x1b[H"
-                      + render_text(load_health(args.telemetry_dir)),
+                      + render_text(load_health(args.telemetry_dir),
+                                    journal=_journal()),
                       flush=True)
                 time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
-    print(render_text(load_health(args.telemetry_dir)))
+    print(render_text(load_health(args.telemetry_dir), journal=_journal()))
     return 0
 
 

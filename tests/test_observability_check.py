@@ -489,3 +489,49 @@ def test_attn_kernel_metrics_from_engine_allowed(tmp_path):
     f.write_text(textwrap.dedent(_ATTN_KERNEL_SRC))
     rel = os.path.join("paddle_tpu", "inference", "engine.py")
     assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+# -- supervisor_* ownership: fleet/supervisor.py is the single writer -------
+_SUPERVISOR_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.inc("supervisor_flips_total", direction="to_serving")
+        _obs.set_gauge("supervisor_fleet_roles", 2.0, role="serving")
+        _obs.event("flip_commit", id="f1")
+"""
+
+
+def test_supervisor_metrics_from_owner_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_SUPERVISOR_SRC))
+    rel = os.path.join("paddle_tpu", "distributed", "fleet", "supervisor.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_supervisor_metrics_from_router_rejected(tmp_path):
+    # the router reacts to flips but must not narrate them — the flip
+    # log's telemetry has exactly one writer, the supervisor
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_SUPERVISOR_SRC))
+    rel = os.path.join("paddle_tpu", "serving", "router.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 2 and all("single-writer" in m for _, m in v)
+
+
+def test_flip_span_owned_by_supervisor(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        from paddle_tpu import observability as _obs
+        def f(tid):
+            _obs.start_span("flip", trace_id=tid, direction="to_serving")
+    """))
+    rel = os.path.join("paddle_tpu", "serving", "worker.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+    rel = os.path.join("paddle_tpu", "distributed", "fleet", "supervisor.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_supervisor_prefix_registered():
+    assert check_observability.OWNED_PREFIXES["supervisor_"].endswith(
+        "supervisor.py")

@@ -312,3 +312,90 @@ def test_live_pallas_plane_declares_interpret():
     assert files, "kernel plane missing"
     for path in files:
         assert not list(check_robustness.check_pallas_interpret(path)), path
+
+
+# -- rule 8: supervisor store ops guarded + journal writes atomic -----------
+def _atomic_violations(tmp_path, src):
+    f = tmp_path / "supervisor_mod.py"
+    f.write_text(textwrap.dedent(src))
+    return list(check_robustness.check_atomic_journal_writes(str(f)))
+
+
+def test_stray_write_open_rejected(tmp_path):
+    v = _atomic_violations(tmp_path, """
+        import json
+
+        def save(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+    """)
+    assert len(v) == 1 and "_atomic_write_json" in v[0][1]
+
+
+def test_append_and_plus_modes_rejected(tmp_path):
+    v = _atomic_violations(tmp_path, """
+        def log(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+        def touch(path):
+            open(path, "r+").close()
+    """)
+    assert len(v) == 2
+
+
+def test_nonliteral_open_mode_rejected(tmp_path):
+    # an open() whose mode is not visible at the call site counts as a
+    # write — the reviewer cannot prove it is read-only
+    v = _atomic_violations(tmp_path, """
+        def save(path, mode):
+            return open(path, mode)
+    """)
+    assert len(v) == 1
+
+
+def test_read_open_allowed(tmp_path):
+    assert not _atomic_violations(tmp_path, """
+        import json
+
+        def load(path):
+            with open(path) as f:
+                return json.load(f)
+        def load_rb(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """)
+
+
+def test_write_inside_atomic_chokepoint_allowed(tmp_path):
+    assert not _atomic_violations(tmp_path, """
+        import json, os
+
+        def _atomic_write_json(path, doc):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+    """)
+
+
+def test_atomic_fn_without_os_replace_rejected(tmp_path):
+    # a "chokepoint" that writes in place is not a chokepoint at all
+    v = _atomic_violations(tmp_path, """
+        import json
+
+        def _atomic_write_json(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+    """)
+    assert len(v) == 1 and "os.replace" in v[0][1]
+
+
+def test_live_supervisor_module_is_durable():
+    for rel in check_robustness.GUARDED_SUPERVISOR_FILES:
+        target = os.path.join(REPO, rel)
+        assert os.path.isfile(target), rel
+        assert not list(check_robustness.check_guarded_store_ops(target)), rel
+        assert not list(
+            check_robustness.check_atomic_journal_writes(target)), rel

@@ -1,0 +1,480 @@
+"""Fleet supervisor: the flip state machine and its crash recovery
+(docs/COLOCATION.md).
+
+Tier-1 deterministic tests: the journal's atomic fence protocol, the
+recovery rule (roll forward at/past ``commit``, roll back before it),
+the hysteresis/cooldown/breaker gates around ``decide()``, planner-
+backed flip pricing, and the store-side drain/evacuate integration with
+a real router + engine workers (slow). The SIGKILL-at-every-fence soak
+lives in test_supervisor_chaos.py.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+from conftest import free_port
+
+from paddle_tpu.distributed.fleet.supervisor import (
+    COMMIT_INDEX, FENCES, FleetSupervisor, FlipDecision, FlipExecutor,
+    FlipJournal, StoreFleetExecutor, SupervisorConfig, read_health)
+
+pytestmark = pytest.mark.fast
+
+
+def _health(burn=0.0, backlog=0):
+    return {
+        "classes": {"interactive": {"objectives": {
+            "burn_rate_latency": burn, "burn_rate_availability": 0.0}}},
+        "queues": {"admission": {"interactive": backlog}},
+    }
+
+
+class RecordingExecutor(FlipExecutor):
+    """Records the per-fence actions in call order; optionally raises at
+    one of them to exercise the rollback path."""
+
+    def __init__(self, fail_at=None, drain_clean=True):
+        self.calls = []
+        self.fail_at = fail_at
+        self.drain_clean = drain_clean
+
+    def _hit(self, name, *args):
+        self.calls.append((name,) + args)
+        if name == self.fail_at:
+            raise RuntimeError(f"injected {name} failure")
+
+    def drain(self, engine, deadline_s):
+        self._hit("drain", engine)
+        return self.drain_clean
+
+    def quiesce(self, engine):
+        self._hit("quiesce", engine)
+
+    def resize(self, source_width, target_width):
+        self._hit("resize", source_width, target_width)
+
+    def activate(self, engine, role):
+        self._hit("activate", engine, role)
+
+    def rollback(self, doc):
+        self._hit("rollback", doc.get("engine"))
+
+
+def _supervisor(tmp_path, executor=None, **cfg):
+    cfg.setdefault("hysteresis_s", 0.0)
+    cfg.setdefault("cooldown_s", 0.0)
+    return FleetSupervisor(
+        str(tmp_path / "journal"), executor=executor or RecordingExecutor(),
+        config=SupervisorConfig(**cfg),
+        roles={"e0": "serving", "e1": "serving"}, training_width=0)
+
+
+# -- journal ----------------------------------------------------------------
+
+def test_journal_fence_round_trip(tmp_path):
+    j = FlipJournal(str(tmp_path / "j"))
+    assert j.pending() is None and j.load_roles() is None
+    doc = {"id": 1, "direction": "to_training", "engine": "e1"}
+    j.begin(doc)
+    assert j.pending()["fence"] == "plan"
+    for fence in FENCES[1:]:
+        j.advance(doc, fence)
+        assert j.pending()["fence"] == fence
+        assert fence in j.pending()["fences"]
+    with pytest.raises(ValueError):
+        j.advance(doc, "teleport")
+    j.close(doc, "committed")
+    assert j.pending() is None
+    (entry,) = j.history()
+    assert entry["outcome"] == "committed" and entry["id"] == 1
+    # re-closing (kill between history append and current unlink) dedups
+    j.close(doc, "committed")
+    assert len(j.history()) == 1
+
+
+def test_journal_writes_are_atomic_files(tmp_path):
+    j = FlipJournal(str(tmp_path / "j"))
+    j.save_roles({"roles": {"e0": "serving"}})
+    j.begin({"id": 2, "direction": "to_serving", "engine": "e0"})
+    # no tmp siblings survive a completed write
+    assert not [f for f in os.listdir(j.root) if ".tmp." in f]
+    assert json.load(open(j.roles_path))["roles"] == {"e0": "serving"}
+
+
+# -- crash recovery ---------------------------------------------------------
+
+def _pending_doc(fence):
+    src = {"roles": {"e0": "serving", "e1": "serving"},
+           "training_width": 0, "breaker_open_until": 0.0,
+           "flips_committed": 0}
+    tgt = json.loads(json.dumps(src))
+    tgt["roles"]["e1"] = "training"
+    tgt["training_width"] = 1
+    tgt["flips_committed"] = 1
+    return {
+        "id": 9, "direction": "to_training", "engine": "e1",
+        "reason": "test", "price": {}, "source_role": "serving",
+        "target_role": "training", "source_roles": dict(src["roles"]),
+        "source_width": 0, "target_width": 1,
+        "source_roles_doc": src, "target_roles_doc": tgt,
+        "resized": fence in ("commit", "finalize"),
+        "fence": fence, "fences": {fence: 0.0},
+    }
+
+
+@pytest.mark.parametrize("fence", FENCES)
+def test_recover_resolves_every_fence(tmp_path, fence):
+    root = str(tmp_path / "journal")
+    j = FlipJournal(root)
+    doc = _pending_doc(fence)
+    j.save_roles(doc["source_roles_doc"])
+    j.begin({"id": 0})          # create then overwrite with the fence
+    import paddle_tpu.distributed.fleet.supervisor as sup_mod
+    sup_mod._atomic_write_json(j.current_path, doc)
+    ex = RecordingExecutor()
+    sup = FleetSupervisor(root, executor=ex)
+    roles = sup.roles_doc
+    if FENCES.index(fence) >= COMMIT_INDEX:
+        assert sup.last_outcome == "rolled_forward"
+        assert roles["roles"]["e1"] == "training"
+        assert roles["training_width"] == 1
+        assert ("activate", "e1", "training") in ex.calls
+        assert not any(c[0] == "rollback" for c in ex.calls)
+        assert sup.journal.history()[-1]["outcome"] == "rolled_forward"
+    else:
+        assert sup.last_outcome == "rolled_back"
+        assert roles["roles"]["e1"] == "serving"
+        assert roles["training_width"] == 0
+        assert ("rollback", "e1") in ex.calls
+        assert not any(c[0] == "activate" for c in ex.calls)
+        assert sup.journal.history()[-1]["outcome"] == "rolled_back"
+    assert sup.journal.pending() is None
+
+
+def test_recover_noop_without_pending(tmp_path):
+    ex = RecordingExecutor()
+    sup = _supervisor(tmp_path, executor=ex)
+    assert sup.last_outcome is None and ex.calls == []
+
+
+# -- the transaction --------------------------------------------------------
+
+def test_flip_to_training_call_order(tmp_path):
+    ex = RecordingExecutor()
+    sup = _supervisor(tmp_path, executor=ex)
+    out = sup.flip(FlipDecision("to_training", "e1", "test"), now=100.0)
+    assert out == "committed"
+    assert [c[0] for c in ex.calls] == \
+        ["drain", "quiesce", "resize", "activate"]
+    assert ("resize", 0, 1) in ex.calls
+    assert ("activate", "e1", "training") in ex.calls
+    doc = sup.roles_doc
+    assert doc["roles"] == {"e0": "serving", "e1": "training"}
+    assert doc["training_width"] == 1 and doc["flips_committed"] == 1
+    entry = sup.journal.history()[-1]
+    assert entry["outcome"] == "committed"
+    assert set(entry["fences"]) == set(FENCES)
+
+
+def test_flip_to_serving_skips_drain(tmp_path):
+    ex = RecordingExecutor()
+    sup = _supervisor(tmp_path, executor=ex)
+    sup.journal.save_roles({"roles": {"e0": "serving", "e1": "training"},
+                            "training_width": 1, "breaker_open_until": 0.0,
+                            "flips_committed": 0})
+    out = sup.flip(FlipDecision("to_serving", "e1", "test"), now=100.0)
+    assert out == "committed"
+    assert [c[0] for c in ex.calls] == ["quiesce", "resize", "activate"]
+    assert ("resize", 1, 0) in ex.calls
+    assert sup.roles_doc["roles"]["e1"] == "serving"
+    assert sup.roles_doc["training_width"] == 0
+
+
+@pytest.mark.parametrize("fail_at", ["drain", "quiesce", "resize"])
+def test_executor_failure_rolls_back(tmp_path, fail_at):
+    ex = RecordingExecutor(fail_at=fail_at)
+    sup = _supervisor(tmp_path, executor=ex)
+    out = sup.flip(FlipDecision("to_training", "e1", "test"), now=100.0)
+    assert out == "rolled_back"
+    assert ex.calls[-1][0] == "rollback"
+    assert not any(c[0] == "activate" for c in ex.calls)
+    doc = sup.roles_doc
+    assert doc["roles"] == {"e0": "serving", "e1": "serving"}
+    assert doc["training_width"] == 0 and doc["flips_committed"] == 0
+    assert sup.journal.pending() is None
+    assert sup.journal.history()[-1]["outcome"] == "rolled_back"
+
+
+# -- decision gates ---------------------------------------------------------
+
+def test_hysteresis_holds_then_fires(tmp_path):
+    sup = _supervisor(tmp_path, hysteresis_s=2.0)
+    sup.journal.save_roles({"roles": {"e0": "serving", "e1": "training"},
+                            "training_width": 1, "breaker_open_until": 0.0,
+                            "flips_committed": 0})
+    hot = _health(burn=3.0)
+    assert sup.decide(hot, now=10.0) is None          # just started
+    assert sup.decide(hot, now=11.0) is None          # still held < 2s
+    d = sup.decide(hot, now=12.0)                     # held 2s: fire
+    assert d is not None and d.direction == "to_serving" and d.engine == "e1"
+    # one cool sample resets the pressure clock
+    assert sup.decide(_health(burn=0.0), now=13.0) is None
+    assert sup.decide(hot, now=14.0) is None
+    assert sup.decide(hot, now=16.0) is not None
+
+
+def test_queue_backlog_is_pressure_too(tmp_path):
+    sup = _supervisor(tmp_path, queue_high=8)
+    sup.journal.save_roles({"roles": {"e0": "serving", "e1": "training"},
+                            "training_width": 1, "breaker_open_until": 0.0,
+                            "flips_committed": 0})
+    d = sup.decide(_health(burn=0.0, backlog=9), now=10.0)
+    assert d is not None and d.direction == "to_serving"
+    assert "backlog=9" in d.reason
+
+
+def test_cooldown_spaces_flips(tmp_path):
+    sup = _supervisor(tmp_path, cooldown_s=5.0)
+    sup.journal.save_roles({"roles": {"e0": "serving", "e1": "training"},
+                            "training_width": 1, "breaker_open_until": 0.0,
+                            "flips_committed": 0})
+    assert sup.flip(sup.decide(_health(burn=3.0), now=10.0),
+                    now=10.0) == "committed"
+    sup.journal.save_roles({"roles": {"e0": "serving", "e1": "training"},
+                            "training_width": 1, "breaker_open_until": 0.0,
+                            "flips_committed": 1})
+    assert sup.decide(_health(burn=3.0), now=12.0) is None   # cooling
+    assert sup.decide(_health(burn=3.0), now=15.5) is not None
+
+
+def test_min_serving_floor_blocks_to_training(tmp_path, monkeypatch):
+    sup = _supervisor(tmp_path, min_serving=2)
+    monkeypatch.setattr(sup, "price", lambda d: {"approve": True})
+    assert sup.decide(_health(burn=0.0), now=10.0) is None
+    sup.config.min_serving = 1
+    d = sup.decide(_health(burn=0.0), now=10.0)
+    assert d is not None and d.direction == "to_training"
+    assert d.engine == "e1"    # highest-sorted serving engine flips
+
+
+def test_pricing_veto_blocks_to_training(tmp_path, monkeypatch):
+    sup = _supervisor(tmp_path)
+    monkeypatch.setattr(
+        sup, "price", lambda d: {"approve": False, "speedup": 1.001})
+    assert sup.decide(_health(burn=0.0), now=10.0) is None
+
+
+def test_breaker_opens_on_flip_storm(tmp_path):
+    sup = _supervisor(tmp_path, breaker_window_s=60.0, breaker_max_flips=2,
+                      breaker_open_s=30.0)
+    for i in range(3):
+        sup.journal.save_roles(
+            {"roles": {"e0": "serving", "e1": "training"},
+             "training_width": 1, "breaker_open_until": 0.0,
+             "flips_committed": i})
+        out = sup.flip(FlipDecision("to_serving", "e1", "storm"),
+                       now=10.0 + i)
+        assert out == "committed"
+    assert sup.roles_doc["breaker_open_until"] > 0
+    # while open the supervisor only observes, even under hard pressure
+    sup.journal.save_roles({**sup.roles_doc,
+                            "roles": {"e0": "serving", "e1": "training"},
+                            "training_width": 1})
+    assert sup.decide(_health(burn=9.0), now=100.0) is None
+
+
+def test_signals_collapse_health_doc():
+    sig = FleetSupervisor._signals(_health(burn=2.5, backlog=3))
+    assert sig["max_burn"] == 2.5 and sig["admission_backlog"] == 3
+    assert FleetSupervisor._signals({}) == \
+        {"max_burn": 0.0, "admission_backlog": 0}
+
+
+def test_read_health_tolerates_missing_and_torn(tmp_path):
+    assert read_health(str(tmp_path / "nope.json")) == {}
+    p = tmp_path / "torn.json"
+    p.write_text('{"torn')
+    assert read_health(str(p)) == {}
+
+
+def test_tick_reads_health_path(tmp_path):
+    hp = tmp_path / "fleet_health.json"
+    hp.write_text(json.dumps(_health(burn=3.0)))
+    sup = FleetSupervisor(
+        str(tmp_path / "journal"), executor=RecordingExecutor(),
+        config=SupervisorConfig(hysteresis_s=0.0, cooldown_s=0.0),
+        health_path=str(hp),
+        roles={"e0": "serving", "e1": "training"}, training_width=1)
+    assert sup.tick(now=10.0) == "committed"
+    assert sup.roles_doc["roles"]["e1"] == "serving"
+    hp.write_text(json.dumps(_health(burn=0.7)))
+    assert sup.tick(now=20.0) is None       # mid-band burn: hold
+    hp.write_text(json.dumps(_health(burn=0.1)))
+    assert sup.tick(now=30.0) == "committed"  # idle again: back to training
+    assert sup.roles_doc["roles"]["e1"] == "training"
+
+
+# -- pricing against the real planner ---------------------------------------
+
+def test_price_runs_the_stage_planner(tmp_path):
+    sup = _supervisor(tmp_path)
+    grow = sup.price("to_training")
+    assert grow["source_width"] == 0 and grow["target_width"] == 1
+    assert grow["source"] is None                  # width 0: idle side
+    assert grow["target"]["predicted_step_s"] > 0
+    assert grow["approve"] is True                 # growth from idle
+    sup.journal.save_roles({"roles": {"e0": "serving", "e1": "training",
+                                      "e2": "training"},
+                            "training_width": 2, "breaker_open_until": 0.0,
+                            "flips_committed": 0})
+    grow2 = sup.price("to_training")
+    assert "speedup" in grow2 and grow2["speedup"] > 0
+    assert grow2["approve"] == (
+        grow2["speedup"] >= 1.0 + sup.config.min_speedup)
+    shrink = sup.price("to_serving")
+    assert shrink["target_width"] == 1 and shrink["approve"] is True
+
+
+# -- store-side executor + router/worker drain (the real fleet) -------------
+
+VOCAB = 61
+ENG = dict(num_slots=2, max_length=64, page_size=16, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    import paddle_tpu.inference as inference
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.fleet.topology import (
+        get_hybrid_communicate_group, set_hybrid_communicate_group)
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    prev = get_hybrid_communicate_group()
+    prev_mesh = _mesh.get_global_mesh()
+    set_hybrid_communicate_group(None)
+    _mesh.set_global_mesh(None)
+    try:
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        m.eval()
+        yield m
+        inference.disable_decode_engine(m)
+    finally:
+        set_hybrid_communicate_group(prev)
+        _mesh.set_global_mesh(prev_mesh)
+
+
+@pytest.fixture()
+def store():
+    from paddle_tpu.runtime import TCPStore
+
+    s = TCPStore(host="127.0.0.1", port=free_port(), is_master=True,
+                 timeout=20.0)
+    yield s
+    s.close()
+
+
+def _reference(model, requests):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+
+    eng = DecodeEngine(model, EngineConfig(num_slots=4, max_length=64,
+                                           page_size=16, prefix_cache=True))
+    rids = [eng.submit(p, params) for p, params in requests]
+    eng.run()
+    return [eng.result(r) for r in rids]
+
+
+def _drive(router, workers, rounds=800):
+    for _ in range(rounds):
+        router.pump()
+        for w in workers:
+            w.poll_once()
+        if not router.pending():
+            return
+    raise AssertionError(f"undrained after {rounds} rounds: {router.stats()}")
+
+
+@pytest.mark.slow
+def test_drain_then_evacuate_loses_nothing(model, store):
+    """The executor's drain path end to end: the drained engine finishes
+    in-flight work and reports ``drained``; the router stops placing on
+    it; a second (timed-out) drain evacuates through the failover
+    resubmit path — and every result stays bit-equal to a one-engine
+    reference."""
+    from paddle_tpu.serving import EngineWorker, Router
+
+    w0 = EngineWorker(model, store, **ENG)
+    w1 = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=32, seed=5)
+    resized = []
+    execu = StoreFleetExecutor(
+        store, router=router,
+        resize_fn=lambda s, t: resized.append((s, t)),
+        pump=lambda: (router.pump(), w0.poll_once(), w1.poll_once()),
+        poll_s=0.0)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (20, 33, 17, 25, 21, 29)]
+    rids = [router.submit(p, slo="standard", max_new_tokens=8,
+                          do_sample=(i % 2 == 0), temperature=0.7,
+                          top_k=8) for i, p in enumerate(prompts)]
+    router.pump()          # both engines hold dispatched work now
+    assert execu.drain(w1.name, deadline_s=30.0) is True
+    occ = router._engines[w1.name]
+    assert occ.draining
+    # drained engine is out of the placement set: new work lands on w0
+    more = [router.submit(p, slo="standard", max_new_tokens=8)
+            for p in prompts[:2]]
+    _drive(router, [w0, w1])
+    for r in more:
+        assert router._requests[r].engine == w0.name
+    # a resumed engine lifts its drain state within one ctl-mirror period
+    execu.activate(w1.name, "serving")
+    import time as _time
+    _time.sleep(0.3)
+    w1.poll_once()
+    assert not w1.draining
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    for r, w in zip(rids, want):
+        np.testing.assert_array_equal(router.result(r), w)
+    assert router.stats()["done"] == len(rids) + len(more)
+
+
+@pytest.mark.slow
+def test_drain_timeout_evacuates_inflight(model, store):
+    """A drain whose engine never finishes in time hands its in-flight
+    requests to the rest of the fleet via ``Router.evacuate`` — nothing
+    dropped, nothing duplicated, results bit-equal."""
+    from paddle_tpu.serving import EngineWorker, Router
+
+    w0 = EngineWorker(model, store, **ENG)
+    w1 = EngineWorker(model, store, **ENG)
+    router = Router(store, queue_limit=32, seed=5)
+    # pump only w0 during the drain wait: w1 is wedged on purpose
+    execu = StoreFleetExecutor(
+        store, router=router,
+        pump=lambda: (router.pump(), w0.poll_once()), poll_s=0.0)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, VOCAB, size=n).astype(np.int64)
+               for n in (20, 33, 17, 25)]
+    rids = [router.submit(p, slo="standard", max_new_tokens=8,
+                          do_sample=True, temperature=0.7, top_k=8,
+                          seed=None) for p in prompts]
+    router.pump()
+    assert any(router._requests[r].engine == w1.name for r in rids)
+    assert execu.drain(w1.name, deadline_s=0.3) is False
+    # w1 never ran: its whole book was resubmitted, and w0 finishes all
+    _drive(router, [w0])
+    want = _reference(model, [(p, router._requests[r].params)
+                              for p, r in zip(prompts, rids)])
+    for r, w in zip(rids, want):
+        np.testing.assert_array_equal(router.result(r), w)
+    stats = router.stats()
+    assert stats["done"] == len(rids)
+    assert router.counters["failover_resubmits"] >= 1
