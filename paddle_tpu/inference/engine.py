@@ -235,6 +235,17 @@ class Request:
     decode_steps_n: int = 0
     verify_steps_n: int = 0
     spec_accepted_n: int = 0
+    #: cost-attribution key (observability/accounting.py): "-" = the
+    #: untagged default; slo mirrors the router's class for the ledger
+    tenant: str = "-"
+    slo: str = "standard"
+    #: True when this request's prefill (and first token) ran on another
+    #: engine (try_import_prefill) — its prefill/first-token usage was
+    #: attributed there, so _finish must not count them again
+    imported: bool = False
+    #: pro-rata KV page occupancy charged to this request so far, in
+    #: integer page-microseconds (PageSecondsMeter)
+    acct_page_us: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -682,6 +693,14 @@ class DecodeEngine:
         self.peak_running = 0
         self.admission_waits = 0
         self.admission_wait_s = 0.0
+        #: untagged prompt tokens prefilled on THIS engine (the
+        #: independent integer the per-tenant ledger reconciles against)
+        self.prompt_tokens_total = 0
+        #: per-tenant metering (observability/accounting.py), created
+        #: lazily on the first submit with accounting enabled; the hot
+        #: paths pay one None check when it is off
+        self._acct = None
+        self._pg_meter = None
         self._backoff_s = 0.0
         self._base_key = jax.random.PRNGKey(cfg.seed)
         self._zero_key = np.asarray(self._base_key)
@@ -693,13 +712,55 @@ class DecodeEngine:
 
     # -- scheduler ----------------------------------------------------------
 
+    def accounting_ledger(self, create: bool = False):
+        """This engine's per-tenant metering ledger (accounting.py), or
+        None while accounting is disabled. ``create=True`` instantiates
+        it when accounting is enabled (one env lookup — the µs-scale
+        disabled-path contract)."""
+        if self._acct is None and create:
+            from ..observability import accounting as _acct
+
+            if _acct.enabled():
+                self._acct = _acct.TenantLedger()
+                self._pg_meter = _acct.PageSecondsMeter(self._acct)
+        return self._acct
+
+    def _acct_tick(self, now: float):
+        """Charge KV page occupancy since the last tick to the running
+        set, shared pages split pro rata (accounting.PageSecondsMeter)."""
+        self._pg_meter.tick(now, self._running.values(),
+                            self.pool.refcount,
+                            self._num_pages - 1 - self.pool.available())
+
+    def _acct_wire_bytes(self, active, vocab: int, rows_per_slot: int):
+        """Attribute one step's sharded-decode logit-recombination wire
+        bytes per tenant. The compiled program all-gathers every slot's
+        logit rows regardless of occupancy, so active requests get their
+        rows and the padded remainder lands on the unattributed cell.
+        Zero when the engine is not mp-sharded (single-device wire-free
+        decode — the bench conservation gate covers this shape too)."""
+        if self._mp_degree <= 1:
+            return
+        from ..observability import accounting as _acct
+
+        itemsize = {"f32": 4, "bf16": 2, "int8": 1}[self._logit_wire]
+        row_bytes = vocab * itemsize * rows_per_slot
+        for _slot, req in active:
+            self._acct.add(req.tenant, req.slo, wire_bytes=row_bytes)
+        pad = self.config.num_slots - len(active)
+        if pad > 0:
+            self._acct.add(_acct.DEFAULT_TENANT, _acct.UNATTRIBUTED_SLO,
+                           wire_bytes=row_bytes * pad)
+
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               *, trace: Optional[dict] = None, **kw) -> int:
+               *, trace: Optional[dict] = None, tenant: Optional[str] = None,
+               slo: Optional[str] = None, **kw) -> int:
         """Queue one request; returns its id. `prompt` is a 1-D int array
         (Tensor/np/list); keyword args build a SamplingParams. ``trace``
         is the router's propagated span context (protocol.py ``trace``
         field): when given, the engine's prefill/decode/verify spans join
-        that request tree."""
+        that request tree. ``tenant``/``slo`` label the request for the
+        per-tenant cost ledger (absent -> the "-" default)."""
         if params is None:
             params = SamplingParams(**kw)
         ids = np.asarray(raw(prompt), dtype=np.int32).reshape(-1)
@@ -733,6 +794,13 @@ class DecodeEngine:
             req.trace_id = trace.get("trace_id")
             req.trace_parent = trace.get("parent_id")
             req.resubmitted = int(trace.get("resubmits", 0) or 0) > 0
+        if tenant is not None or slo is not None:
+            from ..observability import accounting as _acct
+
+            req.tenant = _acct.normalize_tenant(tenant)
+            if slo:
+                req.slo = str(slo)
+        self.accounting_ledger(create=True)
         self._requests[rid] = req
         self._waiting.append(req)
         _obs.inc("serving_requests_total")
@@ -800,6 +868,8 @@ class DecodeEngine:
 
     def _step_decode(self):
         cfg = self.config
+        if self._acct is not None:
+            self._acct_tick(time.perf_counter())
         s = cfg.num_slots
         tokens = np.zeros(s, np.int32)
         positions = np.zeros(s, np.int32)
@@ -839,6 +909,8 @@ class DecodeEngine:
         self.decode_steps += 1
         self._last_logits = logits
         active = list(self._running.items())
+        if self._acct is not None:
+            self._acct_wire_bytes(active, int(logits.shape[-1]), 1)
         for slot, req in active:
             if req.decode_t0 is None:
                 req.decode_t0 = t0  # first batched step this request joined
@@ -854,6 +926,8 @@ class DecodeEngine:
         (position-keyed streams, so acceptance never changes WHAT is
         sampled — only how many tokens one step emits)."""
         cfg = self.config
+        if self._acct is not None:
+            self._acct_tick(time.perf_counter())
         s, k1 = cfg.num_slots, k + 1
         tokens = np.zeros((s, k1), np.int32)
         positions = np.zeros(s, np.int32)
@@ -896,6 +970,9 @@ class DecodeEngine:
         self._last_logits = logits
         emitted = 0
         active_slots = len(self._running)
+        if self._acct is not None:
+            self._acct_wire_bytes(list(self._running.items()),
+                                  int(logits.shape[-1]), k1)
         for slot, req in list(self._running.items()):
             tgt = targets_host[slot]
             m = 0
@@ -1082,6 +1159,7 @@ class DecodeEngine:
             "decode_steps": self.decode_steps,
             "verify_steps": self.verify_steps,
             "total_tokens": self.total_tokens,
+            "prompt_tokens_total": self.prompt_tokens_total,
             "running": len(self._running),
             "waiting": len(self._waiting),
             "page_size": self.config.page_size,
@@ -1124,7 +1202,9 @@ class DecodeEngine:
     # -- disaggregated prefill: KV-page export / import ---------------------
 
     def prefill_export(self, prompt, params: Optional[SamplingParams] = None,
-                       *, trace: Optional[dict] = None, **kw):
+                       *, trace: Optional[dict] = None,
+                       tenant: Optional[str] = None,
+                       slo: Optional[str] = None, **kw):
         """Run one prompt's prefill HERE and hand its KV pages to a decode
         engine (disaggregated serving; serving/worker.py streams the
         result over transport.encode_kv).
@@ -1201,6 +1281,13 @@ class DecodeEngine:
             req.trace_id = trace.get("trace_id")
             req.trace_parent = trace.get("parent_id")
             req.resubmitted = int(trace.get("resubmits", 0) or 0) > 0
+        if tenant is not None or slo is not None:
+            from ..observability import accounting as _acct
+
+            req.tenant = _acct.normalize_tenant(tenant)
+            if slo:
+                req.slo = str(slo)
+        self.accounting_ledger(create=True)
         req.page_ids = shared + pages
         req.cached_len = cached_len
         self.prefix_hit_tokens += cached_len
@@ -1226,6 +1313,23 @@ class DecodeEngine:
         if self._int8:
             out["ks"] = np.asarray(jnp.take(self._ksc, idx, axis=1))
             out["vs"] = np.asarray(jnp.take(self._vsc, idx, axis=1))
+        if req.tenant != "-":
+            # label the handoff so the decode engine's ledger keys match
+            # (absent tenant adds zero wire bytes, like the trace dict)
+            out["tenant"] = req.tenant
+            out["slo"] = req.slo
+        if self._acct is not None:
+            # the prefill engine's half of the request: prompt + first
+            # token here, KV-stream wire bytes to the decode engine; the
+            # occupancy tail is charged while the pages are still held
+            self._acct_tick(time.perf_counter())
+            self._acct.add(
+                req.tenant, req.slo, prefill_tokens=int(t0),
+                decode_tokens=1,
+                queue_seconds=max(req.prefill_t0 - req.submit_time, 0.0),
+                wire_bytes=sum(int(out[kk].nbytes)
+                               for kk in ("k", "v", "ks", "vs")
+                               if kk in out))
         # detach: the decode engine owns the request from its first token
         # on. The registry's +1 refs keep this prompt's full blocks
         # resident for future prefix hits; the request's own refs drop.
@@ -1241,7 +1345,9 @@ class DecodeEngine:
         return out
 
     def try_import_prefill(self, prompt, params: SamplingParams, kv: dict,
-                           *, trace: Optional[dict] = None) -> Optional[int]:
+                           *, trace: Optional[dict] = None,
+                           tenant: Optional[str] = None,
+                           slo: Optional[str] = None) -> Optional[int]:
         """Adopt a prefill computed on ANOTHER engine: write its exported
         content pages into this pool and seat the request directly in
         decode (no local prefill program runs). `kv` is a
@@ -1335,6 +1441,16 @@ class DecodeEngine:
             req.trace_id = trace.get("trace_id")
             req.trace_parent = trace.get("parent_id")
             req.resubmitted = int(trace.get("resubmits", 0) or 0) > 0
+        req.imported = True
+        tenant = tenant if tenant is not None else kv.get("tenant")
+        slo = slo if slo is not None else kv.get("slo")
+        if tenant is not None or slo is not None:
+            from ..observability import accounting as _acct
+
+            req.tenant = _acct.normalize_tenant(tenant)
+            if slo:
+                req.slo = str(slo)
+        self.accounting_ledger(create=True)
         if self.registry is not None:
             keys = PrefixRegistry.block_keys(ids, p)
             for j in range(t0 // p):
@@ -1451,6 +1567,7 @@ class DecodeEngine:
         req.status = "running"
         self._running[slot] = req
         self.total_tokens += 1
+        self.prompt_tokens_total += t0
         _obs.inc("serving_tokens_total")
         self._append_token(req, token)
 
@@ -1463,6 +1580,11 @@ class DecodeEngine:
 
     def _finish(self, req: Request):
         req.status = "done"
+        if self._acct is not None:
+            # charge the page-occupancy tail while the request still
+            # holds its pages, then attribute its totals to the ledger
+            self._acct_tick(time.perf_counter())
+            self._acct_request(req)
         if req.slot >= 0:
             del self._running[req.slot]
             self._tables[req.slot] = 0
@@ -1497,7 +1619,30 @@ class DecodeEngine:
                    queue_s=queue_s, prefill_s=round(req.prefill_s, 6),
                    decode_s=round(decode_s, 6),
                    spec_accepted=req.spec_accepted_n,
+                   spec_wasted=max(
+                       self.config.speculate_k * req.verify_steps_n
+                       - req.spec_accepted_n, 0),
+                   tenant=req.tenant, slo_class=req.slo,
+                   imported=req.imported, kv_page_us=req.acct_page_us,
                    resubmitted=req.resubmitted)
+
+    def _acct_request(self, req: Request):
+        """Fold one finished request into the per-tenant ledger. Token
+        fields mirror the untagged counters exactly: an imported request's
+        prompt + first token were metered on the prefill engine
+        (prefill_export), so only its remaining generated tokens count
+        here — summed across disaggregated engines every token lands in
+        exactly one cell."""
+        wasted = max(self.config.speculate_k * req.verify_steps_n
+                     - req.spec_accepted_n, 0)
+        queue_s = (0.0 if req.prefill_t0 is None
+                   else max(req.prefill_t0 - req.submit_time, 0.0))
+        self._acct.add(
+            req.tenant, req.slo, requests=1,
+            prefill_tokens=0 if req.imported else int(len(req.prompt)),
+            decode_tokens=len(req.tokens) - (1 if req.imported else 0),
+            spec_accepted_tokens=req.spec_accepted_n,
+            spec_wasted_tokens=wasted, queue_seconds=queue_s)
 
     def _update_gauges(self):
         used = sum(len(r.prompt) + len(r.tokens)

@@ -333,6 +333,34 @@ METRICS = {
         "gauge", "1.0 while the flip-storm circuit breaker is open "
                  "(too many commits inside the breaker window; the "
                  "supervisor only observes until it cools)"),
+    # -- per-tenant cost accounting (observability/accounting.py) -----------
+    # Single-writer family: tenant_* may only be recorded from the
+    # accounting module (static gate), the way live_*/slo_* are owned.
+    # Gauges, not counters: they republish cumulative ledger totals, so
+    # re-publishing is idempotent and never double-counts.
+    "tenant_device_seconds": (
+        "gauge", "Cumulative normalized device-seconds attributed to a "
+                 "tenant by the metering ledger, priced via the planner "
+                 "cost constants (labels: tenant)"),
+    "tenant_tokens": (
+        "gauge", "Cumulative tokens attributed to a tenant by the ledger "
+                 "(labels: tenant, kind = prefill|decode|spec_accepted|"
+                 "spec_wasted)"),
+    "tenant_kv_page_seconds": (
+        "gauge", "Cumulative time-integrated KV page occupancy attributed "
+                 "to a tenant, shared-prefix pages split pro rata across "
+                 "refholders (labels: tenant)"),
+    "tenant_wire_bytes": (
+        "gauge", "Cumulative logit/KV wire bytes attributed to a tenant "
+                 "(labels: tenant)"),
+    "tenant_shed_requests": (
+        "gauge", "Cumulative requests shed by router admission control, "
+                 "attributed to the tenant that sent them "
+                 "(labels: tenant)"),
+    "tenant_outstanding_tokens": (
+        "gauge", "Outstanding tokens in flight per engine per tenant at "
+                 "the router — the raw signal the per-tenant quota ladder "
+                 "gates on (labels: engine, tenant)"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
@@ -378,6 +406,8 @@ EVENTS = {
     "supervisor_breaker",  # flip-storm circuit breaker opened
     "rank_straggler",     # step-time EWMA z-score flagged a rank (live plane)
     "stage_imbalance",    # MPMD busy/idle spread crossed threshold (live)
+    "tenant_heavy_hitter",    # a tenant surfaced in the aggregator top-K
+    "tenant_ledger_reconcile",  # live ledger vs post-hoc attribution diff
 }
 
 

@@ -53,9 +53,10 @@ import math
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from paddle_tpu import observability as _obs
+from . import accounting as _acct
 from . import tracing
 
 __all__ = [
@@ -246,10 +247,15 @@ class LiveShipper:
     healed by the next one (the aggregator dedups)."""
 
     def __init__(self, source: str, interval_s: float = 0.5,
-                 redundancy: int = 3, max_spans: int = 2000):
+                 redundancy: int = 3, max_spans: int = 2000,
+                 ledger_fn: Optional[Callable] = None):
         self.source = str(source)
         self.interval_s = float(interval_s)
         self.max_spans = int(max_spans)
+        #: optional zero-arg callable returning this process's tenant
+        #: ledger (accounting.TenantLedger) or None; its drained deltas
+        #: ride the payload under the same (src, seq) exactly-once dedup
+        self.ledger_fn = ledger_fn
         self._seq = 0
         self._last = 0.0
         self._ring: collections.deque = collections.deque(
@@ -294,7 +300,12 @@ class LiveShipper:
                 spans = spans[-self.max_spans:]
         counters = collect_counters()
         stages = stage_stats()
-        fresh = (spans or counters != self._sent_counters
+        tenants = None
+        if self.ledger_fn is not None:
+            led = self.ledger_fn()
+            if led is not None:
+                tenants = led.collect_delta()
+        fresh = (spans or tenants or counters != self._sent_counters
                  or stages != self._sent_stages)
         if fresh:
             self._seq += 1
@@ -309,6 +320,8 @@ class LiveShipper:
             }
             if stages:
                 payload["stages"] = stages
+            if tenants:
+                payload["tenants"] = tenants
             self._sent_counters = counters
             self._sent_stages = stages
             self._ring.append(payload)
@@ -364,7 +377,9 @@ class LiveAggregator:
                  event_cooldown_s: float = 10.0,
                  reconnect_storm_per_min: float = 30.0,
                  tail_local: bool = True,
-                 burn_event_threshold: float = 1.0):
+                 burn_event_threshold: float = 1.0,
+                 heavy_hitter_k: int = 8,
+                 heavy_hitter_share: float = 0.25):
         self.objectives = (dict(objectives) if objectives is not None
                            else dict(_objectives_default()))
         self.window_s = float(window_s)
@@ -376,6 +391,8 @@ class LiveAggregator:
         self.event_cooldown_s = float(event_cooldown_s)
         self.reconnect_storm_per_min = float(reconnect_storm_per_min)
         self.burn_event_threshold = float(burn_event_threshold)
+        self.heavy_hitter_k = int(heavy_hitter_k)
+        self.heavy_hitter_share = float(heavy_hitter_share)
         self._tail_local = bool(tail_local)
 
         self._lock = threading.Lock()
@@ -398,6 +415,15 @@ class LiveAggregator:
         self._last_health = 0.0
         self._last_event: Dict[str, float] = {}
         self._sources: Dict[str, float] = {}
+        # tenant attribution plane: fleet ledger (merged from shipped +
+        # router-fed deltas, each exactly once), heavy-hitter sketch over
+        # priced device-second increments, windowed per-(tenant, slo)
+        # burn counters, and the router's outstanding-token feed
+        self._tenant_ledger = _acct.TenantLedger()
+        self._tenant_sketch = _acct.SpaceSavingSketch(capacity=64)
+        self._tenant_prices: Optional[_acct.Prices] = None
+        self._tenant_outstanding: Dict[str, Dict[str, float]] = {}
+        self._tenant_win: Dict[int, Dict[Tuple[str, str], List[int]]] = {}
 
     # -- ingest ------------------------------------------------------------
     def ingest(self, payload: dict, now: Optional[float] = None) -> bool:
@@ -430,6 +456,12 @@ class LiveAggregator:
                 self._stages[src] = {
                     str(s): dict(rec) for s, rec in stages.items()
                     if isinstance(rec, dict)}
+            tenants = payload.get("tenants")
+            if isinstance(tenants, dict) and tenants:
+                try:
+                    self._adopt_tenants(tenants)
+                except Exception:
+                    pass  # advisory: malformed delta must not kill the pump
         spans = payload.get("spans")
         if isinstance(spans, list) and spans:
             self.ingest_spans(spans, now=now)
@@ -467,6 +499,20 @@ class LiveAggregator:
             cw = ep[slo] = _ClassWindow()
         return cw
 
+    def _tenant_window(self, tenant: str, slo: str, now: float) -> List[int]:
+        """[total, over_target, shed_or_failed] counters for one
+        (tenant, slo) pair in the current sub-window bucket; bounded by
+        folding excess tenants into the overflow cell."""
+        ep = self._tenant_win.setdefault(self._epoch(now), {})
+        key = (tenant, slo)
+        tw = ep.get(key)
+        if tw is None and len(ep) >= 1024:
+            key = (_acct.OVERFLOW_TENANT, slo)
+            tw = ep.get(key)
+        if tw is None:
+            tw = ep[key] = [0, 0, 0]
+        return tw
+
     def _ingest_one(self, rec: dict, now: float) -> None:
         name = rec.get("name")
         dur = float(rec.get("dur_s", 0.0) or 0.0)
@@ -487,6 +533,8 @@ class LiveAggregator:
             slo = str(attrs.get("slo", "unknown"))
             status = attrs.get("status")
             cw = self._cls_window(slo, now)
+            over = False
+            bad = status in ("shed", "failed")
             cw.total += 1
             if status == "shed":
                 cw.shed += 1
@@ -498,6 +546,13 @@ class LiveAggregator:
                     obj = self.objectives.get(slo)
                     if obj and dur > float(obj.get("latency_target_s", 0.0)):
                         cw.over += 1
+                        over = True
+            tenant = attrs.get("tenant")
+            if tenant:
+                tw = self._tenant_window(str(tenant), slo, now)
+                tw[0] += 1
+                tw[1] += int(over)
+                tw[2] += int(bad)
             if tid:
                 self._trace_cls[tid] = slo
                 while len(self._trace_cls) > 50_000:
@@ -525,12 +580,48 @@ class LiveAggregator:
                 self._pending.popitem(last=False)
         pend["phases"].append((phase, dur))
 
+    def _adopt_tenants(self, wire: dict) -> None:
+        """Fold one drained ledger delta (collect_delta wire form) into
+        the fleet ledger and offer its priced device-second increment to
+        the heavy-hitter sketch.  Callers sit behind the (src, seq)
+        dedup (wire) or drain their own ledger (router feed), so each
+        delta is adopted exactly once — conservation holds end to end.
+        Must be called under ``self._lock``."""
+        self._tenant_ledger.merge_wire(wire)
+        if self._tenant_prices is None:
+            self._tenant_prices = _acct.default_prices()
+        inc: Dict[str, float] = {}
+        for key, fields in wire.items():
+            tenant = str(key).partition("|")[0] or _acct.DEFAULT_TENANT
+            ds = self._tenant_prices.device_seconds(fields)
+            if ds > 0.0:
+                inc[tenant] = inc.get(tenant, 0.0) + ds
+        for tenant in sorted(inc):
+            self._tenant_sketch.offer(tenant, inc[tenant])
+
     # -- local feeds -------------------------------------------------------
     def note_queues(self, queues: dict) -> None:
         """Router-supplied queue depths for the health doc (per-class
         admission queues, per-engine outstanding tokens)."""
         with self._lock:
             self._queues = dict(queues)
+
+    def note_tenants(self, delta: Optional[dict],
+                     per_engine: Optional[Dict[str, Dict[str, float]]] = None
+                     ) -> None:
+        """Router-supplied in-process feed: its own drained ledger delta
+        (shed attribution — wire form, may be None) and the per-engine
+        per-tenant outstanding-token map.  Mirrors :meth:`note_queues`;
+        the adoption path is the same one wire-shipped deltas take."""
+        with self._lock:
+            if isinstance(delta, dict) and delta:
+                try:
+                    self._adopt_tenants(delta)
+                except Exception:
+                    pass
+            if per_engine is not None:
+                self._tenant_outstanding = {
+                    str(e): dict(by) for e, by in per_engine.items()}
 
     def _poll_local(self, now: float) -> None:
         if not self._tail_local:
@@ -583,6 +674,98 @@ class LiveAggregator:
                     dst.phases.setdefault(
                         p, MergeableHistogram()).merge(h)
         return out
+
+    def _merged_tenant_burn(self, now: float) -> Dict[str, Dict[str, float]]:
+        """tenant -> slo -> that tenant's share of the class's windowed
+        error-budget burn events (over-target completions plus shed /
+        failed requests, over the class total across tenants).  Shares
+        within one class sum to 1 whenever any burn events exist.
+        Must be called under ``self._lock``."""
+        lo = self._epoch(now - self.window_s)
+        for ep in [e for e in self._tenant_win if e < lo]:
+            del self._tenant_win[ep]
+        merged: Dict[Tuple[str, str], List[int]] = {}
+        for ep, cells in self._tenant_win.items():
+            if ep < lo:
+                continue
+            for key, tw in cells.items():
+                dst = merged.setdefault(key, [0, 0, 0])
+                dst[0] += tw[0]
+                dst[1] += tw[1]
+                dst[2] += tw[2]
+        denom: Dict[str, int] = {}
+        for (_tenant, slo), tw in merged.items():
+            denom[slo] = denom.get(slo, 0) + tw[1] + tw[2]
+        out: Dict[str, Dict[str, float]] = {}
+        for (tenant, slo) in sorted(merged):
+            tw = merged[(tenant, slo)]
+            d = denom.get(slo, 0)
+            out.setdefault(tenant, {})[slo] = (
+                round((tw[1] + tw[2]) / d, 6) if d else 0.0)
+        return out
+
+    def _tenants_doc(self, now: float) -> dict:
+        """The health doc's ``tenants`` block: exact per-tenant usage
+        (conservation table), ranked heavy-hitter rows, fleet totals,
+        prices.  Additive — existing supervisor reads are untouched.
+        Must be called under ``self._lock``."""
+        led = self._tenant_ledger
+        prices = self._tenant_prices
+        if prices is None:
+            prices = self._tenant_prices = _acct.default_prices()
+        burn = self._merged_tenant_burn(now)
+        per_tenant = led.per_tenant()
+        fleet = led.fleet()
+        exact = {}
+        for tenant, cell in per_tenant.items():
+            exact[tenant] = {
+                **{f: cell[f] for f in _acct.INT_FIELDS},
+                "queue_seconds": round(cell["queue_seconds"], 6),
+                "device_seconds": round(prices.device_seconds(cell), 9),
+            }
+        rows = []
+        for rank, (tenant, count, err) in enumerate(
+                self._tenant_sketch.topk(self.heavy_hitter_k)):
+            cell = per_tenant.get(tenant)
+            row = {
+                "tenant": tenant,
+                "rank": rank,
+                "device_seconds": (round(prices.device_seconds(cell), 9)
+                                   if cell else round(count, 9)),
+                "sketch_count": round(count, 9),
+                "sketch_error": round(err, 9),
+            }
+            if cell:
+                row["requests"] = cell["requests"]
+                row["shed_requests"] = cell["shed_requests"]
+                row["prefill_tokens"] = cell["prefill_tokens"]
+                row["decode_tokens"] = cell["decode_tokens"]
+                row["spec_wasted_tokens"] = cell["spec_wasted_tokens"]
+                row["kv_page_seconds"] = round(cell["kv_page_us"] * 1e-6, 6)
+                row["wire_bytes"] = cell["wire_bytes"]
+            bs = burn.get(tenant)
+            if bs:
+                row["burn_share"] = bs
+            outst = {e: by[tenant]
+                     for e, by in sorted(self._tenant_outstanding.items())
+                     if tenant in by}
+            if outst:
+                row["outstanding_tokens"] = outst
+            rows.append(row)
+        return {
+            "fleet": {
+                **{f: fleet[f] for f in _acct.INT_FIELDS},
+                "queue_seconds": round(fleet["queue_seconds"], 6),
+                "device_seconds": round(prices.device_seconds(fleet), 9),
+            },
+            "per_tenant": exact,
+            "top": rows,
+            "tracked": len(led),
+            "folded_tenants": led.folded_tenants,
+            "sketch": {"capacity": self._tenant_sketch.capacity,
+                       "total": round(self._tenant_sketch.total, 9)},
+            "prices": prices.to_dict(),
+        }
 
     def _stragglers(self) -> List[dict]:
         ew = {r: v for r, v in self._step_ewma.items()
@@ -699,6 +882,7 @@ class LiveAggregator:
                 "compile_cache": self._compile_cache_health(),
                 "sources": {s: round(now - ts, 3)
                             for s, ts in sorted(self._sources.items())},
+                "tenants": self._tenants_doc(now),
             }
         return doc
 
@@ -760,6 +944,24 @@ class LiveAggregator:
             _obs.event("stage_imbalance",
                        imbalance=st["imbalance"],
                        idle_fraction=st["idle_fraction"])
+        tn = doc.get("tenants")
+        if tn and (tn["top"] or self._tenant_outstanding):
+            with self._lock:
+                _acct.publish_tenant_gauges(self._tenant_ledger,
+                                            self._tenant_prices)
+                _acct.publish_outstanding(self._tenant_outstanding)
+            fleet_ds = tn["fleet"]["device_seconds"]
+            for row in tn["top"]:
+                tenant = row["tenant"]
+                if tenant in (_acct.DEFAULT_TENANT, _acct.OVERFLOW_TENANT):
+                    continue  # untenanted / folded usage is not actionable
+                share = (row["device_seconds"] / fleet_ds
+                         if fleet_ds > 0.0 else 0.0)
+                if share >= self.heavy_hitter_share and \
+                        self._maybe_event(f"tenant/{tenant}", now):
+                    _acct.emit_heavy_hitter(
+                        tenant, row["device_seconds"], row["rank"],
+                        round(share, 6), self.window_s)
 
     def tick(self, now: Optional[float] = None) -> Optional[dict]:
         """One aggregation round: poll local tails, roll windows, and —

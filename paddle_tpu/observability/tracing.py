@@ -442,6 +442,10 @@ def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
     ``PHASE_OF`` and expressed as shares of the root duration
     (``other`` absorbs the untracked remainder, so every request's
     shares sum to exactly 1.0). Pure function over loaded records.
+    Roots carrying a ``tenant`` attr additionally feed a per-tenant
+    table (``tenants``: request/shed/failed counts, per-class mix,
+    latency quantiles, mean phase shares) alongside the per-class one —
+    the post-hoc side of the accounting plane's attribution.
 
     ``objectives`` (the ``serving/protocol.SLO_OBJECTIVES`` table, passed
     by callers that can reach it — this module stays standalone) adds an
@@ -452,6 +456,7 @@ def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
         by_trace.setdefault(s.get("trace_id", "?"), []).append(s)
 
     per_class: Dict[str, dict] = {}
+    per_tenant: Dict[str, dict] = {}
     requests = 0
     unfinished = 0
     for ss in by_trace.values():
@@ -465,22 +470,38 @@ def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
         cls = per_class.setdefault(slo, {
             "requests": 0, "resubmitted": 0, "shed": 0, "failed": 0,
             "latency": [], "shares": {p: [] for p in PHASES}})
+        # tenant attribution rides the same root attr the router sets;
+        # untenanted roots carry no attr and stay out of the table
+        buckets = [cls]
+        tenant = attrs.get("tenant")
+        if tenant:
+            tn = per_tenant.setdefault(str(tenant), {
+                "requests": 0, "resubmitted": 0, "shed": 0, "failed": 0,
+                "latency": [], "shares": {p: [] for p in PHASES},
+                "by_class": {}})
+            tn["by_class"][slo] = tn["by_class"].get(slo, 0) + 1
+            buckets.append(tn)
         status = attrs.get("status")
         if status == "shed":
-            cls["shed"] += 1
+            for b in buckets:
+                b["shed"] += 1
             continue
         if status not in ("done", "failed"):
             unfinished += 1
             continue
         if status == "failed":
-            cls["failed"] += 1
+            for b in buckets:
+                b["failed"] += 1
         dur = float(root.get("dur_s", 0.0))
         if dur <= 0.0:
             continue
-        cls["requests"] += 1
+        for b in buckets:
+            b["requests"] += 1
         if int(attrs.get("resubmits", 0) or 0) > 0:
-            cls["resubmitted"] += 1
-        cls["latency"].append(dur)
+            for b in buckets:
+                b["resubmitted"] += 1
+        for b in buckets:
+            b["latency"].append(dur)
         sums = {p: 0.0 for p in PHASES}
         for s in ss:
             phase = PHASE_OF.get(s.get("name"))
@@ -493,9 +514,11 @@ def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
         acc = 0.0
         for p in PHASES[:-1]:
             share = sums[p] * scale / dur
-            cls["shares"][p].append(share)
+            for b in buckets:
+                b["shares"][p].append(share)
             acc += share
-        cls["shares"]["other"].append(max(1.0 - acc, 0.0))
+        for b in buckets:
+            b["shares"]["other"].append(max(1.0 - acc, 0.0))
 
     classes = {}
     for slo, cls in sorted(per_class.items()):
@@ -523,6 +546,23 @@ def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
             bad = cls["shed"] + cls["failed"]
             classes[slo]["objectives"] = compute_burn(
                 len(lat), over, bad, admitted, obj)
+    tenants = {}
+    for tenant, tn in sorted(per_tenant.items()):
+        tenants[tenant] = {
+            "requests": tn["requests"],
+            "resubmitted": tn["resubmitted"],
+            "shed": tn["shed"],
+            "failed": tn["failed"],
+            "by_class": dict(sorted(tn["by_class"].items())),
+            "latency_seconds": {
+                "p50": round(_pct(tn["latency"], 50), 6),
+                "p95": round(_pct(tn["latency"], 95), 6),
+            },
+            "phase_share": {
+                p: round(sum(v) / len(v), 6) if v else 0.0
+                for p, v in tn["shares"].items()
+            },
+        }
     return {
         "schema": 1,
         "ts": round(time.time(), 6),
@@ -531,6 +571,7 @@ def summarize_spans(spans: List[dict], objectives: Optional[dict] = None
         "requests": requests,
         "unfinished": unfinished,
         "classes": classes,
+        "tenants": tenants,
     }
 
 

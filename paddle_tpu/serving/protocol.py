@@ -40,6 +40,15 @@ Key schema (all under one namespace, default ``__srv``)::
                           so one request is one span tree across all
                           three processes. Absent when telemetry is off:
                           tracing adds zero wire bytes when disabled.
+                          With tenant accounting on
+                          (observability/accounting.py), a non-default
+                          ``tenant`` label travels the same way — a
+                          ``tenant`` + ``slo`` pair on the record (and
+                          on disaggregated KV handoff payloads) that the
+                          engine meters usage under. Requests without a
+                          tenant add zero wire bytes and land on the
+                          ledger's ``"-"`` default — the same disabled-
+                          path contract as ``trace``.
     {ns}/done/{rid}       completed token stream of router request `rid`
                           (written BEFORE the occupancy ack, so failover
                           can harvest finished work from a dead engine)

@@ -67,6 +67,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import accounting as _acct
 from ..observability import live as _live
 from ..inference.engine import PrefixRegistry, SamplingParams
 from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
@@ -128,6 +129,9 @@ class RouterRequest:
     deadline_t: float
     block_keys: List[bytes]
     status: str = "queued"  # queued | dispatched | done | failed | shed
+    #: cost-attribution label (observability/accounting.py); "-" = the
+    #: untagged default, which adds zero wire bytes to dispatch records
+    tenant: str = "-"
     engine: Optional[str] = None
     seq: int = -1
     tokens: Optional[np.ndarray] = None
@@ -225,6 +229,9 @@ class Router:
         #: lazily on the first pump with the plane enabled; stays None —
         #: one env dict lookup per pump — when it is off
         self._live_agg: Optional[_live.LiveAggregator] = None
+        #: router-side tenant ledger (shed attribution), created lazily
+        #: on the first submit with accounting enabled
+        self._acct: Optional[_acct.TenantLedger] = None
 
     @property
     def _streaming(self) -> bool:
@@ -233,10 +240,13 @@ class Router:
     # -- admission -----------------------------------------------------------
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               slo: str = "standard", **sampling) -> int:
+               slo: str = "standard", tenant: Optional[str] = None,
+               **sampling) -> int:
         """Admit a request (or shed it under overload). Returns its rid;
         a shed request keeps the rid so ``status``/``result`` can report
-        the rejection."""
+        the rejection. ``tenant`` labels the request for per-tenant cost
+        accounting (docs/OBSERVABILITY.md §11); absent it attributes to
+        the "-" default and adds zero wire bytes."""
         if slo not in SLO_CLASSES:
             raise ValueError(
                 f"unknown SLO class {slo!r}; expected one of {SLO_CLASSES}")
@@ -244,6 +254,8 @@ class Router:
             params = SamplingParams(**sampling)
         elif sampling:
             raise ValueError("pass params= or sampling kwargs, not both")
+        if self._acct is None and _acct.enabled():
+            self._acct = _acct.TenantLedger()
         prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
         if params.seed is None:
             # explicit seed => bit-equal streams on ANY engine, which is
@@ -259,6 +271,8 @@ class Router:
                 slo, DEFAULT_DEADLINES[slo]),
             block_keys=PrefixRegistry.block_keys(
                 prompt, self.config.page_size))
+        if tenant is not None:
+            req.tenant = _acct.normalize_tenant(tenant)
         self._next_rid += 1
         self._requests[req.rid] = req
         self.counters["submitted"] += 1
@@ -268,7 +282,8 @@ class Router:
             # the worker's and engine's spans join this tree
             root = _obs.start_span(
                 "srv_request", trace_id=_obs.new_trace_id(), rid=req.rid,
-                slo=slo, prompt_tokens=int(prompt.size))
+                slo=slo, tenant=req.tenant,
+                prompt_tokens=int(prompt.size))
             req.trace_id = root.trace_id
             self._tspans[req.rid] = {"root": root}
             ta = time.perf_counter()
@@ -310,9 +325,11 @@ class Router:
         req.shed_reason = reason
         req.finish_t = time.perf_counter()
         self.counters["shed"] += 1
+        if self._acct is not None:
+            self._acct.add(req.tenant, req.slo, shed_requests=1)
         _obs.inc("serving_router_shed_total")
         _obs.event("serving_router_shed", rid=req.rid, slo=req.slo,
-                   reason=reason)
+                   tenant=req.tenant, reason=reason)
         t = self._tspans.pop(req.rid, None)
         if t:
             for k in ("queue", "retry"):
@@ -637,6 +654,12 @@ class Router:
         est.next_seq += 1
         rec = {"rid": req.rid, "prompt": req.prompt.tolist(),
                "params": asdict(req.params)}
+        if req.tenant != "-":
+            # tenant + class ride the wire only when tagged: an untagged
+            # request's dispatch record is byte-identical to before the
+            # accounting plane existed (zero wire cost when unused)
+            rec["tenant"] = req.tenant
+            rec["slo"] = req.slo
         t = self._tspans.get(req.rid)
         if t:
             root = t["root"]
@@ -819,6 +842,23 @@ class Router:
                 e.name: self._load_tokens(e)
                 for e in self._engines.values() if e.alive},
         })
+        if self._acct is not None:
+            # per-engine per-tenant outstanding tokens: the raw signal
+            # the quota ladder gates on (gauges set by accounting.py —
+            # single writer — and mirrored into fleet_health.json)
+            per_engine: Dict[str, Dict[str, int]] = {}
+            for est in self._engines.values():
+                if not est.alive:
+                    continue
+                for req in est.inflight.values():
+                    if req.status != "dispatched":
+                        continue
+                    by = per_engine.setdefault(est.name, {})
+                    by[req.tenant] = by.get(req.tenant, 0) + len(
+                        req.prompt) + req.params.max_new_tokens
+            _acct.publish_outstanding(per_engine)
+            self._live_agg.note_tenants(self._acct.collect_delta(),
+                                        per_engine)
         self._live_agg.tick()
 
     def pump(self):

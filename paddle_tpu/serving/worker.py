@@ -228,7 +228,8 @@ class EngineWorker:
         try:
             local = self.engine.submit(
                 np.asarray(rec["prompt"], np.int64),
-                SamplingParams(**rec["params"]), trace=tr)
+                SamplingParams(**rec["params"]), trace=tr,
+                tenant=rec.get("tenant"), slo=rec.get("slo"))
         except ValueError as e:
             # invalid geometry for THIS engine (bucket/page limits):
             # report instead of dying — the router surfaces the error
@@ -260,7 +261,8 @@ class EngineWorker:
                 try:
                     payload = self.engine.prefill_export(
                         np.asarray(rec["prompt"], np.int64),
-                        SamplingParams(**rec["params"]), trace=tr)
+                        SamplingParams(**rec["params"]), trace=tr,
+                        tenant=rec.get("tenant"), slo=rec.get("slo"))
                 except ValueError as e:
                     self._publish_one_done(
                         {"rid": rid, "engine": self.name, "error": str(e)})
@@ -326,7 +328,8 @@ class EngineWorker:
             try:
                 local = self.engine.try_import_prefill(
                     np.asarray(rec["prompt"], np.int64),
-                    SamplingParams(**rec["params"]), kv, trace=tr)
+                    SamplingParams(**rec["params"]), kv, trace=tr,
+                    tenant=rec.get("tenant"), slo=rec.get("slo"))
             except ValueError as e:
                 self._publish_one_done(
                     {"rid": rid, "engine": self.name, "error": str(e)})
@@ -406,7 +409,9 @@ class EngineWorker:
         # before anyone can receive it (the ring only re-sends ~3 beats).
         if self._router_cids and _live.live_enabled():
             if self._live_shipper is None:
-                self._live_shipper = _live.LiveShipper(self.name)
+                self._live_shipper = _live.LiveShipper(
+                    self.name,
+                    ledger_fn=self.engine.accounting_ledger)
             pays = self._live_shipper.collect()
             if pays:
                 self._send_routers({"t": "tele", "pays": pays})

@@ -36,6 +36,14 @@ drives a long-prompt-heavy workload through 1 prefill + 1 decode worker
 vs 1 unified worker and asserts the token streams are bit-equal (raw KV
 wire).
 
+A tenant-accounting scenario (``--tenants``) replays a live-traced
+multi-tenant workload — one hot tenant at ~60% plus a long tail —
+with the per-tenant metering ledger off and on, gating greedy
+bit-equality, <= 2% overhead, EXACT conservation of the streamed
+``tenants`` block against both per-tenant sums and the bench's own
+ground-truth token counts, and the scripts/tenant_report.py post-hoc
+reconcile (<= 5%).
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/bench_serving.py
 """
@@ -970,6 +978,276 @@ def _gate_live_plane(args, block):
     return rc
 
 
+def _tenant_traffic(args, rng):
+    """Multi-tenant mix over the routed workload: one hot tenant takes
+    ~60% of requests across every SLO class and a long tail of
+    background tenants splits the rest — the shape the heavy-hitter
+    sketch is built for. Returns [(prompt, slo, new, tenant), ...]."""
+    tail = ("bravo", "coyote", "delta", "echo")
+    out = []
+    for i, (prompt, slo, new) in enumerate(_router_traffic(args, rng)[::3]):
+        tenant = "acme" if i % 5 < 3 else tail[(i // 5) % len(tail)]
+        out.append((prompt, slo, new, tenant))
+    return out
+
+
+def _tenant_phase(args, store, master, ns, tdir, accounting_on):
+    """One live-traced 2-worker routed phase for the tenant-accounting
+    A/B. BOTH sides run the live telemetry plane and submit the same
+    tenant labels (identical wire records), so the delta prices ONLY
+    the metering ledger + its tele-frame shipping. Returns (best wall
+    seconds, new tokens, outputs, health doc, roots, expected per-
+    tenant {prefill, requests}, measured per-tenant decode tokens)."""
+    import numpy as np
+
+    from paddle_tpu.observability import live
+    from paddle_tpu.serving import Router
+
+    extra = {"PADDLE_TPU_TELEMETRY_DIR": tdir,
+             "PADDLE_TPU_LIVE_TELEMETRY": "1",
+             "PADDLE_TPU_TENANT_ACCOUNTING": "1" if accounting_on else "0"}
+    procs = [_spawn_router_worker(
+        args, master, ns,
+        extra_env=dict(extra, PADDLE_TRAINER_ID=str(i + 1)))
+        for i in range(2)]
+    os.environ.update(extra)  # router = rank 0
+    health = None
+    try:
+        router = Router(store, namespace=ns, queue_limit=256,
+                        dataplane=args.dataplane,
+                        engine_grace_s=120.0, page_size=args.page_size,
+                        seed=args.seed, affinity_slack_tokens=128,
+                        max_inflight_per_engine=64,
+                        deadlines={"interactive": 600.0,
+                                   "standard": 600.0, "batch": 600.0})
+        router._live_agg = live.LiveAggregator(window_s=600.0,
+                                               health_interval_s=0.5)
+        deadline = time.monotonic() + 300.0
+        while router._known_engines < 2:
+            if time.monotonic() > deadline:
+                raise RuntimeError("router bench: tenant-phase workers "
+                                   "never registered")
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError("router bench: tenant-phase worker "
+                                       f"died rc={p.returncode}")
+            router.pump()
+            time.sleep(0.05)
+        rng = np.random.default_rng(args.seed + 6)
+        sub = _tenant_traffic(args, rng)
+        rounds = []
+        # warmup round (compile + store path), then two timed trials;
+        # the ledger meters ALL of them, so conservation is checked
+        # against every round's prompts and outputs
+        rids = [router.submit(p, slo=slo, max_new_tokens=new,
+                              tenant=tenant)
+                for p, slo, new, tenant in sub]
+        if not router.drain(timeout=600.0, poll=0.02):
+            raise RuntimeError("router bench: tenant warmup "
+                               f"undrained {router.stats()}")
+        rounds.append(rids)
+        trials = []
+        for _trial in range(2):
+            t0 = time.perf_counter()
+            rids = [router.submit(p, slo=slo, max_new_tokens=new,
+                                  tenant=tenant)
+                    for p, slo, new, tenant in sub]
+            if not router.drain(timeout=600.0, poll=0.02):
+                raise RuntimeError("router bench: tenant phase "
+                                   f"undrained {router.stats()}")
+            trials.append((time.perf_counter() - t0, rids))
+            rounds.append(rids)
+        wall, rids = min(trials, key=lambda t: t[0])
+        new_tokens = sum(len(router.result(r)) - len(p)
+                         for r, (p, _s, _n, _t) in zip(rids, sub))
+        outputs = [np.asarray(router.result(r))
+                   for rnd in rounds for r in rnd]
+        roots = len(rounds) * len(sub)
+        expected = {}
+        decode_by_tenant = {}
+        for rnd in rounds:
+            for r, (p, _slo, _new, tenant) in zip(rnd, sub):
+                ent = expected.setdefault(tenant,
+                                          {"requests": 0,
+                                           "prefill_tokens": 0})
+                ent["requests"] += 1
+                ent["prefill_tokens"] += int(len(p))
+                decode_by_tenant[tenant] = (
+                    decode_by_tenant.get(tenant, 0)
+                    + len(router.result(r)) - len(p))
+        # pump until a health doc covering every root (and, with the
+        # ledger on, every metered request) has landed on disk
+        hp = os.path.join(tdir, "fleet_health.json")
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            router.pump()
+            time.sleep(0.02)
+            if not os.path.exists(hp):
+                continue
+            with open(hp) as f:
+                health = json.load(f)
+            total = sum(c["requests"]
+                        for c in health.get("classes", {}).values())
+            metered = (health.get("tenants", {})
+                       .get("fleet", {}).get("requests", 0))
+            if total >= roots and (not accounting_on or metered >= roots):
+                break
+        else:
+            raise RuntimeError(
+                "router bench: tenant-phase fleet_health.json never "
+                f"converged (accounting_on={accounting_on}, "
+                f"{health and health.get('tenants', {}).get('fleet')})")
+        router.shutdown()
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for k in extra:
+            os.environ.pop(k, None)
+    return (wall, int(new_tokens), outputs, health, roots, expected,
+            decode_by_tenant)
+
+
+def run_tenants(args):
+    """Per-tenant accounting A/B: the SAME live-traced multi-tenant
+    workload with the metering ledger off and on. Gates that the
+    ledger is (a) free at the request path — tokens/s within
+    ``--max-tenant-overhead`` of ledger-off and greedy outputs
+    BIT-EQUAL — (b) conservative: every int field of the streamed
+    ``tenants`` block sums EXACTLY across tenants to the fleet total,
+    and requests/prefill/decode match the bench's own ground truth —
+    and (c) honest post hoc: scripts/tenant_report.py reconciles the
+    event log against the live ledger to within 5%."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.observability.accounting import INT_FIELDS
+    from paddle_tpu.runtime import TCPStore
+
+    port = _free_port()
+    store = TCPStore(host="127.0.0.1", port=port, is_master=True,
+                     timeout=60.0)
+    master = f"127.0.0.1:{port}"
+    try:
+        print("router: tenant-accounting A/B, ledger OFF (live "
+              "baseline)...", file=sys.stderr)
+        off_dir = tempfile.mkdtemp(prefix="bench_tenant_off_")
+        off_wall, off_tokens, off_out, _h, _r, _e, _d = _tenant_phase(
+            args, store, master, "__bencht0", off_dir, accounting_on=False)
+        print("router: tenant-accounting A/B, ledger ON...",
+              file=sys.stderr)
+        on_dir = tempfile.mkdtemp(prefix="bench_tenant_on_")
+        (on_wall, on_tokens, on_out, health, roots, expected,
+         decode_by_tenant) = _tenant_phase(
+            args, store, master, "__bencht1", on_dir, accounting_on=True)
+    finally:
+        store.close()
+    for a, b in zip(off_out, on_out):
+        np.testing.assert_array_equal(
+            a, b, err_msg="token streams changed with tenant "
+                          "accounting enabled")
+    tn = health["tenants"]
+    fleet, per_tenant = tn["fleet"], tn["per_tenant"]
+    # conservation: int fields sum EXACTLY across tenants to the fleet
+    # total, and the ledger agrees with the bench's own ground truth
+    problems = []
+    for f in INT_FIELDS:
+        if fleet[f] != sum(c[f] for c in per_tenant.values()):
+            problems.append(f"fleet {f} {fleet[f]} != per-tenant sum")
+    if fleet["requests"] != roots:
+        problems.append(f"fleet requests {fleet['requests']} != {roots}")
+    exp_prefill = sum(e["prefill_tokens"] for e in expected.values())
+    if fleet["prefill_tokens"] != exp_prefill:
+        problems.append(f"fleet prefill {fleet['prefill_tokens']} != "
+                        f"submitted prompt tokens {exp_prefill}")
+    exp_decode = sum(decode_by_tenant.values())
+    if fleet["decode_tokens"] != exp_decode:
+        problems.append(f"fleet decode {fleet['decode_tokens']} != "
+                        f"served new tokens {exp_decode}")
+    for tenant, ent in sorted(expected.items()):
+        cell = per_tenant.get(tenant)
+        if cell is None:
+            problems.append(f"tenant {tenant} missing from ledger")
+            continue
+        for f, want in (("requests", ent["requests"]),
+                        ("prefill_tokens", ent["prefill_tokens"]),
+                        ("decode_tokens", decode_by_tenant[tenant])):
+            if cell[f] != want:
+                problems.append(
+                    f"tenant {tenant} {f} {cell[f]} != {want}")
+    conservation_exact = not problems
+    for p in problems:
+        print(f"tenant conservation: {p}", file=sys.stderr)
+    top = tn["top"]
+    hot_rank0 = bool(top) and top[0]["tenant"] == "acme"
+    # post-hoc reconcile: event log vs the live ledger, priced the same
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report_path = os.path.join(on_dir, "tenant_report.json")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(repo, "scripts", "tenant_report.py"),
+         on_dir, "--health", os.path.join(on_dir, "fleet_health.json"),
+         "--out", report_path, "--max-rel-diff", "0.05"], cwd=repo)
+    reconcile_worst = None
+    if os.path.exists(report_path):
+        with open(report_path) as f:
+            reconcile_worst = (json.load(f).get("reconcile", {})
+                               .get("worst_rel_diff"))
+    off_tps = off_tokens / off_wall
+    on_tps = on_tokens / on_wall
+    return {
+        "workers": 2,
+        "requests_per_phase": roots,
+        "tenants": {t: e["requests"] for t, e in sorted(expected.items())},
+        "hot_tenant": "acme",
+        "accounting_off": {"seconds": round(off_wall, 4),
+                           "new_tokens": off_tokens,
+                           "tokens_per_second": round(off_tps, 2)},
+        "accounting_on": {"seconds": round(on_wall, 4),
+                          "new_tokens": on_tokens,
+                          "tokens_per_second": round(on_tps, 2)},
+        "overhead_frac": round(1.0 - on_tps / off_tps, 4),
+        "greedy_bit_equal": True,
+        "conservation_exact": conservation_exact,
+        "conservation_problems": problems,
+        "fleet": fleet,
+        "per_tenant": per_tenant,
+        "hot_tenant_rank0": hot_rank0,
+        "heavy_hitter_top": [
+            {k: r[k] for k in ("tenant", "rank", "device_seconds",
+                               "sketch_count", "sketch_error")
+             if k in r} for r in top[:3]],
+        "tenant_report_rc": rc,
+        "reconcile_worst_rel_diff": reconcile_worst,
+    }
+
+
+def _gate_tenants(args, block):
+    rc = 0
+    if (args.max_tenant_overhead
+            and block["overhead_frac"] > args.max_tenant_overhead):
+        print(f"FAIL: tenant-accounting overhead "
+              f"{block['overhead_frac']:.4f} > max "
+              f"{args.max_tenant_overhead} of ledger-off tokens/s",
+              file=sys.stderr)
+        rc = 1
+    if not block["conservation_exact"]:
+        print("FAIL: per-tenant ledger does not conserve — per-tenant "
+              "sums or bench ground truth diverged from fleet totals",
+              file=sys.stderr)
+        rc = 1
+    if not block["hot_tenant_rank0"]:
+        print("FAIL: heavy-hitter sketch did not rank the hot tenant "
+              "first", file=sys.stderr)
+        rc = 1
+    if block["tenant_report_rc"] != 0:
+        print(f"FAIL: tenant_report.py reconcile rc="
+              f"{block['tenant_report_rc']} (event log vs live ledger "
+              "off by more than 5%)", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 class _PacedTrainer:
     """Emulated data-parallel training job riding the serving fleet:
     fixed global batch, so the wall time of one optimizer step is
@@ -1470,6 +1748,17 @@ def main(argv=None):
                          "BENCH_SERVING.json")
     ap.add_argument("--skip-live-plane", action="store_true",
                     help="skip the live-plane scenario in the full run")
+    ap.add_argument("--tenants-only", action="store_true",
+                    help="run only the per-tenant accounting A/B (live-"
+                         "traced 2-worker multi-tenant workload, ledger "
+                         "off vs on; gates conservation, overhead, and "
+                         "the post-hoc reconcile) and merge the tenants "
+                         "block into the existing BENCH_SERVING.json")
+    ap.add_argument("--tenants", action="store_true",
+                    help="alias for --tenants-only")
+    ap.add_argument("--skip-tenants", action="store_true",
+                    help="skip the tenant-accounting scenario in the "
+                         "full run")
     ap.add_argument("--autoscale-only", action="store_true",
                     help="run only the train/serve colocation autoscale "
                          "A/B/C (static 2+0, static 1+1, supervisor-"
@@ -1502,6 +1791,10 @@ def main(argv=None):
                     help="fail if enabling the live telemetry plane "
                          "costs more than this fraction of live-off "
                          "tokens/s (0 disables)")
+    ap.add_argument("--max-tenant-overhead", type=float, default=0.02,
+                    help="fail if enabling the per-tenant accounting "
+                         "ledger costs more than this fraction of "
+                         "ledger-off tokens/s (0 disables)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "BENCH_SERVING.json"))
@@ -1537,6 +1830,18 @@ def main(argv=None):
             f.write("\n")
         print(json.dumps({"live_plane": block}, indent=2))
         return _gate_live_plane(args, block)
+    if args.tenants_only or args.tenants:
+        block = run_tenants(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["tenants"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"tenants": block}, indent=2))
+        return _gate_tenants(args, block)
     if args.autoscale_only or args.autoscale:
         block = run_autoscale(args)
         report = {}
@@ -1676,6 +1981,8 @@ def main(argv=None):
         report["router"] = run_router(args)
     if not args.skip_live_plane:
         report["live_plane"] = run_live_plane(args)
+    if not args.skip_tenants:
+        report["tenants"] = run_tenants(args)
     if not args.skip_autoscale:
         report["colocation"] = run_autoscale(args)
     with open(args.out, "w") as f:
@@ -1691,6 +1998,8 @@ def main(argv=None):
         rc = rc or _gate_router(args, report["router"])
     if not args.skip_live_plane:
         rc = rc or _gate_live_plane(args, report["live_plane"])
+    if not args.skip_tenants:
+        rc = rc or _gate_tenants(args, report["tenants"])
     if not args.skip_autoscale:
         rc = rc or _gate_autoscale(args, report["colocation"])
     return rc

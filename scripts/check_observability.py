@@ -101,6 +101,8 @@ OWNED_PREFIXES = {
     "slo_": os.path.join("paddle_tpu", "observability", "live.py"),
     "supervisor_": os.path.join("paddle_tpu", "distributed", "fleet",
                                 "supervisor.py"),
+    "tenant_": os.path.join("paddle_tpu", "observability",
+                            "accounting.py"),
 }
 
 

@@ -24,6 +24,7 @@ Usage::
     python scripts/fleet_dashboard.py TELEMETRY_DIR            # one shot
     python scripts/fleet_dashboard.py TELEMETRY_DIR --watch    # live loop
     python scripts/fleet_dashboard.py TELEMETRY_DIR --html out.html
+    python scripts/fleet_dashboard.py TELEMETRY_DIR --tenants  # + tenants
     python scripts/fleet_dashboard.py --selftest
 
 Burn-rate reading: 1.0 means the error budget is being consumed exactly
@@ -128,6 +129,61 @@ _CLASS_HEADER = ["class", "done", "shed", "fail", "p50", "p95", "p99",
                  "target", "burn(lat)", "burn(avail)"]
 
 
+_TENANT_HEADER = ["tenant", "rank", "dev-s", "share", "reqs", "shed",
+                  "prefill", "decode", "kv-page-s", "burn share",
+                  "outstanding"]
+
+
+def tenant_rows(doc):
+    """Top-K heavy-hitter rows from the health doc's ``tenants`` block
+    (the accounting plane, docs/OBSERVABILITY.md §11)."""
+    tn = doc.get("tenants") or {}
+    fleet_ds = float((tn.get("fleet") or {}).get("device_seconds", 0.0))
+    rows = []
+    for r in tn.get("top") or []:
+        ds = float(r.get("device_seconds", 0.0))
+        share = ds / fleet_ds if fleet_ds > 0.0 else 0.0
+        burn = r.get("burn_share") or {}
+        outst = r.get("outstanding_tokens") or {}
+        rows.append([
+            r.get("tenant", "?"), r.get("rank", "?"), f"{ds:.4f}",
+            f"{share * 100:.1f}%", r.get("requests", 0),
+            r.get("shed_requests", 0), r.get("prefill_tokens", 0),
+            r.get("decode_tokens", 0),
+            f"{float(r.get('kv_page_seconds', 0.0)):.2f}",
+            " ".join(f"{slo}={v:.2f}" for slo, v in sorted(burn.items()))
+            or "-",
+            " ".join(f"{e}={int(v)}" for e, v in sorted(outst.items()))
+            or "-",
+        ])
+    return rows
+
+
+def tenant_lines(doc):
+    """The ``--tenants`` panel: fleet totals, the heavy-hitter table,
+    and the sketch's coverage note."""
+    tn = (doc or {}).get("tenants") or {}
+    if not tn.get("top") and not tn.get("per_tenant"):
+        return ["tenants: (no attributed usage in the ledger yet)"]
+    fleet = tn.get("fleet") or {}
+    lines = [
+        "tenant attribution  "
+        f"(fleet {float(fleet.get('device_seconds', 0.0)):.4f} dev-s, "
+        f"{fleet.get('prefill_tokens', 0)} prefill + "
+        f"{fleet.get('decode_tokens', 0)} decode tokens, "
+        f"{tn.get('tracked', 0)} tracked"
+        + (f", {tn['folded_tenants']} folded"
+           if tn.get("folded_tenants") else "") + ")"]
+    rows = tenant_rows(doc)
+    if rows:
+        lines += [_table(rows, _TENANT_HEADER)]
+    sk = tn.get("sketch") or {}
+    if sk:
+        lines += [f"heavy-hitter sketch: capacity {sk.get('capacity')}, "
+                  f"{float(sk.get('total', 0.0)):.4f} dev-s offered"]
+    return lines
+
+
 def roles_lines(journal, now=None):
     """The fleet-roles panel from the supervisor journal dir: current
     serving/training split, breaker state, any in-flight flip and the
@@ -168,7 +224,7 @@ def roles_lines(journal, now=None):
     return lines
 
 
-def render_text(doc, now=None, journal=None):
+def render_text(doc, now=None, journal=None, tenants=False):
     """The terminal view: one string, ready to print."""
     if doc is None and journal is None:
         return "[fleet_dashboard] no fleet_health.json yet " \
@@ -224,13 +280,15 @@ def render_text(doc, now=None, journal=None):
     if sources:
         lines += ["", "sources (s since last payload): "
                   + ", ".join(f"{s}={a}" for s, a in sorted(sources.items()))]
+    if tenants:
+        lines += [""] + tenant_lines(doc)
     rl = roles_lines(journal, now=now)
     if rl:
         lines += [""] + rl
     return "\n".join(lines)
 
 
-def render_html(doc, now=None, journal=None):
+def render_html(doc, now=None, journal=None, tenants=False):
     """One-shot static HTML (no JS, no external assets): the same
     content as the terminal view, with flagged cells highlighted."""
     now = time.time() if now is None else now
@@ -254,7 +312,7 @@ def render_html(doc, now=None, journal=None):
             head = "".join(f"<th>{_html.escape(h)}</th>"
                            for h in _CLASS_HEADER)
             parts.append(f"<table><tr>{head}</tr>{cells}</table>")
-        pre = render_text(doc, now=now, journal=journal)
+        pre = render_text(doc, now=now, journal=journal, tenants=tenants)
         parts.append(f"<pre>{_html.escape(pre)}</pre>")
         body = "\n".join(parts)
     return ("<!doctype html><html><head><meta charset='utf-8'>"
@@ -305,6 +363,30 @@ def selftest():
                       "reconnect_rate_per_min": 1.0, "storm": False},
         "compile_cache": {"hits": 9.0, "misses": 1.0, "hit_rate": 0.9},
         "sources": {"engine0": 0.4},
+        "tenants": {
+            "fleet": {"requests": 45, "shed_requests": 1,
+                      "prefill_tokens": 900, "decode_tokens": 450,
+                      "kv_page_us": 9_000_000, "wire_bytes": 0,
+                      "device_seconds": 0.5},
+            "per_tenant": {
+                "acme": {"device_seconds": 0.4},
+                "globex": {"device_seconds": 0.1}},
+            "top": [
+                {"tenant": "acme", "rank": 0, "device_seconds": 0.4,
+                 "sketch_count": 0.4, "sketch_error": 0.0,
+                 "requests": 40, "shed_requests": 1,
+                 "prefill_tokens": 800, "decode_tokens": 400,
+                 "kv_page_seconds": 8.0, "wire_bytes": 0,
+                 "burn_share": {"interactive": 0.75},
+                 "outstanding_tokens": {"engine0": 512}},
+                {"tenant": "globex", "rank": 1, "device_seconds": 0.1,
+                 "sketch_count": 0.1, "sketch_error": 0.0,
+                 "requests": 5, "shed_requests": 0,
+                 "prefill_tokens": 100, "decode_tokens": 50,
+                 "kv_page_seconds": 1.0, "wire_bytes": 0}],
+            "tracked": 2, "folded_tenants": 0,
+            "sketch": {"capacity": 64, "total": 0.5},
+        },
     }
     journal = {
         "roles": {"roles": {"engine0": "serving", "engine1": "training"},
@@ -329,9 +411,21 @@ def selftest():
         assert needle in text, (needle, text)
     # burn < 1 is NOT flagged; the flagged one is availability/interactive
     assert "0.00 BURN" not in text
-    page = render_html(doc, now=1001.0, journal=journal)
+    # the tenants panel is opt-in: absent by default, present with the
+    # flag (heavy-hitter table + fleet totals + burn share + outstanding)
+    assert "tenant attribution" not in text
+    ttext = render_text(doc, now=1001.0, journal=journal, tenants=True)
+    for needle in ("tenant attribution", "acme", "globex",
+                   "interactive=0.75", "engine0=512", "80.0%",
+                   "heavy-hitter sketch: capacity 64"):
+        assert needle in ttext, (needle, ttext)
+    empty = render_text({"ts": 1000.0, "classes": {}}, now=1001.0,
+                        tenants=True)
+    assert "no attributed usage" in empty
+    page = render_html(doc, now=1001.0, journal=journal, tenants=True)
     assert "<table>" in page and "class='burn'" in page
     assert "STRAGGLER" in page and "in-flight flip 77" in page
+    assert "tenant attribution" in page
     # roles panel renders alone when only the journal exists yet
     assert "fleet roles" in render_text(None, journal=journal)
     # missing file / torn doc degrade to a hint, not a crash
@@ -362,6 +456,10 @@ def main(argv=None):
                     help="fleet supervisor journal dir (fleet_roles.json, "
                          "flip_current.json, flip_log.json) — adds the "
                          "fleet-roles panel")
+    ap.add_argument("--tenants", action="store_true",
+                    help="add the per-tenant attribution panel (heavy-"
+                         "hitter table: device-seconds, burn share, shed "
+                         "counts, outstanding tokens)")
     ap.add_argument("--html", default=None, metavar="OUT",
                     help="write a one-shot static HTML page instead of "
                          "printing the terminal view")
@@ -380,7 +478,7 @@ def main(argv=None):
 
     if args.html:
         page = render_html(load_health(args.telemetry_dir),
-                           journal=_journal())
+                           journal=_journal(), tenants=args.tenants)
         tmp = f"{args.html}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(page)
@@ -392,12 +490,14 @@ def main(argv=None):
             while True:
                 print("\x1b[2J\x1b[H"
                       + render_text(load_health(args.telemetry_dir),
-                                    journal=_journal()),
+                                    journal=_journal(),
+                                    tenants=args.tenants),
                       flush=True)
                 time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
-    print(render_text(load_health(args.telemetry_dir), journal=_journal()))
+    print(render_text(load_health(args.telemetry_dir), journal=_journal(),
+                      tenants=args.tenants))
     return 0
 
 

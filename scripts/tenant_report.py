@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""Post-hoc per-tenant capacity attribution + live-ledger reconciliation.
+
+Rebuilds the tenant accounting table from the durable event log — every
+``serving_request_done`` event carries ``tenant`` / ``slo_class`` /
+``prompt_tokens`` / ``generated_tokens`` / ``spec_wasted`` /
+``kv_page_us``, and every ``serving_router_shed`` event carries the shed
+request's tenant — prices it into normalized device-seconds with the
+same ``Prices`` table the live plane used (read back from
+``fleet_health.json`` when present, so both sides price in one
+currency), and reconciles the result against the live aggregator's
+``tenants`` block: the worst per-tenant relative difference in
+device-seconds must stay within ``--max-rel-diff`` (default 5%, the
+same budget trace_report grants live-vs-post-hoc burn rates).
+
+Expected residuals, by construction: the event log attributes a
+request's full usage to the engine where it FINISHED (prompt_tokens on
+an imported request were prefilled elsewhere), while the live ledger
+meters each engine's share in place; wire bytes and the unattributed
+page-second remainder (shared prefix pages held by the registry,
+integer split residue) exist only in the live ledger, most of it on the
+``"-"`` default tenant.  Both views conserve their own totals — they
+differ only in where cross-engine usage lands, which is what the
+rel-diff budget bounds.
+
+Stdlib-only: ``observability/accounting.py`` is loaded straight from
+its file path (the check_observability.py catalog idiom), so this runs
+anywhere the telemetry dir lands, no jax import.
+
+Usage::
+
+    python scripts/tenant_report.py TELEMETRY_DIR \
+        [--health PATH] [--out tenant_report.json] [--max-rel-diff 0.05]
+    python scripts/tenant_report.py --selftest
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ACCOUNTING_PY = os.path.join(
+    _REPO, "paddle_tpu", "observability", "accounting.py")
+
+
+def _load_accounting():
+    spec = importlib.util.spec_from_file_location("_acct", _ACCOUNTING_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_events(directory):
+    """Every parseable event record under the dir (events_rank*.jsonl),
+    torn tail lines skipped like tracing.load_spans."""
+    out = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for fn in names:
+        if not (fn.startswith("events_rank") and fn.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(directory, fn), "rb") as f:
+                for raw in f.read().split(b"\n"):
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        rec = json.loads(raw.decode("utf-8", "replace"))
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        out.append(rec)
+        except OSError:
+            continue
+    return out
+
+
+def attribute(events, acct):
+    """Per-(tenant, slo) ledger rebuilt from the durable event log: the
+    post-hoc view of exactly the fields the done/shed events persist."""
+    ledger = acct.TenantLedger()
+    for rec in events:
+        kind = rec.get("kind")
+        if kind == "serving_request_done":
+            tenant = acct.normalize_tenant(rec.get("tenant"))
+            slo = str(rec.get("slo_class") or "standard")
+            try:
+                ledger.add(
+                    tenant, slo,
+                    requests=1,
+                    prefill_tokens=int(rec.get("prompt_tokens", 0) or 0),
+                    decode_tokens=int(rec.get("generated_tokens", 0) or 0),
+                    spec_accepted_tokens=int(rec.get("spec_accepted", 0)
+                                             or 0),
+                    spec_wasted_tokens=int(rec.get("spec_wasted", 0) or 0),
+                    kv_page_us=int(rec.get("kv_page_us", 0) or 0),
+                    queue_seconds=float(rec.get("queue_s", 0.0) or 0.0),
+                )
+            except (TypeError, ValueError):
+                continue
+        elif kind == "serving_router_shed":
+            tenant = acct.normalize_tenant(rec.get("tenant"))
+            slo = str(rec.get("slo") or "standard")
+            ledger.add(tenant, slo, shed_requests=1)
+    return ledger
+
+
+def _prices_from_health(health, acct):
+    """The price table the live plane published, else the accounting
+    defaults — both sides must price in the same currency for the
+    rel-diff to mean anything."""
+    try:
+        p = health["tenants"]["prices"]
+        return acct.Prices(
+            prefill_token_s=p["prefill_token_s"],
+            decode_token_s=p["decode_token_s"],
+            wasted_token_s=p["wasted_token_s"],
+            page_second_s=p["page_second_s"],
+            wire_byte_s=p["wire_byte_s"],
+            source=str(p.get("source", "fleet_health.json")))
+    except (TypeError, KeyError):
+        return acct.default_prices()
+
+
+def reconcile(post_hoc, live_per_tenant, prices, acct):
+    """Worst per-tenant relative device-second difference between the
+    rebuilt ledger and the live health doc's exact table.  The ``"-"``
+    default and ``"~"`` overflow cells are excluded — they are exactly
+    where the two views park their structural residuals (unattributed
+    page remainders live-side, nothing post-hoc-side)."""
+    rows = []
+    worst = 0.0
+    tenants = (set(post_hoc) | set(live_per_tenant)) - {
+        acct.DEFAULT_TENANT, acct.OVERFLOW_TENANT}
+    for tenant in sorted(tenants):
+        ds_post = prices.device_seconds(post_hoc.get(tenant, {}))
+        live_row = live_per_tenant.get(tenant) or {}
+        ds_live = float(live_row.get("device_seconds", 0.0))
+        denom = max(ds_post, ds_live)
+        rel = abs(ds_post - ds_live) / denom if denom > 0.0 else 0.0
+        worst = max(worst, rel)
+        rows.append({"tenant": tenant,
+                     "device_seconds_post_hoc": round(ds_post, 9),
+                     "device_seconds_live": round(ds_live, 9),
+                     "rel_diff": round(rel, 6)})
+    return worst, rows
+
+
+def run_report(telemetry_dir, health_path, out_path, max_rel_diff):
+    acct = _load_accounting()
+    events = load_events(telemetry_dir)
+    ledger = attribute(events, acct)
+    if not len(ledger):
+        print(f"[tenant_report] no serving_request_done events under "
+              f"{telemetry_dir}", file=sys.stderr)
+        return 1
+    health = None
+    path = health_path or os.path.join(telemetry_dir, "fleet_health.json")
+    try:
+        with open(path) as f:
+            health = json.load(f)
+    except (OSError, ValueError):
+        pass
+    prices = _prices_from_health(health, acct)
+    post_hoc = ledger.per_tenant()
+    doc = {
+        "schema": 1,
+        "events": len(events),
+        "prices": prices.to_dict(),
+        "per_tenant": {
+            t: {**{f: c[f] for f in acct.INT_FIELDS},
+                "queue_seconds": round(c["queue_seconds"], 6),
+                "device_seconds": round(prices.device_seconds(c), 9)}
+            for t, c in post_hoc.items()},
+        "fleet": {f: ledger.fleet()[f] for f in acct.INT_FIELDS},
+    }
+    rc = 0
+    if health is not None:
+        live = (health.get("tenants") or {}).get("per_tenant") or {}
+        worst, rows = reconcile(post_hoc, live, prices, acct)
+        doc["reconcile"] = {
+            "against": path,
+            "worst_rel_diff": round(worst, 6),
+            "max_rel_diff": max_rel_diff,
+            "ok": worst <= max_rel_diff,
+            "rows": rows,
+        }
+        acct.emit_reconcile(worst, len(rows), source="tenant_report")
+        if worst > max_rel_diff:
+            print(f"[tenant_report] RECONCILE FAIL: worst per-tenant "
+                  f"rel diff {worst:.4f} > {max_rel_diff}",
+                  file=sys.stderr)
+            rc = 1
+    if out_path:
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, out_path)
+    print(f"[tenant_report] {len(post_hoc)} tenants from "
+          f"{len(events)} events"
+          + (f", worst rel diff "
+             f"{doc['reconcile']['worst_rel_diff']}"
+             if "reconcile" in doc else "")
+          + (f" -> {out_path}" if out_path else ""))
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+def selftest():
+    acct = _load_accounting()
+    with tempfile.TemporaryDirectory(prefix="tenant_report_") as d:
+        prices = acct.Prices()
+        # synthesize the durable log: two tenants, one imported request,
+        # one shed — and a live health doc whose exact table agrees on
+        # "acme" but drifts 2% on "globex"
+        events = [
+            {"kind": "serving_request_done", "tenant": "acme",
+             "slo_class": "interactive", "prompt_tokens": 100,
+             "generated_tokens": 40, "spec_accepted": 4, "spec_wasted": 2,
+             "kv_page_us": 2_000_000, "queue_s": 0.25},
+            {"kind": "serving_request_done", "tenant": "acme",
+             "slo_class": "standard", "prompt_tokens": 50,
+             "generated_tokens": 10, "spec_accepted": 0, "spec_wasted": 0,
+             "kv_page_us": 500_000, "queue_s": 0.1, "imported": True},
+            {"kind": "serving_request_done", "tenant": "globex",
+             "slo_class": "batch", "prompt_tokens": 20,
+             "generated_tokens": 5, "spec_accepted": 0, "spec_wasted": 0,
+             "kv_page_us": 100_000, "queue_s": 0.0},
+            {"kind": "serving_router_shed", "tenant": "globex",
+             "slo": "batch"},
+            {"kind": "xla_compile", "seconds": 1.0},  # ignored
+        ]
+        with open(os.path.join(d, "events_rank0.jsonl"), "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+            f.write('{"kind": "serving_request_done", "tenant": "torn')
+        ledger = attribute(load_events(d), acct)
+        pt = ledger.per_tenant()
+        assert set(pt) == {"acme", "globex"}, pt
+        assert pt["acme"]["prefill_tokens"] == 150
+        assert pt["acme"]["decode_tokens"] == 50
+        assert pt["acme"]["kv_page_us"] == 2_500_000
+        assert pt["globex"]["shed_requests"] == 1
+        fleet = ledger.fleet()
+        for f_ in acct.INT_FIELDS:
+            assert fleet[f_] == sum(c[f_] for c in pt.values()), f_
+        ds_acme = prices.device_seconds(pt["acme"])
+        ds_glob = prices.device_seconds(pt["globex"])
+        health = {"tenants": {
+            "prices": prices.to_dict(),
+            "per_tenant": {
+                "acme": {"device_seconds": ds_acme},
+                "globex": {"device_seconds": ds_glob * 1.02},
+            }}}
+        hp = os.path.join(d, "fleet_health.json")
+        with open(hp, "w") as f:
+            json.dump(health, f)
+        out = os.path.join(d, "tenant_report.json")
+        rc = run_report(d, hp, out, max_rel_diff=0.05)
+        assert rc == 0, rc
+        with open(out) as f:
+            doc = json.load(f)
+        rows = {r["tenant"]: r for r in doc["reconcile"]["rows"]}
+        assert rows["acme"]["rel_diff"] == 0.0, rows
+        assert 0.015 < rows["globex"]["rel_diff"] < 0.025, rows
+        assert doc["reconcile"]["ok"]
+        # a drift past the budget must fail the gate
+        health["tenants"]["per_tenant"]["globex"]["device_seconds"] = \
+            ds_glob * 1.5
+        with open(hp, "w") as f:
+            json.dump(health, f)
+        rc = run_report(d, hp, out, max_rel_diff=0.05)
+        assert rc == 1, rc
+        print("tenant_report selftest ok")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("tenant_report")
+    ap.add_argument("telemetry_dir", nargs="?",
+                    help="dir holding events_rank*.jsonl")
+    ap.add_argument("--health", default=None,
+                    help="fleet_health.json to reconcile against "
+                         "(default: TELEMETRY_DIR/fleet_health.json)")
+    ap.add_argument("--out", default=None,
+                    help="report output path "
+                         "(default: TELEMETRY_DIR/tenant_report.json)")
+    ap.add_argument("--max-rel-diff", type=float, default=0.05,
+                    help="worst per-tenant device-second disagreement "
+                         "tolerated between live and post-hoc views")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.telemetry_dir:
+        ap.error("telemetry_dir is required (or --selftest)")
+    out = args.out or os.path.join(args.telemetry_dir, "tenant_report.json")
+    return run_report(args.telemetry_dir, args.health, out,
+                      args.max_rel_diff)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

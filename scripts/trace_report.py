@@ -221,13 +221,18 @@ def _synthesize(tracing, d):
     os.environ["PADDLE_TPU_TELEMETRY_DIR"] = d
     os.environ["PADDLE_TRAINER_ID"] = "0"
     trees = []
-    for i, (slo, retried) in enumerate(
-            [("interactive", False), ("standard", False),
-             ("standard", True), ("batch", False)]):
+    # multi-tenant: the hot tenant owns the interactive + retried trees,
+    # the long-tail one a standard tree, and the batch tree is untenanted
+    # (no tenant attr at all — it must stay out of the tenants table)
+    for i, (slo, retried, tenant) in enumerate(
+            [("interactive", False, "acme"), ("standard", False, "globex"),
+             ("standard", True, "acme"), ("batch", False, None)]):
         tid = tracing.new_trace_id()
+        attrs = dict(rid=i, slo=slo, status="done", resubmits=int(retried))
+        if tenant:
+            attrs["tenant"] = tenant
         root = tracing.record_span(
-            "srv_request", trace_id=tid, dur_s=1.0, rid=i, slo=slo,
-            status="done", resubmits=int(retried))
+            "srv_request", trace_id=tid, dur_s=1.0, **attrs)
         tracing.record_span("srv_queue", trace_id=tid, parent_id=root,
                             dur_s=0.2, slo=slo)
         tracing.record_span("srv_dispatch", trace_id=tid, parent_id=root,
@@ -314,9 +319,21 @@ def selftest():
             total = sum(v["mean"] for v in c["phase_share"].values())
             assert abs(total - 1.0) < 1e-6, (c, total)
             assert c["latency_seconds"]["p50"] > 0
+        # per-tenant attribution: roots carrying a tenant attr land in
+        # the tenants table with their class mix and a phase-share
+        # partition; the untenanted batch tree stays out
+        tns = summary["tenants"]
+        assert set(tns) == {"acme", "globex"}, tns
+        assert tns["acme"]["requests"] == 2
+        assert tns["acme"]["resubmitted"] == 1
+        assert tns["acme"]["by_class"] == {"interactive": 1, "standard": 1}
+        assert tns["globex"]["by_class"] == {"standard": 1}
+        for tn in tns.values():
+            total = sum(tn["phase_share"].values())
+            assert abs(total - 1.0) < 1e-6, (tn, total)
         print("trace_report selftest ok "
               f"({len(spans)} spans, {summary['requests']} trees, "
-              f"{len(cls)} classes)")
+              f"{len(cls)} classes, {len(tns)} tenants)")
     return 0
 
 

@@ -535,3 +535,43 @@ def test_flip_span_owned_by_supervisor(tmp_path):
 def test_supervisor_prefix_registered():
     assert check_observability.OWNED_PREFIXES["supervisor_"].endswith(
         "supervisor.py")
+
+
+# ---------------------------------------------------------------------------
+# tenant accounting family (PR 18): single-writer, registered, gauge-kind
+# ---------------------------------------------------------------------------
+_TENANT_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.set_gauge("tenant_device_seconds", 1.5, tenant="acme")
+        _obs.event("tenant_heavy_hitter", tenant="acme", rank=0)
+"""
+
+
+def test_tenant_family_from_accounting_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_TENANT_SRC))
+    rel = os.path.join("paddle_tpu", "observability", "accounting.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_tenant_family_from_wrong_file_rejected(tmp_path):
+    # a router or bench recording tenant_* directly would fork the
+    # family into a mixed-meaning series — both the gauge and the event
+    # must be flagged as single-writer violations
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_TENANT_SRC))
+    rel = os.path.join("paddle_tpu", "serving", "router.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 2 and all("single-writer" in m for _, m in v), v
+
+
+def test_tenant_family_registered():
+    assert check_observability.OWNED_PREFIXES["tenant_"].endswith(
+        "accounting.py")
+    for name in ("tenant_device_seconds", "tenant_tokens",
+                 "tenant_kv_page_seconds", "tenant_wire_bytes",
+                 "tenant_shed_requests", "tenant_outstanding_tokens"):
+        assert CATALOG.METRICS[name][0] == "gauge", name
+    assert "tenant_heavy_hitter" in CATALOG.EVENTS
+    assert "tenant_ledger_reconcile" in CATALOG.EVENTS
