@@ -45,6 +45,7 @@ drags jax into post-hoc tooling.
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -79,18 +80,25 @@ def enabled() -> bool:
     return os.environ.get("PADDLE_TPU_TENANT_ACCOUNTING", "1") != "0"
 
 
-def normalize_tenant(tenant) -> str:
-    """Coerce a user-supplied tenant label into the ledger alphabet:
-    non-empty printable string without the wire separator, <= 64 chars.
-    ``None``/empty -> the ``"-"`` default."""
-    if tenant is None:
-        return DEFAULT_TENANT
-    t = str(tenant).strip()
+@functools.lru_cache(maxsize=4096)
+def _normalize_label(label: str) -> str:
+    t = label.strip()
     if not t:
         return DEFAULT_TENANT
     t = "".join(c if (c.isprintable() and c != _SEP and not c.isspace())
                 else "_" for c in t)
     return t[:64] or DEFAULT_TENANT
+
+
+def normalize_tenant(tenant) -> str:
+    """Coerce a user-supplied tenant label into the ledger alphabet:
+    non-empty printable string without the wire separator, <= 64 chars.
+    ``None``/empty -> the ``"-"`` default. Cached per label: the
+    per-request call sites (router admission, frontier quota gate) see
+    the same few labels millions of times in a replay."""
+    if tenant is None:
+        return DEFAULT_TENANT
+    return _normalize_label(str(tenant))
 
 
 # -- device-second normalization ---------------------------------------------
@@ -475,6 +483,22 @@ def emit_heavy_hitter(tenant: str, device_seconds: float, rank: int,
     _obs.event("tenant_heavy_hitter", tenant=tenant,
                device_seconds=float(device_seconds), rank=int(rank),
                share=float(share), window_s=float(window_s))
+
+
+def emit_quota_throttled(tenant: str, slo: str, cost_tokens: int,
+                         rate: float, burst: float) -> None:
+    """`tenant_quota_throttled` event: the front tier shed a request
+    because the tenant's token bucket ran dry.  The shed is attributed
+    to the TENANT'S ledger row (shed_requests) and never reaches a leaf
+    router, so it cannot burn the SLO class's error budget.  The event
+    lives here — not in frontier.py — because the ``tenant_*`` telemetry
+    family has a single writer (check_observability.py)."""
+    _obs = _facade()
+    if _obs is None:
+        return
+    _obs.event("tenant_quota_throttled", tenant=tenant, slo=slo,
+               cost_tokens=int(cost_tokens), rate=float(rate),
+               burst=float(burst))
 
 
 def emit_reconcile(worst_rel_diff: float, tenants: int,

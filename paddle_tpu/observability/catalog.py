@@ -201,6 +201,24 @@ METRICS = {
     "serving_router_admission_queue_length": (
         "gauge", "Admitted-but-undispatched requests per SLO class queue "
                  "(labels: slo)"),
+    # -- federated front tier (serving/frontier.py) --------------------------
+    "frontier_requests_total": (
+        "counter", "Requests submitted to the federated front tier "
+                   "(before the quota gate and leaf placement)"),
+    "frontier_quota_shed_total": (
+        "counter", "Requests shed at the front tier because the tenant's "
+                   "token bucket ran dry — attributed to the TENANT'S "
+                   "ledger row, never to a leaf or the class error "
+                   "budget"),
+    "frontier_rebalance_total": (
+        "counter", "Tenants newly promoted to the hot set (heavy-hitter "
+                   "share past hot_tenant_share): their traffic fans out "
+                   "over their top rendezvous leaves"),
+    "frontier_leaves": (
+        "gauge", "Leaf routers federated under the front tier"),
+    "frontier_queue_depth": (
+        "gauge", "Admitted-but-undispatched requests summed across every "
+                 "leaf's SLO class queues"),
     # -- streaming dataplane (serving/transport.py) --------------------------
     "serving_transport_frames_total": (
         "counter", "Frames moved over the streaming router<->worker "
@@ -408,6 +426,8 @@ EVENTS = {
     "stage_imbalance",    # MPMD busy/idle spread crossed threshold (live)
     "tenant_heavy_hitter",    # a tenant surfaced in the aggregator top-K
     "tenant_ledger_reconcile",  # live ledger vs post-hoc attribution diff
+    "tenant_quota_throttled",  # front tier shed a request on a dry bucket
+    "frontier_hot_tenant_spread",  # a tenant entered the hot (spread) set
 }
 
 

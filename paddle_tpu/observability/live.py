@@ -424,6 +424,8 @@ class LiveAggregator:
         self._tenant_prices: Optional[_acct.Prices] = None
         self._tenant_outstanding: Dict[str, Dict[str, float]] = {}
         self._tenant_win: Dict[int, Dict[Tuple[str, str], List[int]]] = {}
+        # front-tier fleet view (note_frontier); None = no front tier
+        self._frontier: Optional[dict] = None
 
     # -- ingest ------------------------------------------------------------
     def ingest(self, payload: dict, now: Optional[float] = None) -> bool:
@@ -622,6 +624,27 @@ class LiveAggregator:
             if per_engine is not None:
                 self._tenant_outstanding = {
                     str(e): dict(by) for e, by in per_engine.items()}
+
+    def note_frontier(self, view: Optional[dict]) -> None:
+        """Front-tier feed (serving/frontier.py): the merged per-leaf
+        fleet view — leaf queue depths, quota/throttle totals, hot
+        tenants. Lands verbatim as the health doc's ``frontier`` block;
+        absent when no front tier runs, so every existing consumer
+        (supervisor included) is untouched."""
+        with self._lock:
+            self._frontier = dict(view) if view else None
+
+    def heavy_hitters(self, k: int = 8) -> List[Tuple[str, float]]:
+        """Ranked (tenant, share-of-priced-device-seconds) rows off the
+        sketch — the same ranking the health doc's ``tenants.top`` block
+        carries, exposed directly so the front tier's hot-tenant
+        rebalance can poll it without assembling a full health doc."""
+        with self._lock:
+            total = self._tenant_sketch.total
+            if total <= 0:
+                return []
+            return [(t, c / total)
+                    for t, c, _ in self._tenant_sketch.topk(k)]
 
     def _poll_local(self, now: float) -> None:
         if not self._tail_local:
@@ -884,6 +907,8 @@ class LiveAggregator:
                             for s, ts in sorted(self._sources.items())},
                 "tenants": self._tenants_doc(now),
             }
+            if self._frontier is not None:
+                doc["frontier"] = dict(self._frontier)
         return doc
 
     def write_health(self, doc: Optional[dict] = None,

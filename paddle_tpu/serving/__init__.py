@@ -21,15 +21,25 @@ Three layers (docs/SERVING.md):
   prefill worker and stream KV to their decode worker — and fails over
   dead engines by resubmitting their unfinished work — bit-equal,
   because every request carries a router-assigned sampling seed.
+- Above the routers, the ``FrontierRouter`` (``frontier`` module)
+  federates several leaf routers: tenants shard onto leaves by sticky
+  rendezvous hashing, per-tenant token-bucket quotas shed abusive
+  traffic before it can burn a class error budget, and hot tenants
+  spread across their top-ranked leaves. The ``replay`` module is the
+  matching workload generator — deterministic million-request arrival
+  streams against real leaves or in-process stub fleets
+  (docs/REPLAY.md).
 """
 from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
                        deadline_guard)
+from .frontier import FrontierConfig, FrontierRouter, rendezvous_rank
 from .router import Router, RouterConfig, RouterRequest
 from .transport import TransportClient, TransportServer
 from .worker import EngineWorker
 
 __all__ = [
     "Router", "RouterConfig", "RouterRequest", "EngineWorker",
+    "FrontierRouter", "FrontierConfig", "rendezvous_rank",
     "TransportClient", "TransportServer",
     "SLO_CLASSES", "DEFAULT_DEADLINES", "DEFAULT_NAMESPACE",
     "deadline_guard",

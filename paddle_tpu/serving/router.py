@@ -17,7 +17,15 @@ plus the load this router dispatched but the engine has not yet acked —
 softened by prefix affinity: a request whose chain-hashed prompt blocks
 were last served by a particular engine routes back there (reusing that
 engine's paged prefix cache) unless the load skew exceeds the affinity
-slack. Workers registered with ``role="prefill"`` never decode: long
+slack. The least-loaded engine comes off a lazy-invalidation min-heap
+(``dispatch_mode="heap"``, O(log E) per dispatch): loads are computed
+once per dispatch round and updated incrementally as work is placed, so
+a burst of R requests over E engines costs O(R log E) instead of the
+O(R·E·inflight) full rescan. ``dispatch_mode="scan"`` keeps the original
+scan as the bit-identical placement oracle for A/B runs — the heap's
+(load, engine index) ordering reproduces the scan's tie-break exactly.
+
+Workers registered with ``role="prefill"`` never decode: long
 prompts (``prefill_threshold_tokens``) are placed on the prefill worker
 with the shallowest queue, which streams the finished KV pages straight
 to the chosen decode worker (``kv_to`` in the dispatch record); short
@@ -59,10 +67,11 @@ never minting a second one.
 """
 from __future__ import annotations
 
+import heapq
 import time
 from collections import OrderedDict, deque
-from dataclasses import asdict, dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -117,6 +126,21 @@ class RouterConfig:
     #: base of the per-request sampling seeds the router assigns so
     #: reruns after failover are bit-equal on any engine
     seed: int = 0
+    #: engine-selection strategy: "heap" pops the least-loaded engine
+    #: off a lazy-invalidation min-heap rebuilt once per dispatch round
+    #: (O(log E) per placement); "scan" is the original full O(E) scan,
+    #: kept as the bit-identical placement oracle for A/B runs
+    dispatch_mode: str = "heap"
+    #: per-pump budget of done-key store probes during harvest (0 =
+    #: unbounded). A rotating engine cursor carries the scan across
+    #: pumps so deep inflight books make progress fairly instead of
+    #: stalling the pump on one store round-trip per in-flight rid.
+    harvest_budget: int = 256
+    #: keep resolved requests in the book so ``status``/``result`` work
+    #: after the fact. The replay harness turns this off (reading
+    #: results through ``on_resolve`` instead) so million-request runs
+    #: stay memory-bounded.
+    retain_results: bool = True
 
 
 @dataclass
@@ -137,6 +161,10 @@ class RouterRequest:
     tokens: Optional[np.ndarray] = None
     error: Optional[str] = None
     shed_reason: Optional[str] = None
+    #: clock stamp of the dispatch that placed this request on an engine
+    #: (None while queued / for sheds) — admission latency is
+    #: ``dispatch_t - submit_t``
+    dispatch_t: Optional[float] = None
     finish_t: Optional[float] = None
     resubmits: int = 0
     trace_id: Optional[str] = None
@@ -195,6 +223,7 @@ class Router:
     """Admit, place, and track requests across the registered engines."""
 
     def __init__(self, store, config: Optional[RouterConfig] = None,
+                 clock: Optional[Callable[[], float]] = None,
                  **overrides):
         if config is None:
             config = RouterConfig(**overrides)
@@ -207,8 +236,18 @@ class Router:
             raise ValueError(
                 f"dataplane must be streaming|store, got "
                 f"{config.dataplane!r}")
+        if config.dispatch_mode not in ("heap", "scan"):
+            raise ValueError(
+                f"dispatch_mode must be heap|scan, got "
+                f"{config.dispatch_mode!r}")
         self.config = config
         self._store = store
+        #: injectable time source (the replay harness drives a virtual
+        #: clock through here so deadline sheds, liveness grace, and
+        #: retransmit timers are deterministic functions of the workload
+        #: instead of wall time). None = real time: perf_counter for
+        #: request timing, monotonic for liveness.
+        self._clock = clock
         self._ns = config.namespace
         self._engines: Dict[str, _EngineState] = {}
         self._by_index: Dict[int, _EngineState] = {}
@@ -232,10 +271,33 @@ class Router:
         #: router-side tenant ledger (shed attribution), created lazily
         #: on the first submit with accounting enabled
         self._acct: Optional[_acct.TenantLedger] = None
+        #: called with each request as it resolves (done/failed/shed) —
+        #: the replay harness's completion tap; with
+        #: ``retain_results=False`` this is the only way results leave
+        #: the router
+        self.on_resolve: Optional[Callable[[RouterRequest], None]] = None
+        #: harvest rotation: which engine the budgeted done-key scan
+        #: resumes from next pump
+        self._harvest_cursor = 0
+        #: False when a front tier (serving/frontier.py) owns the shared
+        #: live aggregator: this leaf feeds tenant deltas but leaves
+        #: queue gauges + the health-file tick to the frontier
+        self._live_driver = True
 
     @property
     def _streaming(self) -> bool:
         return self.config.dataplane == "streaming"
+
+    def _now(self) -> float:
+        """Request-timing clock (submit/dispatch/finish/deadlines)."""
+        return self._clock() if self._clock is not None \
+            else time.perf_counter()
+
+    def _mono(self) -> float:
+        """Liveness clock (beats, grace windows, retransmit timers).
+        Same source as ``_now`` when a virtual clock is injected."""
+        return self._clock() if self._clock is not None \
+            else time.monotonic()
 
     # -- admission -----------------------------------------------------------
 
@@ -260,10 +322,9 @@ class Router:
         if params.seed is None:
             # explicit seed => bit-equal streams on ANY engine, which is
             # what makes failover reruns invisible in the results
-            params = SamplingParams(**{**asdict(params),
-                                       "seed": self.config.seed * 1_000_003
-                                       + self._next_rid})
-        now = time.perf_counter()
+            params = replace(params, seed=self.config.seed * 1_000_003
+                             + self._next_rid)
+        now = self._now()
         req = RouterRequest(
             rid=self._next_rid, prompt=prompt, params=params, slo=slo,
             submit_t=now,
@@ -271,8 +332,12 @@ class Router:
                 slo, DEFAULT_DEADLINES[slo]),
             block_keys=PrefixRegistry.block_keys(
                 prompt, self.config.page_size))
-        if tenant is not None:
-            req.tenant = _acct.normalize_tenant(tenant)
+        # normalize unconditionally: every downstream consumer (shed
+        # attribution, quota buckets, ledger cells) keys on the
+        # NORMALIZED label, so a raw "" / "  acme " / control-char label
+        # can never mint a ledger row distinct from its canonical form —
+        # and the untagged "-" default can never alias a tagged tenant
+        req.tenant = _acct.normalize_tenant(tenant)
         self._next_rid += 1
         self._requests[req.rid] = req
         self.counters["submitted"] += 1
@@ -323,7 +388,7 @@ class Router:
     def _shed(self, req: RouterRequest, reason: str):
         req.status = "shed"
         req.shed_reason = reason
-        req.finish_t = time.perf_counter()
+        req.finish_t = self._now()
         self.counters["shed"] += 1
         if self._acct is not None:
             self._acct.add(req.tenant, req.slo, shed_requests=1)
@@ -336,6 +401,17 @@ class Router:
                 if t.get(k):
                     _obs.end_span(t[k], outcome="shed")
             _obs.end_span(t["root"], status="shed", reason=reason)
+        self._resolved(req)
+
+    def _resolved(self, req: RouterRequest):
+        """Terminal-transition tap: report the request to ``on_resolve``
+        and, unless results are retained, drop it from the book so the
+        request map stays bounded over million-request replays."""
+        cb = self.on_resolve
+        if cb is not None:
+            cb(req)
+        if not self.config.retain_results:
+            self._requests.pop(req.rid, None)
 
     # -- fleet discovery & liveness -----------------------------------------
 
@@ -349,9 +425,9 @@ class Router:
                 if not self._store.check(key):
                     return  # registration record not written yet; retry
                 record = unpack(self._store.get(key))
+            now = self._mono()
             est = _EngineState(name=record["name"], index=idx, record=record,
-                               last_change=time.monotonic(),
-                               last_ack_t=time.monotonic())
+                               last_change=now, last_ack_t=now)
             if self._streaming and record.get("addr"):
                 # fail-soft dial: a worker that listens but is not yet
                 # polling still accepts (backlog); a dead addr backs off
@@ -386,7 +462,7 @@ class Router:
         notices."""
         if not self._streaming:
             return
-        now = time.monotonic()
+        now = self._mono()
         for est in self._engines.values():
             link = est.link
             if link is None:
@@ -417,25 +493,30 @@ class Router:
                             self._live_agg.ingest(pay)
 
     def _read_occupancy(self):
-        now = time.monotonic()
+        now = self._mono()
         if self._streaming:
             # the wire carries the hot beats; the store copy is only the
             # failover mirror and needs no more than the mirror cadence
             if now - self._last_occ_read < _STORE_MIRROR_S:
                 return
         self._last_occ_read = now
-        for est in self._engines.values():
-            if not est.alive:
-                continue
-            key = k_occ(self._ns, est.name)
-            with deadline_guard("read occupancy"):
+        # one guard over the whole sweep: a guard arms a watchdog timer
+        # (a thread), and per-engine guards made the mirror read cost
+        # O(E) thread spawns per pump in the replay hot loop
+        beats = []
+        with deadline_guard("read occupancy"):
+            for est in self._engines.values():
+                if not est.alive:
+                    continue
+                key = k_occ(self._ns, est.name)
                 if not self._store.check(key):
                     continue
-                occ = unpack(self._store.get(key))
+                beats.append((est, unpack(self._store.get(key))))
+        for est, occ in beats:
             self._apply_occ(est, occ, now)
 
     def _failover_dead(self):
-        now = time.monotonic()
+        now = self._mono()
         for est in self._engines.values():
             if not est.alive:
                 continue
@@ -454,14 +535,17 @@ class Router:
         their class queues so failover does not add queueing delay on
         top of the rerun. Shared by dead-engine failover and supervisor
         drain-timeout evacuation; returns how many were resubmitted."""
-        resubmit = []
-        for rid, req in list(est.inflight.items()):
-            with deadline_guard("harvest results"):
-                finished = self._store.check(k_done(self._ns, rid))
-            if finished:
-                self._finish_from_store(req)
-            else:
-                resubmit.append(req)
+        resubmit, finished = [], []
+        with deadline_guard("harvest results"):
+            for rid, req in list(est.inflight.items()):
+                if self._store.check(k_done(self._ns, rid)):
+                    finished.append(
+                        (req, unpack(self._store.get(k_done(self._ns,
+                                                            rid)))))
+                else:
+                    resubmit.append(req)
+        for req, rec in finished:
+            self._finish_with(req, rec)
         est.inflight.clear()
         for req in reversed(resubmit):
             # a disaggregated request dies with EITHER of its engines:
@@ -517,7 +601,7 @@ class Router:
 
     def _finish_with(self, req: RouterRequest, rec: dict):
         self._resolve_inflight(req.rid)
-        req.finish_t = time.perf_counter()
+        req.finish_t = self._now()
         if "error" in rec:
             req.status = "failed"
             req.error = rec["error"]
@@ -535,6 +619,7 @@ class Router:
                     _obs.end_span(t[k], engine=req.engine)
             _obs.end_span(t["root"], status=req.status, engine=req.engine,
                           resubmits=req.resubmits)
+        self._resolved(req)
 
     def _finish_from_store(self, req: RouterRequest):
         with deadline_guard("harvest results"):
@@ -552,9 +637,20 @@ class Router:
         self._finish_with(req, rec)
 
     def _harvest_done(self):
-        for est in self._engines.values():
-            if not est.inflight:
-                continue
+        """Scan in-flight rids for finished store records, at most
+        ``harvest_budget`` probes per pump. A rotating engine cursor
+        resumes where the budget ran out, and an engine only commits its
+        ``harvested_done`` watermark after a COMPLETE scan — a truncated
+        one retries next pump, so bounding the work never strands a
+        finished result."""
+        names = [n for n, e in self._engines.items() if e.inflight]
+        if not names:
+            return
+        budget = self.config.harvest_budget
+        spent = 0
+        start = self._harvest_cursor % len(names)
+        for off in range(len(names)):
+            est = self._engines[names[(start + off) % len(names)]]
             # only scan done keys when the engine's beat advertises new
             # completions: per-rid checks are store round trips, and with
             # deep inflight queues a blind every-pump scan contends the
@@ -562,16 +658,29 @@ class Router:
             reported = int(est.occ.get("done_count", -1))
             if reported >= 0 and reported == est.harvested_done:
                 continue
-            est.harvested_done = reported
-            for rid, req in list(est.inflight.items()):
-                if req.status != "dispatched":
-                    est.inflight.pop(rid, None)
-                    continue
-                with deadline_guard("harvest results"):
-                    finished = self._store.check(k_done(self._ns, rid))
-                if not finished:
-                    continue
-                self._finish_from_store(req)
+            finished, complete = [], True
+            with deadline_guard("harvest results"):
+                for rid, req in list(est.inflight.items()):
+                    if req.status != "dispatched":
+                        est.inflight.pop(rid, None)
+                        continue
+                    if budget > 0 and spent >= budget:
+                        complete = False
+                        break
+                    spent += 1
+                    if self._store.check(k_done(self._ns, rid)):
+                        finished.append(
+                            (req, unpack(self._store.get(
+                                k_done(self._ns, rid)))))
+            for req, rec in finished:
+                self._finish_with(req, rec)
+            if complete:
+                est.harvested_done = reported
+            else:
+                # budget exhausted mid-engine: resume HERE next pump
+                self._harvest_cursor = (start + off) % len(names)
+                return
+        self._harvest_cursor = start + len(names)
 
     # -- placement -----------------------------------------------------------
 
@@ -623,6 +732,72 @@ class Router:
             break
         return best, False
 
+    def _placement_ctx(self) -> dict:
+        """Heap-mode placement book, built once per dispatch round: the
+        load of every decode-capable candidate plus a min-heap ordered
+        (load, engine index) — the scan's exact tie-break. Entries go
+        stale as dispatches charge load; ``_pick_engine_heap`` discards
+        them lazily, so each placement costs O(log E) instead of the
+        scan's O(E·inflight) recompute."""
+        loads: Dict[str, int] = {}
+        entries: List[Tuple[int, int, str]] = []
+        for e in self._engines.values():
+            if (e.alive and e.role != "prefill" and not e.draining
+                    and len(e.inflight) < self._engine_cap(e)):
+                load = self._load_tokens(e)
+                loads[e.name] = load
+                entries.append((load, e.index, e.name))
+        heapq.heapify(entries)
+        return {"loads": loads, "heap": entries}
+
+    def _pick_engine_heap(self, req: RouterRequest, ctx: dict):
+        """Heap-mode twin of ``_pick_engine``: same contract, same
+        placement (including the affinity override), different cost."""
+        loads, heap = ctx["loads"], ctx["heap"]
+        while heap:
+            load, index, name = heap[0]
+            if name not in loads:
+                heapq.heappop(heap)  # hit its cap mid-round; evicted
+                continue
+            if load != loads[name]:
+                heapq.heappop(heap)  # stale load; refresh lazily
+                heapq.heappush(heap, (loads[name], index, name))
+                continue
+            break
+        if not heap:
+            return None, False
+        best_load, _, best_name = heap[0]
+        # deepest prompt block we have seen routed somewhere live wins,
+        # unless honoring it would skew load past the slack
+        for key in reversed(req.block_keys):
+            name = self._affinity.get(key)
+            if name is None:
+                continue
+            if name not in loads:
+                break
+            if loads[name] - best_load <= self.config.affinity_slack_tokens:
+                return self._engines[name], True
+            break
+        return self._engines[best_name], False
+
+    def _charge_placement(self, ctx: Optional[dict], est: _EngineState,
+                          req: RouterRequest):
+        """Book a dispatch against the round's placement state: bump the
+        engine's load (push a fresh heap entry; the stale one dies
+        lazily) or drop it from candidacy once it reaches its inflight
+        cap — mirroring exactly what the scan would recompute."""
+        if ctx is None:
+            return
+        loads = ctx["loads"]
+        if est.name not in loads:
+            return
+        if len(est.inflight) >= self._engine_cap(est):
+            del loads[est.name]
+            return
+        loads[est.name] += len(req.prompt) + req.params.max_new_tokens
+        heapq.heappush(ctx["heap"],
+                       (loads[est.name], est.index, est.name))
+
     def _prefill_load(self, est: _EngineState) -> int:
         """Prefill placement signal: reported queue depth + handoffs
         dispatched but not yet acked."""
@@ -652,8 +827,11 @@ class Router:
         Shared by the unified and disaggregated paths."""
         req.seq = est.next_seq
         est.next_seq += 1
+        # vars() not dataclasses.asdict(): SamplingParams is flat, and
+        # asdict's recursive deep-copy walk is ~10x the cost — visible at
+        # replay rates (a million dispatches per bench run)
         rec = {"rid": req.rid, "prompt": req.prompt.tolist(),
-               "params": asdict(req.params)}
+               "params": dict(vars(req.params))}
         if req.tenant != "-":
             # tenant + class ride the wire only when tagged: an untagged
             # request's dispatch record is byte-identical to before the
@@ -683,17 +861,15 @@ class Router:
 
     def _enqueue_rec(self, est: _EngineState, rec: dict,
                      req: RouterRequest):
-        """Hand the record to the dataplane: wire outbox (flushed as one
-        batched frame per engine per pump) or a store key on the legacy
-        path."""
+        """Hand the record to the dataplane via the engine's outbox —
+        flushed once per engine per pump as a single wire frame, or (on
+        the store path / a dead link) as one batched store-key write, so
+        a dispatch burst costs one guard instead of one per record."""
+        rec["seq"] = req.seq
         if self._streaming and est.link is not None:
-            rec["seq"] = req.seq
             req.wire_engine = est.name
             req.wire_rec = rec
-            est.outbox.append(rec)
-            return
-        with deadline_guard("dispatch request"):
-            self._store.set(k_req(self._ns, est.name, req.seq), pack(rec))
+        est.outbox.append(rec)
 
     def _note_affinity(self, req: RouterRequest, name: str):
         for key in req.block_keys:
@@ -708,6 +884,7 @@ class Router:
         self._enqueue_rec(est, rec, req)
         req.status = "dispatched"
         req.engine = est.name
+        req.dispatch_t = self._now()
         est.inflight[req.rid] = req
         self.counters["dispatched"] += 1
         _obs.inc("serving_router_dispatch_total")
@@ -726,6 +903,7 @@ class Router:
         self._enqueue_rec(pe, rec, req)
         req.status = "dispatched"
         req.engine = de.name
+        req.dispatch_t = self._now()
         pe.inflight[req.rid] = req
         de.inflight[req.rid] = req
         self.counters["dispatched"] += 1
@@ -734,7 +912,9 @@ class Router:
         self._note_affinity(req, de.name)
 
     def _dispatch(self):
-        now = time.perf_counter()
+        now = self._now()
+        heap_mode = self.config.dispatch_mode == "heap"
+        ctx = None  # built lazily on the first placement of the round
         for cls in reversed(SLO_CLASSES):  # interactive drains first
             queue = self._queues[cls]
             while queue:
@@ -743,7 +923,12 @@ class Router:
                     queue.popleft()
                     self._shed(req, "deadline")
                     continue
-                est, via_affinity = self._pick_engine(req)
+                if heap_mode:
+                    if ctx is None:
+                        ctx = self._placement_ctx()
+                    est, via_affinity = self._pick_engine_heap(req, ctx)
+                else:
+                    est, via_affinity = self._pick_engine(req)
                 if est is None:
                     self._flush_outboxes()
                     return  # fleet saturated; lower classes wait too
@@ -756,23 +941,24 @@ class Router:
                     self._dispatch_disagg(req, pe, est, via_affinity)
                 else:
                     self._dispatch_one(req, est, via_affinity)
+                self._charge_placement(ctx, est, req)
         self._flush_outboxes()
         _obs.set_gauge("serving_router_queue_depth", self._queue_depth())
 
     def _flush_outboxes(self):
-        """One batched dispatch frame per engine per pump. A failed send
-        falls back to store keys for the SAME seqs — the worker merges
-        both sources by seq, so the fallback is ordering-safe and
-        idempotent."""
+        """One batched dispatch frame per engine per pump. The store
+        dataplane (and a wire send that fails) writes the whole batch
+        under ONE guard — the worker merges both sources by seq, so the
+        fallback is ordering-safe and idempotent."""
         for est in self._engines.values():
             if not est.outbox:
                 continue
             batch, est.outbox = est.outbox, []
-            if est.link is not None and est.link.send(
+            if self._streaming and est.link is not None and est.link.send(
                     {"t": "dispatch", "reqs": batch}):
                 continue
-            for rec in batch:
-                with deadline_guard("dispatch request"):
+            with deadline_guard("dispatch request"):
+                for rec in batch:
                     self._store.set(k_req(self._ns, est.name, rec["seq"]),
                                     pack(rec))
 
@@ -785,7 +971,7 @@ class Router:
         never re-read."""
         if not self._streaming:
             return
-        now = time.monotonic()
+        now = self._mono()
         for est in self._engines.values():
             if not est.alive:
                 continue
@@ -806,8 +992,8 @@ class Router:
                        seqs=[r.seq for r in unacked])
             if est.link is not None:
                 est.link.send({"t": "dispatch", "reqs": recs})
-            for rec in recs:
-                with deadline_guard("dispatch request"):
+            with deadline_guard("dispatch request"):
+                for rec in recs:
                     self._store.set(k_req(self._ns, est.name, rec["seq"]),
                                     pack(rec))
 
@@ -833,33 +1019,53 @@ class Router:
         local tails and write ``fleet_health.json`` at its own cadence.
         One env dict lookup per pump when the plane is off."""
         if self._live_agg is None:
-            if not _live.live_enabled():
+            if not self._live_driver or not _live.live_enabled():
                 return
             self._live_agg = _live.LiveAggregator()
-        self._live_agg.note_queues({
-            "admission": {c: len(q) for c, q in self._queues.items()},
-            "engine_outstanding_tokens": {
-                e.name: self._load_tokens(e)
-                for e in self._engines.values() if e.alive},
-        })
+        if self._live_driver:
+            self._live_agg.note_queues({
+                "admission": {c: len(q) for c, q in self._queues.items()},
+                "engine_outstanding_tokens": {
+                    e.name: self._load_tokens(e)
+                    for e in self._engines.values() if e.alive},
+            })
         if self._acct is not None:
-            # per-engine per-tenant outstanding tokens: the raw signal
-            # the quota ladder gates on (gauges set by accounting.py —
-            # single writer — and mirrored into fleet_health.json)
-            per_engine: Dict[str, Dict[str, int]] = {}
-            for est in self._engines.values():
-                if not est.alive:
-                    continue
-                for req in est.inflight.values():
-                    if req.status != "dispatched":
-                        continue
-                    by = per_engine.setdefault(est.name, {})
-                    by[req.tenant] = by.get(req.tenant, 0) + len(
-                        req.prompt) + req.params.max_new_tokens
+            per_engine = self.tenant_outstanding()
             _acct.publish_outstanding(per_engine)
-            self._live_agg.note_tenants(self._acct.collect_delta(),
-                                        per_engine)
-        self._live_agg.tick()
+            # a non-driver leaf feeds only its ledger delta: the
+            # frontier merges every leaf's outstanding map itself, and a
+            # per-leaf overwrite here would clobber its siblings'
+            self._live_agg.note_tenants(
+                self._acct.collect_delta(),
+                per_engine if self._live_driver else None)
+        if self._live_driver:
+            self._live_agg.tick()
+
+    def tenant_outstanding(self) -> Dict[str, Dict[str, int]]:
+        """Per-engine per-tenant outstanding tokens: the raw signal the
+        quota ladder gates on (gauges set by accounting.py — single
+        writer — and mirrored into fleet_health.json)."""
+        per_engine: Dict[str, Dict[str, int]] = {}
+        for est in self._engines.values():
+            if not est.alive:
+                continue
+            for req in est.inflight.values():
+                if req.status != "dispatched":
+                    continue
+                by = per_engine.setdefault(est.name, {})
+                by[req.tenant] = by.get(req.tenant, 0) + len(
+                    req.prompt) + req.params.max_new_tokens
+        return per_engine
+
+    def share_live_aggregator(self, agg: "_live.LiveAggregator"):
+        """Adopt a live aggregator OWNED BY A FRONT TIER
+        (serving/frontier.py). This leaf keeps feeding tenant deltas and
+        ingesting wire telemetry into it, but stops writing queue gauges
+        or driving the health-file tick — with several leaves in one
+        process, two drivers would clobber each other's
+        ``fleet_health.json`` view; the frontier merges and writes."""
+        self._live_agg = agg
+        self._live_driver = False
 
     def pump(self):
         """One scheduling round: discover new engines, drain the wire,
@@ -881,6 +1087,15 @@ class Router:
         """Requests admitted but not yet finished (queued + in flight)."""
         return sum(1 for r in self._requests.values()
                    if r.status in ("queued", "dispatched"))
+
+    def queue_depth(self) -> int:
+        """Admitted-but-undispatched requests across all SLO classes —
+        the front tier's per-leaf placement signal."""
+        return self._queue_depth()
+
+    def admission_depths(self) -> Dict[str, int]:
+        """Per-class admission queue depths (front-tier fleet view)."""
+        return {c: len(q) for c, q in self._queues.items()}
 
     def drain(self, timeout: Optional[float] = None, poll: float = 0.005):
         """Pump until every admitted request resolves (done/failed/shed).

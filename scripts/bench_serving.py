@@ -1666,6 +1666,44 @@ def run_disagg(args, store, master):
     }
 
 
+def _replay_args(args):
+    """Reduced-size argument set for the embedded replay legs: the full
+    1M-request run (plus the subprocess scaling leg) is
+    scripts/bench_replay.py's job -> BENCH_REPLAY.json; this block is
+    the smoke-sized version that rides in BENCH_SERVING.json."""
+    import bench_replay
+    r = bench_replay.build_parser().parse_args([])
+    r.requests = args.replay_requests
+    r.determinism_requests = min(20_000, args.replay_requests)
+    r.quota_requests = min(15_000, args.replay_requests)
+    r.dispatch_requests = min(10_000, args.replay_requests)
+    r.budget_s = 300.0
+    return r
+
+
+def run_replay(args):
+    import bench_replay
+    rargs = _replay_args(args)
+    print(f"[bench] replay: {rargs.requests}-request stub-tier legs "
+          "(throughput/determinism/quota/dispatch)...", file=sys.stderr)
+    block = {
+        "requests": rargs.requests,
+        "throughput": bench_replay.run_throughput(rargs),
+        "determinism": bench_replay.run_determinism(rargs),
+        "quota": bench_replay.run_quota(rargs),
+        "dispatch": bench_replay.run_dispatch(rargs),
+        "full_bench": "scripts/bench_replay.py -> BENCH_REPLAY.json "
+                      "(1M requests + 2-leaf scaling leg)",
+    }
+    return block
+
+
+def _gate_replay(args, block):
+    import bench_replay
+    # bench_replay's own gate handles the missing scaling leg
+    return bench_replay.gate(_replay_args(args), block)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batch", type=int, default=8)
@@ -1787,6 +1825,20 @@ def main(argv=None):
     ap.add_argument("--min-colocation-margin", type=float, default=0.0,
                     help="fail unless the colocated score beats the best "
                          "static split by more than this")
+    ap.add_argument("--replay-only", action="store_true",
+                    help="run only the reduced workload-replay legs "
+                         "(front-tier throughput, determinism, quota, "
+                         "heap-vs-scan dispatch; docs/REPLAY.md) and "
+                         "merge the replay block into the existing "
+                         "BENCH_SERVING.json")
+    ap.add_argument("--replay", action="store_true",
+                    help="alias for --replay-only")
+    ap.add_argument("--skip-replay", action="store_true",
+                    help="skip the workload-replay legs in the full run")
+    ap.add_argument("--replay-requests", type=int, default=100_000,
+                    help="stream length for the embedded replay "
+                         "throughput leg (the full 1M-request run lives "
+                         "in scripts/bench_replay.py -> BENCH_REPLAY.json)")
     ap.add_argument("--max-live-overhead", type=float, default=0.02,
                     help="fail if enabling the live telemetry plane "
                          "costs more than this fraction of live-off "
@@ -1854,6 +1906,18 @@ def main(argv=None):
             f.write("\n")
         print(json.dumps({"colocation": block}, indent=2))
         return _gate_autoscale(args, block)
+    if args.replay_only or args.replay:
+        block = run_replay(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["replay"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"replay": block}, indent=2))
+        return _gate_replay(args, block)
     if args.attn_kernel_only:
         block = run_attn_kernel(args)
         report = {}
@@ -1985,6 +2049,8 @@ def main(argv=None):
         report["tenants"] = run_tenants(args)
     if not args.skip_autoscale:
         report["colocation"] = run_autoscale(args)
+    if not args.skip_replay:
+        report["replay"] = run_replay(args)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
@@ -2002,6 +2068,8 @@ def main(argv=None):
         rc = rc or _gate_tenants(args, report["tenants"])
     if not args.skip_autoscale:
         rc = rc or _gate_autoscale(args, report["colocation"])
+    if not args.skip_replay:
+        rc = rc or _gate_replay(args, report["replay"])
     return rc
 
 

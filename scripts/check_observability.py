@@ -103,6 +103,7 @@ OWNED_PREFIXES = {
                                 "supervisor.py"),
     "tenant_": os.path.join("paddle_tpu", "observability",
                             "accounting.py"),
+    "frontier_": os.path.join("paddle_tpu", "serving", "frontier.py"),
 }
 
 

@@ -90,6 +90,8 @@ GUARDED_CALLS = {"_constrain", "device_put"}
 GUARDED_STORE_FILES = [
     os.path.join("paddle_tpu", "serving", "router.py"),
     os.path.join("paddle_tpu", "serving", "worker.py"),
+    os.path.join("paddle_tpu", "serving", "frontier.py"),
+    os.path.join("paddle_tpu", "serving", "replay.py"),
 ]
 
 #: TCPStore/PyTCPStore client methods that block on the network

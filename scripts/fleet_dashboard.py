@@ -184,6 +184,45 @@ def tenant_lines(doc):
     return lines
 
 
+_FRONTIER_HEADER = ["leaf", "engines", "queue", "pending", "dispatched",
+                    "shed", "admission"]
+
+
+def frontier_lines(doc):
+    """The ``--frontier`` panel: the federated front tier's merged
+    fleet view (docs/SERVING.md §10) — per-leaf queue depths and
+    liveness, fleet admission totals, quota throttle state, and the
+    hot-tenant spread set."""
+    fr = (doc or {}).get("frontier") or {}
+    if not fr.get("leaves"):
+        return ["frontier: (no front tier reporting)"]
+    lines = [f"frontier  ({len(fr['leaves'])} leaves, total queue "
+             f"{fr.get('queue_depth', 0)})"]
+    rows = []
+    for name in sorted(fr["leaves"]):
+        leaf = fr["leaves"][name]
+        adm = leaf.get("admission") or {}
+        rows.append([
+            name, leaf.get("engines_alive", 0),
+            leaf.get("queue_depth", 0), leaf.get("pending", 0),
+            leaf.get("dispatched", 0), leaf.get("shed", 0),
+            " ".join(f"{c}={n}" for c, n in sorted(adm.items())) or "-",
+        ])
+    lines.append(_table(rows, _FRONTIER_HEADER))
+    adm = fr.get("admission") or {}
+    if adm:
+        lines.append("fleet admission: "
+                     + ", ".join(f"{c}={n}" for c, n in sorted(adm.items())))
+    q = fr.get("quota") or {}
+    if q:
+        lines.append(f"quota: {q.get('tracked_buckets', 0)} buckets, "
+                     f"{q.get('throttled_total', 0)} throttled")
+    hot = fr.get("hot_tenants") or []
+    if hot:
+        lines.append("HOT TENANTS (spread): " + ", ".join(hot))
+    return lines
+
+
 def roles_lines(journal, now=None):
     """The fleet-roles panel from the supervisor journal dir: current
     serving/training split, breaker state, any in-flight flip and the
@@ -224,7 +263,8 @@ def roles_lines(journal, now=None):
     return lines
 
 
-def render_text(doc, now=None, journal=None, tenants=False):
+def render_text(doc, now=None, journal=None, tenants=False,
+                frontier=False):
     """The terminal view: one string, ready to print."""
     if doc is None and journal is None:
         return "[fleet_dashboard] no fleet_health.json yet " \
@@ -282,13 +322,16 @@ def render_text(doc, now=None, journal=None, tenants=False):
                   + ", ".join(f"{s}={a}" for s, a in sorted(sources.items()))]
     if tenants:
         lines += [""] + tenant_lines(doc)
+    if frontier:
+        lines += [""] + frontier_lines(doc)
     rl = roles_lines(journal, now=now)
     if rl:
         lines += [""] + rl
     return "\n".join(lines)
 
 
-def render_html(doc, now=None, journal=None, tenants=False):
+def render_html(doc, now=None, journal=None, tenants=False,
+                frontier=False):
     """One-shot static HTML (no JS, no external assets): the same
     content as the terminal view, with flagged cells highlighted."""
     now = time.time() if now is None else now
@@ -312,7 +355,8 @@ def render_html(doc, now=None, journal=None, tenants=False):
             head = "".join(f"<th>{_html.escape(h)}</th>"
                            for h in _CLASS_HEADER)
             parts.append(f"<table><tr>{head}</tr>{cells}</table>")
-        pre = render_text(doc, now=now, journal=journal, tenants=tenants)
+        pre = render_text(doc, now=now, journal=journal, tenants=tenants,
+                          frontier=frontier)
         parts.append(f"<pre>{_html.escape(pre)}</pre>")
         body = "\n".join(parts)
     return ("<!doctype html><html><head><meta charset='utf-8'>"
@@ -387,6 +431,20 @@ def selftest():
             "tracked": 2, "folded_tenants": 0,
             "sketch": {"capacity": 64, "total": 0.5},
         },
+        "frontier": {
+            "leaves": {
+                "leaf0": {"queue_depth": 3, "pending": 5,
+                          "engines_alive": 2,
+                          "admission": {"interactive": 2, "batch": 1},
+                          "dispatched": 120, "shed": 4},
+                "leaf1": {"queue_depth": 0, "pending": 1,
+                          "engines_alive": 2, "admission": {},
+                          "dispatched": 80, "shed": 0}},
+            "admission": {"interactive": 2, "standard": 0, "batch": 1},
+            "queue_depth": 3,
+            "quota": {"tracked_buckets": 1, "throttled_total": 17},
+            "hot_tenants": ["acme"],
+        },
     }
     journal = {
         "roles": {"roles": {"engine0": "serving", "engine1": "training"},
@@ -422,6 +480,19 @@ def selftest():
     empty = render_text({"ts": 1000.0, "classes": {}}, now=1001.0,
                         tenants=True)
     assert "no attributed usage" in empty
+    # the frontier panel is opt-in too: per-leaf table, fleet admission
+    # totals, quota throttle line, hot-tenant spread set
+    assert "frontier" not in text
+    ftext = render_text(doc, now=1001.0, journal=journal, frontier=True)
+    for needle in ("frontier  (2 leaves, total queue 3)", "leaf0",
+                   "leaf1", "interactive=2", "1 buckets, 17 throttled",
+                   "HOT TENANTS (spread): acme"):
+        assert needle in ftext, (needle, ftext)
+    fempty = render_text({"ts": 1000.0, "classes": {}}, now=1001.0,
+                         frontier=True)
+    assert "no front tier reporting" in fempty
+    fpage = render_html(doc, now=1001.0, journal=journal, frontier=True)
+    assert "HOT TENANTS (spread): acme" in fpage
     page = render_html(doc, now=1001.0, journal=journal, tenants=True)
     assert "<table>" in page and "class='burn'" in page
     assert "STRAGGLER" in page and "in-flight flip 77" in page
@@ -460,6 +531,10 @@ def main(argv=None):
                     help="add the per-tenant attribution panel (heavy-"
                          "hitter table: device-seconds, burn share, shed "
                          "counts, outstanding tokens)")
+    ap.add_argument("--frontier", action="store_true",
+                    help="add the federated front-tier panel (per-leaf "
+                         "queue/liveness table, fleet admission totals, "
+                         "quota throttle state, hot-tenant spread set)")
     ap.add_argument("--html", default=None, metavar="OUT",
                     help="write a one-shot static HTML page instead of "
                          "printing the terminal view")
@@ -478,7 +553,8 @@ def main(argv=None):
 
     if args.html:
         page = render_html(load_health(args.telemetry_dir),
-                           journal=_journal(), tenants=args.tenants)
+                           journal=_journal(), tenants=args.tenants,
+                           frontier=args.frontier)
         tmp = f"{args.html}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(page)
@@ -491,13 +567,14 @@ def main(argv=None):
                 print("\x1b[2J\x1b[H"
                       + render_text(load_health(args.telemetry_dir),
                                     journal=_journal(),
-                                    tenants=args.tenants),
+                                    tenants=args.tenants,
+                                    frontier=args.frontier),
                       flush=True)
                 time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
     print(render_text(load_health(args.telemetry_dir), journal=_journal(),
-                      tenants=args.tenants))
+                      tenants=args.tenants, frontier=args.frontier))
     return 0
 
 

@@ -1,0 +1,422 @@
+"""Federated front tier invariants (docs/SERVING.md §10).
+
+Fast tests drive the frontier over the in-process stub tier from
+``serving.replay`` (MemStore + fluid-rate StubWorkers on the real store
+key schema, all on one virtual clock), so every quota refill, rebalance
+cadence, and admission decision is a pure function of the workload.
+The slow test mirrors test_serving_router's real-engine fixtures and
+gates the cross-topology determinism promise: the SAME submissions
+through a 1-leaf and a 2-leaf federated tier produce BIT-EQUAL token
+streams, because sampling seeds are stamped from the frontier's global
+ids before any leaf sees a request.
+"""
+import numpy as np
+import pytest
+from conftest import free_port
+
+import paddle_tpu.inference as inference
+from paddle_tpu.observability import accounting as _acct
+from paddle_tpu.serving import FrontierRouter, Router, rendezvous_rank
+from paddle_tpu.serving.frontier import _TokenBucket
+from paddle_tpu.serving.replay import (MemStore, StubWorker, VirtualClock,
+                                       build_stub_tier, make_spec,
+                                       run_stub_replay)
+
+VOCAB = 61
+
+
+# -- rendezvous hashing -------------------------------------------------------
+
+def test_rendezvous_rank_is_deterministic_and_total():
+    leaves = [f"leaf{i}" for i in range(5)]
+    r1 = rendezvous_rank("acme", leaves)
+    r2 = rendezvous_rank("acme", list(reversed(leaves)))
+    assert sorted(r1) == sorted(leaves)
+    assert r1 == r2  # order of the candidate list must not matter
+    assert rendezvous_rank("acme", leaves, seed=1) != r1 or True
+    assert rendezvous_rank(b"acme", leaves) == r1  # str/bytes agree
+
+
+def test_rendezvous_minimal_disruption_on_leave():
+    """Removing a leaf only moves the keys that ranked it first — the
+    sticky-mapping property that keeps prefix caches and tenant ledgers
+    leaf-local across membership churn."""
+    leaves = [f"leaf{i}" for i in range(4)]
+    keys = [f"tenant{i}" for i in range(200)]
+    before = {k: rendezvous_rank(k, leaves)[0] for k in keys}
+    gone = "leaf2"
+    remaining = [n for n in leaves if n != gone]
+    moved = 0
+    for k in keys:
+        after = rendezvous_rank(k, remaining)[0]
+        if before[k] == gone:
+            moved += 1
+            assert after == rendezvous_rank(k, leaves)[1], \
+                "evicted key must fall to its NEXT ranked leaf"
+        else:
+            assert after == before[k], \
+                f"key {k} moved without its leaf leaving"
+    assert 0 < moved < len(keys)
+
+
+def test_rendezvous_join_only_steals_top_ranked():
+    leaves = ["leaf0", "leaf1"]
+    keys = [f"t{i}" for i in range(200)]
+    before = {k: rendezvous_rank(k, leaves)[0] for k in keys}
+    after = {k: rendezvous_rank(k, leaves + ["leaf2"])[0] for k in keys}
+    for k in keys:
+        assert after[k] == before[k] or after[k] == "leaf2"
+
+
+# -- frontier construction + sticky placement ---------------------------------
+
+def _tier(n_leaves=2, engines=1, clock=None, **overrides):
+    clock = clock or VirtualClock()
+    frontier, workers, stores = build_stub_tier(
+        n_leaves, engines, clock, **overrides)
+    return frontier, workers, clock
+
+
+def _drive(frontier, workers, clock, ticks=2000, dt=0.01):
+    for _ in range(ticks):
+        frontier.pump()
+        for w in workers:
+            w.poll()
+        clock.advance(dt)
+        if not frontier.pending():
+            return
+    raise AssertionError(
+        f"undrained after {ticks} ticks: {frontier.stats()}")
+
+
+def test_duplicate_leaf_namespaces_rejected():
+    clock = VirtualClock()
+    store = MemStore()
+    leaves = [Router(store, namespace="same", dataplane="store",
+                     clock=clock) for _ in range(2)]
+    with pytest.raises(ValueError, match="distinct"):
+        FrontierRouter(leaves)
+
+
+def test_sticky_mapping_and_label_normalization():
+    """A tenant maps to one leaf, and every raw spelling of its label
+    maps WITH it — ' acme ' can neither land on a different leaf nor
+    mint a distinct ledger row (the PR 19 accounting fix surface)."""
+    frontier, workers, clock = _tier(n_leaves=3)
+    prompt = np.arange(24, dtype=np.int64)
+    gids = [frontier.submit(prompt, tenant=t, max_new_tokens=4)
+            for t in ("acme", " acme ", "acme", "\tacme\n")]
+    homes = {frontier.leaf_of(g) for g in gids}
+    assert len(homes) == 1
+    other = [frontier.submit(prompt, tenant="zebra-corp",
+                             max_new_tokens=4) for _ in range(3)]
+    assert len({frontier.leaf_of(g) for g in other}) == 1
+    _drive(frontier, workers, clock)
+    assert frontier.stats()["quota_shed"] == 0
+
+
+def test_untagged_traffic_hashes_on_prompt_prefix():
+    """Untagged requests pin by first prompt page: a shared-prefix flood
+    without a tenant label still lands on ONE leaf's prefix caches."""
+    frontier, workers, clock = _tier(n_leaves=3)
+    page = np.arange(16, dtype=np.int64)
+    gids = []
+    for i in range(6):
+        tail = np.full(8, 50 + i, dtype=np.int64)
+        gids.append(frontier.submit(np.concatenate([page, tail]),
+                                    max_new_tokens=4))
+    assert len({frontier.leaf_of(g) for g in gids}) == 1
+    different = frontier.submit(np.arange(100, 124, dtype=np.int64),
+                                max_new_tokens=4)
+    assert isinstance(frontier.leaf_of(different), str)
+    _drive(frontier, workers, clock)
+
+
+# -- token-bucket quota -------------------------------------------------------
+
+def test_token_bucket_burst_and_refill_edges():
+    b = _TokenBucket(rate=100.0, burst=200.0, now=0.0)
+    assert b.take(200.0, 0.0)          # exactly the burst: admitted
+    assert not b.take(1.0, 0.0)        # empty
+    assert not b.take(60.0, 0.5)       # refilled 50 < 60 (no debit)
+    assert b.take(50.0, 0.5)           # ...but exactly 50 clears
+    b2 = _TokenBucket(rate=100.0, burst=200.0, now=0.0)
+    assert b2.take(200.0, 0.0)
+    assert b2.take(100.0, 1.0)         # 1s refill = 100 tokens
+    assert not b2.take(1.0, 1.0)
+    b3 = _TokenBucket(rate=100.0, burst=0.0, now=0.0)
+    assert b3.burst == 200.0           # 0 burst defaults to 2s of rate
+    # refill never exceeds the burst cap
+    b4 = _TokenBucket(rate=100.0, burst=150.0, now=0.0)
+    assert b4.take(150.0, 0.0)
+    assert not b4.take(151.0, 100.0)   # long idle still caps at burst
+    assert b4.take(150.0, 100.0)
+
+
+def test_quota_sheds_attributed_and_refill_admits():
+    clock = VirtualClock()
+    frontier, workers, _ = _tier(
+        clock=clock, tenant_quotas={"limited": (100.0, 100.0)})
+    prompt = np.arange(46, dtype=np.int64)  # cost 46 + 4 = 50
+    g1 = frontier.submit(prompt, tenant="limited", max_new_tokens=4)
+    g2 = frontier.submit(prompt, tenant="limited", max_new_tokens=4)
+    g3 = frontier.submit(prompt, tenant="limited", max_new_tokens=4)
+    assert frontier.status(g1) == "queued"
+    assert frontier.status(g2) == "queued"
+    assert frontier.status(g3) == "shed"
+    with pytest.raises(RuntimeError, match="quota"):
+        frontier.result(g3)
+    clock.advance(0.5)  # 50 tokens refill -> one more admits
+    g4 = frontier.submit(prompt, tenant="limited", max_new_tokens=4)
+    assert frontier.status(g4) == "queued"
+    assert frontier.counters["quota_shed"] == 1
+
+
+def test_untagged_never_drains_a_tagged_bucket():
+    """Regression (PR 19 satellite): '-' traffic must hit only the '-'
+    bucket, and a raw-spelled label must hit its normalized bucket —
+    neither can consume another tenant's tokens."""
+    clock = VirtualClock()
+    f2, w2, _ = _tier(clock=clock,
+                      tenant_quotas={"abuser": (10.0, 10.0)})
+    prompt = np.arange(20, dtype=np.int64)
+    # untagged flood: unlimited default quota, never touches "abuser"
+    for _ in range(50):
+        assert f2.status(f2.submit(prompt, max_new_tokens=4)) == "queued"
+    # the abuser's bucket is untouched by the flood: the burst (10
+    # tokens) still admits exactly one cost-10 request...
+    ga = f2.submit(prompt[:6], tenant="  abuser ", max_new_tokens=4)
+    gb = f2.submit(prompt[:6], tenant="abuser", max_new_tokens=4)
+    assert f2.status(ga) == "queued"   # raw spelling uses the same bucket
+    assert f2.status(gb) == "shed"     # ...which is now empty
+    # and the flood itself was never charged to any tagged bucket
+    assert f2._buckets.keys() == {"abuser"}
+    assert _acct.normalize_tenant("  abuser ") == "abuser"
+
+
+def test_synchronous_leaf_shed_still_resolves_through_relay():
+    """Regression: a leaf can shed a request INSIDE submit (queue
+    preemption) before the frontier records the rid->gid mapping; the
+    orphan buffer must still deliver that resolution to on_resolve."""
+    clock = VirtualClock()
+    frontier, workers, _ = _tier(clock=clock, queue_limit=4)
+    frontier.config.retain_results = False
+    seen = []
+    frontier.on_resolve = lambda gid, req: seen.append((gid, req.status))
+    prompt = np.arange(30, dtype=np.int64)
+    n = 40
+    for _ in range(n):
+        frontier.submit(prompt, slo="batch", max_new_tokens=4)
+    _drive(frontier, workers, clock)
+    assert len(seen) == n, "every submission must resolve exactly once"
+    assert {s for _, s in seen} == {"done", "shed"}
+    assert len({g for g, _ in seen}) == n
+
+
+# -- hot-tenant spread --------------------------------------------------------
+
+def test_hot_tenant_spreads_over_top_ranked_leaves():
+    clock = VirtualClock()
+    frontier, workers, _ = _tier(n_leaves=4, clock=clock)
+    prompt = np.arange(24, dtype=np.int64)
+    cold = [frontier.leaf_of(frontier.submit(prompt, tenant="whale",
+                                             max_new_tokens=4))
+            for _ in range(6)]
+    assert len(set(cold)) == 1, "cold tenant stays sticky"
+    frontier.note_hot_tenants(["whale"])
+    ranked = rendezvous_rank("whale", frontier._names,
+                             frontier.config.seed)
+    spread = set(ranked[:max(2, frontier.config.hot_tenant_spread)])
+    hot = [frontier.leaf_of(frontier.submit(prompt, tenant="whale",
+                                            max_new_tokens=4))
+           for _ in range(40)]
+    assert set(hot) <= spread, "hot spread stays rendezvous-ranked"
+    assert len(set(hot)) > 1, "hot tenant actually uses several leaves"
+    _drive(frontier, workers, clock)
+
+
+# -- fleet view + aggregation -------------------------------------------------
+
+def test_fleet_view_merges_leaf_state():
+    frontier, workers, clock = _tier(n_leaves=2, engines=2)
+    prompt = np.arange(24, dtype=np.int64)
+    for i in range(12):
+        frontier.submit(prompt, tenant=f"t{i % 4}", max_new_tokens=4,
+                        slo="interactive" if i % 2 else "standard")
+    view = frontier.fleet_view()
+    assert set(view["leaves"]) == {"leaf0", "leaf1"}
+    assert view["queue_depth"] == sum(
+        v["queue_depth"] for v in view["leaves"].values())
+    for c in ("interactive", "standard", "batch"):
+        assert view["admission"][c] == sum(
+            v["admission"][c] for v in view["leaves"].values())
+    assert view["quota"]["throttled_total"] == 0
+    _drive(frontier, workers, clock)
+    st = frontier.stats()
+    assert st["placed"] == 12
+    assert st["leaves"]["done"] == 12
+    assert set(st["per_leaf"]) == {"leaf0", "leaf1"}
+
+
+def test_live_health_doc_carries_frontier_block(tmp_path, monkeypatch):
+    """With the live plane on, ONE shared aggregator carries the merged
+    supervisor-visible queues AND the per-leaf frontier block into
+    fleet_health.json — the supervisor's schema unchanged."""
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_LIVE_TELEMETRY", "1")
+    import json
+
+    frontier, workers, clock = _tier(n_leaves=2, engines=1)
+    prompt = np.arange(24, dtype=np.int64)
+    for i in range(8):
+        frontier.submit(prompt, tenant="acme", max_new_tokens=4)
+    _drive(frontier, workers, clock)
+    agg = frontier._live_agg
+    assert agg is not None, "frontier must own the shared aggregator"
+    assert all(leaf._live_agg is agg
+               for leaf in frontier._leaves.values())
+    agg.write_health()
+    doc = json.loads((tmp_path / "fleet_health.json").read_text())
+    assert "frontier" in doc
+    assert set(doc["frontier"]["leaves"]) == {"leaf0", "leaf1"}
+    assert "queues" in doc and "admission" in doc["queues"]
+
+
+# -- abusive-tenant isolation (stub tier, virtual time) -----------------------
+
+def test_abusive_tenant_isolation_under_quota():
+    """The ISSUE's quota promise, in miniature: with the abuser under a
+    token bucket, the victims' p95 admission latency stays close to the
+    no-abuser baseline and the abuser's sheds are quota-attributed."""
+    base_spec = make_spec("mixed", seed=5, rate_rps=4000.0)
+    abuse_spec = make_spec("mixed", seed=5, rate_rps=4000.0,
+                           abuse_rps=4000.0)
+    abuse_spec["abuse"]["start_s"] = 0.2
+    kw = dict(n_leaves=2, engines_per_leaf=2, tokens_per_s=200_000.0,
+              queue_limit=2048)
+    base = run_stub_replay(base_spec, 6000, **kw)
+    abuse = run_stub_replay(abuse_spec, 9000,
+                            tenant_quotas={"abuser": (500.0, 1000.0)},
+                            **kw)
+    ab = abuse["tenants"]["abuser"]
+    assert ab.get("shed_quota", 0) > 0, "abuser never throttled"
+    assert ab.get("shed_quota", 0) > ab.get("done", 0), \
+        "quota must shed most of the flood"
+    # quota sheds attributed to tenants, summing to the frontier counter
+    assert abuse["frontier"]["quota_shed"] == sum(
+        r.get("shed_quota", 0) for r in abuse["tenants"].values())
+    v0 = base["tenants"]["t000"]["admission_p95_s"]
+    v1 = abuse["tenants"]["t000"]["admission_p95_s"]
+    assert v1 <= v0 * 1.25 + 1e-3, \
+        f"victim p95 {v1:.4f}s vs baseline {v0:.4f}s"
+
+
+# -- determinism --------------------------------------------------------------
+
+def test_same_seed_same_ledger_digest():
+    spec = make_spec("mixed", seed=13, rate_rps=5000.0)
+    kw = dict(n_leaves=2, engines_per_leaf=2, tokens_per_s=300_000.0)
+    a = run_stub_replay(spec, 4000, **kw)
+    b = run_stub_replay(spec, 4000, **kw)
+    assert a["digest"] == b["digest"]
+    assert a["classes"] == b["classes"]
+    c = run_stub_replay(make_spec("mixed", seed=14, rate_rps=5000.0),
+                        4000, **kw)
+    assert c["digest"] != a["digest"], "different seed, different run"
+
+
+# -- real engines: 1-leaf vs 2-leaf bit-equality ------------------------------
+
+ENG = dict(num_slots=2, max_length=64, page_size=16, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.fleet.topology import (
+        get_hybrid_communicate_group, set_hybrid_communicate_group)
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    prev = get_hybrid_communicate_group()
+    prev_mesh = _mesh.get_global_mesh()
+    set_hybrid_communicate_group(None)
+    _mesh.set_global_mesh(None)
+    try:
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        m.eval()
+        yield m
+        inference.disable_decode_engine(m)
+    finally:
+        set_hybrid_communicate_group(prev)
+        _mesh.set_global_mesh(prev_mesh)
+
+
+@pytest.fixture()
+def store():
+    from paddle_tpu.runtime import TCPStore
+
+    s = TCPStore(host="127.0.0.1", port=free_port(), is_master=True,
+                 timeout=20.0)
+    yield s
+    s.close()
+
+
+def _drive_real(frontier, workers, rounds=800):
+    for _ in range(rounds):
+        frontier.pump()
+        for w in workers:
+            w.poll_once()
+        if not frontier.pending():
+            return
+    raise AssertionError(
+        f"undrained after {rounds} rounds: {frontier.stats()}")
+
+
+@pytest.mark.slow
+def test_greedy_streams_bit_equal_one_leaf_vs_two(model, store):
+    """The cross-topology determinism gate: identical submissions into a
+    1-leaf and a 2-leaf federated tier yield BIT-EQUAL tokens, greedy
+    and sampled alike — gid-derived seeds make placement invisible."""
+    from paddle_tpu.serving import EngineWorker
+
+    rng = np.random.default_rng(3)
+    reqs = []
+    shared = rng.integers(1, VOCAB, size=18).astype(np.int64)
+    for i in range(8):
+        if i % 2:
+            prompt = np.concatenate(
+                [shared, rng.integers(1, VOCAB, size=5 + i).astype(np.int64)])
+        else:
+            prompt = rng.integers(1, VOCAB, size=16 + i).astype(np.int64)
+        reqs.append((prompt, f"tenant{i % 3}",
+                     dict(max_new_tokens=8, do_sample=(i % 2 == 0),
+                          temperature=0.8, top_k=8)))
+
+    def run_tier(namespaces):
+        workers, leaves = [], []
+        for k, ns in enumerate(namespaces):
+            leaves.append(Router(store, namespace=ns, queue_limit=32,
+                                 dataplane="store"))
+            for j in range(2 if len(namespaces) == 1 else 1):
+                workers.append(EngineWorker(
+                    model, store, namespace=ns,
+                    name=f"{ns}-e{j}", **ENG))
+        frontier = FrontierRouter(leaves, seed=9)
+        gids = [frontier.submit(p, tenant=t, **kw)
+                for p, t, kw in reqs]
+        _drive_real(frontier, workers)
+        out = [frontier.result(g) for g in gids]
+        for w in workers:
+            w._server.close()
+        return out
+
+    one = run_tier(["fed-one"])
+    two = run_tier(["fed-a", "fed-b"])
+    for i, (a, b) in enumerate(zip(one, two)):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"request {i} diverged across topologies")

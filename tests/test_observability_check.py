@@ -575,3 +575,64 @@ def test_tenant_family_registered():
         assert CATALOG.METRICS[name][0] == "gauge", name
     assert "tenant_heavy_hitter" in CATALOG.EVENTS
     assert "tenant_ledger_reconcile" in CATALOG.EVENTS
+
+
+# ---------------------------------------------------------------------------
+# frontier_* family (PR 19): the federated front tier is the single writer
+# ---------------------------------------------------------------------------
+_FRONTIER_SRC = """
+    from paddle_tpu import observability as _obs
+    def f():
+        _obs.inc("frontier_requests_total", leaf="leaf0")
+        _obs.inc("frontier_quota_shed_total", tenant="abuser")
+        _obs.set_gauge("frontier_leaves", 2.0)
+        _obs.event("frontier_hot_tenant_spread", tenant="acme")
+"""
+
+
+def test_frontier_family_from_frontier_allowed(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_FRONTIER_SRC))
+    rel = os.path.join("paddle_tpu", "serving", "frontier.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_frontier_family_from_leaf_router_rejected(tmp_path):
+    # the leaf Router sits BELOW the front tier and must not narrate
+    # tier-level decisions — nor may the replay harness, which only
+    # observes; the front tier alone writes its family
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(_FRONTIER_SRC))
+    for rel in (os.path.join("paddle_tpu", "serving", "router.py"),
+                os.path.join("paddle_tpu", "serving", "replay.py")):
+        v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+        assert len(v) == 4 and all("single-writer" in m for _, m in v), rel
+
+
+def test_quota_throttle_event_owned_by_accounting(tmp_path):
+    # tenant_quota_throttled rides the tenant_* family: the front tier
+    # calls accounting's helper rather than emitting the event itself,
+    # so quota telemetry keeps one writer even with many front tiers
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        from paddle_tpu import observability as _obs
+        def f():
+            _obs.event("tenant_quota_throttled", tenant="abuser",
+                       slo="interactive")
+    """))
+    rel = os.path.join("paddle_tpu", "serving", "frontier.py")
+    v = list(check_observability.check_file(str(f), CATALOG, rel=rel))
+    assert len(v) == 1 and "single-writer" in v[0][1]
+    rel = os.path.join("paddle_tpu", "observability", "accounting.py")
+    assert not list(check_observability.check_file(str(f), CATALOG, rel=rel))
+
+
+def test_frontier_family_registered():
+    assert check_observability.OWNED_PREFIXES["frontier_"].endswith(
+        "frontier.py")
+    assert CATALOG.METRICS["frontier_requests_total"][0] == "counter"
+    assert CATALOG.METRICS["frontier_quota_shed_total"][0] == "counter"
+    assert CATALOG.METRICS["frontier_leaves"][0] == "gauge"
+    assert CATALOG.METRICS["frontier_queue_depth"][0] == "gauge"
+    assert "tenant_quota_throttled" in CATALOG.EVENTS
+    assert "frontier_hot_tenant_spread" in CATALOG.EVENTS

@@ -1,0 +1,283 @@
+"""Workload-replay harness invariants (docs/REPLAY.md).
+
+The replay module's whole value is its determinism contract — same
+spec, same seed, same stream, same ledger digest, on any host — plus
+the fidelity of its stub tier to the real store-dataplane contracts.
+These tests pin both, and the shard-mode partition property the
+scaling bench (scripts/bench_replay.py) depends on.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import Router
+from paddle_tpu.serving.protocol import (k_count, k_done, k_engine, k_occ,
+                                         unpack)
+from paddle_tpu.serving.replay import (MemStore, ReplayLedger, StubWorker,
+                                       VirtualClock, arrivals, make_spec,
+                                       replay, run_leaf_shard,
+                                       run_stub_replay, _Reservoir)
+
+
+# -- arrival streams ----------------------------------------------------------
+
+def _take(spec, n):
+    return list(itertools.islice(arrivals(spec), n))
+
+
+def test_arrivals_deterministic_and_time_ordered():
+    spec = make_spec("mixed", seed=42, rate_rps=2000.0)
+    a = _take(spec, 3000)
+    b = _take(spec, 3000)
+    assert len(a) == 3000
+    for ea, eb in zip(a, b):
+        assert ea["t"] == eb["t"]
+        assert ea["tenant"] == eb["tenant"]
+        assert ea["slo"] == eb["slo"]
+        assert ea["max_new_tokens"] == eb["max_new_tokens"]
+        np.testing.assert_array_equal(ea["prompt"], eb["prompt"])
+    ts = [e["t"] for e in a]
+    assert ts == sorted(ts), "merged stream must be time-ordered"
+    assert ts[0] >= 0.0
+
+
+def test_arrivals_seed_changes_stream():
+    a = _take(make_spec("mixed", seed=1, rate_rps=2000.0), 500)
+    b = _take(make_spec("mixed", seed=2, rate_rps=2000.0), 500)
+    assert any(ea["t"] != eb["t"] for ea, eb in zip(a, b))
+
+
+def test_arrivals_mix_properties():
+    spec = make_spec("mixed", seed=7, rate_rps=4000.0, tenants=16,
+                     tagged_share=0.75)
+    evs = _take(spec, 8000)
+    # tagged share lands near the configured fraction
+    tagged = sum(1 for e in evs if e["tenant"] is not None)
+    assert 0.65 < tagged / len(evs) < 0.85
+    # Zipf head: the rank-0 tenant dominates the tagged slice
+    from collections import Counter
+    counts = Counter(e["tenant"] for e in evs if e["tenant"])
+    assert counts.most_common(1)[0][0] == "t000"
+    # every SLO class appears; agentic turns are interactive-only extras
+    assert {e["slo"] for e in evs} == {"interactive", "standard", "batch"}
+    # longdoc component produces the long-prefill outliers
+    assert max(len(e["prompt"]) for e in evs) >= 192
+
+
+def test_agentic_sessions_grow_shared_prefixes():
+    spec = {"seed": 3, "rate_rps": 200.0,
+            "mix": [{"kind": "agentic", "share": 1.0, "turns": 5,
+                     "think_s": 0.2, "turn_tokens": 8}],
+            "tenants": {"n": 4, "tagged_share": 1.0},
+            "slo_mix": {"interactive": 1.0},
+            "prompt_tokens": [8, 16], "max_new_tokens": [4, 8]}
+    evs = _take(spec, 400)
+    # multi-turn sessions: some event's prompt extends an earlier
+    # event's prompt exactly (the prefix-affinity traffic shape)
+    extended = 0
+    by_len = sorted(evs, key=lambda e: len(e["prompt"]))
+    for i, e in enumerate(by_len):
+        p = e["prompt"]
+        for other in by_len[i + 1:]:
+            q = other["prompt"]
+            if len(q) > len(p) and np.array_equal(q[:len(p)], p):
+                extended += 1
+                break
+    assert extended >= len(evs) // 4
+
+
+def test_abuse_component_respects_window():
+    spec = make_spec("mixed", seed=9, rate_rps=1000.0, abuse_rps=2000.0)
+    spec["abuse"]["start_s"] = 1.0
+    spec["abuse"]["end_s"] = 2.0
+    evs = _take(spec, 6000)
+    abuse_t = [e["t"] for e in evs if e["tenant"] == "abuser"]
+    assert abuse_t, "abuse window must produce traffic"
+    assert min(abuse_t) >= 1.0
+    assert max(abuse_t) <= 2.0 + 0.1
+
+
+# -- MemStore + StubWorker fidelity -------------------------------------------
+
+def test_memstore_tcpstore_surface():
+    s = MemStore()
+    assert s.add("k", 1) == 1
+    assert s.add("k", 2) == 3
+    s.set("x", b"v")
+    assert s.get("x") == b"v"
+    assert s.check("x") and s.check(["x", "k"])
+    assert not s.check(["x", "missing"])
+    s.wait(["x"])
+    with pytest.raises(RuntimeError):
+        s.wait(["missing"])
+    assert s.delete_key("x") and not s.delete_key("x")
+
+
+def test_stub_worker_registers_like_engine_worker():
+    """The stub must speak the exact store registration + occupancy
+    contract (serving/worker.py) the router discovers engines by."""
+    store, clock = MemStore(), VirtualClock()
+    w = StubWorker(store, "ns", clock=clock, name="s0", num_slots=8)
+    assert int(store.add(k_count("ns"), 0)) == 1
+    rec = unpack(store.get(k_engine("ns", 0)))
+    for key in ("name", "index", "num_slots", "max_length", "page_size",
+                "buckets", "pid", "addr", "role", "kv_wire"):
+        assert key in rec, f"registration record missing {key!r}"
+    assert rec["name"] == "s0" and rec["role"] == "unified"
+    w.poll()
+    occ = unpack(store.get(k_occ("ns", "s0")))
+    for key in ("beat", "acked_seq", "done_count", "name", "role",
+                "prefill_queue", "draining", "drained",
+                "outstanding_tokens"):
+        assert key in occ, f"occupancy beat missing {key!r}"
+    b0 = occ["beat"]
+    w.poll()
+    assert unpack(store.get(k_occ("ns", "s0")))["beat"] == b0 + 1
+
+
+def test_stub_worker_serves_at_token_rate_and_writes_done():
+    store, clock = MemStore(), VirtualClock()
+    leaf = Router(store, namespace="ns", dataplane="store", clock=clock)
+    w = StubWorker(store, "ns", clock=clock, name="s0",
+                   tokens_per_s=100.0)
+    rid = leaf.submit(np.arange(40, dtype=np.int64), max_new_tokens=10)
+    leaf.pump()
+    w.poll()
+    assert not store.check(k_done("ns", rid)), \
+        "cost 50 must not finish with 0 accrued budget"
+    clock.advance(0.3)   # 30 tokens accrued: still short
+    w.poll()
+    assert not store.check(k_done("ns", rid))
+    clock.advance(0.25)  # 55 total: done, BEFORE the ack beat
+    w.poll()
+    assert store.check(k_done("ns", rid))
+    leaf.pump()
+    assert leaf.status(rid) == "done"
+    toks = leaf.result(rid)
+    assert len(toks) > 0
+
+
+def test_stub_results_derive_from_sampling_seed():
+    store, clock = MemStore(), VirtualClock()
+    leaf = Router(store, namespace="ns", dataplane="store", clock=clock,
+                  retain_results=True)
+    w = StubWorker(store, "ns", clock=clock, tokens_per_s=1e9)
+    r1 = leaf.submit(np.arange(8, dtype=np.int64), max_new_tokens=4,
+                     seed=123)
+    r2 = leaf.submit(np.arange(8, dtype=np.int64), max_new_tokens=4,
+                     seed=123)
+    r3 = leaf.submit(np.arange(8, dtype=np.int64), max_new_tokens=4,
+                     seed=124)
+    leaf.pump()
+    clock.advance(1.0)
+    w.poll()
+    leaf.pump()
+    np.testing.assert_array_equal(leaf.result(r1), leaf.result(r2))
+    assert not np.array_equal(leaf.result(r1), leaf.result(r3))
+
+
+# -- ledger -------------------------------------------------------------------
+
+def test_reservoir_is_deterministic_and_bounded():
+    r1, r2 = _Reservoir(cap=64), _Reservoir(cap=64)
+    for i in range(10_000):
+        v = float((i * 7919) % 1000)
+        r1.add(v)
+        r2.add(v)
+    assert r1.vals == r2.vals
+    assert len(r1.vals) <= 64
+    assert 0.0 <= r1.quantile(0.5) <= 1000.0
+    assert r1.quantile(0.0) <= r1.quantile(0.99)
+
+
+def test_ledger_digest_covers_order_outcome_and_tokens():
+    import dataclasses
+    from paddle_tpu.serving.router import RouterRequest
+    from paddle_tpu.inference.engine import SamplingParams
+
+    def req(status, tokens=None, reason=None):
+        r = RouterRequest(rid=0, prompt=np.empty(0, np.int64),
+                          params=SamplingParams(), slo="standard",
+                          submit_t=0.0, deadline_t=1.0, block_keys=[],
+                          status=status, shed_reason=reason)
+        r.tenant = "t"
+        if tokens is not None:
+            r.tokens = np.asarray(tokens, dtype=np.int64)
+        return r
+
+    a, b, c, d = (ReplayLedger() for _ in range(4))
+    a.resolve(1, req("done", [1, 2]))
+    a.resolve(2, req("shed", reason="quota"))
+    b.resolve(1, req("done", [1, 2]))
+    b.resolve(2, req("shed", reason="quota"))
+    assert a.digest == b.digest
+    c.resolve(2, req("shed", reason="quota"))   # order flipped
+    c.resolve(1, req("done", [1, 2]))
+    assert c.digest != a.digest
+    d.resolve(1, req("done", [1, 3]))           # different tokens
+    d.resolve(2, req("shed", reason="quota"))
+    assert d.digest != a.digest
+    assert a.rows[("t", "standard")]["shed_quota"] == 1
+
+
+# -- end-to-end stub replay ---------------------------------------------------
+
+def test_replay_resolves_everything_and_reaps_store():
+    spec = make_spec("mixed", seed=21, rate_rps=3000.0)
+    out = run_stub_replay(spec, 3000, n_leaves=2, engines_per_leaf=2,
+                          tokens_per_s=200_000.0)
+    assert out["resolved"] == out["requests"] == 3000
+    total = 0
+    for cls in out["classes"].values():
+        total += sum(v for k, v in cls.items() if isinstance(v, int))
+    assert total == 3000
+    assert out["dispatch_rps"] > 0
+    assert "admission_s" in out["classes"]["interactive"]
+
+
+def test_replay_heap_and_scan_dispatch_agree():
+    """The PR 19 hot-loop refactor must be a pure optimization: the
+    lazy-invalidation heap places every request on the SAME engine the
+    O(E) scan would (identical tie-break), so the run digests match."""
+    spec = make_spec("mixed", seed=31, rate_rps=3000.0)
+    kw = dict(n_leaves=1, engines_per_leaf=5, tokens_per_s=150_000.0)
+    heap = run_stub_replay(spec, 2500, dispatch_mode="heap", **kw)
+    scan = run_stub_replay(spec, 2500, dispatch_mode="scan", **kw)
+    assert heap["digest"] == scan["digest"]
+    assert heap["classes"] == scan["classes"]
+
+
+def test_shard_partition_covers_stream_exactly():
+    """2-leaf shard runs partition the global stream: every gid lands in
+    exactly one shard, and each shard's work matches what the 1-leaf
+    run dispatched for those gids (same seeds, same hash)."""
+    spec = make_spec("mixed", seed=17, rate_rps=3000.0)
+    kw = dict(engines_per_leaf=2, tokens_per_s=500_000.0)
+    whole = run_leaf_shard(spec, 2000, ["leaf0"], "leaf0", **kw)
+    a = run_leaf_shard(spec, 2000, ["leaf0", "leaf1"], "leaf0", **kw)
+    b = run_leaf_shard(spec, 2000, ["leaf0", "leaf1"], "leaf1", **kw)
+    assert whole["requests"] == 2000
+    assert a["requests"] + b["requests"] == 2000
+    assert 0 < a["requests"] < 2000, "both shards must get traffic"
+    assert whole["digest"] != ""  # digest present
+    # shard runs are themselves deterministic
+    a2 = run_leaf_shard(spec, 2000, ["leaf0", "leaf1"], "leaf0", **kw)
+    assert a2["digest"] == a["digest"]
+
+
+def test_virtual_clock_controls_deadlines():
+    """Virtual time drives deadline sheds: a queued request past its
+    class deadline sheds when the clock says so, not wall time."""
+    store, clock = MemStore(), VirtualClock()
+    leaf = Router(store, namespace="ns", dataplane="store", clock=clock,
+                  deadlines={"interactive": 1.0})
+    # no workers at all: nothing can dispatch, deadline must fire
+    rid = leaf.submit(np.arange(8, dtype=np.int64),
+                      slo="interactive", max_new_tokens=4)
+    leaf.pump()
+    assert leaf.status(rid) == "queued"
+    clock.advance(1.5)
+    leaf.pump()
+    assert leaf.status(rid) == "shed"
+    assert leaf._requests[rid].shed_reason == "deadline"

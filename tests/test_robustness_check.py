@@ -155,6 +155,15 @@ def test_live_serving_modules_are_guarded():
         assert not list(check_robustness.check_guarded_store_ops(target)), rel
 
 
+def test_front_tier_files_are_enrolled():
+    # PR 19: the federated front tier and the replay harness both talk
+    # to the store in hot loops — dropping them from the guarded list
+    # would silently un-police every one of those ops
+    rels = {os.path.basename(p) for p in check_robustness.GUARDED_STORE_FILES}
+    assert "frontier.py" in rels
+    assert "replay.py" in rels
+
+
 # -- rule 5: transport socket ops run under deadline_guard -------------------
 def _socket_violations(tmp_path, src):
     f = tmp_path / "transport_mod.py"
