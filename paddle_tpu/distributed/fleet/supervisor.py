@@ -64,13 +64,22 @@ from ...serving.protocol import (DEFAULT_NAMESPACE, deadline_guard,
                                  k_ctl_engine, k_occ, pack, unpack)
 from ...testing import chaos
 
-__all__ = ["FENCES", "FlipExecutor", "FlipJournal", "FleetSupervisor",
-           "StoreFleetExecutor", "SupervisorConfig", "read_health"]
+__all__ = ["FENCES", "WEIGHT_FENCES", "FlipExecutor", "FlipJournal",
+           "FleetSupervisor", "StoreFleetExecutor", "SupervisorConfig",
+           "read_health"]
 
 #: ordered flip-transition fences; ``commit`` is the durability point —
 #: recovery rolls forward at/after it and back before it
 FENCES = ("plan", "drain", "quiesce", "resize", "commit", "finalize")
 COMMIT_INDEX = FENCES.index("commit")
+
+#: ordered fences of the online WEIGHT-epoch transaction (the journal's
+#: second transaction type, serving/online.py): ``commit`` is journaled
+#: BEFORE the engine pointer-swaps, so roll-forward recovery re-sends
+#: the idempotent swap orders (engines at/past the epoch no-op) and
+#: roll-back discards shadow buffers that were never promoted
+WEIGHT_FENCES = ("publish", "stream", "commit", "swap", "finalize")
+WEIGHT_COMMIT_INDEX = WEIGHT_FENCES.index("commit")
 
 #: committed/rolled-back flips kept in the journal's history log
 _HISTORY_CAP = 64
@@ -163,9 +172,16 @@ class FlipJournal:
                            no flip is in flight); rewritten atomically
                            at every fence
         flip_log.json      bounded history of closed flips, newest last
+        weights_current.json  the in-flight online weight-epoch
+                           transaction (serving/online.py), same
+                           fence-before-action protocol over
+                           WEIGHT_FENCES
+        weight_log.json    bounded history of closed weight flips
 
     One flip is in flight at a time — the supervisor serializes role
-    changes, which is what makes single-doc recovery sufficient.
+    changes, which is what makes single-doc recovery sufficient. The
+    weight transaction is serialized the same way (one epoch publishes
+    at a time) and shares the atomic-write chokepoint.
     """
 
     def __init__(self, root: str):
@@ -174,6 +190,8 @@ class FlipJournal:
         self.roles_path = os.path.join(root, "fleet_roles.json")
         self.current_path = os.path.join(root, "flip_current.json")
         self.history_path = os.path.join(root, "flip_log.json")
+        self.weights_path = os.path.join(root, "weights_current.json")
+        self.weight_history_path = os.path.join(root, "weight_log.json")
 
     # -- roles doc -----------------------------------------------------------
 
@@ -220,6 +238,45 @@ class FlipJournal:
 
     def history(self) -> List[dict]:
         return _read_json(self.history_path) or []
+
+    # -- the in-flight weight-epoch transaction (serving/online.py) ---------
+
+    def pending_weights(self) -> Optional[dict]:
+        return _read_json(self.weights_path)
+
+    def begin_weights(self, doc: dict) -> None:
+        doc["fence"] = WEIGHT_FENCES[0]
+        doc["fences"] = {WEIGHT_FENCES[0]: time.time()}
+        _atomic_write_json(self.weights_path, doc)
+
+    def advance_weights(self, doc: dict, fence: str) -> None:
+        if fence not in WEIGHT_FENCES:
+            raise ValueError(f"unknown weight fence {fence!r}")
+        doc["fence"] = fence
+        doc["fences"][fence] = time.time()
+        _atomic_write_json(self.weights_path, doc)
+
+    def close_weights(self, doc: dict, outcome: str) -> None:
+        """Retire the in-flight weight flip into its history log, THEN
+        drop the current doc (same idempotent two-write order as
+        ``close``)."""
+        entry = {k: doc.get(k) for k in
+                 ("id", "epoch", "engines", "fence", "fences", "leaves",
+                  "wire", "bytes", "acked")}
+        entry["outcome"] = outcome
+        entry["closed_ts"] = time.time()
+        history = _read_json(self.weight_history_path) or []
+        history = [h for h in history if h.get("id") != entry["id"]]
+        history.append(entry)
+        _atomic_write_json(self.weight_history_path,
+                           history[-_HISTORY_CAP:])
+        try:
+            os.remove(self.weights_path)
+        except OSError:
+            pass
+
+    def weight_history(self) -> List[dict]:
+        return _read_json(self.weight_history_path) or []
 
 
 class FlipExecutor:

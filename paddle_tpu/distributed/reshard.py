@@ -520,10 +520,21 @@ def _transfer(arr, sharding: NamedSharding, key: str):
     except Exception as e:
         _obs.inc("reshard_fallback_total", why="host_roundtrip")
         print(f"[reshard] direct transfer of {key!r} failed ({e!r}); "
-              "degrading to a host round-trip", file=sys.stderr)
-        host = np.asarray(arr)
+              "degrading to a per-shard host round-trip", file=sys.stderr)
+        # Bounded round-trip: materialize only each target shard's slice
+        # on the host (make_array_from_callback pulls arr[idx] per
+        # device) instead of gathering the FULL leaf — the old
+        # np.asarray(arr) path put one complete copy on the host and
+        # re-shipped it whole to every device, defeating the planned
+        # shard spec exactly when memory is tightest.
+        shard_shape = sharding.shard_shape(tuple(arr.shape))
+        shard_b = (int(np.prod(shard_shape)) if shard_shape else 1) \
+            * np.dtype(arr.dtype).itemsize
+        _obs.observe("reshard_peak_bytes", shard_b)
         with deadline_guard(f"host transfer {key}"):
-            return jax.device_put(host, sharding)
+            return jax.make_array_from_callback(
+                tuple(arr.shape), sharding,
+                lambda idx: np.asarray(arr[idx]))
 
 
 def _target_sharding(v) -> Optional[NamedSharding]:

@@ -246,6 +246,11 @@ class Request:
     #: pro-rata KV page occupancy charged to this request so far, in
     #: integer page-microseconds (PageSecondsMeter)
     acct_page_us: int = 0
+    #: weight epoch this request was admitted under (per-slot epoch pin):
+    #: the request decodes against these weights until it finishes, even
+    #: if the engine promotes a newer epoch mid-flight — the per-epoch
+    #: greedy bit-equal contract rides on this
+    epoch: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -654,16 +659,28 @@ class DecodeEngine:
         #: freed slots are zeroed so their writes/gathers hit trash
         self._tables = np.zeros((cfg.num_slots, self._mp), np.int32)
         # stable state ordering for the compiled-call state swap (the
-        # TracedLayer idiom): dedup'd params first, then buffers
-        self._state, seen = [], set()
-        for _, p in model.named_parameters():
+        # TracedLayer idiom): dedup'd params first, then buffers. Names
+        # ride along (first name wins on dedup) so the online weight
+        # plane can address leaves by name over the wire.
+        self._state, self._state_names, seen = [], [], set()
+        for name, p in model.named_parameters():
             if id(p) not in seen:
                 seen.add(id(p))
                 self._state.append(p)
-        for _, b in model.named_buffers():
+                self._state_names.append(name)
+        for name, b in model.named_buffers():
             if id(b) not in seen:
                 seen.add(id(b))
                 self._state.append(b)
+                self._state_names.append(name)
+        self._state_index = {n: i for i, n in enumerate(self._state_names)}
+        #: versioned weight-epoch plane (serving/online.py): the live
+        #: epoch, value snapshots pinned for in-flight old-epoch
+        #: requests, and the double-buffered shadow set an in-progress
+        #: wt stream stages into
+        self._epoch = 0
+        self._epoch_vals: Dict[int, List] = {}
+        self._shadow: Optional[dict] = None
         if self._mesh is not None:
             for t in self._state:
                 t._value = jax.device_put(t._value,
@@ -820,13 +837,24 @@ class DecodeEngine:
                 self._admission_backoff()
             return bool(self._waiting)
         self._backoff_s = 0.0
+        epochs = sorted({r.epoch for r in self._running.values()})
+        if len(epochs) > 1:
+            # mixed-epoch flip window: one masked decode per epoch group
+            # (excluded slots' table rows are zeroed, so their KV writes
+            # land on the trash page and their sampled tokens are
+            # ignored). Speculation is skipped for the window — verify
+            # and decode sample identical position-keyed streams, so
+            # forcing plain decode costs throughput, never bits.
+            for e in epochs:
+                self._step_decode(epoch=e)
+            return True
         k = self.config.speculate_k
         if k > 0 and self._spec_worthwhile(k):
             drafts, any_real = self._collect_drafts(k)
             if any_real and self._verify_headroom(k):
-                self._step_verify(drafts, k)
+                self._step_verify(drafts, k, epoch=epochs[0])
                 return True
-        self._step_decode()
+        self._step_decode(epoch=epochs[0])
         return True
 
     def _admission_backoff(self):
@@ -866,10 +894,14 @@ class DecodeEngine:
     def _ema(prev, x, alpha=0.3):
         return x if prev is None else (1 - alpha) * prev + alpha * x
 
-    def _step_decode(self):
+    def _step_decode(self, epoch: Optional[int] = None):
         cfg = self.config
         if self._acct is not None:
             self._acct_tick(time.perf_counter())
+        if epoch is None:
+            epoch = self._epoch
+        active = [(slot, req) for slot, req in self._running.items()
+                  if req.epoch == epoch]
         s = cfg.num_slots
         tokens = np.zeros(s, np.int32)
         positions = np.zeros(s, np.int32)
@@ -879,21 +911,31 @@ class DecodeEngine:
         greedy = np.ones(s, bool)
         keys = np.broadcast_to(self._zero_key, (s,) + self._zero_key.shape)
         keys = np.array(keys)
-        for slot, req in self._running.items():
+        for slot, req in active:
             tokens[slot] = req.tokens[-1]
             positions[slot] = len(req.prompt) + len(req.tokens) - 1
             t_, k_, p_, g_ = req.params.fields()
             temp[slot], top_k[slot], top_p[slot], greedy[slot] = t_, k_, p_, g_
             keys[slot] = req.key_np
+        tables = self._tables
+        excluded = [slot for slot, req in self._running.items()
+                    if req.epoch != epoch]
+        if excluded:
+            # other epoch groups ride along this call as masked slots:
+            # zeroed table rows route their KV writes to the trash page,
+            # exactly the warmup mechanism — their real pages are
+            # untouched and their tokens below are never applied
+            tables = self._tables.copy()
+            tables[excluded] = 0
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
         warm = "decode" in self._compiled
         t0 = time.perf_counter()
         out = self._run_counted(
             "decode", self._decode_jit,
-            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(self._tables), jnp.asarray(keys),
+            self._state_vals(epoch), self._kc, self._vc, self._ksc,
+            self._vsc, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(tables), jnp.asarray(keys),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(greedy))
         self._kc, self._vc, self._ksc, self._vsc, nxt, logits = out
@@ -908,7 +950,6 @@ class DecodeEngine:
         self._steps_since_probe += 1
         self.decode_steps += 1
         self._last_logits = logits
-        active = list(self._running.items())
         if self._acct is not None:
             self._acct_wire_bytes(active, int(logits.shape[-1]), 1)
         for slot, req in active:
@@ -920,12 +961,17 @@ class DecodeEngine:
         _obs.inc("serving_tokens_total", len(active))
         self._update_gauges()
 
-    def _step_verify(self, drafts: Dict[int, np.ndarray], k: int):
+    def _step_verify(self, drafts: Dict[int, np.ndarray], k: int,
+                     epoch: Optional[int] = None):
         """One multi-token speculative step: score cur + k drafts in a
         single target pass; accept target tokens while the draft agrees
         (position-keyed streams, so acceptance never changes WHAT is
-        sampled — only how many tokens one step emits)."""
+        sampled — only how many tokens one step emits). Only runs when
+        every running slot shares ``epoch`` (step() forces plain decode
+        during mixed-epoch flip windows)."""
         cfg = self.config
+        if epoch is None:
+            epoch = self._epoch
         if self._acct is not None:
             self._acct_tick(time.perf_counter())
         s, k1 = cfg.num_slots, k + 1
@@ -950,8 +996,8 @@ class DecodeEngine:
         t0 = time.perf_counter()
         out = self._run_counted(
             f"verify_k{k}", self._verify_jit,
-            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
-            jnp.asarray(tokens), jnp.asarray(positions),
+            self._state_vals(epoch), self._kc, self._vc, self._ksc,
+            self._vsc, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self._tables), jnp.asarray(keys),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(greedy))
@@ -1095,12 +1141,20 @@ class DecodeEngine:
         page 0 — pool, scheduler, and prefix registry are untouched. With
         ``PADDLE_TPU_COMPILE_CACHE`` set, each build is served from the
         persistent AOT cache when fingerprints match; ``cache_hits`` in
-        the returned dict counts those."""
+        the returned dict counts those.
+
+        Idempotent: programs already compiled by THIS engine (a prior
+        warmup, or live traffic) are skipped and counted as cache hits
+        instead of re-executed — so a warmup after a weight flip is a
+        cheap no-op rather than a second full sweep."""
         cfg = self.config
         s = cfg.num_slots
         hits0, n0 = self.aot_cache_hits, self.compile_count
         row = np.zeros(self._mp, np.int32)
         for tb in self.buckets:
+            if f"prefill_b{tb}" in self._compiled:
+                self.aot_cache_hits += 1
+                continue
             fn = self._prefill_jit.get(tb)
             if fn is None:
                 fn = self._build_prefill(tb)
@@ -1121,30 +1175,38 @@ class DecodeEngine:
         greedy = np.ones(s, bool)
         keys = np.array(np.broadcast_to(
             self._zero_key, (s,) + self._zero_key.shape))
-        if self._decode_jit is None:
-            self._decode_jit = self._build_decode()
-        out = self._run_counted(
-            "decode", self._decode_jit,
-            self._state_vals(), self._kc, self._vc, self._ksc, self._vsc,
-            jnp.asarray(np.zeros(s, np.int32)), jnp.asarray(positions),
-            jnp.asarray(self._tables), jnp.asarray(keys),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(greedy))
-        self._kc, self._vc, self._ksc, self._vsc = out[:4]
+        if "decode" in self._compiled:
+            self.aot_cache_hits += 1
+        else:
+            if self._decode_jit is None:
+                self._decode_jit = self._build_decode()
+            out = self._run_counted(
+                "decode", self._decode_jit,
+                self._state_vals(), self._kc, self._vc, self._ksc,
+                self._vsc, jnp.asarray(np.zeros(s, np.int32)),
+                jnp.asarray(positions),
+                jnp.asarray(self._tables), jnp.asarray(keys),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(greedy))
+            self._kc, self._vc, self._ksc, self._vsc = out[:4]
         verify = False
         k = cfg.speculate_k
         if k > 0:
-            if self._verify_jit is None:
-                self._verify_jit = self._build_verify(k + 1)
-            out = self._run_counted(
-                f"verify_k{k}", self._verify_jit,
-                self._state_vals(), self._kc, self._vc, self._ksc,
-                self._vsc, jnp.asarray(np.zeros((s, k + 1), np.int32)),
-                jnp.asarray(positions), jnp.asarray(self._tables),
-                jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p), jnp.asarray(greedy))
-            self._kc, self._vc, self._ksc, self._vsc = out[:4]
             verify = True
+            if f"verify_k{k}" in self._compiled:
+                self.aot_cache_hits += 1
+            else:
+                if self._verify_jit is None:
+                    self._verify_jit = self._build_verify(k + 1)
+                out = self._run_counted(
+                    f"verify_k{k}", self._verify_jit,
+                    self._state_vals(), self._kc, self._vc, self._ksc,
+                    self._vsc, jnp.asarray(np.zeros((s, k + 1), np.int32)),
+                    jnp.asarray(positions), jnp.asarray(self._tables),
+                    jnp.asarray(keys), jnp.asarray(temp),
+                    jnp.asarray(top_k), jnp.asarray(top_p),
+                    jnp.asarray(greedy))
+                self._kc, self._vc, self._ksc, self._vsc = out[:4]
         return {"buckets": len(self.buckets), "decode": True,
                 "verify": verify,
                 "programs": self.compile_count - n0,
@@ -1176,6 +1238,8 @@ class DecodeEngine:
             "admission_waits": self.admission_waits,
             "admission_wait_s": self.admission_wait_s,
             "attn_kernel": self._attn_kernel,
+            "weight_epoch": int(self._epoch),
+            "pinned_epochs": sorted(self._epoch_vals),
         }
 
     def occupancy(self) -> dict:
@@ -1197,6 +1261,7 @@ class DecodeEngine:
             "decode_steps": int(self.decode_steps),
             "total_tokens": int(self.total_tokens),
             "compile_cache_hits": int(self.aot_cache_hits),
+            "weight_epoch": int(self._epoch),
         }
 
     # -- disaggregated prefill: KV-page export / import ---------------------
@@ -1432,7 +1497,7 @@ class DecodeEngine:
         now = time.perf_counter()
         req = Request(req_id=rid, prompt=ids, params=params,
                       key_np=np.asarray(key), submit_time=now,
-                      status="running", slot=slot)
+                      status="running", slot=slot, epoch=self._epoch)
         req.page_ids = list(pages)
         req.prefill_t0 = now
         req.prefill_s = float(kv.get("prefill_s", 0.0))
@@ -1472,8 +1537,102 @@ class DecodeEngine:
                 return b
         raise ValueError(f"no prompt bucket holds length {n}")
 
-    def _state_vals(self):
-        return [t._value for t in self._state]
+    def _state_vals(self, epoch: Optional[int] = None):
+        """Weight/buffer value list for one compiled call. ``epoch``
+        selects a pinned old-epoch snapshot during a mixed-epoch flip
+        window; None (or the live epoch) reads the live tensors. The
+        value list is jit argument #0 and excluded from the AOT cache
+        key, which is exactly why an epoch flip never recompiles."""
+        if epoch is None or epoch == self._epoch:
+            return [t._value for t in self._state]
+        return list(self._epoch_vals[epoch])
+
+    # -- versioned weight epochs (serving/online.py) ------------------------
+
+    @property
+    def weight_epoch(self) -> int:
+        """The epoch new admissions are pinned to."""
+        return self._epoch
+
+    def state_keys(self) -> List[str]:
+        """Leaf names in compiled-call state order (dedup'd params then
+        buffers; first name wins) — the wt-stream address space."""
+        return list(self._state_names)
+
+    def begin_weight_epoch(self, epoch: int) -> bool:
+        """Open the shadow param set for ``epoch``: a copy-on-stage view
+        of the live values that ``stage_weight`` overwrites leaf by leaf
+        while decoding continues on the live set. False (no-op) when
+        ``epoch`` is not newer than the live one — a replayed wt stream
+        after crash recovery must not reopen a committed epoch."""
+        epoch = int(epoch)
+        if epoch <= self._epoch:
+            return False
+        self._shadow = {"epoch": epoch,
+                        "vals": [t._value for t in self._state],
+                        "staged": set()}
+        return True
+
+    def stage_weight(self, name: str, value) -> None:
+        """Stage one leaf's new-epoch value into the shadow set (host or
+        device array; cast to the live leaf's dtype, replicated onto the
+        serving mesh). The live set — and every in-flight request — is
+        untouched until ``promote_epoch``."""
+        if self._shadow is None:
+            raise RuntimeError("stage_weight with no open shadow epoch "
+                               "(begin_weight_epoch first)")
+        i = self._state_index[name]
+        cur = self._state[i]._value
+        val = jnp.asarray(value, jnp.asarray(cur).dtype)
+        if tuple(val.shape) != tuple(cur.shape):
+            raise ValueError(
+                f"staged weight {name!r} shape {tuple(val.shape)} != "
+                f"live {tuple(cur.shape)}")
+        if self._mesh is not None:
+            val = jax.device_put(val, self._replicated_sharding)
+        self._shadow["vals"][i] = val
+        self._shadow["staged"].add(name)
+
+    def discard_shadow(self, epoch: Optional[int] = None) -> bool:
+        """Drop an un-promoted shadow set (weight-transaction rollback).
+        ``epoch`` narrows the discard to that epoch's shadow; None drops
+        whatever is open. Idempotent."""
+        if self._shadow is None:
+            return False
+        if epoch is not None and self._shadow["epoch"] != int(epoch):
+            return False
+        self._shadow = None
+        return True
+
+    def promote_epoch(self, epoch: int) -> bool:
+        """Flip the live weights to the staged shadow set by pointer
+        swap — the request-boundary epoch flip. No compiled program is
+        touched (the AOT cache key carries only shapes/mesh), no slot is
+        drained: in-flight requests keep decoding against their pinned
+        epoch (the pre-swap values are snapshotted for them), new
+        admissions read the promoted set. Exactly-once by construction:
+        an ``epoch`` at/below the live one, or with no matching staged
+        shadow, is a False no-op — crash recovery re-sends swap orders
+        freely. This is the ONLY method that rebinds ``_state`` values
+        (check_robustness.py rule 9 pins its callers to the journaled
+        weight transaction)."""
+        epoch = int(epoch)
+        if epoch <= self._epoch:
+            return False
+        if self._shadow is None or self._shadow["epoch"] != epoch:
+            return False
+        if any(r.epoch == self._epoch for r in self._running.values()):
+            # pin the outgoing epoch's values for its in-flight slots
+            self._epoch_vals[self._epoch] = [t._value for t in self._state]
+        for t, v in zip(self._state, self._shadow["vals"]):
+            t._value = v
+        self._epoch = epoch
+        self._shadow = None
+        # drop pins whose last request already finished
+        live = {r.epoch for r in self._running.values()}
+        for e in [e for e in self._epoch_vals if e not in live]:
+            del self._epoch_vals[e]
+        return True
 
     def _admit(self):
         while self._free and self._waiting:
@@ -1565,6 +1724,7 @@ class DecodeEngine:
                 kernel=self._attn_kernel)
         req.slot = slot
         req.status = "running"
+        req.epoch = self._epoch  # admission pins the epoch it prefilled on
         self._running[slot] = req
         self.total_tokens += 1
         self.prompt_tokens_total += t0
@@ -1590,6 +1750,11 @@ class DecodeEngine:
             self._tables[req.slot] = 0
             self._free.append(req.slot)
             req.slot = -1
+            if req.epoch in self._epoch_vals and not any(
+                    r.epoch == req.epoch for r in self._running.values()):
+                # last in-flight request of a retired epoch: release its
+                # pinned weight snapshot
+                del self._epoch_vals[req.epoch]
         for page in req.page_ids:
             self.pool.decref(page)
         req.page_ids = []

@@ -379,6 +379,25 @@ METRICS = {
         "gauge", "Outstanding tokens in flight per engine per tenant at "
                  "the router — the raw signal the per-tenant quota ladder "
                  "gates on (labels: engine, tenant)"),
+    # -- online continuous learning (serving/online.py) ---------------------
+    # Single-writer family: online_* may only be recorded from the
+    # online weight-flip coordinator (static gate), like supervisor_*.
+    "online_weight_epoch": (
+        "gauge", "Latest weight epoch committed into the serving fleet "
+                 "by the online coordinator (new admissions decode on "
+                 "it; in-flight requests finish on their pinned epoch)"),
+    "online_flip_seconds": (
+        "histogram", "Wall time of one journaled weight-flip "
+                     "transaction, publish fence through close — decode "
+                     "never drains inside it"),
+    "online_wt_bytes_total": (
+        "counter", "Source bytes streamed as wt leaf frames, after "
+                   "per-engine delta skipping (labels: engine; the wire "
+                   "itself is counted by serving_transport_*)"),
+    "online_flips_total": (
+        "counter", "Weight-flip transactions by terminal outcome "
+                   "(labels: outcome = committed|rolled_back|"
+                   "rolled_forward)"),
     # -- chaos --------------------------------------------------------------
     "chaos_fault_total": (
         "counter", "Faults injected by the chaos harness (labels: fault)"),
@@ -428,6 +447,10 @@ EVENTS = {
     "tenant_ledger_reconcile",  # live ledger vs post-hoc attribution diff
     "tenant_quota_throttled",  # front tier shed a request on a dry bucket
     "frontier_hot_tenant_spread",  # a tenant entered the hot (spread) set
+    "weight_flip_commit",     # online coordinator committed a weight epoch
+                              # into the fleet (epoch, leaves, bytes)
+    "weight_flip_rollback",   # weight flip rolled back (pre-commit
+                              # failure) or retired by crash recovery
 }
 
 
@@ -535,6 +558,11 @@ SPANS = {
         "finalize/rollback (attrs: id, direction, engine, outcome); "
         "trace_report attributes flip wall time against the drain/"
         "resize it covers"),
+    "weight_flip": (
+        "paddle_tpu/serving/online.py",
+        "One journaled online weight-flip transaction, publish fence "
+        "through close (attrs: epoch, engines, outcome); brackets the "
+        "wt stream + pointer swap, during which decode keeps running"),
 }
 
 

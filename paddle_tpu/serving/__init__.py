@@ -29,10 +29,16 @@ Three layers (docs/SERVING.md):
   matching workload generator — deterministic million-request arrival
   streams against real leaves or in-process stub fleets
   (docs/REPLAY.md).
+- The ``online`` module closes the continuous-learning loop: a trainer
+  publishes versioned weight epochs into live engines as journaled,
+  seq-acked ``wt`` streams that flip by pointer swap at a request
+  boundary — zero drain, zero recompile (docs/ONLINE.md).
 """
 from .protocol import (DEFAULT_DEADLINES, DEFAULT_NAMESPACE, SLO_CLASSES,
                        deadline_guard)
 from .frontier import FrontierConfig, FrontierRouter, rendezvous_rank
+from .online import (EngineSink, OnlineCoordinator, WireEngineSink,
+                     rollout_round)
 from .router import Router, RouterConfig, RouterRequest
 from .transport import TransportClient, TransportServer
 from .worker import EngineWorker
@@ -41,6 +47,7 @@ __all__ = [
     "Router", "RouterConfig", "RouterRequest", "EngineWorker",
     "FrontierRouter", "FrontierConfig", "rendezvous_rank",
     "TransportClient", "TransportServer",
+    "OnlineCoordinator", "EngineSink", "WireEngineSink", "rollout_round",
     "SLO_CLASSES", "DEFAULT_DEADLINES", "DEFAULT_NAMESPACE",
     "deadline_guard",
 ]

@@ -35,6 +35,23 @@ a ``t`` tag:
     tq_ack    {"t","ch","seq"}               receiver consumed everything on
                                              ``ch`` up to and incl. ``seq``
                                              (sender drops its replay copy)
+    wt        {"t","ch","seq","kind",...}    online weight-epoch stream
+                                             (trainer -> engine): ``begin``
+                                             opens epoch E's shadow set,
+                                             ``leaf`` carries one named
+                                             weight leaf (encode_tensor,
+                                             bf16 wire by default), ``swap``
+                                             orders the pointer-swap promote,
+                                             ``discard`` drops an un-promoted
+                                             shadow (rollback). Seq-acked
+                                             and consumed in order per
+                                             channel like dispatch records
+    wt_ack    {"t","ch","seq","epoch",..}    receiver consumed the wt frame
+                                             with that seq; a swap ack's
+                                             ``applied`` reports whether the
+                                             promote actually flipped (False
+                                             = already at/past that epoch,
+                                             the exactly-once no-op)
     tele      {"t","pays":[...]}             live-telemetry batches riding the
                                              occupancy beat: each payload is
                                              (src, seq)-numbered and re-sent
@@ -87,6 +104,7 @@ __all__ = [
     "encode_frame", "encode_kv", "decode_kv",
     "encode_tensor", "decode_tensor",
     "encode_tq_frame", "decode_tq_frame", "encode_tq_ack",
+    "encode_wt_frame", "decode_wt_frame", "encode_wt_ack",
 ]
 
 _HDR = struct.Struct(">I")
@@ -567,3 +585,58 @@ def encode_tq_ack(channel: str, seq: int) -> dict:
     """Cumulative ack: everything on ``channel`` up to and including
     ``seq`` was consumed — the sender may drop its replay copies."""
     return {"t": "tq_ack", "ch": channel, "seq": int(seq)}
+
+
+# ---------------------------------------------------------------------------
+# Online weight-epoch frames (serving/online.py trainer -> engine wire)
+# ---------------------------------------------------------------------------
+
+#: wt frame kinds, in protocol order: ``begin`` opens the shadow set,
+#: ``leaf`` frames stream the delta, ``swap`` promotes (commit side),
+#: ``discard`` drops the shadow (rollback side)
+WT_KINDS = ("begin", "leaf", "swap", "discard")
+
+
+def encode_wt_frame(channel: str, seq: int, kind: str, epoch: int,
+                    name: Optional[str] = None, arr=None,
+                    wire: str = "bf16",
+                    meta: Optional[dict] = None) -> dict:
+    """One weight-stream frame. ``leaf`` frames carry the named tensor
+    through ``encode_tensor`` (bf16 wire by default — the PR 13 absmax
+    machinery handles int8); control kinds (begin/swap/discard) carry
+    only the epoch. ``meta`` rides small facts the receiver wants
+    without decoding the payload (leaf count, restore spec)."""
+    if kind not in WT_KINDS:
+        raise ValueError(f"wt kind must be one of {WT_KINDS}, got {kind!r}")
+    frame = {"t": "wt", "ch": channel, "seq": int(seq), "kind": kind,
+             "epoch": int(epoch)}
+    if kind == "leaf":
+        if name is None or arr is None:
+            raise ValueError("wt leaf frames need name and arr")
+        frame["name"] = str(name)
+        frame["x"] = encode_tensor(np.asarray(arr), wire)
+    if meta:
+        frame["meta"] = meta
+    return frame
+
+
+def decode_wt_frame(frame: dict):
+    """-> (kind, epoch, name, arr, meta); name/arr are None for control
+    kinds."""
+    kind = frame["kind"]
+    arr = decode_tensor(frame["x"]) if kind == "leaf" else None
+    return (kind, int(frame["epoch"]), frame.get("name"), arr,
+            frame.get("meta") or {})
+
+
+def encode_wt_ack(channel: str, seq: int, epoch: int,
+                  applied: Optional[bool] = None) -> dict:
+    """Per-frame ack (NOT cumulative — the publisher journals stream
+    progress fence by fence): the wt frame with ``seq`` was consumed.
+    ``applied`` is set on swap acks: True = the promote flipped the
+    epoch, False = it was the exactly-once no-op."""
+    ack = {"t": "wt_ack", "ch": channel, "seq": int(seq),
+           "epoch": int(epoch)}
+    if applied is not None:
+        ack["applied"] = bool(applied)
+    return ack

@@ -137,6 +137,8 @@ class EngineWorker:
         self.draining = False
         self._idle = True
         self._last_drain_ctl = -float("inf")
+        #: connection ids with a live wt (weight-epoch) stream attached
+        self._wt_cids: set = set()
         self.publish_occupancy()
 
     # -- transport I/O ------------------------------------------------------
@@ -159,8 +161,40 @@ class EngineWorker:
                     self._rx_seq.stash("dispatch", int(rec["seq"]), rec)
             elif t == "kv":
                 self._kv_imports.append(frame)
+            elif t == "wt":
+                # weight-epoch stream (serving/online.py). Seqs are per
+                # PUBLISHER CONNECTION: a restarted coordinator redials
+                # and restarts at 0, so the channel is keyed by cid
+                self._rx_seq.stash(f"wt:{cid}", int(frame["seq"]),
+                                   (cid, frame))
+                self._wt_cids.add(cid)
         live = set(self._server.conn_ids())
         self._router_cids &= live
+
+    #: wt frames applied per poll round. A whole epoch's leaves can land
+    #: in one socket batch; applying them all before the next engine
+    #: step would stall in-flight decode — the drain the flip exists to
+    #: avoid. Bounding the per-round apply keeps per-step jitter at a
+    #: few leaf decodes while the stream spreads across poll rounds.
+    _WT_FRAMES_PER_POLL = 2
+
+    def _drain_weights(self):
+        """Apply stashed wt frames in seq order between engine steps —
+        ``online.apply_wt_frame`` is the sole promote/discard chokepoint
+        — and ack each one back to its publisher. Runs even while
+        draining: a weight flip is not an admission."""
+        from .online import apply_wt_frame
+        budget = self._WT_FRAMES_PER_POLL
+        for cid in list(self._wt_cids):
+            while budget > 0:
+                item = self._rx_seq.pop_next(f"wt:{cid}")
+                if item is None:
+                    break
+                _cid, frame = item
+                ack = apply_wt_frame(self.engine, frame)
+                self._server.send(cid, ack)
+                budget -= 1
+        self._wt_cids &= set(self._server.conn_ids())
 
     def _send_routers(self, frame: dict):
         for cid in list(self._router_cids):
@@ -463,6 +497,8 @@ class EngineWorker:
         checks; an idle engine checks every poll so first dispatch lands
         fast. Returns True while the engine still holds work."""
         self._pump_transport()
+        if self._wt_cids:
+            self._drain_weights()
         self._check_drain_ctl()
         now = time.monotonic()
         if self.draining:

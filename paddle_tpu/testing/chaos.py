@@ -54,6 +54,17 @@ Env knobs (all read lazily so tests can flip them per-case):
                                     before firing (targets the n+1-th
                                     flip of a run; default 0)
   PADDLE_CHAOS_FLIP_LATENCY_MS=<ms> sleep injected by the latency mode
+  PADDLE_CHAOS_WEIGHT_MODE=kill|latency
+  PADDLE_CHAOS_WEIGHT_AT=<fence>    which named weight-flip fence the fault
+                                    fires at (serving/online.py journals a
+                                    fence before every weight-transaction
+                                    transition: publish|stream|wt:<seq>|
+                                    commit|swap|finalize — wt:<seq> targets
+                                    the send of one streamed weight frame)
+  PADDLE_CHAOS_WEIGHT_SKIP=<n>      skip the first n matching weight fences
+                                    before firing (targets a later epoch's
+                                    flip; default 0)
+  PADDLE_CHAOS_WEIGHT_LATENCY_MS=<ms> sleep injected by the latency mode
   PADDLE_CHAOS_NET_MODE=drop|half_open|latency
   PADDLE_CHAOS_NET_AT=<k>           which transport frame send the network
                                     fault fires at (serving/transport.py
@@ -127,9 +138,10 @@ def rng() -> random.Random:
 def reset() -> None:
     """Drop cached rng/fence state (tests flipping env knobs
     mid-process)."""
-    global _rng, _flip_fence_hits
+    global _rng, _flip_fence_hits, _weight_fence_hits
     _rng = None
     _flip_fence_hits = 0
+    _weight_fence_hits = 0
 
 
 def _log(msg: str) -> None:
@@ -272,6 +284,54 @@ def flip_fence(fence: str) -> None:
     elif mode == "latency":
         ms = float(_env("PADDLE_CHAOS_FLIP_LATENCY_MS", "0"))
         _fault("flip_latency", fence=fence, ms=ms)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
+# Online weight-flip faults (serving/online.py weight-transaction fences)
+# ---------------------------------------------------------------------------
+_weight_fence_hits = 0
+
+
+def weight_fence(fence: str) -> None:
+    """Fault point at a named online weight-transaction fence. The
+    coordinator journals each fence BEFORE calling this (same discipline
+    as ``flip_fence``), so a kill here leaves ``weights_current.json``
+    durably recording exactly how far the epoch flip got — recovery
+    rolls forward at/after ``commit`` (re-issuing the idempotent swap
+    orders) and back before it (discarding shadow buffers).
+
+    Fences are matched by NAME (``PADDLE_CHAOS_WEIGHT_AT``):
+    publish | stream | wt:<seq> | commit | swap | finalize — the
+    ``wt:<seq>`` form targets the send of one streamed weight frame, so
+    a soak can kill mid-stream with some leaves already staged.
+    ``PADDLE_CHAOS_WEIGHT_SKIP`` skips the first n matches so a later
+    epoch's flip takes the fault.
+
+    kill    — SIGKILL at the matching fence; the relaunched coordinator
+              must recover exactly-once epoch flips from the journal.
+    latency — sleep PADDLE_CHAOS_WEIGHT_LATENCY_MS at the matching
+              fence, widening the mixed-epoch serving window.
+    """
+    global _weight_fence_hits
+    if not armed():
+        return
+    mode = _env("PADDLE_CHAOS_WEIGHT_MODE")
+    if mode is None:
+        return
+    if _env("PADDLE_CHAOS_WEIGHT_AT") != fence:
+        return
+    skip = int(_env("PADDLE_CHAOS_WEIGHT_SKIP", "0"))
+    _weight_fence_hits += 1
+    if _weight_fence_hits <= skip:
+        return
+    if mode == "kill":
+        _fault("weight_kill", fence=fence, hit=_weight_fence_hits)
+        _sigkill(f"kill injected at online weight fence {fence!r}")
+    elif mode == "latency":
+        ms = float(_env("PADDLE_CHAOS_WEIGHT_LATENCY_MS", "0"))
+        _fault("weight_latency", fence=fence, ms=ms)
         if ms > 0:
             time.sleep(ms / 1000.0)
 

@@ -1704,6 +1704,427 @@ def _gate_replay(args, block):
     return bench_replay.gate(_replay_args(args), block)
 
 
+# ---------------------------------------------------------------------------
+# online: zero-drain weight flips vs drain-and-restart (docs/ONLINE.md)
+# ---------------------------------------------------------------------------
+
+def _online_cfg(args, max_len):
+    from paddle_tpu.text.models.gpt import GPTConfig
+    return GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_hidden_layers=args.layers, num_attention_heads=args.heads,
+        max_position_embeddings=max_len,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+def _online_snap(model):
+    import numpy as np
+    return {n: np.asarray(p._value, np.float32).copy()
+            for n, p in model.named_parameters()}
+
+
+def _online_set(model, params):
+    import jax.numpy as jnp
+    import numpy as np
+    for n, p in model.named_parameters():
+        p._value = jnp.asarray(params[n],
+                               np.asarray(p._value).dtype)
+
+
+def _online_bf16(params):
+    """What an engine actually holds after a bf16-wire flip: replay
+    references and the drain-restart baseline must round the same way or
+    the bit-equality legs compare against weights no engine ever ran."""
+    import jax.numpy as jnp
+    import numpy as np
+    return {n: np.asarray(jnp.asarray(v, jnp.bfloat16)).astype(np.float32)
+            for n, v in params.items()}
+
+
+def _online_train(args, cfg, batches, on_epoch=None):
+    """One deterministic AdamW run over the scripted batches. Returns
+    (params-per-epoch, loss trajectory). ``on_epoch(e, params)`` fires
+    after each epoch's steps — the online phase publishes from it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+    paddle.seed(args.seed + 41)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    params = {0: _online_snap(model)}
+    losses = []
+    for e in range(1, args.online_epochs + 1):
+        for ids_np in batches[e]:
+            ids = paddle.to_tensor(ids_np)
+            loss = model(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        params[e] = _online_snap(model)
+        if on_epoch is not None:
+            on_epoch(e, params[e])
+    return params, losses
+
+
+class _OnlineDriver:
+    """Single-threaded wave driver over a DecodeEngine: submits a wave,
+    steps until done, records per-request latency, final tokens and the
+    PINNED epoch the engine decoded the request on.
+
+    ``step_floor_s`` paces step-to-step intervals the way the router
+    scenario's --router-step-floor-ms does: it emulates an accelerator-
+    bound step so the flip-window gate measures the weight stream's
+    control-plane cost against realistic step times — host-side frame
+    applies overlap device compute and hide in the floor's slack."""
+
+    def __init__(self, engine, new_tokens, step_floor_s=0.0):
+        self.engine = engine
+        self.new_tokens = new_tokens
+        self.step_floor_s = step_floor_s
+        self._not_before = 0.0
+        self._t_sub = {}
+        self._tag = {}
+        self.pending = set()
+        self.results = {}   # key -> {"tokens", "epoch", "tag"}
+        self.latencies = []  # (tag, seconds)
+
+    def submit_wave(self, keys, prompts, tag):
+        import time
+        from paddle_tpu.inference.engine import SamplingParams
+        for key, prompt in zip(keys, prompts):
+            rid = self.engine.submit(
+                prompt, SamplingParams(max_new_tokens=self.new_tokens))
+            self._t_sub[rid] = (key, time.perf_counter())
+            self._tag[rid] = tag
+            self.pending.add(rid)
+
+    def step(self):
+        import time
+        if self.step_floor_s:
+            now = time.perf_counter()
+            if now < self._not_before:
+                time.sleep(self._not_before - now)
+            self._not_before = time.perf_counter() + self.step_floor_s
+        self.engine.step()
+        now = time.perf_counter()
+        for rid in [r for r in self.pending
+                    if self.engine._requests[r].status == "done"]:
+            self.pending.discard(rid)
+            key, t0 = self._t_sub.pop(rid)
+            tag = self._tag.pop(rid)
+            if key in self.results:
+                raise RuntimeError(f"duplicate completion for {key}")
+            self.results[key] = {
+                "tokens": [int(t) for t in self.engine.result(rid)],
+                "epoch": int(self.engine._requests[rid].epoch),
+                "tag": tag,
+            }
+            self.latencies.append((tag, now - t0))
+
+    def run_until_idle(self):
+        while self.pending:
+            self.step()
+
+
+class _SteppingSink:
+    """EngineSink that keeps the engine decoding between wt frames — the
+    single-threaded analogue of a worker applying the stream between
+    poll rounds, at the worker's per-round frame budget
+    (worker._WT_FRAMES_PER_POLL). This is the zero-drain property the
+    goodput gate measures."""
+
+    _FRAMES_PER_STEP = 2
+
+    def __init__(self, inner, driver):
+        self._inner = inner
+        self._driver = driver
+        self._frames = 0
+        self.name = inner.name
+
+    @property
+    def known_epoch(self):
+        return self._inner.known_epoch
+
+    @known_epoch.setter
+    def known_epoch(self, value):
+        self._inner.known_epoch = value
+
+    def send(self, frame):
+        if self._driver.pending and self._frames % self._FRAMES_PER_STEP == 0:
+            self._driver.step()
+        self._frames += 1
+        self._inner.send(frame)
+
+    def pump(self):
+        self._inner.pump()
+
+    def collect_acks(self):
+        return self._inner.collect_acks()
+
+    def close(self):
+        self._inner.close()
+
+
+def run_online(args):
+    """A/B the continuous-learning loop: identical wave workloads and
+    identical trainer schedules served (a) through zero-drain journaled
+    weight flips into ONE live engine and (b) by draining and rebuilding
+    a fresh engine per epoch. Then replays every epoch on a fresh engine
+    for the bit-equality legs."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.supervisor import FlipJournal
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.serving.online import EngineSink, OnlineCoordinator
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+    EP = args.online_epochs
+    W = args.online_waves
+    slots = 4
+    new_tok = args.online_new_tokens
+    plen = args.prompt_len
+    max_len = max(64, 1 << (plen + new_tok - 1).bit_length())
+    cfg = _online_cfg(args, max_len)
+    ecfg = EngineConfig(num_slots=slots, max_length=max_len)
+
+    # scripted, phase-independent inputs
+    rng = np.random.default_rng(args.seed + 77)
+    prompts = {(e, w): [rng.integers(1, args.vocab, plen).astype(np.int64)
+                        for _ in range(slots)]
+               for e in range(EP + 1) for w in range(W)}
+    drng = np.random.default_rng(args.seed + 99)
+    batches = {e: [drng.integers(0, args.vocab, (4, 16)).astype(np.int32)
+                   for _ in range(args.online_train_steps)]
+               for e in range(1, EP + 1)}
+
+    print(f"[bench] online: {EP} weight flips x {W} waves x {slots} reqs "
+          f"(zero-drain vs drain-restart)...", file=sys.stderr)
+
+    # offline trainer run: the loss-parity reference AND the baseline's
+    # per-epoch weights
+    params_off, losses_off = _online_train(args, cfg, batches)
+
+    def wave_keys(e, w):
+        return [(e, w, i) for i in range(slots)]
+
+    # ---- phase A: one live engine, flips overlap the last wave --------
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    _online_set(model, params_off[0])
+    eng = DecodeEngine(model, ecfg)
+    eng.warmup()
+    floor_s = args.online_step_floor_ms / 1e3
+    driver = _OnlineDriver(eng, new_tok, floor_s)
+    journal = FlipJournal(os.path.join(tempfile.mkdtemp(), "journal"))
+    coord = OnlineCoordinator(
+        journal, {"engine0": _SteppingSink(EngineSink(eng), driver)},
+        yield_fn=lambda: driver.step() if driver.pending else None)
+    cc0 = eng.compile_count
+    flip_secs = []
+
+    def publish(e, params):
+        flip_secs.append(coord.publish_epoch(e, params)["seconds"])
+
+    # trainer built before the clock; its step work runs inside the
+    # timed window at the same schedule points as the baseline's
+    paddle.seed(args.seed + 41)
+    trainer = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=trainer.parameters())
+    losses_on = []
+    t0 = time.perf_counter()
+    for e in range(EP + 1):
+        for w in range(W):
+            flip_wave = (w == W - 1) and e < EP
+            if flip_wave:
+                # train at the wave boundary (engine idle), then let the
+                # flip's wt stream overlap the wave it precedes
+                for ids_np in batches[e + 1]:
+                    ids = paddle.to_tensor(ids_np)
+                    loss = trainer(ids, labels=ids)
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses_on.append(float(loss))
+            driver.submit_wave(wave_keys(e, w), prompts[(e, w)],
+                               "flip" if flip_wave else "steady")
+            if flip_wave:
+                for _ in range(3):
+                    driver.step()
+                publish(e + 1, _online_snap(trainer))
+            driver.run_until_idle()
+    online_s = time.perf_counter() - t0
+    compile_stable = eng.compile_count == cc0
+    online_results = driver.results
+    online_lat = driver.latencies
+    weight_history = [[h["id"], h["outcome"]]
+                      for h in journal.weight_history()]
+
+    # ---- phase B: drain, rebuild, re-warm per epoch -------------------
+    model_b = GPTForCausalLM(cfg)
+    model_b.eval()
+    _online_set(model_b, params_off[0])
+    eng_b = DecodeEngine(model_b, ecfg)
+    eng_b.warmup()
+    driver_b = _OnlineDriver(eng_b, new_tok, floor_s)
+    paddle.seed(args.seed + 41)
+    trainer_b = GPTForCausalLM(cfg)
+    opt_b = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=trainer_b.parameters())
+    losses_b = []
+    compiles_b = 0
+    t0 = time.perf_counter()
+    for e in range(EP + 1):
+        for w in range(W):
+            flip_wave = (w == W - 1) and e < EP
+            if flip_wave:
+                for ids_np in batches[e + 1]:
+                    ids = paddle.to_tensor(ids_np)
+                    loss = trainer_b(ids, labels=ids)
+                    loss.backward()
+                    opt_b.step()
+                    opt_b.clear_grad()
+                    losses_b.append(float(loss))
+            driver_b.submit_wave(wave_keys(e, w), prompts[(e, w)],
+                                 "flip" if flip_wave else "steady")
+            driver_b.run_until_idle()
+            if flip_wave:
+                # the drain already happened (wave ran to completion);
+                # restart: fresh engine on the new weights, recompile
+                compiles_b += eng_b.compile_count
+                _online_set(model_b, _online_bf16(params_off[e + 1]))
+                results_b, lat_b = driver_b.results, driver_b.latencies
+                eng_b = DecodeEngine(model_b, ecfg)
+                eng_b.warmup()
+                driver_b = _OnlineDriver(eng_b, new_tok, floor_s)
+                # carry the ledgers across restarts
+                driver_b.results, driver_b.latencies = results_b, lat_b
+    baseline_s = time.perf_counter() - t0
+    compiles_b += eng_b.compile_count
+
+    tokens = sum(len(r["tokens"]) - plen for r in online_results.values())
+    tokens_b = sum(len(r["tokens"]) - plen
+                   for r in driver_b.results.values())
+
+    # ---- gates' raw material ------------------------------------------
+    expected_keys = {(e, w, i) for e in range(EP + 1) for w in range(W)
+                     for i in range(slots)}
+    zero_dropped_dup = set(online_results) == expected_keys
+
+    # pinned-epoch attribution: wave W-1 of epoch e admits BEFORE the
+    # flip to e+1 lands, so every request of epoch-e waves decodes on e
+    epochs_ok = all(r["epoch"] == e
+                    for (e, _w, _i), r in online_results.items())
+
+    # per-epoch bit-equal replay: ONE fresh engine re-runs the epoch
+    # history through the same flip machinery and must reproduce every
+    # wave bit-for-bit
+    model_r = GPTForCausalLM(cfg)
+    model_r.eval()
+    _online_set(model_r, params_off[0])
+    eng_r = DecodeEngine(model_r, ecfg)
+    eng_r.warmup()
+    driver_r = _OnlineDriver(eng_r, new_tok)
+    coord_r = OnlineCoordinator(
+        FlipJournal(os.path.join(tempfile.mkdtemp(), "journal")),
+        {"engine0": EngineSink(eng_r)})
+    replay_ok = True
+    for e in range(EP + 1):
+        if e > 0:
+            coord_r.publish_epoch(e, params_off[e])
+        for w in range(W):
+            driver_r.submit_wave(wave_keys(e, w), prompts[(e, w)],
+                                 "steady")
+            driver_r.run_until_idle()
+    for key, r in online_results.items():
+        if driver_r.results[key]["tokens"] != r["tokens"]:
+            replay_ok = False
+    phases_equal = all(
+        driver_b.results[key]["tokens"] == r["tokens"]
+        for key, r in online_results.items())
+
+    loss_parity = (losses_on == losses_off and losses_b == losses_off)
+
+    def _p95(tag, lats):
+        vals = [s for t, s in lats if t == tag]
+        return float(np.percentile(vals, 95)) if vals else 0.0
+
+    steady_p95 = _p95("steady", online_lat)
+    flip_p95 = _p95("flip", online_lat)
+    goodput = tokens / online_s
+    goodput_b = tokens_b / baseline_s
+    return {
+        "epochs": EP,
+        "waves_per_epoch": W,
+        "wave_requests": slots,
+        "new_tokens": new_tok,
+        "train_steps_per_epoch": args.online_train_steps,
+        "requests_total": len(expected_keys),
+        "online": {
+            "seconds": online_s,
+            "tokens": tokens,
+            "goodput_tokens_per_second": goodput,
+            "flip_seconds": flip_secs,
+            "steady_p95_s": steady_p95,
+            "flip_window_p95_s": flip_p95,
+            "compile_count_stable": compile_stable,
+            "weight_history": weight_history,
+        },
+        "drain_restart": {
+            "seconds": baseline_s,
+            "tokens": tokens_b,
+            "goodput_tokens_per_second": goodput_b,
+            "compile_count_total": compiles_b,
+        },
+        "goodput_ratio": goodput / goodput_b if goodput_b else 0.0,
+        "flip_window_p95_ratio": (flip_p95 / steady_p95
+                                  if steady_p95 else 0.0),
+        "zero_dropped_duplicated": zero_dropped_dup,
+        "pinned_epochs_correct": epochs_ok,
+        "per_epoch_bit_equal_replay": replay_ok,
+        "greedy_bit_equal_across_phases": phases_equal,
+        "trainer_loss_bit_equal_offline": loss_parity,
+    }
+
+
+def _gate_online(args, block):
+    rc = 0
+    ratio = block["goodput_ratio"]
+    if args.min_online_goodput_ratio and ratio < args.min_online_goodput_ratio:
+        print(f"FAIL: online goodput ratio {ratio:.2f}x < "
+              f"{args.min_online_goodput_ratio}x drain-restart",
+              file=sys.stderr)
+        rc = 1
+    p95r = block["flip_window_p95_ratio"]
+    if args.max_online_flip_p95_ratio and p95r > args.max_online_flip_p95_ratio:
+        print(f"FAIL: flip-window p95 {p95r:.2f}x steady-state > "
+              f"{args.max_online_flip_p95_ratio}x", file=sys.stderr)
+        rc = 1
+    for flag in ("zero_dropped_duplicated", "pinned_epochs_correct",
+                 "per_epoch_bit_equal_replay",
+                 "greedy_bit_equal_across_phases",
+                 "trainer_loss_bit_equal_offline"):
+        if not block[flag]:
+            print(f"FAIL: online {flag} is false", file=sys.stderr)
+            rc = 1
+    if not block["online"]["compile_count_stable"]:
+        print("FAIL: online flips recompiled the engine", file=sys.stderr)
+        rc = 1
+    history = block["online"]["weight_history"]
+    want = [[f"wt-{e}", "committed"]
+            for e in range(1, block["epochs"] + 1)]
+    if history != want:
+        print(f"FAIL: weight journal history {history} != {want}",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batch", type=int, default=8)
@@ -1835,6 +2256,40 @@ def main(argv=None):
                     help="alias for --replay-only")
     ap.add_argument("--skip-replay", action="store_true",
                     help="skip the workload-replay legs in the full run")
+    ap.add_argument("--online-only", action="store_true",
+                    help="run only the online continuous-learning A/B "
+                         "(zero-drain journaled weight flips into one "
+                         "live engine vs drain-and-restart per epoch; "
+                         "docs/ONLINE.md) and merge the online block "
+                         "into the existing BENCH_SERVING.json")
+    ap.add_argument("--online", action="store_true",
+                    help="alias for --online-only")
+    ap.add_argument("--skip-online", action="store_true",
+                    help="skip the online weight-flip scenario in the "
+                         "full run")
+    ap.add_argument("--online-epochs", type=int, default=3,
+                    help="weight flips per phase (epochs 1..N)")
+    ap.add_argument("--online-waves", type=int, default=2,
+                    help="decode waves per epoch; the last wave of each "
+                         "epoch overlaps its flip")
+    ap.add_argument("--online-new-tokens", type=int, default=16,
+                    help="greedy tokens per online-scenario request")
+    ap.add_argument("--online-train-steps", type=int, default=2,
+                    help="AdamW steps between flips")
+    ap.add_argument("--online-step-floor-ms", type=float, default=20.0,
+                    help="pace online-scenario engine steps to at least "
+                         "this wall time (emulating accelerator-bound "
+                         "steps, like --router-step-floor-ms) so the "
+                         "flip-window gate measures the weight stream's "
+                         "cost against realistic step times; 0 = raw "
+                         "compute")
+    ap.add_argument("--min-online-goodput-ratio", type=float, default=2.0,
+                    help="fail unless zero-drain goodput reaches this "
+                         "multiple of drain-and-restart (0 disables)")
+    ap.add_argument("--max-online-flip-p95-ratio", type=float,
+                    default=1.10,
+                    help="fail if flip-window request p95 exceeds this "
+                         "multiple of steady-state p95 (0 disables)")
     ap.add_argument("--replay-requests", type=int, default=100_000,
                     help="stream length for the embedded replay "
                          "throughput leg (the full 1M-request run lives "
@@ -1906,6 +2361,18 @@ def main(argv=None):
             f.write("\n")
         print(json.dumps({"colocation": block}, indent=2))
         return _gate_autoscale(args, block)
+    if args.online_only or args.online:
+        block = run_online(args)
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report["online"] = block
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"online": block}, indent=2))
+        return _gate_online(args, block)
     if args.replay_only or args.replay:
         block = run_replay(args)
         report = {}
@@ -2051,6 +2518,8 @@ def main(argv=None):
         report["colocation"] = run_autoscale(args)
     if not args.skip_replay:
         report["replay"] = run_replay(args)
+    if not args.skip_online:
+        report["online"] = run_online(args)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
@@ -2070,6 +2539,8 @@ def main(argv=None):
         rc = rc or _gate_autoscale(args, report["colocation"])
     if not args.skip_replay:
         rc = rc or _gate_replay(args, report["replay"])
+    if not args.skip_online:
+        rc = rc or _gate_online(args, report["online"])
     return rc
 
 

@@ -104,6 +104,7 @@ OWNED_PREFIXES = {
     "tenant_": os.path.join("paddle_tpu", "observability",
                             "accounting.py"),
     "frontier_": os.path.join("paddle_tpu", "serving", "frontier.py"),
+    "online_": os.path.join("paddle_tpu", "serving", "online.py"),
 }
 
 

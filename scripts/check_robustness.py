@@ -60,6 +60,18 @@ rejects two classes of hang/mask bugs that code review keeps re-admitting:
      ``os.replace``: the flip journal is what makes SIGKILL-at-any-
      fence recoverable, so a stray in-place write would reintroduce
      torn-journal states the two-phase protocol exists to rule out.
+  9. unjournaled weight flips — the online continuous-learning plane
+     (``paddle_tpu/serving``) flips live engine weights only inside the
+     journaled weight transaction: (a) ``engine.promote_epoch(...)`` /
+     ``engine.discard_shadow(...)`` may only be called from the single
+     ``apply_wt_frame`` chokepoint in ``online.py`` — a stray promote
+     would swap a shadow buffer no journal fence covers, so a SIGKILL
+     there is unrecoverable; and (b) in ``online.py`` building a
+     ``swap``/``discard`` wt frame (``encode_wt_frame(..., "swap", ...)``)
+     must happen inside a function that also advances or closes the
+     weight journal (``advance_weights``/``close_weights``) — the order
+     journal-then-order is what lets recovery classify a crash as
+     roll-forward or roll-back.
 
 Exit status 0 = clean, 1 = violations (printed one per line as
 ``path:line: message``). Runs under plain CPython — no third-party deps —
@@ -129,6 +141,15 @@ GUARDED_SUPERVISOR_FILES = [
 
 #: the sole function allowed to open files for writing in rule-8 files
 ATOMIC_WRITE_FN = "_atomic_write_json"
+
+#: rule 9: the serving package scanned for stray epoch flips, the online
+#: module whose journal discipline is checked, and the one function
+#: allowed to call the engine's swap/discard methods
+WEIGHT_FLIP_DIR = os.path.join("paddle_tpu", "serving")
+WEIGHT_FLIP_FILE = os.path.join("paddle_tpu", "serving", "online.py")
+WEIGHT_APPLY_FN = "apply_wt_frame"
+WEIGHT_FLIP_CALLS = {"promote_epoch", "discard_shadow"}
+WEIGHT_JOURNAL_CALLS = {"advance_weights", "close_weights"}
 
 
 def _py_files(root):
@@ -438,6 +459,81 @@ def check_atomic_journal_writes(path: str):
                "chokepoint must publish via atomic rename (rule 8)")
 
 
+def check_weight_flip_confinement(path: str, is_online: bool):
+    """Yield (line, message) for rule 9. In every serving file:
+    ``<engine>.promote_epoch(...)``/``.discard_shadow(...)`` must sit
+    lexically inside ``def apply_wt_frame`` (only possible in online.py).
+    In online.py additionally: an ``encode_wt_frame`` call whose literal
+    kind is ``"swap"``/``"discard"`` must be inside a function whose body
+    also calls ``advance_weights`` or ``close_weights``."""
+    with open(path, "rb") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    parent = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def _enclosing_fn(node):
+        anc = node
+        while anc in parent:
+            anc = parent[anc]
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and func.attr in WEIGHT_FLIP_CALLS):
+            fn = _enclosing_fn(node)
+            if fn is None or fn.name != WEIGHT_APPLY_FN:
+                yield (node.lineno,
+                       f"engine .{func.attr}(...) outside "
+                       f"{WEIGHT_APPLY_FN}() — a weight flip not driven "
+                       "by a wt frame escapes the journaled transaction, "
+                       "so a crash there is unrecoverable (rule 9)")
+        if not is_online:
+            continue
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None)
+        if name != "encode_wt_frame":
+            continue
+        kind = node.args[2] if len(node.args) >= 3 else None
+        for kw in node.keywords:
+            if kw.arg == "kind":
+                kind = kw.value
+        if not (isinstance(kind, ast.Constant)
+                and kind.value in ("swap", "discard")):
+            continue
+        fn = _enclosing_fn(node)
+        journaled = fn is not None and any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, (ast.Name, ast.Attribute))
+            and (sub.func.id if isinstance(sub.func, ast.Name)
+                 else sub.func.attr) in WEIGHT_JOURNAL_CALLS
+            for sub in ast.walk(fn))
+        if not journaled:
+            yield (node.lineno,
+                   f"wt {kind.value!r} frame built in a function that "
+                   "never advances/closes the weight journal — the swap/"
+                   "discard order must be journaled first so crash "
+                   "recovery can classify it (rule 9)")
+
+
+def _serving_files(root):
+    base = os.path.join(root, WEIGHT_FLIP_DIR)
+    if not os.path.isdir(base):
+        return
+    for dirpath, _dirnames, filenames in os.walk(base):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
 def _pallas_files(root):
     for d in PALLAS_DIRS:
         base = os.path.join(root, d)
@@ -491,6 +587,11 @@ def main(argv=None):
         for line, msg in check_guarded_store_ops(path):
             violations.append(f"{rel}:{line}: {msg}")
         for line, msg in check_atomic_journal_writes(path):
+            violations.append(f"{rel}:{line}: {msg}")
+    for path in _serving_files(root):
+        rel = os.path.relpath(path, root)
+        is_online = rel == WEIGHT_FLIP_FILE
+        for line, msg in check_weight_flip_confinement(path, is_online):
             violations.append(f"{rel}:{line}: {msg}")
     for v in violations:
         print(v)

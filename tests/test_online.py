@@ -1,0 +1,421 @@
+"""Online continuous-learning weight-flip plane (docs/ONLINE.md).
+
+Gates the epoch contract end to end, in process and over the wire:
+
+* a flip never recompiles (the AOT cache key excludes the value list)
+  and never drains — a request in flight when the epoch flips finishes
+  BIT-EQUAL to a run pinned on its admission epoch, while the next
+  admission decodes bit-equal to the new weights;
+* the wt stream is a journaled two-phase transaction: a pre-commit
+  failure rolls back completely (shadow discarded, epoch unchanged) and
+  replayed frames after a commit are exactly-once no-ops;
+* ``warmup()`` is idempotent (satellite: cached programs are counted,
+  not re-run) and the reshard host-roundtrip fallback is bounded to the
+  planned shard (satellite: ``reshard_peak_bytes`` sees shard bytes, not
+  the full leaf);
+* ``check_robustness.py`` rule 9 statically confines the pointer swap
+  to the journaled transaction.
+"""
+import importlib.util
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+from conftest import free_port
+
+import paddle_tpu.inference as inference
+from paddle_tpu.distributed.fleet.supervisor import (FlipJournal,
+                                                     WEIGHT_FENCES)
+from paddle_tpu.inference.engine import (DecodeEngine, EngineConfig,
+                                         SamplingParams)
+from paddle_tpu.serving import EngineWorker
+from paddle_tpu.serving.online import (EngineSink, OnlineCoordinator,
+                                       WireEngineSink, apply_wt_frame,
+                                       rollout_round)
+from paddle_tpu.serving.transport import (decode_wt_frame, encode_wt_ack,
+                                          encode_wt_frame)
+from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.fleet.topology import (
+        get_hybrid_communicate_group, set_hybrid_communicate_group)
+
+    prev = get_hybrid_communicate_group()
+    prev_mesh = _mesh.get_global_mesh()
+    set_hybrid_communicate_group(None)
+    _mesh.set_global_mesh(None)
+    try:
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        m.eval()
+        yield m
+        inference.disable_decode_engine(m)
+    finally:
+        set_hybrid_communicate_group(prev)
+        _mesh.set_global_mesh(prev_mesh)
+
+
+def _prompts(b, t, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, VOCAB, (b, t), dtype=np.int64)
+
+
+def _epoch0(model):
+    """Snapshot the live f32 params (epoch 0's values)."""
+    return {n: np.asarray(p._value, np.float32)
+            for n, p in model.named_parameters()}
+
+
+def _perturbed(params, scale=0.01):
+    return {n: v + scale * np.sign(v) for n, v in params.items()}
+
+
+def _restore(model, params):
+    import jax.numpy as jnp
+
+    for n, p in model.named_parameters():
+        p._value = jnp.asarray(params[n], jnp.asarray(p._value).dtype)
+
+
+# ---------------------------------------------------------------------------
+# wt wire codec
+# ---------------------------------------------------------------------------
+def test_wt_frame_roundtrip():
+    x = np.random.default_rng(0).standard_normal((4, 6)).astype(np.float32)
+    fr = encode_wt_frame("wt", 3, "leaf", 2, name="w", arr=x, wire="bf16",
+                         meta={"spec": [["dp"], []]})
+    kind, epoch, name, arr, meta = decode_wt_frame(fr)
+    assert (kind, epoch, name) == ("leaf", 2, "w")
+    assert meta == {"spec": [["dp"], []]}
+    # bf16 wire: equal after one round trip, idempotent after two
+    import jax.numpy as jnp
+    want = np.asarray(jnp.asarray(x, jnp.bfloat16)).astype(np.float32)
+    np.testing.assert_array_equal(arr, want)
+    for k in ("begin", "swap", "discard"):
+        kind, epoch, name, arr, meta = decode_wt_frame(
+            encode_wt_frame("wt", 0, k, 5))
+        assert (kind, epoch, name, arr, meta) == (k, 5, None, None, {})
+    with pytest.raises(ValueError, match="kind"):
+        encode_wt_frame("wt", 0, "flip", 1)
+    with pytest.raises(ValueError, match="need name and arr"):
+        encode_wt_frame("wt", 0, "leaf", 1)
+    ack = encode_wt_ack("wt", 7, 2, applied=True)
+    assert ack == {"t": "wt_ack", "ch": "wt", "seq": 7, "epoch": 2,
+                   "applied": True}
+
+
+# ---------------------------------------------------------------------------
+# satellite: warmup is idempotent
+# ---------------------------------------------------------------------------
+def test_warmup_idempotent(model):
+    eng = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+    first = eng.warmup()
+    cc = eng.compile_count
+    assert first["cache_hits"] == 0
+    second = eng.warmup()
+    assert eng.compile_count == cc, "second warmup recompiled"
+    assert second["programs"] == 0
+    assert second["cache_hits"] == first["programs"]
+
+
+# ---------------------------------------------------------------------------
+# the flip itself: no drain, no recompile, bit-equal on both epochs
+# ---------------------------------------------------------------------------
+def test_flip_mid_flight_bit_equal_and_no_recompile(model, tmp_path):
+    e0 = _epoch0(model)
+    try:
+        eng = DecodeEngine(model, EngineConfig(num_slots=4, max_length=64))
+        ids = _prompts(3, 7, seed=1)
+        # settle compilation before the flip so the pin is a strict
+        # equality on compile_count across it
+        r0 = eng.submit(ids[0], SamplingParams(max_new_tokens=12))
+        eng.run()
+        base = eng.result(r0)
+        cc = eng.compile_count
+
+        coord = OnlineCoordinator(FlipJournal(str(tmp_path)),
+                                  {"engine0": EngineSink(eng)})
+        e1 = _perturbed(e0)
+        ra = eng.submit(ids[1], SamplingParams(max_new_tokens=20))
+        for _ in range(5):
+            eng.step()  # ra is mid-decode on epoch 0
+        entry = coord.publish_epoch(1, e1)
+        assert entry["outcome"] == "committed" and entry["leaves"] > 0
+        assert eng.weight_epoch == 1
+        rb = eng.submit(ids[2], SamplingParams(max_new_tokens=12))
+        eng.run()  # mixed-epoch window: ra pinned on 0, rb on 1
+        out_a, out_b = eng.result(ra), eng.result(rb)
+        assert eng.compile_count == cc, "epoch flip recompiled"
+        assert eng.stats()["pinned_epochs"] == []
+
+        # ground truth per epoch, each from a fresh engine
+        solo0 = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        # model still holds epoch-1 values — pin them back to epoch 0
+        _restore(model, e0)
+        s0 = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        ria = s0.submit(ids[1], SamplingParams(max_new_tokens=20))
+        s0.run()
+        np.testing.assert_array_equal(s0.result(ria), out_a)
+        rbase = s0.submit(ids[0], SamplingParams(max_new_tokens=12))
+        s0.run()
+        np.testing.assert_array_equal(s0.result(rbase), base)
+        # epoch 1 reference decodes the bf16-wire-rounded values, which
+        # is exactly what the engine staged
+        import jax.numpy as jnp
+        _restore(model, {n: np.asarray(jnp.asarray(v, jnp.bfloat16))
+                         .astype(np.float32) for n, v in e1.items()})
+        s1 = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        rib = s1.submit(ids[2], SamplingParams(max_new_tokens=12))
+        s1.run()
+        np.testing.assert_array_equal(s1.result(rib), out_b)
+        del solo0
+    finally:
+        _restore(model, e0)
+
+
+def test_delta_skipping_and_replay_exactly_once(model, tmp_path):
+    e0 = _epoch0(model)
+    try:
+        eng = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        journal = FlipJournal(str(tmp_path))
+        sink = EngineSink(eng)
+        coord = OnlineCoordinator(journal, {"engine0": sink})
+        e1 = _perturbed(e0)
+        first = coord.publish_epoch(1, e1)
+        assert first["leaves"] == len(e1)
+        # same values as epoch 2: every leaf is digest-equal -> 0 sent
+        second = coord.publish_epoch(2, e1)
+        assert second["leaves"] == 0 and eng.weight_epoch == 2
+        # replayed stream for a committed epoch: every frame no-ops
+        assert not apply_wt_frame(eng, encode_wt_frame(
+            "wt", 99, "begin", 2))["applied"]
+        assert not apply_wt_frame(eng, encode_wt_frame(
+            "wt", 100, "leaf", 2, name=next(iter(e1)),
+            arr=e1[next(iter(e1))]))["applied"]
+        assert not apply_wt_frame(eng, encode_wt_frame(
+            "wt", 101, "swap", 2))["applied"]
+        assert eng.weight_epoch == 2
+        # ensure_epoch converges without a re-publish
+        assert coord.ensure_epoch(2, e1)["outcome"] == "already_current"
+        hist = journal.weight_history()
+        assert [(h["id"], h["outcome"]) for h in hist] == [
+            ("wt-1", "committed"), ("wt-2", "committed")]
+    finally:
+        _restore(model, e0)
+
+
+def test_pre_commit_failure_rolls_back(model, tmp_path):
+    e0 = _epoch0(model)
+    try:
+        eng = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        journal = FlipJournal(str(tmp_path))
+        coord = OnlineCoordinator(journal, {"engine0": EngineSink(eng)})
+        bad = dict(_perturbed(e0))
+        bad["not.a.leaf"] = np.zeros((2, 2), np.float32)
+        with pytest.raises(KeyError):
+            coord.publish_epoch(1, bad)
+        assert eng.weight_epoch == 0 and eng._shadow is None
+        assert journal.pending_weights() is None
+        assert journal.weight_history()[-1]["outcome"] == "rolled_back"
+        # the failed stream must not poison the digests: a clean publish
+        # re-sends every leaf and commits
+        good = coord.publish_epoch(1, _perturbed(e0))
+        assert good["outcome"] == "committed"
+        assert good["leaves"] == len(e0)
+        assert eng.weight_epoch == 1
+    finally:
+        _restore(model, e0)
+
+
+def test_recover_classifies_by_commit_fence(model, tmp_path):
+    e0 = _epoch0(model)
+    try:
+        eng = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        journal = FlipJournal(str(tmp_path))
+        coord = OnlineCoordinator(journal, {"engine0": EngineSink(eng)})
+        # a crash mid-stream (pre-commit): rolled back
+        doc = {"id": "wt-1", "epoch": 1, "engines": ["engine0"],
+               "leaves": 0, "wire": "bf16", "bytes": 0, "acked": {}}
+        journal.begin_weights(doc)
+        journal.advance_weights(doc, "stream")
+        assert coord.recover() == "rolled_back"
+        assert journal.pending_weights() is None
+        # a crash at/past commit: rolled forward — ensure_epoch then
+        # re-publishes to convergence
+        doc = {"id": "wt-1", "epoch": 1, "engines": ["engine0"],
+               "leaves": 0, "wire": "bf16", "bytes": 0, "acked": {}}
+        journal.begin_weights(doc)
+        for fence in WEIGHT_FENCES[1:WEIGHT_FENCES.index("swap") + 1]:
+            journal.advance_weights(doc, fence)
+        assert coord.recover() == "rolled_forward"
+        out = coord.ensure_epoch(1, _perturbed(e0))
+        assert out["outcome"] == "committed" and eng.weight_epoch == 1
+        assert coord.recover() is None
+    finally:
+        _restore(model, e0)
+
+
+def test_rollout_round_closes_the_loop(model, tmp_path):
+    e0 = _epoch0(model)
+    try:
+        eng = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        coord = OnlineCoordinator(FlipJournal(str(tmp_path)),
+                                  {"engine0": EngineSink(eng)})
+        ids = _prompts(2, 6, seed=9)
+        seen = {}
+
+        def generate():
+            rids = [eng.submit(p, SamplingParams(max_new_tokens=6))
+                    for p in ids]
+            eng.run()
+            return [eng.result(r) for r in rids]
+
+        def reward(tokens):
+            return float(len(set(tokens.tolist())))  # distinct-token score
+
+        def train(rollouts, rewards):
+            seen["rewards"] = rewards
+            return _perturbed(e0, scale=1e-3 * sum(rewards))
+
+        entry = rollout_round(coord, 1, generate_fn=generate,
+                              reward_fn=reward, train_fn=train)
+        assert entry["outcome"] == "committed"
+        assert eng.weight_epoch == 1
+        assert len(seen["rewards"]) == 2
+    finally:
+        _restore(model, e0)
+
+
+# ---------------------------------------------------------------------------
+# the wire path: a real worker applies the stream between steps
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_wire_flip_through_engine_worker(model, tmp_path):
+    from paddle_tpu.runtime import TCPStore
+
+    e0 = _epoch0(model)
+    store = TCPStore(host="127.0.0.1", port=free_port(), is_master=True,
+                     timeout=20.0)
+    try:
+        w = EngineWorker(model, store, num_slots=2, max_length=64)
+        sink = WireEngineSink(w._server.addr, w.name)
+        coord = OnlineCoordinator(FlipJournal(str(tmp_path)),
+                                  {w.name: sink}, ack_timeout_s=10.0)
+        import threading
+        stop = threading.Event()
+
+        def drive():
+            while not stop.is_set():
+                w.poll_once()
+                time.sleep(0.001)
+
+        th = threading.Thread(target=drive, daemon=True)
+        th.start()
+        try:
+            entry = coord.publish_epoch(1, _perturbed(e0))
+            assert entry["outcome"] == "committed"
+            assert w.engine.weight_epoch == 1
+            assert sink.known_epoch == 1
+            assert w.engine.occupancy()["weight_epoch"] == 1
+            # idempotent convergence over the wire
+            assert coord.ensure_epoch(
+                1, _perturbed(e0))["outcome"] == "already_current"
+        finally:
+            stop.set()
+            th.join(2.0)
+            sink.close()
+    finally:
+        store.close()
+        _restore(model, e0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: reshard host-roundtrip fallback is bounded to the shard
+# ---------------------------------------------------------------------------
+def test_reshard_fallback_bounded_to_shard(tmp_path, monkeypatch):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import paddle_tpu.distributed.reshard as reshard
+    from paddle_tpu import observability as obs
+
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+    obs.reset()
+    try:
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs[:4].reshape(4), ("dp",))
+        x = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+        dst = NamedSharding(mesh, P("dp"))
+        src = jax.numpy.asarray(x)  # device-resident before the patch
+        real = jax.device_put
+        state = {"fails": 0}
+
+        def flaky(a, sharding=None, **kw):
+            if state["fails"] == 0:
+                state["fails"] += 1
+                raise RuntimeError("injected direct-transfer failure")
+            return real(a, sharding, **kw)
+
+        monkeypatch.setattr(reshard.jax, "device_put", flaky)
+        out = reshard._transfer(src, dst, "w")
+        assert state["fails"] == 1
+        np.testing.assert_array_equal(np.asarray(out), x)
+        snap = obs.registry().get("reshard_peak_bytes").snapshot()
+        peak = max(s["max"] for s in snap["series"].values())
+        # bounded: one target SHARD (16/4 rows), not the full leaf
+        assert peak == (16 // 4) * 8 * 4
+        assert peak < x.nbytes
+    finally:
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# rule 9: the static gate actually bites
+# ---------------------------------------------------------------------------
+def _load_checker():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "check_robustness.py")
+    spec = importlib.util.spec_from_file_location("check_robustness", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rule9_repo_clean_and_catches_violations(tmp_path):
+    checker = _load_checker()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # the live repo is clean
+    for path in checker._serving_files(repo):
+        rel = os.path.relpath(path, repo)
+        got = list(checker.check_weight_flip_confinement(
+            path, rel == checker.WEIGHT_FLIP_FILE))
+        assert got == [], f"{rel}: {got}"
+    # a stray promote outside apply_wt_frame is flagged
+    bad_dir = tmp_path / "paddle_tpu" / "serving"
+    bad_dir.mkdir(parents=True)
+    (bad_dir / "rogue.py").write_text(
+        "def hot_swap(engine, epoch):\n"
+        "    engine.promote_epoch(epoch)\n")
+    got = list(checker.check_weight_flip_confinement(
+        str(bad_dir / "rogue.py"), False))
+    assert len(got) == 1 and "rule 9" in got[0][1]
+    # an unjournaled swap frame in online.py is flagged
+    (bad_dir / "online.py").write_text(
+        "def fire_and_forget(sink, epoch):\n"
+        "    sink.send(encode_wt_frame('wt', 0, 'swap', epoch))\n")
+    got = list(checker.check_weight_flip_confinement(
+        str(bad_dir / "online.py"), True))
+    assert len(got) == 1 and "journal" in got[0][1]
+    # main() wires the rule in: the rogue tree fails the gate
+    assert checker.main([str(tmp_path)]) == 1
