@@ -526,15 +526,39 @@ def _transfer(arr, sharding: NamedSharding, key: str):
         # device) instead of gathering the FULL leaf — the old
         # np.asarray(arr) path put one complete copy on the host and
         # re-shipped it whole to every device, defeating the planned
-        # shard spec exactly when memory is tightest.
+        # shard spec exactly when memory is tightest. Basic indexing
+        # cannot run at all on a non-fully-addressable source, so that
+        # case takes one whole-leaf gather up front (it either works or
+        # raises its own clear error) and the callback slices the host
+        # copy. ``reshard_peak_bytes`` observes the bytes ACTUALLY
+        # materialized per callback, not the planned shard size, so an
+        # indexing path that secretly gathers more than the plan shows
+        # up in telemetry (``reshard_fallback_total{why=overshot_plan}``).
         shard_shape = sharding.shard_shape(tuple(arr.shape))
         shard_b = (int(np.prod(shard_shape)) if shard_shape else 1) \
             * np.dtype(arr.dtype).itemsize
-        _obs.observe("reshard_peak_bytes", shard_b)
+        if not getattr(arr, "is_fully_addressable", True):
+            with deadline_guard(f"host gather {key}"):
+                host = np.asarray(arr)
+            _obs.observe("reshard_peak_bytes", int(host.nbytes))
+            _obs.inc("reshard_fallback_total", why="whole_leaf")
+            with deadline_guard(f"host transfer {key}"):
+                return jax.make_array_from_callback(
+                    tuple(arr.shape), sharding, lambda idx: host[idx])
+        peak = {"b": 0}
+
+        def _pull(idx):
+            out = np.asarray(arr[idx])
+            peak["b"] = max(peak["b"], int(out.nbytes))
+            return out
+
         with deadline_guard(f"host transfer {key}"):
-            return jax.make_array_from_callback(
-                tuple(arr.shape), sharding,
-                lambda idx: np.asarray(arr[idx]))
+            result = jax.make_array_from_callback(
+                tuple(arr.shape), sharding, _pull)
+        _obs.observe("reshard_peak_bytes", peak["b"] or shard_b)
+        if peak["b"] > shard_b:
+            _obs.inc("reshard_fallback_total", why="overshot_plan")
+        return result
 
 
 def _target_sharding(v) -> Optional[NamedSharding]:

@@ -246,7 +246,10 @@ METRICS = {
         "histogram", "Planned collective steps per resharded leaf"),
     "reshard_peak_bytes": (
         "histogram", "Analytic peak per-device bytes of one leaf's plan "
-                     "(max over steps of in+out local shard bytes)"),
+                     "(max over steps of in+out local shard bytes); the "
+                     "host-roundtrip fallback observes the host bytes it "
+                     "actually materialized per shard callback instead, "
+                     "so the planned bound is falsifiable"),
     "reshard_bytes_total": (
         "counter", "Bytes moved through reshard collectives (sum of "
                    "per-step output local bytes across devices)"),

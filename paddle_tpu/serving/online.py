@@ -98,7 +98,13 @@ def apply_wt_frame(engine, frame: dict) -> dict:
         applied = engine.promote_epoch(epoch)
     elif kind == "discard":
         applied = engine.discard_shadow(epoch)
-    return encode_wt_ack(frame["ch"], frame["seq"], epoch, applied=applied)
+    # the ack echoes the frame kind and the engine's post-apply serving
+    # epoch: ``live`` is the only field that proves what the engine
+    # serves — a begin/leaf/discard ack carries the pre-flip epoch
+    # there, so the publisher can never mistake "shadow opened" for
+    # "epoch flipped" (encode_wt_ack documents per-kind semantics)
+    return encode_wt_ack(frame["ch"], frame["seq"], epoch, applied=applied,
+                         kind=kind, live=int(engine.weight_epoch))
 
 
 class EngineSink:
@@ -169,6 +175,12 @@ class OnlineCoordinator:
     wt seq streams, and the per-engine last-sent digests that turn a full
     param set into a delta set. ``sinks`` maps engine name to an
     :class:`EngineSink` or :class:`WireEngineSink`.
+
+    Size ``ack_timeout_s`` to the model: a worker applies at most
+    ``EngineWorker._WT_FRAMES_PER_POLL`` wt frames per poll round (so
+    decode never stalls), so a full-delta stream needs about
+    ``leaves / _WT_FRAMES_PER_POLL`` rounds — for many-leaf models
+    raise the timeout above the 30s default accordingly.
     """
 
     def __init__(self, journal, sinks: Dict[str, object], *,
@@ -243,18 +255,33 @@ class OnlineCoordinator:
                         continue
                     sink = self.sinks[name]
                     for ack in sink.collect_acks():
+                        # known_epoch advances ONLY from what the ack
+                        # proves the engine serves. ``live`` is the
+                        # engine's post-apply serving epoch (any kind);
+                        # without it, only a swap ack counts — begin/
+                        # leaf/discard acks set ``applied`` too, but
+                        # "shadow opened" is not "epoch flipped", and
+                        # treating it as such let a pre-commit failure
+                        # leave known_epoch at the new epoch so the
+                        # ensure_epoch retry no-op'd on stale weights.
+                        if "live" in ack:
+                            sink.known_epoch = max(
+                                sink.known_epoch, int(ack["live"]))
+                        elif (ack.get("kind") == "swap"
+                                and ack.get("applied") is not None):
+                            # swap True = flipped now; swap False = the
+                            # exactly-once no-op (already at/past)
+                            sink.known_epoch = max(
+                                sink.known_epoch, int(ack["epoch"]))
                         seq = int(ack["seq"])
+                        if seq not in pending:
+                            # stale ack (e.g. a rolled-back stream's
+                            # discard): seqs are never reused, so it
+                            # cannot be one of ours
+                            continue
                         pending.discard(seq)
                         doc["acked"][name] = max(
                             doc["acked"].get(name, -1), seq)
-                        if ack.get("applied"):
-                            sink.known_epoch = max(
-                                sink.known_epoch, int(ack["epoch"]))
-                        elif ack.get("applied") is False:
-                            # no-op guard fired: engine is already at or
-                            # past this epoch
-                            sink.known_epoch = max(
-                                sink.known_epoch, int(ack["epoch"]))
                 if any(want.values()):
                     if time.monotonic() > deadline:
                         missing = {n: sorted(p)[:4]
@@ -289,6 +316,12 @@ class OnlineCoordinator:
             # -- stream: begin + changed leaves, per engine ----------------
             self.journal.advance_weights(doc, "stream")
             chaos.weight_fence("stream")
+            # drop acks left over from a previous transaction (e.g. the
+            # unawaited discards of a rollback) so they are never read
+            # as this stream's — seqs are disjoint, but stale ``live``
+            # values would be harmless and stale seq bookkeeping is not
+            for sink in self.sinks.values():
+                sink.collect_acks()
             want: Dict[str, set] = {}
             for name, sink in self.sinks.items():
                 seqs = set()
@@ -322,11 +355,17 @@ class OnlineCoordinator:
             chaos.weight_fence("commit")
         except Exception:
             # pre-commit failure: discard every engine's shadow and retire
-            # the doc as rolled back; nothing flipped
+            # the doc as rolled back; nothing flipped. The discards are
+            # best-effort — a dead sink must not mask the streaming error
+            # (a shadow that survives an unreachable discard is replaced
+            # wholesale by the next publish's begin frame)
             for name, sink in self.sinks.items():
                 seq = self._seq[name]
-                sink.send(encode_wt_frame(
-                    WEIGHT_CHANNEL, seq, "discard", epoch))
+                try:
+                    sink.send(encode_wt_frame(
+                        WEIGHT_CHANNEL, seq, "discard", epoch))
+                except Exception:
+                    pass
                 self._seq[name] = seq + 1
             self.journal.close_weights(doc, "rolled_back")
             _obs.inc("online_flips_total", outcome="rolled_back")
@@ -382,8 +421,13 @@ class OnlineCoordinator:
                        >= WEIGHT_COMMIT_INDEX)
         for name, sink in self.sinks.items():
             seq = self._seq[name]
-            sink.send(encode_wt_frame(
-                WEIGHT_CHANNEL, seq, "discard", epoch))
+            try:
+                sink.send(encode_wt_frame(
+                    WEIGHT_CHANNEL, seq, "discard", epoch))
+            except Exception:
+                pass  # best-effort: recovery must retire the doc even
+                # when an engine is unreachable; its shadow is replaced
+                # by the next publish's begin frame
             self._seq[name] = seq + 1
         # the restarted publisher holds no digests for these engines, so
         # the next publish re-sends full state — correct by construction

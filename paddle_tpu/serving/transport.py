@@ -512,6 +512,16 @@ class SeqChannels:
         the store when a socket frame was lost)."""
         self._cursor[channel] = self.cursor(channel) + 1
 
+    def drop(self, channel: str):
+        """Forget a channel entirely — stash, cursor, and send counter.
+        For per-connection channels (``wt:<cid>``) whose peer died: the
+        stashed items can never be consumed (their publisher's unacked
+        frames die with it) and a reconnect is a NEW cid, so keeping the
+        namespace only leaks memory."""
+        self._stash.pop(channel, None)
+        self._cursor.pop(channel, None)
+        self._next_send.pop(channel, None)
+
     def pending(self, channel: str) -> int:
         return len(self._stash.get(channel, ()))
 
@@ -630,13 +640,34 @@ def decode_wt_frame(frame: dict):
 
 
 def encode_wt_ack(channel: str, seq: int, epoch: int,
-                  applied: Optional[bool] = None) -> dict:
+                  applied: Optional[bool] = None,
+                  kind: Optional[str] = None,
+                  live: Optional[int] = None) -> dict:
     """Per-frame ack (NOT cumulative — the publisher journals stream
     progress fence by fence): the wt frame with ``seq`` was consumed.
-    ``applied`` is set on swap acks: True = the promote flipped the
-    epoch, False = it was the exactly-once no-op."""
+
+    ``kind`` echoes the acked frame's kind so the publisher can tell a
+    swap ack from a begin/leaf/discard ack. ``applied`` semantics are
+    per kind:
+
+    * ``begin``   True = shadow opened; False = epoch not newer than
+      live (replay of a committed epoch)
+    * ``leaf``    True = staged into the open shadow; False = dropped
+      (no matching shadow — replay, or rolled back)
+    * ``swap``    True = the promote flipped the epoch; False = the
+      exactly-once no-op (engine at/past the epoch, or no shadow)
+    * ``discard`` True = a shadow was dropped; False = nothing open
+
+    Only ``live`` — the engine's serving epoch AFTER the frame was
+    applied — proves what the engine serves: a begin/leaf/discard ack
+    carries the pre-flip epoch there, so no ack kind can claim a flip
+    that has not happened (see OnlineCoordinator._wait_acks)."""
     ack = {"t": "wt_ack", "ch": channel, "seq": int(seq),
            "epoch": int(epoch)}
     if applied is not None:
         ack["applied"] = bool(applied)
+    if kind is not None:
+        ack["kind"] = str(kind)
+    if live is not None:
+        ack["live"] = int(live)
     return ack

@@ -182,19 +182,35 @@ class EngineWorker:
         """Apply stashed wt frames in seq order between engine steps —
         ``online.apply_wt_frame`` is the sole promote/discard chokepoint
         — and ack each one back to its publisher. Runs even while
-        draining: a weight flip is not an admission."""
+        draining: a weight flip is not an admission. The per-poll budget
+        is round-robined one frame per publisher connection per pass, so
+        concurrent publishers make proportional progress instead of the
+        first cid in iteration order consuming the whole budget."""
         from .online import apply_wt_frame
         budget = self._WT_FRAMES_PER_POLL
-        for cid in list(self._wt_cids):
-            while budget > 0:
+        cids = sorted(self._wt_cids)
+        while budget > 0:
+            progressed = False
+            for cid in cids:
+                if budget <= 0:
+                    break
                 item = self._rx_seq.pop_next(f"wt:{cid}")
                 if item is None:
-                    break
+                    continue
                 _cid, frame = item
                 ack = apply_wt_frame(self.engine, frame)
                 self._server.send(cid, ack)
                 budget -= 1
-        self._wt_cids &= set(self._server.conn_ids())
+                progressed = True
+            if not progressed:
+                break
+        # dead publishers: drop the whole per-cid channel, not just the
+        # cid — stashed frames of a dead connection can never be
+        # consumed and a reconnect arrives under a fresh cid
+        live = set(self._server.conn_ids())
+        for cid in self._wt_cids - live:
+            self._rx_seq.drop(f"wt:{cid}")
+        self._wt_cids &= live
 
     def _send_routers(self, frame: dict):
         for cid in list(self._router_cids):

@@ -113,6 +113,10 @@ def test_wt_frame_roundtrip():
     ack = encode_wt_ack("wt", 7, 2, applied=True)
     assert ack == {"t": "wt_ack", "ch": "wt", "seq": 7, "epoch": 2,
                    "applied": True}
+    # the full ack carries the frame kind and the engine's post-apply
+    # serving epoch — the publisher's only proof of what is served
+    ack = encode_wt_ack("wt", 8, 2, applied=True, kind="begin", live=1)
+    assert ack["kind"] == "begin" and ack["live"] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +238,43 @@ def test_pre_commit_failure_rolls_back(model, tmp_path):
         assert good["outcome"] == "committed"
         assert good["leaves"] == len(e0)
         assert eng.weight_epoch == 1
+    finally:
+        _restore(model, e0)
+
+
+def test_commit_fence_failure_does_not_fake_known_epoch(model, tmp_path):
+    """Regression: a fully-acked stream (begin + every leaf applied)
+    that fails AT the commit fence must roll back without known_epoch
+    claiming the new epoch — begin/leaf acks say "shadow opened", not
+    "epoch flipped". The old ack handling bumped known_epoch on any
+    applied ack, so the ensure_epoch retry no-op'd ("already_current")
+    while every engine kept serving stale weights."""
+    e0 = _epoch0(model)
+    try:
+        eng = DecodeEngine(model, EngineConfig(num_slots=2, max_length=64))
+        journal = FlipJournal(str(tmp_path))
+        sink = EngineSink(eng)
+        coord = OnlineCoordinator(journal, {"engine0": sink})
+        real = journal.advance_weights
+
+        def flaky(doc, fence):
+            if fence == "commit":
+                raise RuntimeError("injected commit-fence failure")
+            return real(doc, fence)
+
+        journal.advance_weights = flaky
+        with pytest.raises(RuntimeError, match="commit-fence"):
+            coord.publish_epoch(1, _perturbed(e0))
+        journal.advance_weights = real
+        assert eng.weight_epoch == 0 and eng._shadow is None
+        assert sink.known_epoch == 0, (
+            "pre-commit acks must not advance known_epoch")
+        assert journal.weight_history()[-1]["outcome"] == "rolled_back"
+        # the retry must RE-PUBLISH (the rollback's stale discard ack
+        # must not be mistaken for progress either), and converge
+        out = coord.ensure_epoch(1, _perturbed(e0))
+        assert out["outcome"] == "committed"
+        assert eng.weight_epoch == 1 and sink.known_epoch == 1
     finally:
         _restore(model, e0)
 

@@ -249,6 +249,27 @@ def test_seq_channels_reorder_and_seek():
     assert ch.pending("cot0") == 0            # seq 5 < 6 is stale now
 
 
+def test_seq_channels_drop_forgets_dead_connection_channel():
+    """Per-connection channels (``wt:<cid>``) are dropped wholesale when
+    the peer dies: stashed frames can never be consumed, and a reconnect
+    arrives under a new cid starting back at seq 0."""
+    from paddle_tpu.serving.transport import SeqChannels
+
+    ch = SeqChannels()
+    ch.next_seq("wt:7")
+    assert ch.stash("wt:7", 0, "begin")
+    assert ch.pop_next("wt:7") == "begin"
+    assert ch.stash("wt:7", 2, "orphan")      # seq 1 lost with the peer
+    ch.drop("wt:7")
+    assert ch.pending("wt:7") == 0
+    assert ch.cursor("wt:7") == 0             # fresh namespace
+    assert ch.next_seq("wt:7") == 0
+    # other channels are untouched
+    ch.stash("dispatch", 0, "d0")
+    ch.drop("wt:9")
+    assert ch.pop_next("dispatch") == "d0"
+
+
 def test_tq_frame_codec_roundtrip_f32_bit_equal():
     from paddle_tpu.serving.transport import (decode_tq_frame,
                                               encode_tq_ack,
